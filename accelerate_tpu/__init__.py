@@ -1,0 +1,32 @@
+"""accelerate_tpu — a TPU-native training-portability framework.
+
+Brand-new implementation with the capabilities of HuggingFace Accelerate
+(reference mounted at /root/reference, snapshot 2024-10-08), built
+TPU-first on JAX/XLA: GSPMD sharding over a named device mesh, optax
+optimizers, orbax-style sharded checkpoints, Pallas kernels for attention
+and quantization. See SURVEY.md for the capability blueprint.
+"""
+
+__version__ = "0.1.0"
+
+from .state import AcceleratorState, GradientState, PartialState
+from .parallel.mesh import MeshConfig, make_mesh
+from .utils.dataclasses import (
+    AutocastKwargs,
+    ContextParallelPlugin,
+    DataLoaderConfiguration,
+    DeepSpeedPlugin,
+    DistributedInitKwargs,
+    DistributedType,
+    ExpertParallelPlugin,
+    FullyShardedDataParallelPlugin,
+    GradientAccumulationPlugin,
+    GradScalerKwargs,
+    InitProcessGroupKwargs,
+    MegatronLMPlugin,
+    PipelineParallelPlugin,
+    ProfileKwargs,
+    ProjectConfiguration,
+    TensorParallelPlugin,
+)
+from .utils.random import set_seed

@@ -1,0 +1,52 @@
+"""`accelerate-tpu` CLI root (reference: src/accelerate/commands/accelerate_cli.py:27-48).
+
+Subcommands are registered lazily; each lives in its own module under
+``accelerate_tpu.commands``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def _subcommand_modules():
+    # name -> (module, parser-registration fn name)
+    from . import config as config_cmd  # noqa: F401
+    from . import env as env_cmd
+    from . import estimate as estimate_cmd
+    from . import launch as launch_cmd
+    from . import merge as merge_cmd
+    from . import test as test_cmd
+    from .config import config as config_entry
+
+    return {
+        "config": config_entry.config_command_parser,
+        "env": env_cmd.env_command_parser,
+        "estimate-memory": estimate_cmd.estimate_command_parser,
+        "launch": launch_cmd.launch_command_parser,
+        "merge-weights": merge_cmd.merge_command_parser,
+        "test": test_cmd.test_command_parser,
+    }
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        "accelerate-tpu", usage="accelerate-tpu <command> [<args>]", allow_abbrev=False
+    )
+    subparsers = parser.add_subparsers(help="accelerate-tpu command helpers", dest="command")
+    try:
+        for register in _subcommand_modules().values():
+            register(subparsers=subparsers)
+    except ImportError as e:  # partial build: some subcommands may not exist yet
+        print(f"warning: some subcommands unavailable ({e})", file=sys.stderr)
+
+    args = parser.parse_args()
+    if not hasattr(args, "func"):
+        parser.print_help()
+        return 1
+    return args.func(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main() or 0)

@@ -1,0 +1,1 @@
+from .mesh import MeshConfig, make_mesh, mesh_batch_size_multiple

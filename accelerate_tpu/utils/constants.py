@@ -1,0 +1,61 @@
+"""Name constants shared across the framework.
+
+Capability parity with the reference's ``utils/constants.py`` (reference:
+src/accelerate/utils/constants.py:18-47) re-thought for a JAX/TPU stack:
+checkpoint artifact names are msgpack/safetensors/orbax-flavored instead of
+torch ``.bin``/``.pt``.
+"""
+
+MODEL_NAME = "model"
+OPTIMIZER_NAME = "optimizer"
+SCHEDULER_NAME = "scheduler"
+SAMPLER_NAME = "sampler"
+DATALOADER_NAME = "dataloader"
+RNG_STATE_NAME = "random_states"
+CUSTOM_OBJECTS_NAME = "custom_checkpoint"
+PROFILE_PATTERN_NAME = "profile_{suffix}"
+
+SAFE_WEIGHTS_NAME = "model.safetensors"
+SAFE_WEIGHTS_INDEX_NAME = "model.safetensors.index.json"
+MSGPACK_WEIGHTS_NAME = "model.msgpack"
+OPTIMIZER_STATE_NAME = "optimizer.msgpack"
+SCHEDULER_STATE_NAME = "scheduler.json"
+SAMPLER_STATE_NAME = "sampler.json"
+
+# Directory layout used by Accelerator.save_state (reference: accelerator.py:2915)
+CHECKPOINT_DIR_PREFIX = "checkpoint"
+
+# Sharded-array checkpoint subdirectory (orbax / tensorstore backed)
+SHARDED_STATE_DIR = "sharded_state"
+
+# Environment-variable prefix. The launcher communicates with runtime state
+# exclusively through these (reference: utils/launch.py:184-313).
+ENV_PREFIX = "ACCELERATE_TPU_"
+
+# Mesh axis names, in canonical order. All shardings in the framework are
+# expressed over these logical axes (scaling-book style mesh design):
+#   dp    - pure data parallelism (gradients psum'd, params replicated)
+#   fsdp  - fully-sharded data parallelism (params/grads/opt-state sharded)
+#   tp    - tensor (operator) parallelism
+#   cp    - context/sequence parallelism (ring attention axis)
+#   ep    - expert parallelism (MoE)
+#   pp    - pipeline stage axis
+MESH_AXIS_DP = "dp"
+MESH_AXIS_FSDP = "fsdp"
+MESH_AXIS_TP = "tp"
+MESH_AXIS_CP = "cp"
+MESH_AXIS_EP = "ep"
+MESH_AXIS_PP = "pp"
+MESH_AXES = (MESH_AXIS_DP, MESH_AXIS_FSDP, MESH_AXIS_TP, MESH_AXIS_CP, MESH_AXIS_EP, MESH_AXIS_PP)
+
+# Axes over which a global batch is split (data-like axes).
+BATCH_AXES = (MESH_AXIS_DP, MESH_AXIS_FSDP)
+
+TORCH_LAUNCH_PARAMS: list = []  # placeholder for launch-arg parity tables
+
+# Supported mixed-precision modes ("fp8" is weight/activation scaling on TPU).
+PRECISION_CHOICES = ("no", "fp32", "bf16", "fp16", "fp8")
+
+SAGEMAKER_PYTORCH_VERSION = None  # SageMaker paths are not applicable on TPU.
+
+WEIGHTS_PATTERN = "model-{:05d}-of-{:05d}.safetensors"

@@ -1,0 +1,178 @@
+"""Environment-variable parsing and process-environment helpers.
+
+Capability parity with the reference's ``utils/environment.py`` (reference:
+src/accelerate/utils/environment.py:40-120) — the launcher encodes all config
+as env vars and the runtime reads them back here.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import platform
+import socket
+import subprocess
+import sys
+from functools import lru_cache
+from typing import Any
+
+from .constants import ENV_PREFIX
+
+
+def str_to_bool(value: str) -> int:
+    """Convert a string to 1/0 truth value (reference: utils/environment.py:40).
+
+    True values: y, yes, t, true, on, 1. False values: n, no, f, false, off, 0.
+    """
+    value = value.lower()
+    if value in ("y", "yes", "t", "true", "on", "1"):
+        return 1
+    elif value in ("n", "no", "f", "false", "off", "0"):
+        return 0
+    else:
+        raise ValueError(f"invalid truth value {value!r}")
+
+
+def get_int_from_env(env_keys, default: int) -> int:
+    """Return the first positive env value found in ``env_keys``."""
+    for e in env_keys:
+        val = int(os.environ.get(e, -1))
+        if val >= 0:
+            return val
+    return default
+
+
+def parse_flag_from_env(key: str, default: bool = False) -> bool:
+    """Read a boolean flag from the environment (reference: utils/environment.py:82)."""
+    value = os.environ.get(key, str(default))
+    return bool(str_to_bool(value))
+
+
+def parse_choice_from_env(key: str, default: str = "no") -> str:
+    value = os.environ.get(key, str(default))
+    return value
+
+
+def env_var(name: str) -> str:
+    """Namespaced env var name: ``env_var('DEBUG') == 'ACCELERATE_TPU_DEBUG'``."""
+    return ENV_PREFIX + name
+
+
+def are_libraries_initialized(*library_names: str) -> list[str]:
+    """Return names of libraries already imported into ``sys.modules``."""
+    return [lib for lib in library_names if lib in sys.modules]
+
+
+@contextlib.contextmanager
+def patch_environment(**kwargs):
+    """Temporarily set environment variables (reference: utils/other.py:246).
+
+    Keys are upper-cased; previous values restored on exit.
+    """
+    existing = {}
+    for key, value in kwargs.items():
+        key = key.upper()
+        if key in os.environ:
+            existing[key] = os.environ[key]
+        os.environ[key] = str(value)
+    try:
+        yield
+    finally:
+        for key in kwargs:
+            key = key.upper()
+            if key in existing:
+                os.environ[key] = existing[key]
+            else:
+                os.environ.pop(key, None)
+
+
+@lru_cache(maxsize=None)
+def get_cpu_count() -> int:
+    return os.cpu_count() or 1
+
+
+def get_host_ip() -> str:
+    """Best-effort routable IP of this host (for coordinator addresses)."""
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.connect(("8.8.8.8", 80))
+        ip = s.getsockname()[0]
+        s.close()
+        return ip
+    except OSError:
+        return "127.0.0.1"
+
+
+def check_os_kernel():
+    """Warn on Linux kernels < 5.5 (reference: utils/other.py:334)."""
+    info = platform.uname()
+    if info.system != "Linux":
+        return None
+    try:
+        version = tuple(int(v) for v in info.release.split("-")[0].split(".")[:2])
+    except ValueError:
+        return None
+    if version < (5, 5):
+        import logging
+
+        logging.getLogger(__name__).warning(
+            f"Detected kernel version {info.release}, which is below the recommended minimum of 5.5; "
+            "this can cause the process to hang. It is recommended to upgrade the kernel."
+        )
+    return version
+
+
+def _read_tpu_env_metadata(key: str) -> str | None:
+    """Read TPU VM metadata either from env or the GCE metadata server."""
+    val = os.environ.get(key)
+    if val:
+        return val
+    return None
+
+
+def get_gpu_info():  # pragma: no cover - GPU never present in this stack
+    return [], 0
+
+
+def override_numa_affinity(local_process_index: int, verbose: bool | None = None) -> None:
+    """Bind this process to the NUMA node of its local device.
+
+    Parity with reference numa-affinity support (reference:
+    utils/environment.py:220-260). On TPU VMs each host typically exposes one
+    NUMA node; this is a no-op unless numactl-style info is available.
+    """
+    try:
+        nodes = sorted(
+            int(d.split("node")[-1])
+            for d in os.listdir("/sys/devices/system/node")
+            if d.startswith("node")
+        )
+    except OSError:
+        return
+    if len(nodes) <= 1:
+        return
+    node = nodes[local_process_index % len(nodes)]
+    try:
+        cpu_list_path = f"/sys/devices/system/node/node{node}/cpulist"
+        with open(cpu_list_path) as f:
+            cpulist = f.read().strip()
+        cpus = set()
+        for part in cpulist.split(","):
+            if "-" in part:
+                lo, hi = part.split("-")
+                cpus.update(range(int(lo), int(hi) + 1))
+            elif part:
+                cpus.add(int(part))
+        if cpus and hasattr(os, "sched_setaffinity"):
+            os.sched_setaffinity(0, cpus)
+            if verbose:
+                print(f"Assigning process {local_process_index} to NUMA node {node} (cpus {cpulist})")
+    except (OSError, ValueError):
+        return
+
+
+def run_command(cmd: list[str], capture: bool = False, env: dict[str, Any] | None = None):
+    """Run a subprocess, optionally capturing stdout."""
+    if capture:
+        return subprocess.run(cmd, capture_output=True, text=True, check=True, env=env).stdout
+    return subprocess.run(cmd, check=True, env=env)

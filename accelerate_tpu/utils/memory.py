@@ -1,0 +1,116 @@
+"""OOM-retry and memory-release helpers.
+
+Parity with the reference's ``utils/memory.py`` (reference:
+src/accelerate/utils/memory.py — find_executable_batch_size :106,
+release_memory :58, clear_device_cache :36). On JAX the retry works by
+catching XLA RESOURCE_EXHAUSTED compile/run errors and re-jitting at a
+smaller static batch size.
+"""
+
+from __future__ import annotations
+
+import functools
+import gc
+import inspect
+from typing import Callable, Optional
+
+
+def _is_oom_error(exception: BaseException) -> bool:
+    """Detect HBM/host OOM from XLA/JAX exceptions (reference: should_reduce_batch_size :77)."""
+    msg = str(exception)
+    markers = (
+        "RESOURCE_EXHAUSTED",
+        "Out of memory",
+        "out of memory",
+        "Resource exhausted",
+        "Attempting to allocate",
+        "exceeds the limit",
+    )
+    return isinstance(exception, (MemoryError,)) or any(m in msg for m in markers)
+
+
+def clear_device_cache(garbage_collection: bool = False):
+    """Drop cached executables + device buffers where possible (reference: :36)."""
+    if garbage_collection:
+        gc.collect()
+    try:
+        import jax
+
+        jax.clear_caches()
+    except Exception:
+        pass
+
+
+def release_memory(*objects):
+    """Delete references and clear caches (reference: :58).
+
+    Returns a list of ``None`` of the same length, so callers can do
+    ``a, b = release_memory(a, b)``.
+    """
+    if not isinstance(objects, list):
+        objects = list(objects)
+    for i in range(len(objects)):
+        objects[i] = None
+    clear_device_cache(garbage_collection=True)
+    return objects
+
+
+def find_executable_batch_size(
+    function: Optional[Callable] = None,
+    starting_batch_size: int = 128,
+    reduce_batch_size_fn: Optional[Callable] = None,
+):
+    """Decorator retrying ``function(batch_size, ...)`` with halved batch size
+    on OOM (reference: utils/memory.py:106-155).
+
+    Works naturally under jit: a smaller batch size is a new static shape, so
+    the failing executable is simply recompiled smaller.
+    """
+    if function is None:
+        return functools.partial(
+            find_executable_batch_size,
+            starting_batch_size=starting_batch_size,
+            reduce_batch_size_fn=reduce_batch_size_fn,
+        )
+    if reduce_batch_size_fn is None:
+        reduce_batch_size_fn = lambda bs: bs // 2
+
+    batch_size = starting_batch_size
+
+    @functools.wraps(function)
+    def decorator(*args, **kwargs):
+        nonlocal batch_size
+        clear_device_cache(garbage_collection=True)
+        params = list(inspect.signature(function).parameters.keys())
+        if len(params) < (len(args) + 1):
+            arg_str = ", ".join([f"{arg}={value}" for arg, value in zip(params[1:], args[1:])])
+            raise TypeError(
+                f"Batch size was passed into `{function.__name__}` as the first argument when called."
+                f"Remove this as the decorator already does so: `{function.__name__}({arg_str})`"
+            )
+        while True:
+            if batch_size == 0:
+                raise RuntimeError("No executable batch size found, reached zero.")
+            try:
+                return function(batch_size, *args, **kwargs)
+            except Exception as e:
+                if _is_oom_error(e):
+                    clear_device_cache(garbage_collection=True)
+                    batch_size = reduce_batch_size_fn(batch_size)
+                else:
+                    raise
+
+    return decorator
+
+
+def get_device_memory_stats(device=None) -> dict:
+    """Per-device HBM stats via jax memory_stats (used by device-map solver)."""
+    import jax
+
+    device = device or jax.devices()[0]
+    stats = device.memory_stats() or {}
+    return {
+        "bytes_in_use": stats.get("bytes_in_use", 0),
+        "bytes_limit": stats.get("bytes_limit", stats.get("bytes_reservable_limit", 0)),
+        "peak_bytes_in_use": stats.get("peak_bytes_in_use", 0),
+    }

@@ -1,0 +1,375 @@
+"""Pytree-recursive collectives and tensor transport.
+
+Capability parity with the reference's ``utils/operations.py`` (reference:
+src/accelerate/utils/operations.py — recursively_apply :85, send_to_device
+:136, gather :306, gather_object :449, broadcast :543, slice_tensors :585,
+concatenate :605, pad_across_processes :632, reduce :725,
+convert_outputs_to_fp32 :816, verify_operation :368).
+
+TPU-native semantics: inside a jitted step, "collectives" are just XLA ops or
+implicit GSPMD resharding — none of this module is needed there. This module
+provides the *eager-facing* API for the host-side parts of a training script
+(metrics gathering, logging, object broadcast), implemented over
+``jax.experimental.multihost_utils`` and ``jax.device_get`` on globally
+sharded arrays.
+"""
+
+from __future__ import annotations
+
+import functools
+import pickle
+from typing import Any, Callable, Mapping
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .dataclasses import DistributedType
+
+
+def PartialState():
+    """Lazy accessor avoiding a circular import at package-init time."""
+    from ..state import PartialState as _PS
+
+    return _PS()
+
+
+class DistributedOperationException(Exception):
+    """Raised when a collective is called with inconsistent shapes across
+    processes (reference: utils/operations.py debug sanitizer :368)."""
+
+
+def is_tensor_like(x) -> bool:
+    return isinstance(x, (jax.Array, np.ndarray)) or hasattr(x, "__jax_array__")
+
+
+def honor_type(obj, generator):
+    """Rebuild a sequence preserving its type, incl. namedtuples (reference: :55)."""
+    if isinstance(obj, tuple) and hasattr(obj, "_fields"):  # namedtuple
+        return type(obj)(*list(generator))
+    return type(obj)(generator)
+
+
+def recursively_apply(func: Callable, data, *args, test_type: Callable = is_tensor_like,
+                      error_on_other_type: bool = False, **kwargs):
+    """Apply ``func`` to every leaf of a nested list/tuple/dict structure
+    (reference: utils/operations.py:85 — the pytree engine)."""
+    if isinstance(data, (tuple, list)):
+        return honor_type(
+            data,
+            (recursively_apply(func, o, *args, test_type=test_type,
+                               error_on_other_type=error_on_other_type, **kwargs) for o in data),
+        )
+    elif isinstance(data, Mapping):
+        return type(data)(
+            {k: recursively_apply(func, v, *args, test_type=test_type,
+                                  error_on_other_type=error_on_other_type, **kwargs)
+             for k, v in data.items()}
+        )
+    elif test_type(data):
+        return func(data, *args, **kwargs)
+    elif error_on_other_type:
+        raise TypeError(
+            f"Unsupported types ({type(data)}) passed to `{func.__name__}`. Only nested "
+            f"list/tuple/dicts of objects that are valid for `{test_type.__name__}` should be passed."
+        )
+    return data
+
+
+def send_to_device(tensor, device=None, non_blocking: bool = True, skip_keys=None):
+    """Move a pytree of arrays onto device(s) (reference: utils/operations.py:136).
+
+    ``device`` may be a jax Device, a Sharding, or None (commit to default
+    device). JAX transfers are always async; ``non_blocking`` kept for parity.
+    """
+    if isinstance(skip_keys, str):
+        skip_keys = [skip_keys]
+
+    def _send(t):
+        return jax.device_put(t, device)
+
+    if skip_keys and isinstance(tensor, Mapping):
+        return type(tensor)(
+            {k: (v if k in skip_keys else send_to_device(v, device, non_blocking, skip_keys=skip_keys))
+             for k, v in tensor.items()}
+        )
+    elif skip_keys and isinstance(tensor, (tuple, list)):
+        return honor_type(tensor, (send_to_device(v, device, non_blocking, skip_keys=skip_keys) for v in tensor))
+    return recursively_apply(_send, tensor)
+
+
+def get_data_structure(data):
+    """Shape/dtype skeleton of a pytree (reference: :171)."""
+
+    def _get_data_structure(tensor):
+        return jax.ShapeDtypeStruct(np.shape(tensor), getattr(tensor, "dtype", np.asarray(tensor).dtype))
+
+    return recursively_apply(_get_data_structure, data)
+
+
+def get_shape(data):
+    """Pytree of shapes (reference: :191)."""
+    return recursively_apply(lambda t: list(np.shape(t)), data)
+
+
+def initialize_tensors(data_structure):
+    """Materialize empty tensors matching a skeleton (reference: :211)."""
+    return recursively_apply(
+        lambda s: jnp.zeros(s.shape, s.dtype),
+        data_structure,
+        test_type=lambda x: isinstance(x, jax.ShapeDtypeStruct),
+    )
+
+
+def find_batch_size(data) -> int | None:
+    """Leading dimension of the first tensor leaf (reference: :253)."""
+    leaves = jax.tree_util.tree_leaves(data)
+    for leaf in leaves:
+        if hasattr(leaf, "shape") and len(leaf.shape) > 0:
+            return leaf.shape[0]
+    return None
+
+
+def listify(data):
+    """Pytree of arrays -> pytree of Python lists (reference: :273)."""
+    return recursively_apply(lambda t: np.asarray(jax.device_get(t)).tolist(), data)
+
+
+def _verify_shapes_across_processes(tensor, op_name: str):
+    """Debug-mode shape sanitizer (reference: verify_operation :368).
+
+    Gathers each process's leaf shapes and raises with a per-rank table on
+    mismatch.
+    """
+    state = PartialState()
+    if state.num_processes == 1:
+        return
+    from jax.experimental import multihost_utils
+
+    shapes = get_shape(tensor)
+    payload = pickle.dumps(shapes)
+    n = np.array([len(payload)], dtype=np.int64)
+    all_lens = multihost_utils.process_allgather(n, tiled=False).reshape(-1)
+    max_len = int(all_lens.max())
+    arr = np.frombuffer(payload.ljust(max_len, b"\0"), dtype=np.uint8)
+    all_payloads = multihost_utils.process_allgather(arr, tiled=False)
+    all_shapes = [
+        pickle.loads(bytes(all_payloads[i][: int(all_lens[i])].tobytes())) for i in range(len(all_lens))
+    ]
+    if any(s != all_shapes[0] for s in all_shapes):
+        table = "\n".join(f"  - Process {i}: {s}" for i, s in enumerate(all_shapes))
+        raise DistributedOperationException(
+            f"Cannot apply the `{op_name}` operation: tensor shapes differ across processes:\n{table}"
+        )
+
+
+def verify_operation(function: Callable):
+    """Decorator enabling the shape sanitizer under debug mode (reference: :368)."""
+
+    @functools.wraps(function)
+    def wrapper(*args, **kwargs):
+        from ..state import PartialState as _PS
+
+        state = _PS._shared_state
+        if state and state.get("debug", False):
+            tensor = kwargs.get("tensor", args[0] if args else None)
+            if tensor is not None:
+                _verify_shapes_across_processes(tensor, function.__name__)
+        return function(*args, **kwargs)
+
+    return wrapper
+
+
+def _is_distributed() -> bool:
+    return PartialState().use_distributed
+
+
+def _process_allgather(t, tiled: bool):
+    from jax.experimental import multihost_utils
+
+    out = multihost_utils.process_allgather(np.asarray(jax.device_get(t)), tiled=tiled)
+    return out
+
+
+@verify_operation
+def gather(tensor):
+    """Gather each process's tensor, concatenated on dim 0 (reference: :306).
+
+    Single-process multi-device runs return the (already global) value; in
+    multi-host runs each host contributes its local value.
+    """
+    state = PartialState()
+    if state.num_processes > 1:
+        return recursively_apply(lambda t: _process_allgather(t, tiled=True), tensor)
+    return tensor
+
+
+def gather_object(object: Any):
+    """Gather arbitrary picklable objects from each process into a list
+    (reference: :449 — notably *unsupported* on TPU there; supported here)."""
+    state = PartialState()
+    if state.num_processes == 1:
+        return [object]
+    from jax.experimental import multihost_utils
+
+    payload = pickle.dumps(object)
+    n = np.array([len(payload)], dtype=np.int64)
+    lens = _process_allgather(n, tiled=False).reshape(-1)
+    max_len = int(lens.max())
+    buf = np.frombuffer(payload.ljust(max_len, b"\0"), dtype=np.uint8)
+    gathered = _process_allgather(buf, tiled=False)
+    return [pickle.loads(bytes(gathered[i][: int(lens[i])].tobytes())) for i in range(state.num_processes)]
+
+
+@verify_operation
+def broadcast(tensor, from_process: int = 0):
+    """Broadcast a pytree from one process to all (reference: :543)."""
+    state = PartialState()
+    if state.num_processes == 1:
+        return tensor
+    from jax.experimental import multihost_utils
+
+    return recursively_apply(
+        lambda t: multihost_utils.broadcast_one_to_all(
+            np.asarray(jax.device_get(t)), is_source=state.process_index == from_process
+        ),
+        tensor,
+    )
+
+
+def broadcast_object_list(object_list: list, from_process: int = 0):
+    """Broadcast a list of picklable objects (reference: :564)."""
+    state = PartialState()
+    if state.num_processes == 1:
+        return object_list
+    from jax.experimental import multihost_utils
+
+    payload = pickle.dumps(object_list)
+    n = np.array([len(payload)], dtype=np.int64)
+    n_bcast = multihost_utils.broadcast_one_to_all(n, is_source=state.process_index == from_process)
+    buf = np.frombuffer(payload.ljust(int(n_bcast[0]), b"\0"), dtype=np.uint8)
+    if len(buf) != int(n_bcast[0]):
+        buf = np.zeros(int(n_bcast[0]), dtype=np.uint8)
+    out = multihost_utils.broadcast_one_to_all(buf, is_source=state.process_index == from_process)
+    result = pickle.loads(bytes(out.tobytes()))
+    for i in range(len(object_list)):
+        object_list[i] = result[i]
+    return object_list
+
+
+def slice_tensors(data, tensor_slice, process_index=None, num_processes=None):
+    """Slice every leaf (reference: :585)."""
+    return recursively_apply(lambda t: t[tensor_slice], data)
+
+
+def concatenate(data, dim: int = 0):
+    """Concatenate a list of same-structure pytrees leafwise (reference: :605)."""
+    if isinstance(data[0], (tuple, list)):
+        return honor_type(data[0], (concatenate([d[i] for d in data], dim=dim) for i in range(len(data[0]))))
+    elif isinstance(data[0], Mapping):
+        return type(data[0])({k: concatenate([d[k] for d in data], dim=dim) for k in data[0].keys()})
+    elif not is_tensor_like(data[0]):
+        raise TypeError(f"Can only concatenate tensors but got {type(data[0])}")
+    return jnp.concatenate(data, axis=dim)
+
+
+@verify_operation
+def pad_across_processes(tensor, dim: int = 0, pad_index: int = 0, pad_first: bool = False):
+    """Pad each process's tensor to the max size on ``dim`` so it can be
+    gathered (reference: :632)."""
+    state = PartialState()
+
+    def _pad(t):
+        if dim >= len(t.shape):
+            return t
+        size = np.array([t.shape[dim]], dtype=np.int64)
+        if state.num_processes > 1:
+            max_size = int(_process_allgather(size, tiled=False).max())
+        else:
+            max_size = int(size[0])
+        if max_size == t.shape[dim]:
+            return t
+        pad_width = [(0, 0)] * len(t.shape)
+        pad_width[dim] = (max_size - t.shape[dim], 0) if pad_first else (0, max_size - t.shape[dim])
+        return jnp.pad(t, pad_width, constant_values=pad_index)
+
+    return recursively_apply(_pad, tensor)
+
+
+def pad_input_tensors(tensor, batch_size: int, num_processes: int, dim: int = 0):
+    """Pad a batch so it divides evenly across processes (reference: :684)."""
+    remainder = batch_size % num_processes
+    if remainder == 0:
+        return tensor
+    to_add = num_processes - remainder
+
+    def _pad(t):
+        if dim >= len(t.shape) or t.shape[dim] != batch_size:
+            return t
+        reps = [t[-1:]] * to_add
+        return jnp.concatenate([t] + reps, axis=dim)
+
+    return recursively_apply(_pad, tensor)
+
+
+@verify_operation
+def reduce(tensor, reduction: str = "sum", scale: float = 1.0):
+    """Reduce a pytree across processes (reference: :725)."""
+    state = PartialState()
+
+    def _reduce(t):
+        if state.num_processes > 1:
+            gathered = _process_allgather(t, tiled=False)  # [P, ...]
+            out = gathered.sum(axis=0)
+        else:
+            out = jnp.asarray(t)
+        if reduction == "mean":
+            out = out / state.num_processes
+        return out * scale
+
+    return recursively_apply(_reduce, tensor)
+
+
+def convert_to_fp32(tensor):
+    """Upcast floating leaves to fp32 (reference: :787)."""
+
+    def _convert(t):
+        return t.astype(jnp.float32)
+
+    def _is_fp16_bf16(t):
+        return is_tensor_like(t) and getattr(t, "dtype", None) in (jnp.float16, jnp.bfloat16)
+
+    return recursively_apply(_convert, tensor, test_type=_is_fp16_bf16)
+
+
+class ConvertOutputsToFp32:
+    """Callable wrapper upcasting a function's outputs (reference: :796)."""
+
+    def __init__(self, model_forward):
+        self.model_forward = model_forward
+        functools.update_wrapper(self, model_forward)
+
+    def __call__(self, *args, **kwargs):
+        return convert_to_fp32(self.model_forward(*args, **kwargs))
+
+
+def convert_outputs_to_fp32(model_forward):
+    """Decorate a forward fn to return fp32 outputs (reference: :816)."""
+    return ConvertOutputsToFp32(model_forward)
+
+
+def find_device(data):
+    """Device of the first array leaf (reference: :836)."""
+    for leaf in jax.tree_util.tree_leaves(data):
+        if isinstance(leaf, jax.Array):
+            devs = leaf.devices()
+            return next(iter(devs))
+    return None
+
+
+def ignorant_find_batch_size(data):
+    """find_batch_size that returns None instead of raising (reference: :265)."""
+    try:
+        return find_batch_size(data)
+    except (TypeError, IndexError):
+        return None

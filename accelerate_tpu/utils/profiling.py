@@ -1,0 +1,97 @@
+"""Profiler session wrapping ``jax.profiler``.
+
+Parity with the reference's torch.profiler integration (reference:
+utils/dataclasses.py:400-503 builds torch.profiler.profile;
+accelerator.py:3423-3480 exports per-rank Chrome traces). On TPU the
+profiler of record is jax.profiler: XPlane traces viewable in
+TensorBoard/Perfetto, capturing XLA ops, HBM usage, and ICI traffic.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import TYPE_CHECKING, Optional
+
+if TYPE_CHECKING:  # pragma: no cover
+    from .dataclasses import ProfileKwargs
+
+
+class ProfileSession:
+    """Context manager driving a jax.profiler trace with an optional
+    wait/warmup/active schedule (the reference's schedule_option).
+
+    Usage::
+
+        with ProfileSession(ProfileKwargs(), log_dir="/tmp/trace") as prof:
+            for batch in loader:
+                train_step(...)
+                prof.step()
+    """
+
+    def __init__(self, kwargs: "ProfileKwargs", log_dir: Optional[str] = None):
+        self.kwargs = kwargs
+        self.log_dir = log_dir or kwargs.output_trace_dir or "./jax_trace"
+        sched = kwargs.schedule_option or {}
+        self.wait = int(sched.get("wait", 0)) + int(sched.get("skip_first", 0))
+        self.warmup = int(sched.get("warmup", 0))
+        self.active = int(sched.get("active", 0)) or None  # None = whole block
+        self._step = 0
+        self._tracing = False
+
+    def _should_trace(self) -> bool:
+        if self.active is None:
+            return True
+        start = self.wait + self.warmup
+        return start <= self._step < start + self.active
+
+    def _start(self):
+        import jax
+
+        os.makedirs(self.log_dir, exist_ok=True)
+        jax.profiler.start_trace(
+            self.log_dir,
+            create_perfetto_link=self.kwargs.create_perfetto_link,
+            create_perfetto_trace=self.kwargs.create_perfetto_trace,
+        )
+        self._tracing = True
+
+    def _stop(self):
+        import jax
+
+        if self._tracing:
+            jax.profiler.stop_trace()
+            self._tracing = False
+            if self.kwargs.on_trace_ready is not None:
+                self.kwargs.on_trace_ready(self)
+
+    def __enter__(self):
+        if self._should_trace():
+            self._start()
+        return self
+
+    def step(self):
+        """Advance the schedule (reference: torch profiler .step())."""
+        self._step += 1
+        should = self._should_trace()
+        if should and not self._tracing:
+            self._start()
+        elif not should and self._tracing:
+            self._stop()
+
+    def __exit__(self, *exc):
+        self._stop()
+        return False
+
+
+def annotate(name: str):
+    """Named trace span (maps to jax.profiler.TraceAnnotation)."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)
+
+
+def save_device_memory_profile(path: str):
+    """Dump a device memory profile (pprof format)."""
+    import jax
+
+    jax.profiler.save_device_memory_profile(path)

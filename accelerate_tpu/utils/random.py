@@ -1,0 +1,84 @@
+"""Seeding and RNG synchronization.
+
+Parity with the reference's ``utils/random.py`` (reference:
+src/accelerate/utils/random.py — set_seed :31, synchronize_rng_state :66).
+
+JAX's explicit threaded PRNG keys make most of the reference's RNG-sync
+subsystem unnecessary *inside* the step (keys are part of the replicated /
+sharded train state, so they are globally consistent by construction). What
+remains host-side: python/numpy seeding for data pipelines and broadcasting a
+root seed across processes.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Iterable, Optional
+
+import numpy as np
+
+from .dataclasses import RNGType
+
+
+def PartialState():
+    """Lazy accessor avoiding a circular import at package-init time."""
+    from ..state import PartialState as _PS
+
+    return _PS()
+
+
+def set_seed(seed: int, device_specific: bool = False, deterministic: bool = False) -> int:
+    """Seed python/numpy (+ make a jax root key reproducible) (reference: :31).
+
+    Args:
+        seed: base seed.
+        device_specific: offset the seed by process index so each host draws
+            different data-pipeline randomness (reference semantics).
+        deterministic: parity no-op — XLA:TPU is deterministic by default.
+    Returns the (possibly offset) seed actually used.
+    """
+    if device_specific:
+        seed += PartialState().process_index
+    random.seed(seed)
+    np.random.seed(seed % (2**32))
+    return seed
+
+
+def make_rng_key(seed: int):
+    """Root jax PRNG key from a seed."""
+    import jax
+
+    return jax.random.PRNGKey(seed)
+
+
+def synchronize_rng_state(rng_type: Optional[RNGType] = None, generator=None):
+    """Broadcast host RNG state from process 0 (reference: :66).
+
+    For JAX keys this is a no-op (keys live in the train state). For
+    python/numpy we broadcast process 0's seed-derived state.
+    """
+    state = PartialState()
+    if state.num_processes == 1:
+        return
+    from .operations import broadcast_object_list
+
+    if rng_type == RNGType.NUMPY:
+        payload = [np.random.get_state()]
+        payload = broadcast_object_list(payload)
+        np.random.set_state(payload[0])
+    elif rng_type == RNGType.PYTHON:
+        payload = [random.getstate()]
+        payload = broadcast_object_list(payload)
+        random.setstate(payload[0])
+    elif rng_type == RNGType.GENERATOR and generator is not None:
+        payload = [generator.bit_generator.state]
+        payload = broadcast_object_list(payload)
+        generator.bit_generator.state = payload[0]
+    # RNGType.JAX: nothing to do — keys are explicit values.
+
+
+def synchronize_rng_states(rng_types: Iterable[str | RNGType], generator=None):
+    """Synchronize several RNG streams (reference: :124)."""
+    for rng_type in rng_types:
+        synchronize_rng_state(RNGType(rng_type) if not isinstance(rng_type, RNGType) else rng_type,
+                              generator=generator)

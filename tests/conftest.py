@@ -1,0 +1,34 @@
+"""Test configuration: run everything on an 8-device virtual CPU mesh.
+
+Mirrors the reference's strategy of testing distributed logic without a
+cluster (SURVEY.md §4): JAX's host-platform device-count emulation is the
+"fake backend" the reference lacks.
+
+Note: this environment pre-imports jax via sitecustomize with a TPU platform
+pinned, so we must override through jax.config (env vars are read too early).
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def reset_state():
+    """Reset the state singletons between tests (reference: AccelerateTestCase,
+    test_utils/testing.py:479)."""
+    yield
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()

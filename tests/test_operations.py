@@ -1,0 +1,166 @@
+"""Tests for pytree collectives/ops (reference: test_utils/scripts/test_ops.py
+and tests/test_utils.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from accelerate_tpu.utils import (
+    broadcast,
+    broadcast_object_list,
+    concatenate,
+    convert_outputs_to_fp32,
+    convert_to_fp32,
+    find_batch_size,
+    gather,
+    gather_object,
+    get_data_structure,
+    get_shape,
+    honor_type,
+    initialize_tensors,
+    listify,
+    pad_across_processes,
+    pad_input_tensors,
+    recursively_apply,
+    reduce,
+    send_to_device,
+)
+
+
+def test_recursively_apply_nested():
+    data = {"a": jnp.ones((2, 3)), "b": [jnp.zeros(4), (jnp.ones(1), "str")]}
+    out = recursively_apply(lambda t: t + 1, data)
+    assert out["a"].sum() == 12
+    assert out["b"][1][1] == "str"
+
+
+def test_honor_type_namedtuple():
+    from collections import namedtuple
+
+    Point = namedtuple("Point", ["x", "y"])
+    p = Point(1, 2)
+    out = honor_type(p, iter([3, 4]))
+    assert isinstance(out, Point) and out.x == 3
+
+
+def test_send_to_device():
+    batch = {"x": np.ones((4, 2), dtype=np.float32), "y": np.arange(4)}
+    out = send_to_device(batch, jax.devices()[0])
+    assert isinstance(out["x"], jax.Array)
+    assert set(out["x"].devices()) == {jax.devices()[0]}
+
+
+def test_send_to_device_skip_keys():
+    batch = {"x": np.ones(3), "meta": np.zeros(2)}
+    out = send_to_device(batch, jax.devices()[0], skip_keys=["meta"])
+    assert isinstance(out["meta"], np.ndarray)
+
+
+def test_get_data_structure_roundtrip():
+    data = {"a": jnp.ones((2, 3), dtype=jnp.bfloat16)}
+    skel = get_data_structure(data)
+    assert skel["a"].shape == (2, 3)
+    out = initialize_tensors(skel)
+    assert out["a"].dtype == jnp.bfloat16 and out["a"].shape == (2, 3)
+
+
+def test_get_shape_and_batch_size():
+    data = [jnp.ones((5, 2)), {"k": jnp.ones((5,))}]
+    assert get_shape(data) == [[5, 2], {"k": [5]}]
+    assert find_batch_size(data) == 5
+
+
+def test_gather_single_process_identity():
+    x = jnp.arange(8.0)
+    assert np.allclose(gather(x), np.arange(8.0))
+
+
+def test_gather_object_single():
+    assert gather_object({"a": 1}) == [{"a": 1}]
+
+
+def test_broadcast_single():
+    x = {"t": jnp.ones(3)}
+    out = broadcast(x)
+    assert np.allclose(out["t"], 1.0)
+    objs = ["a", "b"]
+    assert broadcast_object_list(objs) == ["a", "b"]
+
+
+def test_concatenate():
+    data = [{"x": jnp.ones((2, 3))}, {"x": jnp.zeros((1, 3))}]
+    out = concatenate(data)
+    assert out["x"].shape == (3, 3)
+
+
+def test_pad_across_processes_noop_single():
+    x = jnp.ones((3, 2))
+    out = pad_across_processes(x, dim=0)
+    assert out.shape == (3, 2)
+
+
+def test_pad_input_tensors():
+    batch = {"x": jnp.arange(10).reshape(5, 2)}
+    out = pad_input_tensors(batch, batch_size=5, num_processes=4)
+    assert out["x"].shape == (8, 2)
+    # last row repeated
+    assert np.allclose(out["x"][5], out["x"][4])
+
+
+def test_reduce_mean():
+    x = jnp.ones((2, 2)) * 4
+    out = reduce(x, "mean")
+    assert np.allclose(out, 4.0)
+
+
+def test_convert_to_fp32():
+    data = {"a": jnp.ones(2, dtype=jnp.bfloat16), "b": jnp.ones(2, dtype=jnp.int32)}
+    out = convert_to_fp32(data)
+    assert out["a"].dtype == jnp.float32
+    assert out["b"].dtype == jnp.int32  # non-float untouched
+
+    fn = convert_outputs_to_fp32(lambda: jnp.ones(1, dtype=jnp.float16))
+    assert fn().dtype == jnp.float32
+
+
+def test_listify():
+    assert listify({"a": jnp.arange(3)}) == {"a": [0, 1, 2]}
+
+
+def test_find_executable_batch_size():
+    from accelerate_tpu.utils import find_executable_batch_size
+
+    attempts = []
+
+    @find_executable_batch_size(starting_batch_size=64)
+    def train(batch_size):
+        attempts.append(batch_size)
+        if batch_size > 16:
+            raise RuntimeError("RESOURCE_EXHAUSTED: Out of memory while trying to allocate")
+        return batch_size
+
+    assert train() == 16
+    assert attempts == [64, 32, 16]
+
+
+def test_find_executable_batch_size_non_oom_raises():
+    from accelerate_tpu.utils import find_executable_batch_size
+
+    @find_executable_batch_size(starting_batch_size=8)
+    def train(batch_size):
+        raise ValueError("unrelated")
+
+    with pytest.raises(ValueError):
+        train()
+
+
+def test_set_seed():
+    from accelerate_tpu.utils import set_seed
+
+    s = set_seed(42)
+    a = np.random.rand(3)
+    set_seed(42)
+    b = np.random.rand(3)
+    assert np.allclose(a, b)
+    assert s == 42

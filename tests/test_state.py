@@ -1,0 +1,124 @@
+"""Tests for state singletons and mesh construction (reference test surface:
+tests/test_state_checkpointing.py + state assertions inside
+test_utils/scripts/test_script.py)."""
+
+import jax
+import numpy as np
+import pytest
+
+from accelerate_tpu import AcceleratorState, GradientState, MeshConfig, PartialState
+from accelerate_tpu.utils import DistributedType, FullyShardedDataParallelPlugin, TensorParallelPlugin
+
+
+def test_partial_state_singleton():
+    s1 = PartialState()
+    s2 = PartialState()
+    assert s1.__dict__ is s2.__dict__
+    assert s1.num_devices == 8
+    assert s1.num_processes == 1
+    assert s1.is_main_process
+    assert s1.distributed_type == DistributedType.MULTI_CPU
+
+
+def test_split_between_processes_single():
+    s = PartialState()
+    with s.split_between_processes([1, 2, 3]) as inputs:
+        assert inputs == [1, 2, 3]
+
+
+def test_mesh_config_default():
+    mesh = MeshConfig().build()
+    assert mesh.shape["dp"] == 8
+    assert mesh.shape["tp"] == 1
+    assert set(mesh.axis_names) == {"dp", "fsdp", "tp", "cp", "ep", "pp"}
+
+
+def test_mesh_config_2d():
+    mesh = MeshConfig(dp=2, fsdp=2, tp=2).build()
+    assert mesh.shape["dp"] == 2 and mesh.shape["fsdp"] == 2 and mesh.shape["tp"] == 2
+    assert mesh.devices.size == 8
+
+
+def test_mesh_config_autofill():
+    cfg = MeshConfig(tp=4)
+    sizes = cfg.axis_sizes(8)
+    assert sizes["dp"] == 2 and sizes["tp"] == 4
+
+
+def test_mesh_config_invalid():
+    with pytest.raises(ValueError):
+        MeshConfig(dp=3).build()  # 3 does not divide 8
+    with pytest.raises(ValueError):
+        MeshConfig(dp=-1, tp=-1).axis_sizes(8)
+
+
+def test_accelerator_state_mixed_precision():
+    state = AcceleratorState(mixed_precision="bf16")
+    assert state.mixed_precision == "bf16"
+    assert state.num_devices == 8  # delegated to PartialState
+    # Re-init with conflicting precision raises
+    with pytest.raises(ValueError):
+        AcceleratorState(mixed_precision="fp16")
+
+
+def test_accelerator_state_fsdp_rewrites_type_and_mesh():
+    state = AcceleratorState(fsdp_plugin=FullyShardedDataParallelPlugin())
+    assert state.distributed_type == DistributedType.FSDP
+    assert state.mesh.shape["fsdp"] == 8
+    assert state.mesh.shape["dp"] == 1
+
+
+def test_accelerator_state_tp_mesh():
+    state = AcceleratorState(tp_plugin=TensorParallelPlugin(tp_size=2))
+    assert state.distributed_type == DistributedType.TENSOR_PARALLEL
+    assert state.mesh.shape["tp"] == 2
+    assert state.mesh.shape["dp"] == 4
+
+
+def test_gradient_state():
+    from accelerate_tpu.utils import GradientAccumulationPlugin
+
+    gs = GradientState(GradientAccumulationPlugin(num_steps=4))
+    assert gs.num_steps == 4
+    assert gs.sync_gradients
+    assert not gs.end_of_dataloader
+    assert gs.remainder == -1
+
+    class FakeLoader:
+        end_of_dataloader = True
+        remainder = 3
+
+    loader = FakeLoader()
+    gs._add_dataloader(loader)
+    assert gs.in_dataloader and gs.end_of_dataloader and gs.remainder == 3
+    gs._remove_dataloader(loader)
+    assert not gs.in_dataloader
+
+
+def test_deepspeed_plugin_translation():
+    from accelerate_tpu.utils import DeepSpeedPlugin
+
+    ds = DeepSpeedPlugin(hf_ds_config={"zero_optimization": {"stage": 3, "offload_optimizer": {"device": "cpu"}}})
+    fsdp = ds.to_fsdp_plugin()
+    assert fsdp.sharding_strategy == "FULL_SHARD"
+    assert fsdp.cpu_offload
+    state = AcceleratorState(deepspeed_plugin=ds)
+    assert state.distributed_type == DistributedType.DEEPSPEED
+    assert state.mesh.shape["fsdp"] == 8
+
+
+def test_megatron_plugin_translation():
+    from accelerate_tpu.utils import MegatronLMPlugin
+
+    m = MegatronLMPlugin(tp_degree=2, pp_degree=2)
+    state = AcceleratorState(megatron_lm_plugin=m)
+    assert state.distributed_type == DistributedType.MEGATRON_LM
+    assert state.mesh.shape["tp"] == 2 and state.mesh.shape["pp"] == 2 and state.mesh.shape["dp"] == 2
+
+
+def test_main_process_first():
+    s = PartialState()
+    order = []
+    with s.main_process_first():
+        order.append("main")
+    assert order == ["main"]
