@@ -9,6 +9,12 @@ and quantization. See SURVEY.md for the capability blueprint.
 
 __version__ = "0.1.0"
 
+from .accelerator import AcceleratedModel, Accelerator, Model
+from .data_loader import NumpyDataLoader, prepare_data_loader, skip_first_batches
+from .logging import get_logger
+from .optimizer import AcceleratedOptimizer
+from .precision import Policy, policy_for
+from .scheduler import AcceleratedScheduler, LRScheduler
 from .state import AcceleratorState, GradientState, PartialState
 from .parallel.mesh import MeshConfig, make_mesh
 from .utils.dataclasses import (

@@ -1,0 +1,385 @@
+"""Checkpoint / resume engine.
+
+Capability parity with the reference's two-tier checkpointing
+(reference: checkpointing.py:52-302 save/load_accelerator_state — model
+weights, optimizers, schedulers, sampler/dataloader state, scaler, per-rank
+RNG states, custom objects; accelerator.py:2915-3217 save_state/load_state
+with checkpoint_{i} rotation + total_limit pruning; accelerator.py:2769
+save_model sharded safetensors export).
+
+TPU-native redesign: arrays are *globally sharded* jax.Arrays, so the
+sharded-state-dict problem torch FSDP solves with
+torch.distributed.checkpoint (reference: utils/fsdp_utils.py:65-243) is
+handled by orbax/tensorstore, which writes each host's shards in parallel
+and restores with resharding across different mesh shapes (elastic resume).
+Small host-side states (scheduler counters, RNG, loss scale) are JSON.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import shutil
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+from .logging import get_logger
+from .state import PartialState
+from .utils.constants import (
+    CHECKPOINT_DIR_PREFIX,
+    CUSTOM_OBJECTS_NAME,
+    MODEL_NAME,
+    OPTIMIZER_NAME,
+    RNG_STATE_NAME,
+    SAFE_WEIGHTS_INDEX_NAME,
+    SAMPLER_NAME,
+    SCHEDULER_NAME,
+    WEIGHTS_PATTERN,
+)
+
+logger = get_logger(__name__)
+
+
+def _is_orbax_available():
+    try:
+        import orbax.checkpoint  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Array-tree IO (orbax primary, msgpack fallback)
+# ---------------------------------------------------------------------------
+
+def save_array_tree(tree, path: str | Path):
+    """Write a pytree of (possibly sharded) arrays.
+
+    orbax/tensorstore handles multi-host coordination: each host writes only
+    its addressable shards (the torch.distributed.checkpoint equivalent).
+    """
+    path = Path(path).absolute()
+    if _is_orbax_available():
+        import orbax.checkpoint as ocp
+
+        with ocp.PyTreeCheckpointer() as ckptr:
+            ckptr.save(path, tree, force=True)
+    else:  # pragma: no cover - orbax is baked into the image
+        import jax
+        from flax import serialization
+
+        host_tree = jax.tree_util.tree_map(lambda x: np.asarray(jax.device_get(x)), tree)
+        path.mkdir(parents=True, exist_ok=True)
+        (path / "tree.msgpack").write_bytes(serialization.to_bytes(host_tree))
+
+
+def load_array_tree(path: str | Path, target=None, shardings=None):
+    """Restore a pytree; with ``shardings`` the arrays are restored directly
+    into the requested (possibly different) mesh layout — elastic resume."""
+    path = Path(path).absolute()
+    if _is_orbax_available() and not (path / "tree.msgpack").exists():
+        import jax
+        import orbax.checkpoint as ocp
+
+        with ocp.PyTreeCheckpointer() as ckptr:
+            if target is not None:
+                def _abstract(t, s=None):
+                    sharding = s if s is not None else getattr(t, "sharding", None)
+                    return jax.ShapeDtypeStruct(t.shape, t.dtype, sharding=sharding)
+
+                if shardings is not None:
+                    abstract = jax.tree_util.tree_map(_abstract, target, shardings)
+                else:
+                    abstract = jax.tree_util.tree_map(_abstract, target)
+                return ckptr.restore(path, abstract)
+            return ckptr.restore(path)
+    else:  # pragma: no cover
+        from flax import serialization
+
+        raw = (path / "tree.msgpack").read_bytes()
+        if target is not None:
+            return serialization.from_bytes(target, raw)
+        return serialization.msgpack_restore(raw)
+
+
+# ---------------------------------------------------------------------------
+# RNG state (reference: checkpointing.py:144-160)
+# ---------------------------------------------------------------------------
+
+def get_rng_state(accelerator=None) -> dict:
+    state = {
+        "python": random.getstate(),
+        "numpy": np.random.get_state(),
+    }
+    if accelerator is not None:
+        state["jax_key"] = np.asarray(accelerator._rng_key).tolist()
+    return state
+
+
+def set_rng_state(state: dict, accelerator=None):
+    import jax.numpy as jnp
+
+    if "python" in state:
+        py = state["python"]
+        random.setstate((py[0], tuple(py[1]), py[2]) if isinstance(py, (list, tuple)) else py)
+    if "numpy" in state:
+        np_state = state["numpy"]
+        np.random.set_state(
+            (np_state[0], np.array(np_state[1], dtype=np.uint32), *np_state[2:])
+            if isinstance(np_state, (list, tuple))
+            else np_state
+        )
+    if accelerator is not None and "jax_key" in state:
+        accelerator._rng_key = jnp.asarray(np.array(state["jax_key"], dtype=np.uint32))
+
+
+# ---------------------------------------------------------------------------
+# save_state / load_state (reference: accelerator.py:2915/3081)
+# ---------------------------------------------------------------------------
+
+def _checkpoint_dir(accelerator, output_dir: Optional[str], for_load: bool = False) -> Path:
+    pc = accelerator.project_configuration
+    if output_dir is not None:
+        return Path(output_dir)
+    if pc.project_dir is None:
+        raise ValueError("No output_dir given and no ProjectConfiguration.project_dir set.")
+    base = Path(pc.project_dir) / "checkpoints"
+    if pc.automatic_checkpoint_naming:
+        if for_load:
+            existing = sorted(base.glob(f"{CHECKPOINT_DIR_PREFIX}_*"), key=lambda p: int(p.name.split("_")[-1]))
+            if not existing:
+                raise FileNotFoundError(f"No checkpoints found in {base}")
+            return existing[-1]
+        return base / f"{CHECKPOINT_DIR_PREFIX}_{pc.iteration}"
+    return base
+
+
+def _prune_checkpoints(accelerator, base: Path):
+    """total_limit rotation (reference: accelerator.py:2953-2977)."""
+    pc = accelerator.project_configuration
+    if pc.total_limit is None:
+        return
+    existing = sorted(base.parent.glob(f"{CHECKPOINT_DIR_PREFIX}_*"), key=lambda p: int(p.name.split("_")[-1]))
+    while len(existing) >= pc.total_limit:
+        victim = existing.pop(0)
+        if accelerator.is_main_process:
+            shutil.rmtree(victim, ignore_errors=True)
+
+
+def save_accelerator_state(accelerator, output_dir: Optional[str] = None, safe_serialization: bool = True):
+    """Save the whole training state (reference: save_state :2915)."""
+    out = _checkpoint_dir(accelerator, output_dir)
+    pc = accelerator.project_configuration
+    if pc.automatic_checkpoint_naming and output_dir is None:
+        _prune_checkpoints(accelerator, out)
+    state = PartialState()
+    if state.is_main_process:
+        out.mkdir(parents=True, exist_ok=True)
+    state.wait_for_everyone()
+
+    # Models (sharded arrays via orbax — all hosts participate).
+    for i, model in enumerate(accelerator._models):
+        save_array_tree(model.params, out / f"{MODEL_NAME}_{i}" if i > 0 else out / MODEL_NAME)
+
+    # Optimizers: opt_state arrays + scalar state.
+    for i, opt in enumerate(accelerator._optimizers):
+        save_array_tree(opt.opt_state, out / (f"{OPTIMIZER_NAME}_{i}" if i > 0 else OPTIMIZER_NAME))
+        meta = {"steps_applied": opt.steps_applied}
+        if opt.loss_scale is not None:
+            meta["loss_scale"] = [
+                float(opt.loss_scale.scale),
+                int(opt.loss_scale.growth_tracker),
+                int(opt.loss_scale.fin_steps),
+            ]
+        if state.is_main_process:
+            (out / f"optimizer_meta_{i}.json").write_text(json.dumps(meta))
+
+    # Schedulers (host-side JSON).
+    if state.is_main_process:
+        for i, sched in enumerate(accelerator._schedulers):
+            (out / (f"{SCHEDULER_NAME}_{i}.json" if i > 0 else f"{SCHEDULER_NAME}.json")).write_text(
+                json.dumps(sched.state_dict())
+            )
+        # Dataloaders (sampler epoch + batches consumed, reference SAMPLER_NAME).
+        for i, dl in enumerate(accelerator._dataloaders):
+            (out / f"{SAMPLER_NAME}_{i}.json").write_text(json.dumps(dl.state_dict()))
+        # Custom registered objects.
+        for i, obj in enumerate(accelerator._custom_objects):
+            payload = obj.state_dict()
+            try:
+                (out / f"{CUSTOM_OBJECTS_NAME}_{i}.json").write_text(json.dumps(payload))
+            except TypeError:
+                import pickle
+
+                (out / f"{CUSTOM_OBJECTS_NAME}_{i}.pkl").write_bytes(pickle.dumps(payload))
+        # RNG states: per-process (reference: per-rank rng, checkpointing.py:144).
+    rng_file = out / f"{RNG_STATE_NAME}_{state.process_index}.json"
+    rng = get_rng_state(accelerator)
+    rng_ser = {
+        "python": [rng["python"][0], list(rng["python"][1]), rng["python"][2]],
+        "numpy": [rng["numpy"][0], np.asarray(rng["numpy"][1]).tolist(), *rng["numpy"][2:]],
+        "jax_key": rng.get("jax_key"),
+    }
+    rng_file.write_text(json.dumps(rng_ser))
+
+    # Increment on EVERY process — hosts must agree on the next checkpoint
+    # path or the collective orbax save diverges.
+    if pc.automatic_checkpoint_naming and output_dir is None:
+        pc.iteration += 1
+    state.wait_for_everyone()
+    logger.info(f"Saved accelerator state to {out}")
+    return str(out)
+
+
+def load_accelerator_state(accelerator, input_dir: Optional[str] = None, load_kwargs: Optional[dict] = None):
+    """Restore the whole training state (reference: load_state :3081)."""
+    src = _checkpoint_dir(accelerator, input_dir, for_load=True)
+    if not Path(src).exists():
+        raise FileNotFoundError(f"Checkpoint directory {src} does not exist")
+    state = PartialState()
+
+    for i, model in enumerate(accelerator._models):
+        path = src / (f"{MODEL_NAME}_{i}" if i > 0 else MODEL_NAME)
+        model.params = load_array_tree(path, target=model.params, shardings=model.param_shardings)
+
+    for i, opt in enumerate(accelerator._optimizers):
+        path = src / (f"{OPTIMIZER_NAME}_{i}" if i > 0 else OPTIMIZER_NAME)
+        if path.exists() and opt.opt_state is not None:
+            opt.opt_state = load_array_tree(path, target=opt.opt_state)
+        meta_path = src / f"optimizer_meta_{i}.json"
+        if meta_path.exists():
+            meta = json.loads(meta_path.read_text())
+            opt._steps_applied = meta.get("steps_applied", 0)
+            if "loss_scale" in meta and opt.loss_scale is not None:
+                import jax.numpy as jnp
+
+                from .precision import LossScaleState
+
+                ls = meta["loss_scale"]
+                opt.loss_scale = LossScaleState(
+                    scale=jnp.asarray(ls[0], jnp.float32),
+                    growth_tracker=jnp.asarray(ls[1], jnp.int32),
+                    fin_steps=jnp.asarray(ls[2], jnp.int32),
+                )
+
+    for i, sched in enumerate(accelerator._schedulers):
+        path = src / (f"{SCHEDULER_NAME}_{i}.json" if i > 0 else f"{SCHEDULER_NAME}.json")
+        if path.exists():
+            sched.load_state_dict(json.loads(path.read_text()))
+
+    for i, dl in enumerate(accelerator._dataloaders):
+        path = src / f"{SAMPLER_NAME}_{i}.json"
+        if path.exists():
+            dl.load_state_dict(json.loads(path.read_text()))
+
+    for i, obj in enumerate(accelerator._custom_objects):
+        jpath = src / f"{CUSTOM_OBJECTS_NAME}_{i}.json"
+        ppath = src / f"{CUSTOM_OBJECTS_NAME}_{i}.pkl"
+        if jpath.exists():
+            obj.load_state_dict(json.loads(jpath.read_text()))
+        elif ppath.exists():
+            import pickle
+
+            obj.load_state_dict(pickle.loads(ppath.read_bytes()))
+
+    rng_file = src / f"{RNG_STATE_NAME}_{state.process_index}.json"
+    if rng_file.exists():
+        set_rng_state(json.loads(rng_file.read_text()), accelerator)
+
+    logger.info(f"Loaded accelerator state from {src}")
+    return str(src)
+
+
+# ---------------------------------------------------------------------------
+# Model export: sharded safetensors (reference: accelerator.py:2769)
+# ---------------------------------------------------------------------------
+
+def _parse_size(size: str) -> int:
+    units = {"KB": 2**10, "MB": 2**20, "GB": 2**30}
+    for suffix, mult in units.items():
+        if size.upper().endswith(suffix):
+            return int(float(size[: -len(suffix)]) * mult)
+    return int(size)
+
+
+def flatten_params(tree, prefix="") -> dict:
+    """Pytree -> flat {'a.b.c': array} dict (safetensors naming)."""
+    flat = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            flat.update(flatten_params(v, f"{prefix}{k}."))
+    else:
+        flat[prefix[:-1]] = tree
+    return flat
+
+
+def unflatten_params(flat: dict) -> dict:
+    tree: dict = {}
+    for key, val in flat.items():
+        parts = key.split(".")
+        node = tree
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = val
+    return tree
+
+
+def save_model(accelerator, model, save_directory: str, max_shard_size: str = "10GB",
+               safe_serialization: bool = True):
+    """Export model weights as (sharded) safetensors for interchange
+    (reference: save_model :2769 via split_torch_state_dict_into_shards)."""
+    import jax
+
+    os.makedirs(save_directory, exist_ok=True)
+    params = model.params if hasattr(model, "params") else model
+    flat = flatten_params(params)
+    host_flat = {k: np.asarray(jax.device_get(v)) for k, v in flat.items()}
+    if not accelerator.is_main_process:
+        accelerator.wait_for_everyone()
+        return
+
+    limit = _parse_size(max_shard_size)
+    shards: list[dict] = [{}]
+    sizes = [0]
+    for k, v in host_flat.items():
+        nbytes = v.nbytes
+        if sizes[-1] + nbytes > limit and shards[-1]:
+            shards.append({})
+            sizes.append(0)
+        shards[-1][k] = v
+        sizes[-1] += nbytes
+
+    from safetensors.numpy import save_file
+
+    if len(shards) == 1:
+        save_file(shards[0], os.path.join(save_directory, "model.safetensors"))
+    else:
+        index = {"metadata": {"total_size": sum(sizes)}, "weight_map": {}}
+        for i, shard in enumerate(shards):
+            name = WEIGHTS_PATTERN.format(i + 1, len(shards))
+            save_file(shard, os.path.join(save_directory, name))
+            for k in shard:
+                index["weight_map"][k] = name
+        with open(os.path.join(save_directory, SAFE_WEIGHTS_INDEX_NAME), "w") as f:
+            json.dump(index, f, indent=2)
+    accelerator.wait_for_everyone()
+
+
+def load_safetensors_model(save_directory: str) -> dict:
+    """Load a safetensors export back into a nested param pytree."""
+    from safetensors.numpy import load_file
+
+    d = Path(save_directory)
+    index_path = d / SAFE_WEIGHTS_INDEX_NAME
+    flat: dict = {}
+    if index_path.exists():
+        index = json.loads(index_path.read_text())
+        for name in sorted(set(index["weight_map"].values())):
+            flat.update(load_file(d / name))
+    else:
+        flat = load_file(d / "model.safetensors")
+    return unflatten_params(flat)

@@ -1,0 +1,61 @@
+"""Multi-process-aware logging.
+
+Parity with the reference's ``logging.py`` (reference:
+src/accelerate/logging.py — MultiProcessAdapter :22, get_logger :85):
+``main_process_only`` / ``in_order`` kwargs on every log call.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import os
+
+
+class MultiProcessAdapter(logging.LoggerAdapter):
+    """Logs only on main process unless ``main_process_only=False``; with
+    ``in_order=True`` processes log one at a time (barrier-sequenced)."""
+
+    @staticmethod
+    def _should_log(main_process_only):
+        from .state import PartialState
+
+        state = PartialState()
+        return not main_process_only or (main_process_only and state.is_main_process)
+
+    def log(self, level, msg, *args, **kwargs):
+        if os.environ.get("ACCELERATE_TPU_DISABLE_LOGGING", "false").lower() in ("1", "true"):
+            return
+        from .state import PartialState
+
+        main_process_only = kwargs.pop("main_process_only", True)
+        in_order = kwargs.pop("in_order", False)
+        kwargs.setdefault("stacklevel", 2)
+
+        if self.isEnabledFor(level):
+            if self._should_log(main_process_only):
+                msg, kwargs = self.process(msg, kwargs)
+                self.logger.log(level, msg, *args, **kwargs)
+            elif in_order:
+                state = PartialState()
+                for i in range(state.num_processes):
+                    if i == state.process_index:
+                        msg, kwargs = self.process(msg, kwargs)
+                        self.logger.log(level, msg, *args, **kwargs)
+                    state.wait_for_everyone()
+
+    @functools.lru_cache(None)
+    def warning_once(self, *args, **kwargs):
+        """Emit a warning only once per unique message (reference: :75)."""
+        self.warning(*args, **kwargs)
+
+
+def get_logger(name: str, log_level: str | None = None) -> MultiProcessAdapter:
+    """Multi-process logger factory (reference: logging.py:85)."""
+    logger = logging.getLogger(name)
+    if log_level is None:
+        log_level = os.environ.get("ACCELERATE_TPU_LOG_LEVEL", None)
+    if log_level is not None:
+        logger.setLevel(log_level.upper())
+        logger.root.setLevel(log_level.upper())
+    return MultiProcessAdapter(logger, {})

@@ -1,0 +1,138 @@
+"""BERT-style encoder for sequence classification, TPU-first.
+
+Counterpart of the reference's canonical example workload (reference:
+examples/nlp_example.py — BERT-base on GLUE/MRPC). Parameter naming follows
+the TP sharding rules (query/key/value/attn_out, intermediate/mlp_out).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    hidden_dropout_prob: float = 0.1
+    num_labels: int = 2
+    use_flash_attention: bool = True
+
+    @classmethod
+    def base(cls, **overrides):
+        return dataclasses.replace(cls(), **overrides)
+
+    @classmethod
+    def tiny(cls, **overrides):
+        cfg = cls(vocab_size=1024, hidden_size=64, num_hidden_layers=2,
+                  num_attention_heads=4, intermediate_size=128, max_position_embeddings=128)
+        return dataclasses.replace(cfg, **overrides)
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+
+class BertSelfAttention(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x, attention_mask=None):
+        cfg = self.config
+        B, S, _ = x.shape
+        H, D = cfg.num_attention_heads, cfg.head_dim
+        dense = lambda feats, name: nn.Dense(feats, name=name, dtype=x.dtype, param_dtype=jnp.float32)
+        q = dense(H * D, "query")(x).reshape(B, S, H, D)
+        k = dense(H * D, "key")(x).reshape(B, S, H, D)
+        v = dense(H * D, "value")(x).reshape(B, S, H, D)
+
+        scale = D ** -0.5
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q * scale, k)
+        if attention_mask is not None:
+            big_neg = jnp.finfo(logits.dtype).min
+            logits = jnp.where(attention_mask[:, None, None, :].astype(bool), logits, big_neg)
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(x.dtype)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(B, S, H * D)
+        return dense(cfg.hidden_size, "attn_out")(out)
+
+
+class BertLayer(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x, attention_mask=None, deterministic=True):
+        cfg = self.config
+        attn = BertSelfAttention(cfg, name="attention")(x, attention_mask)
+        attn = nn.Dropout(cfg.hidden_dropout_prob, deterministic=deterministic)(attn)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="attn_norm", param_dtype=jnp.float32)(x + attn)
+        h = nn.Dense(cfg.intermediate_size, name="intermediate", dtype=x.dtype, param_dtype=jnp.float32)(x)
+        h = jax.nn.gelu(h)
+        h = nn.Dense(cfg.hidden_size, name="mlp_out", dtype=x.dtype, param_dtype=jnp.float32)(h)
+        h = nn.Dropout(cfg.hidden_dropout_prob, deterministic=deterministic)(h)
+        return nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="mlp_norm", param_dtype=jnp.float32)(x + h)
+
+
+class BertEncoder(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None, deterministic=True):
+        cfg = self.config
+        B, S = input_ids.shape
+        word = nn.Embed(cfg.vocab_size, cfg.hidden_size, name="word_embeddings", param_dtype=jnp.float32)(input_ids)
+        pos_ids = jnp.broadcast_to(jnp.arange(S)[None, :], (B, S))
+        pos = nn.Embed(cfg.max_position_embeddings, cfg.hidden_size, name="position_embeddings",
+                       param_dtype=jnp.float32)(pos_ids)
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        typ = nn.Embed(cfg.type_vocab_size, cfg.hidden_size, name="token_type_embeddings",
+                       param_dtype=jnp.float32)(token_type_ids)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="embed_norm", param_dtype=jnp.float32)(word + pos + typ)
+        x = nn.Dropout(cfg.hidden_dropout_prob, deterministic=deterministic)(x)
+        for i in range(cfg.num_hidden_layers):
+            x = BertLayer(cfg, name=f"layer_{i}")(x, attention_mask, deterministic)
+        return x
+
+
+class BertForSequenceClassification(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None, deterministic=True):
+        cfg = self.config
+        x = BertEncoder(cfg, name="encoder")(input_ids, attention_mask, token_type_ids, deterministic)
+        pooled = jnp.tanh(nn.Dense(cfg.hidden_size, name="pooler", param_dtype=jnp.float32)(x[:, 0]))
+        return nn.Dense(cfg.num_labels, name="classifier", param_dtype=jnp.float32)(pooled)
+
+    def init_params(self, rng, batch_size=1, seq_len=8):
+        dummy = jnp.zeros((batch_size, seq_len), jnp.int32)
+        return self.init(rng, dummy)["params"]
+
+
+def classification_loss(apply_fn):
+    """loss_fn for Accelerator: softmax cross-entropy over labels."""
+
+    def loss_fn(params, batch, rng=None):
+        variables = params if isinstance(params, dict) and "params" in params else {"params": params}
+        kwargs = {}
+        if rng is not None:
+            kwargs = {"deterministic": False, "rngs": {"dropout": rng}}
+        logits = apply_fn(
+            variables, batch["input_ids"], batch.get("attention_mask"), batch.get("token_type_ids"), **kwargs
+        )
+        labels = batch["labels"]
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        nll = -jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
+        return nll.mean()
+
+    return loss_fn

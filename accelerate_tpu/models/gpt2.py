@@ -1,0 +1,84 @@
+"""GPT-2-style decoder (learned positions, pre-LN) — parity with the
+reference's big-model-inference benchmark family (GPT-J/GPT-NeoX are GPT
+variants; reference: benchmarks/big_model_inference)."""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from .llama import multi_head_attention
+
+
+@dataclasses.dataclass
+class GPT2Config:
+    vocab_size: int = 50257
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    max_position_embeddings: int = 1024
+    layer_norm_eps: float = 1e-5
+    use_flash_attention: bool = True
+
+    @classmethod
+    def xl(cls):
+        return cls(hidden_size=1600, num_hidden_layers=48, num_attention_heads=25)
+
+    @classmethod
+    def tiny(cls, **overrides):
+        cfg = cls(vocab_size=256, hidden_size=64, num_hidden_layers=2,
+                  num_attention_heads=4, max_position_embeddings=128)
+        return dataclasses.replace(cfg, **overrides)
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+
+class GPT2Block(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        B, S, _ = x.shape
+        H, D = cfg.num_attention_heads, cfg.head_dim
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="ln_1", param_dtype=jnp.float32)(x)
+        qkv = nn.Dense(3 * H * D, name="qkv", dtype=x.dtype, param_dtype=jnp.float32)(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q, k, v = (t.reshape(B, S, H, D) for t in (q, k, v))
+        attn = multi_head_attention(q, k, v, causal=True, use_flash=cfg.use_flash_attention)
+        attn = nn.Dense(cfg.hidden_size, name="attn_out", dtype=x.dtype, param_dtype=jnp.float32)(
+            attn.reshape(B, S, H * D)
+        )
+        x = x + attn
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="ln_2", param_dtype=jnp.float32)(x)
+        h = nn.Dense(4 * cfg.hidden_size, name="fc1", dtype=x.dtype, param_dtype=jnp.float32)(h)
+        h = jax.nn.gelu(h)
+        h = nn.Dense(cfg.hidden_size, name="fc2", dtype=x.dtype, param_dtype=jnp.float32)(h)
+        return x + h
+
+
+class GPT2LMHeadModel(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, input_ids):
+        cfg = self.config
+        B, S = input_ids.shape
+        wte = nn.Embed(cfg.vocab_size, cfg.hidden_size, name="wte", param_dtype=jnp.float32)
+        wpe = nn.Embed(cfg.max_position_embeddings, cfg.hidden_size, name="wpe", param_dtype=jnp.float32)
+        x = wte(input_ids) + wpe(jnp.broadcast_to(jnp.arange(S)[None], (B, S)))
+        for i in range(cfg.num_hidden_layers):
+            x = GPT2Block(cfg, name=f"h_{i}")(x)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="ln_f", param_dtype=jnp.float32)(x)
+        # tied head
+        embed = self.variables["params"]["wte"]["embedding"]
+        return x @ embed.T.astype(x.dtype)
+
+    def init_params(self, rng, batch_size=1, seq_len=8):
+        dummy = jnp.zeros((batch_size, seq_len), jnp.int32)
+        return self.init(rng, dummy)["params"]

@@ -1,0 +1,95 @@
+"""ResNet for image classification — parity with the reference's cv_example
+(reference: examples/cv_example.py — ResNet-50 fine-tune).
+
+NHWC layout (TPU-native; conv lowering prefers channels-last on the MXU).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass
+class ResNetConfig:
+    stage_sizes: Sequence[int] = (3, 4, 6, 3)   # ResNet-50
+    num_filters: int = 64
+    num_classes: int = 1000
+    bottleneck: bool = True
+
+    @classmethod
+    def resnet50(cls, num_classes=1000):
+        return cls(stage_sizes=(3, 4, 6, 3), num_classes=num_classes)
+
+    @classmethod
+    def resnet18(cls, num_classes=1000):
+        return cls(stage_sizes=(2, 2, 2, 2), bottleneck=False, num_classes=num_classes)
+
+    @classmethod
+    def tiny(cls, num_classes=10):
+        return cls(stage_sizes=(1, 1), num_filters=8, bottleneck=False, num_classes=num_classes)
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    strides: int = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        norm = lambda name: nn.BatchNorm(use_running_average=not train, name=name, param_dtype=jnp.float32)
+        residual = x
+        y = nn.Conv(self.filters, (1, 1), use_bias=False, name="conv1")(x)
+        y = nn.relu(norm("bn1")(y))
+        y = nn.Conv(self.filters, (3, 3), strides=(self.strides, self.strides), use_bias=False, name="conv2")(y)
+        y = nn.relu(norm("bn2")(y))
+        y = nn.Conv(self.filters * 4, (1, 1), use_bias=False, name="conv3")(y)
+        y = norm("bn3")(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(self.filters * 4, (1, 1), strides=(self.strides, self.strides),
+                               use_bias=False, name="proj")(x)
+            residual = norm("bn_proj")(residual)
+        return nn.relu(y + residual)
+
+
+class BasicBlock(nn.Module):
+    filters: int
+    strides: int = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        norm = lambda name: nn.BatchNorm(use_running_average=not train, name=name, param_dtype=jnp.float32)
+        residual = x
+        y = nn.Conv(self.filters, (3, 3), strides=(self.strides, self.strides), use_bias=False, name="conv1")(x)
+        y = nn.relu(norm("bn1")(y))
+        y = nn.Conv(self.filters, (3, 3), use_bias=False, name="conv2")(y)
+        y = norm("bn2")(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(self.filters, (1, 1), strides=(self.strides, self.strides),
+                               use_bias=False, name="proj")(x)
+            residual = norm("bn_proj")(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    config: ResNetConfig
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cfg = self.config
+        x = nn.Conv(cfg.num_filters, (7, 7), strides=(2, 2), use_bias=False, name="conv_stem")(x)
+        x = nn.relu(nn.BatchNorm(use_running_average=not train, name="bn_stem", param_dtype=jnp.float32)(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        block = BottleneckBlock if cfg.bottleneck else BasicBlock
+        for i, size in enumerate(cfg.stage_sizes):
+            for j in range(size):
+                strides = 2 if i > 0 and j == 0 else 1
+                x = block(cfg.num_filters * 2**i, strides, name=f"stage{i}_block{j}")(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(cfg.num_classes, name="classifier", param_dtype=jnp.float32)(x)
+
+    def init_variables(self, rng, image_size=32):
+        dummy = jnp.zeros((1, image_size, image_size, 3))
+        return self.init(rng, dummy, train=False)

@@ -1,0 +1,33 @@
+"""Tiny deterministic models for tests and examples (reference:
+test_utils/training.py RegressionModel :22-50)."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class RegressionModel(nn.Module):
+    """y = a*x + b (reference RegressionModel parity)."""
+
+    @nn.compact
+    def __call__(self, x):
+        a = self.param("a", nn.initializers.zeros, ())
+        b = self.param("b", nn.initializers.zeros, ())
+        return a * x + b
+
+
+class MLP(nn.Module):
+    features: tuple = (64, 64)
+    num_outputs: int = 1
+
+    @nn.compact
+    def __call__(self, x):
+        for i, f in enumerate(self.features):
+            x = nn.Dense(f, name=f"dense_{i}", param_dtype=jnp.float32)(x)
+            x = nn.relu(x)
+        return nn.Dense(self.num_outputs, name="out", param_dtype=jnp.float32)(x)
+
+    def init_params(self, rng, input_dim):
+        return self.init(rng, jnp.zeros((1, input_dim)))["params"]

@@ -1,0 +1,61 @@
+"""Attention kernels: Pallas flash attention for TPU.
+
+The hot op of every transformer in the framework. The Pallas kernel (tiled
+online-softmax over KV blocks, VMEM-resident accumulators) lives here;
+models dispatch through :func:`flash_attention` which falls back to the
+einsum path on non-TPU backends (tests run on CPU).
+
+Replaces what the reference gets from Megatron/TransformerEngine fused CUDA
+kernels (reference: utils/megatron_lm.py delegates attention entirely).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def flash_attention_available(q=None) -> bool:
+    """True when the Pallas TPU lowering can run (real TPU backend) and the
+    shapes are tileable."""
+    try:
+        if jax.default_backend() != "tpu":
+            return False
+    except Exception:
+        return False
+    if q is not None:
+        # Kernel wants seq divisible by block size and head_dim <= 256.
+        seq = q.shape[1]
+        return seq >= 128 and seq % 128 == 0 and q.shape[-1] <= 256
+    return True
+
+
+def _einsum_attention(q, k, v, causal: bool, segment_ids=None):
+    """XLA-fused reference path: [B, S, H, D] -> [B, S, H, D]."""
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q * scale, k)
+    big_neg = jnp.finfo(logits.dtype).min
+    if causal:
+        q_len, k_len = q.shape[1], k.shape[1]
+        mask = jnp.tril(jnp.ones((q_len, k_len), dtype=bool))
+        logits = jnp.where(mask[None, None], logits, big_neg)
+    if segment_ids is not None:
+        seg_mask = segment_ids[:, :, None] == segment_ids[:, None, :]
+        logits = jnp.where(seg_mask[:, None], logits, big_neg)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def flash_attention(q, k, v, causal: bool = True, block_q: int = 128, block_k: int = 128):
+    """Flash attention entry point.
+
+    Args are [batch, seq, heads, head_dim]. Dispatches to the Pallas kernel
+    on TPU; einsum fallback elsewhere.
+    """
+    if not flash_attention_available(q):
+        return _einsum_attention(q, k, v, causal)
+    from .flash_pallas import pallas_flash_attention
+
+    return pallas_flash_attention(q, k, v, causal=causal, block_q=block_q, block_k=block_k)

@@ -1,0 +1,132 @@
+"""Mixed-precision policies and dynamic loss scaling.
+
+Replaces the reference's autocast + GradScaler machinery (reference:
+accelerator.py:466-494 selects a torch GradScaler per device;
+utils/dataclasses.py:90 AutocastKwargs; optimizer.py:155-170 scaler step with
+skipped-step detection) with the JAX idiom: a *policy* of explicit dtypes
+(params / compute / output) baked into the compiled step, plus a pure
+functional loss-scale state threaded through the step for fp16.
+
+On TPU the default is bf16 compute with fp32 master params — no scaling
+needed (bf16 shares fp32's exponent range); fp16 support is kept for parity
+and uses dynamic scaling equivalent to torch.cuda.amp.GradScaler.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .utils.dataclasses import GradScalerKwargs, PrecisionType
+
+
+@dataclass(frozen=True)
+class Policy:
+    """Dtype policy (jmp-style)."""
+
+    param_dtype: Any = jnp.float32
+    compute_dtype: Any = jnp.float32
+    output_dtype: Any = jnp.float32
+
+    def cast_to_compute(self, tree):
+        return _cast_floating(tree, self.compute_dtype)
+
+    def cast_to_param(self, tree):
+        return _cast_floating(tree, self.param_dtype)
+
+    def cast_to_output(self, tree):
+        return _cast_floating(tree, self.output_dtype)
+
+
+def _cast_floating(tree, dtype):
+    def conv(x):
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+            return x.astype(dtype)
+        return x
+
+    return jax.tree_util.tree_map(conv, tree)
+
+
+def policy_for(mixed_precision: str | PrecisionType) -> Policy:
+    """Policy from an accelerate-style mixed_precision string.
+
+    * "no"/"fp32": everything fp32.
+    * "bf16": fp32 params, bf16 compute (MXU-native), fp32 outputs.
+    * "fp16": fp32 params, fp16 compute + dynamic loss scale.
+    * "fp8": bf16 policy here; fp8 matmuls are applied per-op (ops/quant.py).
+    """
+    mp = str(mixed_precision)
+    if mp in ("no", "fp32"):
+        return Policy()
+    if mp == "bf16":
+        return Policy(param_dtype=jnp.float32, compute_dtype=jnp.bfloat16, output_dtype=jnp.float32)
+    if mp == "fp16":
+        return Policy(param_dtype=jnp.float32, compute_dtype=jnp.float16, output_dtype=jnp.float32)
+    if mp == "fp8":
+        return Policy(param_dtype=jnp.float32, compute_dtype=jnp.bfloat16, output_dtype=jnp.float32)
+    raise ValueError(f"Unknown mixed precision mode {mixed_precision}")
+
+
+class LossScaleState(NamedTuple):
+    """Functional GradScaler state (reference: torch GradScaler semantics)."""
+
+    scale: jnp.ndarray          # current loss scale
+    growth_tracker: jnp.ndarray  # consecutive finite steps
+    fin_steps: jnp.ndarray       # total applied steps (diagnostics)
+
+
+def make_loss_scale(kwargs: Optional[GradScalerKwargs] = None, enabled: bool = True) -> Optional[LossScaleState]:
+    kwargs = kwargs or GradScalerKwargs()
+    if not enabled or not kwargs.enabled:
+        return None
+    return LossScaleState(
+        scale=jnp.asarray(kwargs.init_scale, jnp.float32),
+        growth_tracker=jnp.zeros((), jnp.int32),
+        fin_steps=jnp.zeros((), jnp.int32),
+    )
+
+
+def scale_loss(loss, scale_state: Optional[LossScaleState]):
+    if scale_state is None:
+        return loss
+    return loss * scale_state.scale.astype(loss.dtype)
+
+
+def unscale_grads(grads, scale_state: Optional[LossScaleState]):
+    if scale_state is None:
+        return grads
+    inv = 1.0 / scale_state.scale
+
+    return jax.tree_util.tree_map(lambda g: (g.astype(jnp.float32) * inv).astype(g.dtype), grads)
+
+
+def grads_finite(grads) -> jnp.ndarray:
+    leaves = jax.tree_util.tree_leaves(grads)
+    if not leaves:
+        return jnp.asarray(True)
+    return jnp.all(jnp.stack([jnp.all(jnp.isfinite(g)) for g in leaves]))
+
+
+def update_loss_scale(
+    scale_state: LossScaleState,
+    finite: jnp.ndarray,
+    kwargs: Optional[GradScalerKwargs] = None,
+) -> LossScaleState:
+    """Grow/backoff the scale (reference: GradScaler.update semantics)."""
+    kwargs = kwargs or GradScalerKwargs()
+    tracker = jnp.where(finite, scale_state.growth_tracker + 1, 0)
+    grow = tracker >= kwargs.growth_interval
+    new_scale = jnp.where(
+        finite,
+        jnp.where(grow, scale_state.scale * kwargs.growth_factor, scale_state.scale),
+        scale_state.scale * kwargs.backoff_factor,
+    )
+    tracker = jnp.where(grow, 0, tracker)
+    return LossScaleState(
+        scale=new_scale,
+        growth_tracker=tracker,
+        fin_steps=scale_state.fin_steps + finite.astype(jnp.int32),
+    )

@@ -513,9 +513,14 @@ class GradientState:
         self.dataloader_references.append(dataloader)
 
     def _remove_dataloader(self, dataloader):
-        if dataloader in self.dataloader_references:
-            self.dataloader_references.remove(dataloader)
-        self.active_dataloader = self.dataloader_references[-1]
+        # Defensive: a GC'd loader generator may call this after a test reset
+        # the singleton state.
+        refs = self.__dict__.get("dataloader_references")
+        if refs is None:
+            return
+        if dataloader in refs:
+            refs.remove(dataloader)
+        self.active_dataloader = refs[-1] if refs else None
 
     @staticmethod
     def _reset_state():

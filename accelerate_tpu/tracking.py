@@ -1,0 +1,456 @@
+"""Experiment trackers.
+
+Capability parity with the reference's ``tracking.py`` (reference:
+src/accelerate/tracking.py — GeneralTracker ABC :91 with on_main_process
+decorator :67; integrations TensorBoard :165, WandB :276, CometML :399, Aim
+:480, MLflow :579, ClearML :724, DVCLive :876; filter_trackers :971).
+
+Adds a TPU-native zero-dependency JSONL tracker (the default) so metric
+logging works on fresh TPU VMs without any tracker package installed.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import time
+from typing import Any, Optional, Union
+
+from .logging import get_logger
+from .state import PartialState
+from .utils.dataclasses import LoggerType
+from .utils.imports import (
+    is_aim_available,
+    is_clearml_available,
+    is_comet_ml_available,
+    is_dvclive_available,
+    is_mlflow_available,
+    is_tensorboard_available,
+    is_wandb_available,
+)
+
+logger = get_logger(__name__)
+
+
+def on_main_process(function):
+    """Run a tracker method only on the main process (reference: tracking.py:67)."""
+
+    @functools.wraps(function)
+    def execute_on_main_process(self, *args, **kwargs):
+        if getattr(self, "main_process_only", True) and not PartialState().is_main_process:
+            return None
+        return function(self, *args, **kwargs)
+
+    return execute_on_main_process
+
+
+class GeneralTracker:
+    """Tracker ABC (reference: tracking.py:91). Subclasses set ``name``,
+    ``requires_logging_directory`` and implement store_init_configuration/log."""
+
+    main_process_only = True
+
+    def __init__(self, _blank: bool = False):
+        if not _blank:
+            err = []
+            if not hasattr(self, "name"):
+                err.append("`name`")
+            if not hasattr(self, "requires_logging_directory"):
+                err.append("`requires_logging_directory`")
+            if "tracker" not in dir(self):
+                err.append("`tracker`")
+            if err:
+                raise NotImplementedError(
+                    f"The implementation for this tracker class is missing the following "
+                    f"required attributes: {', '.join(err)}"
+                )
+
+    def store_init_configuration(self, values: dict):
+        pass
+
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        pass
+
+    def finish(self):
+        pass
+
+
+class JSONLTracker(GeneralTracker):
+    """Native file tracker: one JSON object per log call (TPU-friendly
+    default; plays well with gsutil-synced logging dirs)."""
+
+    name = "jsonl"
+    requires_logging_directory = True
+
+    @on_main_process
+    def __init__(self, run_name: str, logging_dir: str = ".", **kwargs):
+        super().__init__()
+        self.run_name = run_name
+        os.makedirs(logging_dir, exist_ok=True)
+        self.path = os.path.join(logging_dir, f"{run_name.replace('/', '_')}.metrics.jsonl")
+        self._fh = open(self.path, "a")
+
+    @property
+    def tracker(self):
+        return self._fh
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        self._write({"_type": "config", "config": values})
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        self._write({"_type": "metrics", "step": step, "time": time.time(), **values})
+
+    def _write(self, obj):
+        def _clean(v):
+            try:
+                json.dumps(v)
+                return v
+            except TypeError:
+                return float(v) if hasattr(v, "__float__") else str(v)
+
+        self._fh.write(json.dumps({k: _clean(v) for k, v in obj.items()}) + "\n")
+        self._fh.flush()
+
+    @on_main_process
+    def finish(self):
+        self._fh.close()
+
+
+class TensorBoardTracker(GeneralTracker):
+    """(reference: tracking.py:165)"""
+
+    name = "tensorboard"
+    requires_logging_directory = True
+
+    @on_main_process
+    def __init__(self, run_name: str, logging_dir: str, **kwargs):
+        super().__init__()
+        try:
+            from torch.utils import tensorboard
+        except ImportError:
+            import tensorboardX as tensorboard
+        self.run_name = run_name
+        self.logging_dir = os.path.join(logging_dir, run_name)
+        self.writer = tensorboard.SummaryWriter(self.logging_dir, **kwargs)
+
+    @property
+    def tracker(self):
+        return self.writer
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        self.writer.add_hparams(
+            {k: v for k, v in values.items() if isinstance(v, (int, float, str, bool))}, metric_dict={}
+        )
+        self.writer.flush()
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        for k, v in values.items():
+            if isinstance(v, (int, float)):
+                self.writer.add_scalar(k, v, global_step=step, **kwargs)
+            elif isinstance(v, str):
+                self.writer.add_text(k, v, global_step=step, **kwargs)
+            elif isinstance(v, dict):
+                self.writer.add_scalars(k, v, global_step=step, **kwargs)
+        self.writer.flush()
+
+    @on_main_process
+    def finish(self):
+        self.writer.close()
+
+
+class WandBTracker(GeneralTracker):
+    """(reference: tracking.py:276)"""
+
+    name = "wandb"
+    requires_logging_directory = False
+    main_process_only = True
+
+    @on_main_process
+    def __init__(self, run_name: str, **kwargs):
+        super().__init__()
+        import wandb
+
+        self.run_name = run_name
+        self.run = wandb.init(project=self.run_name, **kwargs)
+
+    @property
+    def tracker(self):
+        return self.run
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        import wandb
+
+        wandb.config.update(values, allow_val_change=True)
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        self.run.log(values, step=step, **kwargs)
+
+    @on_main_process
+    def finish(self):
+        self.run.finish()
+
+
+class MLflowTracker(GeneralTracker):
+    """(reference: tracking.py:579)"""
+
+    name = "mlflow"
+    requires_logging_directory = False
+
+    @on_main_process
+    def __init__(self, experiment_name: str = None, logging_dir: str = None, run_id=None,
+                 tags=None, nested_run=False, run_name=None, description=None):
+        super().__init__()
+        import mlflow
+
+        exp_id = mlflow.create_experiment(experiment_name) if experiment_name else None
+        self.active_run = mlflow.start_run(
+            run_id=run_id, experiment_id=exp_id, run_name=run_name, nested=nested_run,
+            tags=tags, description=description,
+        )
+
+    @property
+    def tracker(self):
+        return self.active_run
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        import mlflow
+
+        for chunk in [dict(list(values.items())[i : i + 100]) for i in range(0, len(values), 100)]:
+            mlflow.log_params(chunk)
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        import mlflow
+
+        metrics = {k: v for k, v in values.items() if isinstance(v, (int, float))}
+        mlflow.log_metrics(metrics, step=step)
+
+    @on_main_process
+    def finish(self):
+        import mlflow
+
+        mlflow.end_run()
+
+
+class CometMLTracker(GeneralTracker):
+    """(reference: tracking.py:399)"""
+
+    name = "comet_ml"
+    requires_logging_directory = False
+
+    @on_main_process
+    def __init__(self, run_name: str, **kwargs):
+        super().__init__()
+        from comet_ml import Experiment
+
+        self.run_name = run_name
+        self.writer = Experiment(project_name=run_name, **kwargs)
+
+    @property
+    def tracker(self):
+        return self.writer
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        self.writer.log_parameters(values)
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        if step is not None:
+            self.writer.set_step(step)
+        self.writer.log_others(values)
+
+    @on_main_process
+    def finish(self):
+        self.writer.end()
+
+
+class AimTracker(GeneralTracker):
+    """(reference: tracking.py:480)"""
+
+    name = "aim"
+    requires_logging_directory = True
+
+    @on_main_process
+    def __init__(self, run_name: str, logging_dir: str = ".", **kwargs):
+        super().__init__()
+        from aim import Run
+
+        self.writer = Run(repo=logging_dir, **kwargs)
+        self.writer.name = run_name
+
+    @property
+    def tracker(self):
+        return self.writer
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        self.writer["hparams"] = values
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        for k, v in values.items():
+            self.writer.track(v, name=k, step=step, **kwargs)
+
+    @on_main_process
+    def finish(self):
+        self.writer.close()
+
+
+class ClearMLTracker(GeneralTracker):
+    """(reference: tracking.py:724)"""
+
+    name = "clearml"
+    requires_logging_directory = False
+
+    @on_main_process
+    def __init__(self, run_name: str = None, **kwargs):
+        super().__init__()
+        from clearml import Task
+
+        self.task = Task.init(project_name=run_name, **kwargs)
+
+    @property
+    def tracker(self):
+        return self.task
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        self.task.connect_configuration(values)
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        clearml_logger = self.task.get_logger()
+        for k, v in values.items():
+            if isinstance(v, (int, float)):
+                title, _, series = k.partition("/")
+                clearml_logger.report_scalar(title=title, series=series or title, value=v, iteration=step or 0)
+
+    @on_main_process
+    def finish(self):
+        self.task.close()
+
+
+class DVCLiveTracker(GeneralTracker):
+    """(reference: tracking.py:876)"""
+
+    name = "dvclive"
+    requires_logging_directory = False
+
+    @on_main_process
+    def __init__(self, run_name=None, live=None, **kwargs):
+        super().__init__()
+        from dvclive import Live
+
+        self.live = live if live is not None else Live(**kwargs)
+
+    @property
+    def tracker(self):
+        return self.live
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        self.live.log_params(values)
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        if step is not None:
+            self.live.step = step
+        for k, v in values.items():
+            self.live.log_metric(k, v)
+        self.live.next_step()
+
+    @on_main_process
+    def finish(self):
+        self.live.end()
+
+
+LOGGER_TYPE_TO_CLASS = {
+    "aim": AimTracker,
+    "comet_ml": CometMLTracker,
+    "mlflow": MLflowTracker,
+    "tensorboard": TensorBoardTracker,
+    "wandb": WandBTracker,
+    "clearml": ClearMLTracker,
+    "dvclive": DVCLiveTracker,
+    "jsonl": JSONLTracker,
+}
+
+_AVAILABILITY = {
+    "tensorboard": is_tensorboard_available,
+    "wandb": is_wandb_available,
+    "comet_ml": is_comet_ml_available,
+    "aim": is_aim_available,
+    "mlflow": is_mlflow_available,
+    "clearml": is_clearml_available,
+    "dvclive": is_dvclive_available,
+    "jsonl": lambda: True,
+}
+
+
+def filter_trackers(log_with, logging_dir: Optional[str] = None):
+    """Resolve requested tracker names to available ones (reference:
+    tracking.py:971)."""
+    loggers = []
+    if log_with is None:
+        return []
+    if not isinstance(log_with, (list, tuple)):
+        log_with = [log_with]
+    if "all" in [str(x) for x in log_with] or LoggerType.ALL in log_with:
+        candidates = list(LOGGER_TYPE_TO_CLASS)
+    else:
+        candidates = []
+        for item in log_with:
+            if isinstance(item, GeneralTracker):
+                loggers.append(item)
+                continue
+            name = str(item)
+            if name not in LOGGER_TYPE_TO_CLASS:
+                raise ValueError(
+                    f"Unknown tracker {name!r}; choose from {list(LOGGER_TYPE_TO_CLASS)} "
+                    "or pass a GeneralTracker instance."
+                )
+            candidates.append(name)
+    for name in candidates:
+        if _AVAILABILITY[name]():
+            cls = LOGGER_TYPE_TO_CLASS[name]
+            if cls.requires_logging_directory and logging_dir is None:
+                logger.warning(f"Tracker {name} requires a logging_dir; skipping.")
+                continue
+            loggers.append(name)
+        else:
+            logger.debug(f"Tracker {name} not available; skipping.")
+    return loggers
+
+
+def resolve_trackers(log_with, project_name: str, logging_dir: Optional[str], config=None,
+                     init_kwargs: Optional[dict] = None):
+    """Instantiate trackers + store the run config (used by
+    Accelerator.init_trackers, reference: accelerator.py:2610)."""
+    init_kwargs = init_kwargs or {}
+    if log_with is None:
+        log_with = ["jsonl"]
+    names_or_instances = filter_trackers(log_with, logging_dir)
+    trackers = []
+    for item in names_or_instances:
+        if isinstance(item, GeneralTracker):
+            trackers.append(item)
+            continue
+        cls = LOGGER_TYPE_TO_CLASS[item]
+        kwargs = init_kwargs.get(item, {})
+        if cls.requires_logging_directory:
+            trackers.append(cls(project_name, logging_dir or ".", **kwargs))
+        else:
+            trackers.append(cls(project_name, **kwargs))
+    if config is not None:
+        for t in trackers:
+            t.store_init_configuration(config)
+    return trackers

@@ -1,0 +1,127 @@
+"""Benchmark: training throughput + MFU of the fused train step on real TPU.
+
+Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+
+The reference publishes no training-throughput numbers (SURVEY.md §6); the
+tracked north-star is MFU (target >=45% for FSDP fine-tuning). vs_baseline
+reports achieved_MFU / 0.45.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+# Peak bf16 TFLOP/s per chip by TPU generation.
+PEAK_TFLOPS = {
+    "v4": 275.0,
+    "v5 lite": 197.0,  # v5e
+    "v5e": 197.0,
+    "v5p": 459.0,
+    "v6": 918.0,
+}
+
+
+def detect_peak_tflops(device) -> float:
+    kind = str(getattr(device, "device_kind", "")).lower()
+    for key, val in PEAK_TFLOPS.items():
+        if key in kind:
+            return val
+    return 197.0
+
+
+def model_flops_per_token(n_params: int, cfg, seq: int) -> float:
+    """Training FLOPs/token: 6N for matmul params + attention score/value
+    term 12*L*h*seq (fwd 2 matmuls * 2 FLOPs * s*h per token, x3 for bwd)."""
+    attn = 12.0 * cfg.num_hidden_layers * cfg.hidden_size * seq
+    return 6.0 * n_params + attn
+
+
+def main():
+    import jax
+    import numpy as np
+    import optax
+
+    from accelerate_tpu import Accelerator, Model
+    from accelerate_tpu.data_loader import make_global_batch
+    from accelerate_tpu.models.llama import LlamaConfig, LlamaForCausalLM, causal_lm_loss
+
+    on_tpu = jax.default_backend() == "tpu" or any(
+        "TPU" in str(d.device_kind) for d in jax.devices()
+    )
+
+    if on_tpu:
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+            num_hidden_layers=10, num_attention_heads=16, num_key_value_heads=8,
+            max_position_embeddings=2048, remat=False, use_flash_attention=True,
+        )
+        batch, seq, iters, warmup = 8, 1024, 20, 3
+    else:  # CPU smoke fallback so the bench always emits a line
+        cfg = LlamaConfig.tiny(use_flash_attention=False)
+        batch, seq, iters, warmup = 4, 32, 3, 1
+
+    model_def = LlamaForCausalLM(cfg)
+    params = model_def.init_params(jax.random.PRNGKey(0), batch_size=1, seq_len=8)
+
+    acc = Accelerator(mixed_precision="bf16")
+    model, opt = acc.prepare(Model(model_def, params), optax.adamw(1e-4))
+    step = acc.compile_train_step(causal_lm_loss(model_def.apply), max_grad_norm=1.0)
+
+    rng = np.random.default_rng(0)
+    batches = [
+        make_global_batch(
+            {"input_ids": rng.integers(0, cfg.vocab_size, size=(batch, seq)).astype(np.int32)}, acc.mesh
+        )
+        for _ in range(4)
+    ]
+
+    for i in range(warmup):
+        metrics = step(batches[i % 4])
+    # NB: device_get, not block_until_ready — the latter is a no-op on some
+    # experimental PJRT platforms (observed on the axon tunnel).
+    jax.device_get(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for i in range(iters):
+        metrics = step(batches[i % 4])
+    jax.device_get(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    tokens = batch * seq * iters
+    tokens_per_sec = tokens / dt
+    n_chips = len(jax.devices())
+    tokens_per_sec_per_chip = tokens_per_sec / n_chips
+
+    n_params = sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(model.params))
+    # The input embedding is a gather, not a matmul — exclude it from 6N.
+    n_matmul_params = n_params - cfg.vocab_size * cfg.hidden_size
+    flops_per_tok = model_flops_per_token(n_matmul_params, cfg, seq)
+    achieved_tflops = tokens_per_sec_per_chip * flops_per_tok / 1e12
+    peak = detect_peak_tflops(jax.devices()[0])
+    mfu = achieved_tflops / peak
+
+    result = {
+        "metric": "llama_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec_per_chip, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / 0.45, 4),
+        "extra": {
+            "mfu": round(mfu, 4),
+            "achieved_tflops": round(achieved_tflops, 2),
+            "peak_tflops": peak,
+            "step_ms": round(1000 * dt / iters, 2),
+            "config": {
+                "hidden": cfg.hidden_size, "layers": cfg.num_hidden_layers,
+                "batch": batch, "seq": seq, "backend": jax.default_backend(),
+            },
+            "loss": float(metrics["loss"]),
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

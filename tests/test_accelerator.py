@@ -1,0 +1,303 @@
+"""End-to-end Accelerator tests on the 8-device virtual mesh (reference test
+surface: tests/test_accelerator.py + the training_check parity tests in
+test_utils/scripts/test_script.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from accelerate_tpu import (
+    Accelerator,
+    GradientState,
+    MeshConfig,
+    Model,
+    NumpyDataLoader,
+)
+from accelerate_tpu.utils import FullyShardedDataParallelPlugin, GradScalerKwargs
+
+
+def make_regression_data(n=64, seed=0):
+    """Tiny deterministic regression task (reference: RegressionDataset,
+    test_utils/training.py:22)."""
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 4)).astype(np.float32)
+    w = np.array([[1.0], [-2.0], [0.5], [3.0]], dtype=np.float32)
+    y = x @ w + 0.1 * rng.normal(size=(n, 1)).astype(np.float32)
+    return [{"x": x[i], "y": y[i]} for i in range(n)]
+
+
+def init_mlp(seed=0, din=4, dh=16, dout=1):
+    k = jax.random.PRNGKey(seed)
+    k1, k2 = jax.random.split(k)
+    return {
+        "w1": jax.random.normal(k1, (din, dh)) * 0.3,
+        "b1": jnp.zeros((dh,)),
+        "w2": jax.random.normal(k2, (dh, dout)) * 0.3,
+        "b2": jnp.zeros((dout,)),
+    }
+
+
+def mlp_apply(params, x):
+    h = jnp.tanh(x @ params["w1"] + params["b1"])
+    return h @ params["w2"] + params["b2"]
+
+
+def mse_loss(params, batch):
+    pred = mlp_apply(params, batch["x"])
+    return jnp.mean((pred - batch["y"]) ** 2)
+
+
+def train_loop(accelerator, num_epochs=2, batch_size=8, accum=1, lr=0.05, clip=None):
+    data = make_regression_data()
+    loader = NumpyDataLoader(data, batch_size=batch_size)
+    model = Model(mlp_apply, init_mlp())
+    tx = optax.sgd(lr)
+    model, opt, loader = accelerator.prepare(model, tx, loader)
+
+    losses = []
+    epoch_losses = []
+    for _ in range(num_epochs):
+        total = 0.0
+        for batch in loader:
+            with accelerator.accumulate(model):
+                loss = accelerator.backward(mse_loss, batch)
+                if clip is not None:
+                    accelerator.clip_grad_norm_(max_norm=clip)
+                opt.step()
+                opt.zero_grad()
+            losses.append(float(loss))
+            total += float(loss)
+        epoch_losses.append(total)
+    return model, opt, losses, epoch_losses
+
+
+class TestTrainingLoop:
+    def test_loss_decreases(self):
+        acc = Accelerator()
+        model, opt, losses, epoch_losses = train_loop(acc)
+        assert epoch_losses[-1] < epoch_losses[0] * 0.5
+        assert opt.steps_applied == len(losses)
+
+    def test_bf16_policy(self):
+        acc = Accelerator(mixed_precision="bf16")
+        model, opt, losses, epoch_losses = train_loop(acc)
+        assert epoch_losses[-1] < epoch_losses[0]
+        # master params stay fp32
+        assert all(p.dtype == jnp.float32 for p in jax.tree_util.tree_leaves(model.params))
+
+    def test_grad_accumulation_equivalence(self):
+        """accum=4 microbatches of 4 == one batch of 16 (reference:
+        test_utils/scripts/test_sync.py semantics)."""
+        acc = Accelerator(gradient_accumulation_steps=4)
+        data = make_regression_data(32)
+        model = Model(mlp_apply, init_mlp())
+        loader = NumpyDataLoader(data, batch_size=4)
+        model, opt, loader = acc.prepare(model, optax.sgd(0.1), loader)
+        for batch in loader:
+            with acc.accumulate(model):
+                acc.backward(mse_loss, batch)
+                opt.step()
+                opt.zero_grad()
+        params_accum = jax.tree_util.tree_map(np.asarray, model.params)
+        # only every 4th step applied
+        assert opt.steps_applied == len(loader) // 4
+
+        GradientState._reset_state()
+        from accelerate_tpu.state import AcceleratorState
+
+        AcceleratorState._reset_state()
+        acc2 = Accelerator()
+        model2 = Model(mlp_apply, init_mlp())
+        loader2 = NumpyDataLoader(data, batch_size=16)
+        model2, opt2, loader2 = acc2.prepare(model2, optax.sgd(0.1), loader2)
+        for batch in loader2:
+            with acc2.accumulate(model2):
+                acc2.backward(mse_loss, batch)
+                opt2.step()
+                opt2.zero_grad()
+        params_big = jax.tree_util.tree_map(np.asarray, model2.params)
+        for a, b in zip(jax.tree_util.tree_leaves(params_accum), jax.tree_util.tree_leaves(params_big)):
+            np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-5)
+
+    def test_clip_grad_norm(self):
+        acc = Accelerator()
+        data = make_regression_data(8)
+        model = Model(mlp_apply, init_mlp())
+        loader = NumpyDataLoader(data, batch_size=8)
+        model, opt, loader = acc.prepare(model, optax.sgd(1.0), loader)
+        batch = next(iter(loader))
+        params_before = jax.tree_util.tree_map(np.asarray, model.params)
+        with acc.accumulate(model):
+            acc.backward(mse_loss, batch)
+            gnorm = acc.clip_grad_norm_(max_norm=0.001)
+            # post-clip grads have norm <= max_norm
+            clipped_norm = float(
+                jnp.sqrt(sum(jnp.sum(jnp.square(g)) for g in jax.tree_util.tree_leaves(opt.acc_grads)))
+            )
+            opt.step()
+            opt.zero_grad()
+        assert float(gnorm) > 0.001  # pre-clip norm was larger
+        assert clipped_norm <= 0.001 * 1.01
+        # with sgd(lr=1) the param delta == clipped grad -> tiny
+        delta = max(
+            float(np.abs(np.asarray(a) - b).max())
+            for a, b in zip(jax.tree_util.tree_leaves(model.params), jax.tree_util.tree_leaves(params_before))
+        )
+        assert delta <= 0.0011
+
+    def test_fsdp_sharded_training(self):
+        acc = Accelerator(fsdp_plugin=FullyShardedDataParallelPlugin(min_weight_size_to_shard=1))
+        assert acc.mesh.shape["fsdp"] == 8
+        data = make_regression_data()
+        model = Model(mlp_apply, init_mlp(dh=16))
+        loader = NumpyDataLoader(data, batch_size=8)
+        model, opt, loader = acc.prepare(model, optax.adam(1e-2), loader)
+        # w1 (4,16): dim1 divisible by 8 -> sharded over fsdp
+        spec = model.param_shardings["w1"].spec
+        assert "fsdp" in str(spec)
+        epoch_losses = []
+        for _ in range(3):
+            total = 0.0
+            for batch in loader:
+                with acc.accumulate(model):
+                    loss = acc.backward(mse_loss, batch)
+                    opt.step()
+                    opt.zero_grad()
+                total += float(loss)
+            epoch_losses.append(total)
+        assert epoch_losses[-1] < epoch_losses[0]
+
+    def test_fp16_loss_scaling(self):
+        acc = Accelerator(mixed_precision="fp16")
+        model, opt, losses, epoch_losses = train_loop(acc, num_epochs=2)
+        assert opt.loss_scale is not None
+        assert float(opt.loss_scale.scale) > 0
+        assert epoch_losses[-1] < epoch_losses[0]
+
+    def test_fp16_nonfinite_skips_step(self):
+        acc = Accelerator(mixed_precision="fp16", kwargs_handlers=[GradScalerKwargs(init_scale=4.0)])
+        model = Model(mlp_apply, init_mlp())
+        data = make_regression_data(8)
+        loader = NumpyDataLoader(data, batch_size=8)
+        model, opt, loader = acc.prepare(model, optax.sgd(0.1), loader)
+
+        def nan_loss(params, batch):
+            return jnp.mean(params["w1"]) * jnp.nan
+
+        params_before = jax.tree_util.tree_map(np.asarray, model.params)
+        for batch in loader:
+            with acc.accumulate(model):
+                acc.backward(nan_loss, batch)
+                opt.step()
+                opt.zero_grad()
+        assert opt.step_was_skipped
+        # params unchanged, scale backed off
+        for a, b in zip(
+            jax.tree_util.tree_leaves(params_before), jax.tree_util.tree_leaves(model.params)
+        ):
+            np.testing.assert_allclose(a, np.asarray(b))
+        assert float(opt.loss_scale.scale) == 2.0
+
+
+class TestFusedStep:
+    def test_fused_matches_loop(self):
+        acc = Accelerator()
+        data = make_regression_data(32)
+        model = Model(mlp_apply, init_mlp())
+        loader = NumpyDataLoader(data, batch_size=8)
+        model, opt, loader = acc.prepare(model, optax.sgd(0.1), loader)
+        step = acc.compile_train_step(mse_loss, max_grad_norm=1.0)
+        metrics = None
+        for batch in loader:
+            metrics = step(batch)
+        assert "loss" in metrics and "grad_norm" in metrics
+        assert np.isfinite(float(metrics["loss"]))
+
+    def test_fused_accumulation(self):
+        acc = Accelerator()
+        model = Model(mlp_apply, init_mlp())
+        data = make_regression_data(32)
+        loader = NumpyDataLoader(data, batch_size=16)
+        model, opt, loader = acc.prepare(model, optax.sgd(0.1), loader)
+        step = acc.compile_train_step(mse_loss, accumulation_steps=4)
+        for batch in loader:
+            # reshape to [accum, micro, ...]
+            micro = jax.tree_util.tree_map(lambda x: np.asarray(x).reshape(4, 4, *np.shape(x)[1:]), dict(batch))
+            metrics = step(micro)
+        assert np.isfinite(float(metrics["loss"]))
+
+
+class TestSchedulers:
+    def test_scheduler_steps_with_optimizer(self):
+        from accelerate_tpu import LRScheduler
+
+        acc = Accelerator(gradient_accumulation_steps=2)
+        model = Model(mlp_apply, init_mlp())
+        data = make_regression_data(16)
+        loader = NumpyDataLoader(data, batch_size=4)
+        sched = LRScheduler(optax.linear_schedule(0.1, 0.0, 8))
+        model, opt, loader, sched = acc.prepare(model, optax.sgd(0.1), loader, sched)
+        for batch in loader:
+            with acc.accumulate(model):
+                acc.backward(mse_loss, batch)
+                opt.step()
+                sched.step()
+                opt.zero_grad()
+        # 4 batches, accum 2 -> 2 optimizer steps -> scheduler stepped twice
+        assert sched.scheduler.count == 2
+
+
+class TestGatherForMetrics:
+    def test_truncates_remainder(self):
+        acc = Accelerator()
+        gs = acc.gradient_state
+
+        class FakeLoader:
+            end_of_dataloader = True
+            remainder = 5
+
+        gs._add_dataloader(FakeLoader())
+        out = acc.gather_for_metrics(jnp.arange(8))
+        assert out.shape == (5,)
+        gs._remove_dataloader(gs.active_dataloader)
+
+    def test_no_truncation_mid_epoch(self):
+        acc = Accelerator()
+        out = acc.gather_for_metrics(jnp.arange(8))
+        assert out.shape == (8,)
+
+
+class TestMisc:
+    def test_unwrap_and_state_dict(self):
+        acc = Accelerator()
+        model = Model(mlp_apply, init_mlp())
+        model = acc.prepare(model)
+        sd = acc.get_state_dict(model)
+        assert isinstance(sd["w1"], np.ndarray)
+        inner = acc.unwrap_model(model)
+        assert isinstance(inner, Model)
+
+    def test_trigger(self):
+        acc = Accelerator()
+        assert not acc.check_trigger()
+        acc.set_trigger()
+        assert acc.check_trigger()
+        assert not acc.check_trigger()  # reset after firing
+
+    def test_accumulate_counter(self):
+        acc = Accelerator(gradient_accumulation_steps=3)
+        syncs = []
+        for i in range(6):
+            with acc.accumulate():
+                syncs.append(acc.sync_gradients)
+        assert syncs == [False, False, True, False, False, True]
+
+    def test_no_sync(self):
+        acc = Accelerator()
+        with acc.accumulate():
+            pass
+        with acc.no_sync():
+            assert not acc.sync_gradients
+        assert acc.sync_gradients

@@ -1,0 +1,210 @@
+"""Checkpoint/resume tests (reference: tests/test_state_checkpointing.py +
+checkpointing paths of test_accelerator.py)."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from accelerate_tpu import Accelerator, Model, NumpyDataLoader, LRScheduler
+from accelerate_tpu.checkpointing import (
+    flatten_params,
+    load_safetensors_model,
+    save_model,
+    unflatten_params,
+)
+from accelerate_tpu.utils import FullyShardedDataParallelPlugin, ProjectConfiguration
+
+
+def mlp_apply(params, x):
+    h = jnp.tanh(x @ params["w1"] + params["b1"])
+    return h @ params["w2"] + params["b2"]
+
+
+def init_mlp(seed=0):
+    k1, k2 = jax.random.split(jax.random.PRNGKey(seed))
+    return {
+        "w1": jax.random.normal(k1, (4, 16)) * 0.3,
+        "b1": jnp.zeros((16,)),
+        "w2": jax.random.normal(k2, (16, 1)) * 0.3,
+        "b2": jnp.zeros((1,)),
+    }
+
+
+def mse_loss(params, batch):
+    return jnp.mean((mlp_apply(params, batch["x"]) - batch["y"]) ** 2)
+
+
+def make_data(n=32):
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(n, 4)).astype(np.float32)
+    y = (x @ np.array([[1.0], [-2.0], [0.5], [3.0]], np.float32)).astype(np.float32)
+    return [{"x": x[i], "y": y[i]} for i in range(n)]
+
+
+def build(tmp_path, seed=0):
+    acc = Accelerator(project_config=ProjectConfiguration(project_dir=str(tmp_path), automatic_checkpoint_naming=True))
+    loader = NumpyDataLoader(make_data(), batch_size=8)
+    sched = LRScheduler(optax.constant_schedule(0.05))
+    model, opt, loader, sched = acc.prepare(Model(mlp_apply, init_mlp(seed)), optax.adam(0.05), loader, sched)
+    return acc, model, opt, loader, sched
+
+
+def train_steps(acc, model, opt, loader, sched, n=4):
+    it = iter(loader)
+    for _ in range(n):
+        batch = next(it)
+        with acc.accumulate(model):
+            acc.backward(mse_loss, batch)
+            opt.step()
+            sched.step()
+            opt.zero_grad()
+
+
+class TestSaveLoadState:
+    def test_roundtrip(self, tmp_path):
+        acc, model, opt, loader, sched = build(tmp_path)
+        train_steps(acc, model, opt, loader, sched)
+        params_at_save = jax.tree_util.tree_map(np.asarray, model.params)
+        out = acc.save_state()
+        assert os.path.isdir(out)
+
+        # keep training, then restore
+        train_steps(acc, model, opt, loader, sched)
+        changed = jax.tree_util.tree_map(np.asarray, model.params)
+        assert not np.allclose(changed["w1"], params_at_save["w1"])
+
+        acc.load_state()
+        restored = jax.tree_util.tree_map(np.asarray, model.params)
+        np.testing.assert_allclose(restored["w1"], params_at_save["w1"], atol=1e-6)
+        assert sched.scheduler.count == 4  # scheduler state restored
+        assert opt.steps_applied == 4
+
+    def test_resume_continues_identically(self, tmp_path):
+        """Save at step 4, run 4 more; fresh process loads + runs 4 -> same
+        params (reference: test_utils/scripts/test_checkpointing semantics)."""
+        acc, model, opt, loader, sched = build(tmp_path)
+        train_steps(acc, model, opt, loader, sched, 4)
+        acc.save_state()
+        train_steps(acc, model, opt, loader, sched, 4)
+        final_a = jax.tree_util.tree_map(np.asarray, model.params)
+
+        from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+        AcceleratorState._reset_state(); GradientState._reset_state(); PartialState._reset_state()
+        acc2, model2, opt2, loader2, sched2 = build(tmp_path, seed=1)  # different init
+        acc2.load_state()
+        train_steps(acc2, model2, opt2, loader2, sched2, 4)
+        final_b = jax.tree_util.tree_map(np.asarray, model2.params)
+        np.testing.assert_allclose(final_a["w1"], final_b["w1"], atol=1e-5)
+
+    def test_rotation_total_limit(self, tmp_path):
+        acc, model, opt, loader, sched = build(tmp_path)
+        acc.project_configuration.total_limit = 2
+        train_steps(acc, model, opt, loader, sched, 1)
+        for _ in range(4):
+            acc.save_state()
+        ckpts = sorted(os.listdir(tmp_path / "checkpoints"))
+        assert len(ckpts) == 2
+        assert ckpts == ["checkpoint_2", "checkpoint_3"]
+
+    def test_custom_objects(self, tmp_path):
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def state_dict(self):
+                return {"n": self.n}
+
+            def load_state_dict(self, sd):
+                self.n = sd["n"]
+
+        acc, model, opt, loader, sched = build(tmp_path)
+        c = Counter()
+        c.n = 7
+        acc.register_for_checkpointing(c)
+        train_steps(acc, model, opt, loader, sched, 1)
+        acc.save_state()
+        c.n = 0
+        acc.load_state()
+        assert c.n == 7
+
+    def test_register_invalid_object(self, tmp_path):
+        acc, *_ = build(tmp_path)
+        with pytest.raises(ValueError):
+            acc.register_for_checkpointing(object())
+
+    def test_rng_restored(self, tmp_path):
+        acc, model, opt, loader, sched = build(tmp_path)
+        train_steps(acc, model, opt, loader, sched, 1)
+        acc.save_state()
+        key_at_save = np.asarray(acc._rng_key)
+        acc.next_rng_key()
+        assert not np.array_equal(np.asarray(acc._rng_key), key_at_save)
+        acc.load_state()
+        np.testing.assert_array_equal(np.asarray(acc._rng_key), key_at_save)
+
+
+class TestSafetensorsExport:
+    def test_flatten_roundtrip(self):
+        tree = {"a": {"b": np.ones(2), "c": {"d": np.zeros(3)}}}
+        flat = flatten_params(tree)
+        assert set(flat) == {"a.b", "a.c.d"}
+        back = unflatten_params(flat)
+        assert back["a"]["c"]["d"].shape == (3,)
+
+    def test_save_model_single_shard(self, tmp_path):
+        acc, model, opt, loader, sched = build(tmp_path)
+        acc.save_model(model, str(tmp_path / "export"))
+        loaded = load_safetensors_model(str(tmp_path / "export"))
+        np.testing.assert_allclose(loaded["w1"], np.asarray(model.params["w1"]))
+
+    def test_save_model_sharded(self, tmp_path):
+        acc, model, opt, loader, sched = build(tmp_path)
+        acc.save_model(model, str(tmp_path / "export"), max_shard_size="100")  # bytes -> forces shards
+        files = os.listdir(tmp_path / "export")
+        assert any("index" in f for f in files)
+        loaded = load_safetensors_model(str(tmp_path / "export"))
+        np.testing.assert_allclose(loaded["w1"], np.asarray(model.params["w1"]))
+
+
+class TestFSDPShardedCheckpoint:
+    def test_sharded_save_load(self, tmp_path):
+        acc = Accelerator(
+            fsdp_plugin=FullyShardedDataParallelPlugin(min_weight_size_to_shard=1),
+            project_config=ProjectConfiguration(project_dir=str(tmp_path), automatic_checkpoint_naming=True),
+        )
+        loader = NumpyDataLoader(make_data(), batch_size=8)
+        model, opt, loader = acc.prepare(Model(mlp_apply, init_mlp()), optax.adam(0.05), loader)
+        train_steps(acc, model, opt, loader, LRScheduler(optax.constant_schedule(0.05)), 2)
+        saved = jax.tree_util.tree_map(np.asarray, model.params)
+        acc.save_state()
+        train_steps(acc, model, opt, loader, LRScheduler(optax.constant_schedule(0.05)), 2)
+        acc.load_state()
+        np.testing.assert_allclose(np.asarray(model.params["w1"]), saved["w1"], atol=1e-6)
+        # restored arrays keep their sharding
+        assert "fsdp" in str(model.params["w1"].sharding.spec)
+
+
+class TestTracking:
+    def test_jsonl_tracker(self, tmp_path):
+        acc, *_ = build(tmp_path)
+        acc._log_with = ["jsonl"]
+        acc.init_trackers("run1", config={"lr": 0.05})
+        acc.log({"loss": 1.5}, step=0)
+        acc.log({"loss": 1.0}, step=1)
+        tracker = acc.get_tracker("jsonl")
+        acc.end_training()
+        lines = [json.loads(l) for l in open(tracker.path)]
+        assert lines[0]["_type"] == "config" and lines[0]["config"]["lr"] == 0.05
+        assert lines[2]["loss"] == 1.0 and lines[2]["step"] == 1
+
+    def test_unknown_tracker_raises(self, tmp_path):
+        from accelerate_tpu.tracking import filter_trackers
+
+        with pytest.raises(ValueError):
+            filter_trackers(["not_a_tracker"], str(tmp_path))
