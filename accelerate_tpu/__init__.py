@@ -10,7 +10,18 @@ and quantization. See SURVEY.md for the capability blueprint.
 __version__ = "0.1.0"
 
 from .accelerator import AcceleratedModel, Accelerator, Model
+from .big_modeling import (
+    BlockSpec,
+    StreamedModel,
+    cpu_offload,
+    disk_offload,
+    dispatch_model,
+    init_empty_weights,
+    load_checkpoint_and_dispatch,
+    load_checkpoint_in_model,
+)
 from .data_loader import NumpyDataLoader, prepare_data_loader, skip_first_batches
+from .launchers import debug_launcher, notebook_launcher
 from .logging import get_logger
 from .optimizer import AcceleratedOptimizer
 from .precision import Policy, policy_for
@@ -34,5 +45,12 @@ from .utils.dataclasses import (
     ProfileKwargs,
     ProjectConfiguration,
     TensorParallelPlugin,
+)
+from .utils.modeling import (
+    calculate_maximum_sizes,
+    compute_module_sizes,
+    get_balanced_memory,
+    get_max_memory,
+    infer_auto_device_map,
 )
 from .utils.random import set_seed

@@ -1,0 +1,564 @@
+"""Big-model inference: run models larger than HBM (L7).
+
+TPU-native re-design of the reference's big-model stack (reference:
+src/accelerate/big_modeling.py — init_empty_weights :57, cpu_offload :170,
+disk_offload :231, dispatch_model :306, load_checkpoint_and_dispatch :504;
+src/accelerate/hooks.py — AlignDevicesHook :220).
+
+The reference's mechanism is per-module forward *hooks* that move torch
+weights between disk/CPU/GPU around each submodule call. Hooks don't exist
+in JAX — and aren't wanted: under jit every weight movement would be traced
+away or force a host sync. The TPU-native design instead:
+
+* "meta device" init        → ``jax.eval_shape`` (zero-memory abstract tree)
+* device-map solver         → pure math over the abstract tree
+  (``utils/modeling.infer_auto_device_map``) with HBM → host DRAM → disk tiers
+* hook-based streaming      → a **block-wise executor**: the model is split
+  into an embed block, N identical layer blocks, and a head block; one jitted
+  block function is compiled *once* and reused for every layer (identical
+  shapes → one XLA executable), while a background thread prefetches the next
+  block's weights host→HBM (``jax.device_put`` is async, so transfer overlaps
+  compute). Disk tiers are lazy references into the original safetensors
+  shards — no duplicate offload copy is written unless requested.
+
+Peak HBM = largest block × 2 (double buffer), matching the reference's
+"peak GPU memory == module size" property (reference:
+benchmarks/big_model_inference/README.md:43-45).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Optional, Union
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .utils.modeling import (
+    DeviceId,
+    check_device_map,
+    get_balanced_memory,
+    infer_auto_device_map,
+    named_parameters,
+)
+
+SAFE_INDEX = "model.safetensors.index.json"
+
+
+# ---------------------------------------------------------------------------
+# Abstract ("meta") initialization
+# ---------------------------------------------------------------------------
+
+def init_empty_weights(module, *example_args, rng=None, **example_kwargs):
+    """Abstract parameter tree with zero memory (reference: init_empty_weights
+    :57 patches ``register_parameter`` onto the meta device; here
+    ``jax.eval_shape`` traces ``module.init`` without allocating).
+
+    Returns the inner param tree (no ``{"params": ...}`` wrapper) of
+    ``jax.ShapeDtypeStruct`` leaves.
+    """
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    if not example_args and not example_kwargs:
+        example_args = (jnp.zeros((1, 8), jnp.int32),)
+    variables = jax.eval_shape(lambda: module.init(rng, *example_args, **example_kwargs))
+    return _unwrap_params(variables)
+
+
+def _unwrap_params(tree):
+    """Strip the flax ``{"params": ...}`` wrapper so names match flattened
+    safetensors keys. Extra variable collections (e.g. BatchNorm
+    ``batch_stats``) are dropped — the streaming executor targets inference
+    on param-only architectures; stateful collections must be handled by the
+    caller."""
+    if hasattr(tree, "keys") and "params" in set(tree.keys()):
+        return dict(tree)["params"]
+    return tree
+
+
+def _subtree(tree, prefix: str):
+    node = tree
+    for part in prefix.split("."):
+        node = node[part]
+    return node
+
+
+def _nest(flat: dict) -> dict:
+    out: dict = {}
+    for key, val in flat.items():
+        parts = key.split(".")
+        node = out
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = val
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Weight store: flat name -> resident array | lazy disk reference
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class LazyWeight:
+    """A tensor still on disk: either a safetensors shard member or a raw
+    offload memmap (reference: OffloadedWeightsLoader :127 / set_module_tensor
+    staging). Materialized only when its block is fetched."""
+
+    path: str
+    key: str
+    dtype: Optional[Any] = None  # cast target
+    memmap_info: Optional[dict] = None  # set for raw .dat memmaps (utils/offload.py)
+
+    def load(self) -> np.ndarray:
+        if self.memmap_info is not None:
+            from .utils.offload import load_offloaded_weight
+
+            arr = np.asarray(load_offloaded_weight(self.path, self.memmap_info))
+        else:
+            from safetensors import safe_open
+
+            with safe_open(self.path, framework="numpy") as f:
+                arr = f.get_tensor(self.key)
+        if self.dtype is not None:
+            arr = arr.astype(self.dtype)
+        return arr
+
+
+class WeightStore:
+    """Flat ``{param_name: entry}`` with per-name placement. Entries are
+    jax.Arrays (resident in HBM), numpy arrays (host DRAM), or LazyWeight
+    (disk)."""
+
+    def __init__(self):
+        self.entries: dict[str, Any] = {}
+        self.placement: dict[str, DeviceId] = {}
+
+    def put(self, name: str, value, device: DeviceId):
+        self.placement[name] = device
+        self.entries[name] = value
+
+    def names_under(self, prefix: str) -> list[str]:
+        return [n for n in self.entries if n == prefix or n.startswith(prefix + ".")]
+
+    def fetch_subtree(self, prefix: str, device=None):
+        """Materialize the subtree under ``prefix`` (relative names) onto
+        ``device``. Lazy/disk and host entries are read + transferred;
+        resident entries pass through."""
+        flat = {}
+        for name in self.names_under(prefix):
+            rel = name[len(prefix) + 1:] if name != prefix else name.rsplit(".", 1)[-1]
+            val = self.entries[name]
+            if isinstance(val, LazyWeight):
+                val = val.load()
+            if device is not None and not _on_device(val, device):
+                val = jax.device_put(val, device)
+            flat[rel] = val
+        return _nest(flat)
+
+    def total_bytes(self, kind: Optional[str] = None) -> int:
+        total = 0
+        for name, val in self.entries.items():
+            place = self.placement.get(name)
+            k = "disk" if isinstance(val, LazyWeight) else ("cpu" if place == "cpu" else "device")
+            if kind is None or k == kind:
+                if isinstance(val, LazyWeight):
+                    total += 0
+                else:
+                    total += int(np.prod(val.shape)) * val.dtype.itemsize if hasattr(val, "shape") else 0
+        return total
+
+
+def _on_device(val, device) -> bool:
+    if not isinstance(val, jax.Array):
+        return False
+    try:
+        return list(val.devices()) == [device]
+    except Exception:
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Block specs: how a model family splits into streamable blocks
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class BlockSpec:
+    """One streamable unit. ``apply(ptrees, *activations)`` where ``ptrees``
+    is a tuple of param subtrees, one per prefix in order. The tuple (not a
+    prefix-keyed dict) keeps the jit treedef identical across layers, so
+    blocks sharing ``kind`` share one jitted executable (all layer blocks
+    have identical param shapes -> exactly one XLA compilation)."""
+
+    name: str
+    prefixes: tuple[str, ...]
+    apply: Callable
+    kind: str = "unique"
+
+
+def block_specs_for(module) -> Optional[list[BlockSpec]]:
+    """Auto-derive block specs for the shipped model families. Returns None
+    for unknown architectures (caller must pass specs explicitly)."""
+    from .models.llama import LlamaForCausalLM
+    from .models.gpt2 import GPT2LMHeadModel
+
+    if isinstance(module, LlamaForCausalLM):
+        return _llama_block_specs(module.config)
+    if isinstance(module, GPT2LMHeadModel):
+        return _gpt2_block_specs(module.config)
+    return None
+
+
+def _llama_block_specs(cfg) -> list[BlockSpec]:
+    import flax.linen as nn
+    from .models.llama import LlamaBlock, RMSNorm
+
+    def embed_apply(ptrees, input_ids):
+        embed = nn.Embed(cfg.vocab_size, cfg.hidden_size, param_dtype=jnp.float32)
+        x = embed.apply({"params": ptrees[0]}, input_ids)
+        positions = jnp.broadcast_to(
+            jnp.arange(input_ids.shape[1], dtype=jnp.int32)[None, :], input_ids.shape)
+        return x, positions
+
+    block = LlamaBlock(cfg)
+
+    def layer_apply(ptrees, x, positions):
+        return block.apply({"params": ptrees[0]}, x, positions), positions
+
+    def head_apply(ptrees, x, positions):
+        h = RMSNorm(cfg.rms_norm_eps).apply({"params": ptrees[0]}, x)
+        if cfg.tie_word_embeddings:
+            kernel = ptrees[1]["embedding"].T
+        else:
+            kernel = ptrees[1]["kernel"]
+        return h @ kernel.astype(h.dtype)
+
+    specs = [BlockSpec("embed", ("model.embed_tokens",), embed_apply, kind="embed")]
+    for i in range(cfg.num_hidden_layers):
+        specs.append(BlockSpec(f"layers_{i}", (f"model.layers_{i}",), layer_apply, kind="layer"))
+    head_prefixes = ("model.norm", "model.embed_tokens") if cfg.tie_word_embeddings else ("model.norm", "lm_head")
+    specs.append(BlockSpec("head", head_prefixes, head_apply, kind="head"))
+    return specs
+
+
+def _gpt2_block_specs(cfg) -> list[BlockSpec]:
+    import flax.linen as nn
+    from .models.gpt2 import GPT2Block
+
+    def embed_apply(ptrees, input_ids):
+        wte = ptrees[0]["embedding"]
+        wpe = ptrees[1]["embedding"]
+        x = wte[input_ids] + wpe[jnp.arange(input_ids.shape[1])][None, :]
+        return (x,)
+
+    block = GPT2Block(cfg)
+
+    def layer_apply(ptrees, x):
+        return (block.apply({"params": ptrees[0]}, x),)
+
+    def head_apply(ptrees, x):
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps).apply({"params": ptrees[0]}, x)
+        return h @ ptrees[1]["embedding"].T.astype(h.dtype)
+
+    specs = [BlockSpec("embed", ("wte", "wpe"), embed_apply, kind="embed")]
+    for i in range(cfg.num_hidden_layers):
+        specs.append(BlockSpec(f"h_{i}", (f"h_{i}",), layer_apply, kind="layer"))
+    specs.append(BlockSpec("head", ("ln_f", "wte"), head_apply, kind="head"))
+    return specs
+
+
+# ---------------------------------------------------------------------------
+# Streamed executor
+# ---------------------------------------------------------------------------
+
+class StreamedModel:
+    """Executes a block-split model whose weights live across HBM / host DRAM
+    / disk, double-buffering host→HBM transfers (reference equivalent:
+    AlignDevicesHook pre/post_forward, hooks.py:323-390 — redesigned as
+    ahead-of-time block prefetch instead of per-module hooks).
+
+    ``__call__`` is eager Python over jitted per-kind block functions; the
+    layer blocks all share one executable. With everything resident in HBM
+    the fetch is a no-op passthrough.
+    """
+
+    def __init__(self, specs: list[BlockSpec], store: WeightStore,
+                 execution_device=None, prefetch: bool = True):
+        self.specs = specs
+        self.store = store
+        self.device = execution_device if execution_device is not None else jax.local_devices()[0]
+        self.prefetch = prefetch
+        self._jitted: dict[str, Callable] = {}
+        self._pool: Optional[ThreadPoolExecutor] = None
+        self._resident_cache: dict[str, Any] = {}
+        self._lock = threading.Lock()
+
+    def _submit(self, fn, *args):
+        if self._pool is None:
+            self._pool = ThreadPoolExecutor(max_workers=1, thread_name_prefix="weight-prefetch")
+        return self._pool.submit(fn, *args)
+
+    def close(self):
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+            self._pool = None
+
+    def __del__(self):  # best-effort; close() is the real API
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    # -- weight movement ---------------------------------------------------
+    def _fetch(self, spec: BlockSpec) -> tuple:
+        cached = self._resident_cache.get(spec.name)
+        if cached is not None:
+            return cached
+        ptrees = tuple(self.store.fetch_subtree(p, self.device) for p in spec.prefixes)
+        if all(self.store.placement.get(n) not in ("cpu", "disk")
+               for p in spec.prefixes for n in self.store.names_under(p)):
+            with self._lock:
+                self._resident_cache[spec.name] = ptrees
+        return ptrees
+
+    def _apply(self, spec: BlockSpec, ptrees: tuple, args: tuple):
+        fn = self._jitted.get(spec.kind)
+        if fn is None:
+            fn = jax.jit(spec.apply)
+            self._jitted[spec.kind] = fn
+        return fn(ptrees, *args)
+
+    # -- forward -----------------------------------------------------------
+    def __call__(self, input_ids):
+        input_ids = jax.device_put(jnp.asarray(input_ids), self.device)
+        args: tuple = (input_ids,)
+        nxt = self._submit(self._fetch, self.specs[0]) if self.prefetch else None
+        for i, spec in enumerate(self.specs):
+            ptrees = nxt.result() if nxt is not None else self._fetch(spec)
+            if self.prefetch and i + 1 < len(self.specs):
+                nxt = self._submit(self._fetch, self.specs[i + 1])
+            else:
+                nxt = None
+            out = self._apply(spec, ptrees, args)
+            args = out if isinstance(out, tuple) else (out,)
+        return args[0] if len(args) == 1 else args
+
+    def generate(self, input_ids, max_new_tokens: int = 20, eos_token_id: Optional[int] = None):
+        """Greedy decoding by repeated full forward (capability parity with
+        the reference's hook-streamed ``model.generate``; KV-cache decode is
+        a planned optimization)."""
+        ids = jnp.asarray(input_ids)
+        for _ in range(max_new_tokens):
+            logits = self(ids)
+            nxt = jnp.argmax(logits[:, -1, :], axis=-1)[:, None].astype(ids.dtype)
+            ids = jnp.concatenate([ids, nxt], axis=1)
+            if eos_token_id is not None and bool((nxt == eos_token_id).all()):
+                break
+        return ids
+
+    @property
+    def hbm_resident_bytes(self) -> int:
+        return self.store.total_bytes("device")
+
+
+# ---------------------------------------------------------------------------
+# Loading + dispatch
+# ---------------------------------------------------------------------------
+
+def _resolve_device(dev: DeviceId):
+    if isinstance(dev, int):
+        return jax.local_devices()[dev]
+    return None
+
+
+def _placement_for(name: str, device_map: dict) -> DeviceId:
+    best, best_len = None, -1
+    for prefix, dev in device_map.items():
+        if prefix == "" or name == prefix or name.startswith(prefix + "."):
+            if len(prefix) > best_len:
+                best, best_len = dev, len(prefix)
+    if best is None:
+        raise ValueError(f"{name} not covered by device_map")
+    return best
+
+
+def _checkpoint_shards(checkpoint: str) -> list[tuple[str, list[str]]]:
+    """[(shard_path, [keys])] for a safetensors file / dir / sharded dir."""
+    from safetensors import safe_open
+
+    if os.path.isfile(checkpoint):
+        paths = [checkpoint]
+    else:
+        index = os.path.join(checkpoint, SAFE_INDEX)
+        if os.path.isfile(index):
+            with open(index) as f:
+                weight_map = json.load(f)["weight_map"]
+            paths = [os.path.join(checkpoint, s) for s in sorted(set(weight_map.values()))]
+        else:
+            single = os.path.join(checkpoint, "model.safetensors")
+            if not os.path.isfile(single):
+                raise FileNotFoundError(f"No safetensors checkpoint under {checkpoint}")
+            paths = [single]
+    out = []
+    for p in paths:
+        with safe_open(p, framework="numpy") as f:
+            out.append((p, list(f.keys())))
+    return out
+
+
+def load_checkpoint_in_model(
+    abstract_params,
+    checkpoint: str,
+    device_map: Optional[dict] = None,
+    dtype=None,
+    offload_folder: Optional[str] = None,
+    offload_to_memmap: bool = False,
+) -> WeightStore:
+    """Stream safetensors shards into a placed WeightStore (reference:
+    load_checkpoint_in_model, utils/modeling.py:1683-1905).
+
+    Placement per tensor follows ``device_map`` (longest-prefix match):
+    ints → ``jax.device_put`` to that local device; ``"cpu"`` → host numpy;
+    ``"disk"`` → a LazyWeight pointing back into the original shard (no
+    copy), or a memmap copy under ``offload_folder`` when
+    ``offload_to_memmap=True`` (reference behavior, utils/offload.py:25).
+    Host RSS stays ~one shard at a time.
+    """
+    from safetensors import safe_open
+
+    device_map = device_map or {"": 0}
+    store = WeightStore()
+    expected = set(named_parameters(abstract_params).keys()) if abstract_params is not None else None
+    seen = set()
+    memmap_index: dict = {}
+
+    for shard_path, keys in _checkpoint_shards(checkpoint):
+        with safe_open(shard_path, framework="numpy") as f:
+            for key in keys:
+                if expected is not None and key not in expected:
+                    continue
+                seen.add(key)
+                place = _placement_for(key, device_map)
+                if place == "disk" and not offload_to_memmap:
+                    store.put(key, LazyWeight(shard_path, key, dtype), place)
+                    continue
+                arr = f.get_tensor(key)
+                if dtype is not None:
+                    arr = arr.astype(dtype)
+                if place == "disk":
+                    from .utils.offload import offload_weight
+
+                    memmap_index = offload_weight(arr, key, offload_folder, memmap_index)
+                    store.put(key, LazyWeight(os.path.join(offload_folder, f"{key}.dat"), key,
+                                              None, memmap_info=memmap_index[key]), place)
+                elif place == "cpu":
+                    store.put(key, arr, place)
+                else:
+                    store.put(key, jax.device_put(arr, _resolve_device(place)), place)
+    if memmap_index and offload_folder:
+        from .utils.offload import save_offload_index
+
+        save_offload_index(memmap_index, offload_folder)
+    if expected is not None:
+        missing = expected - seen
+        if missing:
+            raise ValueError(f"Checkpoint {checkpoint} is missing keys: {sorted(missing)[:5]}...")
+    return store
+
+
+def store_from_params(params, device_map: dict) -> WeightStore:
+    """Place an in-memory param tree per device_map (dispatch without a
+    checkpoint — reference: dispatch_model on a materialized model)."""
+    store = WeightStore()
+    for name, leaf in named_parameters(params).items():
+        place = _placement_for(name, device_map)
+        if place == "cpu":
+            store.put(name, np.asarray(jax.device_get(leaf)), place)
+        elif place == "disk":
+            raise ValueError("store_from_params cannot disk-offload; use load_checkpoint_in_model "
+                             "or offload_state_dict first")
+        else:
+            store.put(name, jax.device_put(leaf, _resolve_device(place)), place)
+    return store
+
+
+def dispatch_model(
+    module,
+    params=None,
+    store: Optional[WeightStore] = None,
+    device_map: Optional[dict] = None,
+    block_specs: Optional[list[BlockSpec]] = None,
+    execution_device=None,
+) -> StreamedModel:
+    """Wrap a model for execution with weights spread over HBM/host/disk
+    (reference: dispatch_model, big_modeling.py:306 — hook attachment
+    replaced by the block-streaming executor)."""
+    specs = block_specs or block_specs_for(module)
+    if specs is None:
+        raise ValueError(
+            f"No block specs known for {type(module).__name__}; pass block_specs=[BlockSpec(...)]")
+    if store is None:
+        if params is None:
+            raise ValueError("dispatch_model needs params or a WeightStore")
+        device_map = device_map or {"": 0}
+        store = store_from_params(params, device_map)
+    exec_dev = execution_device
+    if exec_dev is None:
+        dev_ids = [d for d in store.placement.values() if isinstance(d, int)]
+        exec_dev = jax.local_devices()[dev_ids[0] if dev_ids else 0]
+    return StreamedModel(specs, store, exec_dev)
+
+
+def load_checkpoint_and_dispatch(
+    module,
+    checkpoint: str,
+    device_map: Union[str, dict, None] = "auto",
+    max_memory: Optional[dict] = None,
+    no_split_module_classes: Optional[list[str]] = None,
+    dtype=None,
+    offload_folder: Optional[str] = None,
+    offload_to_memmap: bool = False,
+    example_args: tuple = (),
+    block_specs: Optional[list[BlockSpec]] = None,
+) -> StreamedModel:
+    """One-call big-model load (reference: load_checkpoint_and_dispatch,
+    big_modeling.py:504): abstract init → device-map solve → shard-streamed
+    load → streaming executor."""
+    abstract = init_empty_weights(module, *example_args)
+    if device_map in ("auto", "balanced", None):
+        balanced = device_map == "balanced"
+        mm = (get_balanced_memory(abstract, max_memory=max_memory,
+                                  no_split_module_classes=no_split_module_classes, dtype=dtype)
+              if balanced else max_memory)
+        device_map = infer_auto_device_map(
+            abstract, max_memory=mm, no_split_module_classes=no_split_module_classes, dtype=dtype)
+    check_device_map(abstract, device_map)
+    store = load_checkpoint_in_model(
+        abstract, checkpoint, device_map=device_map, dtype=dtype,
+        offload_folder=offload_folder, offload_to_memmap=offload_to_memmap)
+    return dispatch_model(module, store=store, block_specs=block_specs)
+
+
+def cpu_offload(module, params, execution_device=None, block_specs=None) -> StreamedModel:
+    """All weights in host DRAM, streamed block-by-block into HBM
+    (reference: cpu_offload, big_modeling.py:170)."""
+    return dispatch_model(module, params=params, device_map={"": "cpu"},
+                          block_specs=block_specs, execution_device=execution_device)
+
+
+def disk_offload(module, checkpoint: str, offload_folder: Optional[str] = None,
+                 execution_device=None, block_specs=None, example_args=()) -> StreamedModel:
+    """All weights on disk, streamed per block (reference: disk_offload,
+    big_modeling.py:231). Without ``offload_folder`` the store keeps lazy
+    refs into the original safetensors shards (zero-copy); with one, weights
+    are re-written as raw memmaps there (reference behavior)."""
+    abstract = init_empty_weights(module, *example_args)
+    store = load_checkpoint_in_model(abstract, checkpoint, device_map={"": "disk"},
+                                     offload_folder=offload_folder,
+                                     offload_to_memmap=offload_folder is not None)
+    return dispatch_model(module, store=store, block_specs=block_specs,
+                          execution_device=execution_device)

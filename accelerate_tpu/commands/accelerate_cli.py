@@ -10,23 +10,25 @@ import argparse
 import sys
 
 
-def _subcommand_modules():
-    # name -> (module, parser-registration fn name)
-    from . import config as config_cmd  # noqa: F401
-    from . import env as env_cmd
-    from . import estimate as estimate_cmd
-    from . import launch as launch_cmd
-    from . import merge as merge_cmd
-    from . import test as test_cmd
-    from .config import config as config_entry
+def _subcommand_registrars():
+    """name -> registrar import, resolved lazily so one broken subcommand
+    can't take down the rest."""
+
+    def _lazy(module: str, attr: str):
+        def load():
+            import importlib
+
+            return getattr(importlib.import_module(module, __package__), attr)
+
+        return load
 
     return {
-        "config": config_entry.config_command_parser,
-        "env": env_cmd.env_command_parser,
-        "estimate-memory": estimate_cmd.estimate_command_parser,
-        "launch": launch_cmd.launch_command_parser,
-        "merge-weights": merge_cmd.merge_command_parser,
-        "test": test_cmd.test_command_parser,
+        "config": _lazy(".config.config", "config_command_parser"),
+        "env": _lazy(".env", "env_command_parser"),
+        "estimate-memory": _lazy(".estimate", "estimate_command_parser"),
+        "launch": _lazy(".launch", "launch_command_parser"),
+        "merge-weights": _lazy(".merge", "merge_command_parser"),
+        "test": _lazy(".test", "test_command_parser"),
     }
 
 
@@ -35,11 +37,11 @@ def main():
         "accelerate-tpu", usage="accelerate-tpu <command> [<args>]", allow_abbrev=False
     )
     subparsers = parser.add_subparsers(help="accelerate-tpu command helpers", dest="command")
-    try:
-        for register in _subcommand_modules().values():
-            register(subparsers=subparsers)
-    except ImportError as e:  # partial build: some subcommands may not exist yet
-        print(f"warning: some subcommands unavailable ({e})", file=sys.stderr)
+    for name, load in _subcommand_registrars().items():
+        try:
+            load()(subparsers=subparsers)
+        except ImportError as e:  # partial build: register the rest anyway
+            print(f"warning: subcommand {name} unavailable ({e})", file=sys.stderr)
 
     args = parser.parse_args()
     if not hasattr(args, "func"):

@@ -1,0 +1,86 @@
+"""`accelerate-tpu config` — interactive wizard writing the default YAML
+(reference: commands/config/config.py :99 + cluster.py questionnaire :54,
+menu UI collapsed into plain prompts)."""
+
+from __future__ import annotations
+
+import argparse
+from typing import Optional
+
+from .config_args import ClusterConfig, default_config_file
+from .default import write_basic_config
+
+
+def _ask(question: str, default: str, choices: Optional[list[str]] = None) -> str:
+    suffix = f" [{'/'.join(choices)}] ({default})" if choices else f" ({default})"
+    try:
+        answer = input(f"{question}{suffix}: ").strip()
+    except EOFError:
+        answer = ""
+    if not answer:
+        return default
+    if choices and answer not in choices:
+        print(f"  invalid choice {answer!r}, using {default!r}")
+        return default
+    return answer
+
+
+def _ask_int(question: str, default: int) -> int:
+    raw = _ask(question, str(default))
+    try:
+        return int(raw)
+    except ValueError:
+        return default
+
+
+def get_user_input() -> ClusterConfig:
+    cfg = ClusterConfig()
+    cfg.compute_environment = _ask(
+        "Compute environment", "LOCAL_MACHINE", ["LOCAL_MACHINE", "TPU_POD"])
+    if cfg.compute_environment == "TPU_POD":
+        cfg.num_machines = _ask_int("Number of TPU hosts (processes)", 1)
+        if cfg.num_machines > 1:
+            cfg.main_process_ip = _ask("Coordinator (host 0) IP", "") or None
+            cfg.main_process_port = _ask_int("Coordinator port", 8476)
+            cfg.machine_rank = _ask_int("Rank of this host", 0)
+        cfg.tpu_name = _ask("TPU name (for gcloud orchestration, blank to skip)", "") or None
+        cfg.tpu_zone = _ask("TPU zone", "") or None
+    cfg.mixed_precision = _ask("Mixed precision", "bf16", ["no", "bf16", "fp16"])
+    cfg.mesh_dp = _ask_int("Mesh: data-parallel size (-1 = all remaining devices)", -1)
+    cfg.mesh_fsdp = _ask_int("Mesh: FSDP (param-shard) size", 1)
+    cfg.mesh_tp = _ask_int("Mesh: tensor-parallel size", 1)
+    cfg.mesh_cp = _ask_int("Mesh: context-parallel size (long sequences)", 1)
+    cfg.mesh_pp = _ask_int("Mesh: pipeline-parallel size", 1)
+    cfg.mesh_ep = _ask_int("Mesh: expert-parallel size (MoE)", 1)
+    cfg.debug = _ask("Enable debug mode (collective shape checks)", "no", ["yes", "no"]) == "yes"
+    return cfg
+
+
+def config_command(args) -> int:
+    if args.default:
+        path = write_basic_config(mixed_precision=args.mixed_precision,
+                                  config_file=args.config_file)
+        print(f"accelerate-tpu config written to {path}")
+        return 0
+    cfg = get_user_input()
+    path = cfg.save(args.config_file)
+    print(f"accelerate-tpu config saved to {path}")
+    return 0
+
+
+def config_command_parser(subparsers=None):
+    description = "Create the launch config file"
+    if subparsers is not None:
+        parser = subparsers.add_parser("config", description=description)
+    else:
+        parser = argparse.ArgumentParser("accelerate-tpu config", description=description)
+    parser.add_argument(
+        "--config_file", default=None,
+        help=f"Where to write the config (default {default_config_file()})")
+    parser.add_argument(
+        "--default", action="store_true",
+        help="Skip the questionnaire; write a sensible single-host default")
+    parser.add_argument("--mixed_precision", default="bf16", choices=["no", "bf16", "fp16"])
+    if subparsers is not None:
+        parser.set_defaults(func=config_command)
+    return parser

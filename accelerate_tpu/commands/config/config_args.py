@@ -1,0 +1,128 @@
+"""Persisted launch configuration (reference:
+src/accelerate/commands/config/config_args.py — BaseConfig :74,
+ClusterConfig :179 — redesigned around the TPU mesh instead of
+process-group fields).
+
+The config file is the single source of truth `accelerate-tpu launch`
+merges CLI flags into; everything reaches the runtime as
+``ACCELERATE_TPU_*`` env vars (see state.py / parallel/mesh.py), mirroring
+the reference's three-stage config pipeline (SURVEY.md §5 config system).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Optional
+
+import yaml
+
+default_config_dir = Path(
+    os.environ.get("ACCELERATE_TPU_CONFIG_DIR", Path.home() / ".cache" / "accelerate_tpu")
+)
+
+
+def default_config_file() -> Path:
+    return default_config_dir / "default_config.yaml"
+
+
+def load_config_from_file(config_file: Optional[str] = None) -> "ClusterConfig":
+    """Load YAML/JSON config; returns defaults if no file exists (reference:
+    load_config_from_file, config_args.py:48)."""
+    path = Path(config_file) if config_file else default_config_file()
+    if not path.exists():
+        if config_file:
+            raise FileNotFoundError(f"Config file {path} not found")
+        return ClusterConfig()
+    text = path.read_text()
+    data = json.loads(text) if path.suffix == ".json" else yaml.safe_load(text)
+    data = data or {}
+    known = {f.name for f in dataclasses.fields(ClusterConfig)}
+    extra = {k: v for k, v in data.items() if k not in known}
+    cfg = ClusterConfig(**{k: v for k, v in data.items() if k in known})
+    cfg.extra = extra
+    return cfg
+
+
+@dataclass
+class ClusterConfig:
+    """TPU-first launch config. The reference's rdzv/process-group fields
+    collapse into JAX's one-process-per-host model: a coordinator address +
+    host count + this host's id (reference fields: config_args.py:179-234)."""
+
+    compute_environment: str = "LOCAL_MACHINE"  # or TPU_POD
+    mixed_precision: str = "no"                 # no|bf16|fp16
+    debug: bool = False
+
+    # Mesh shape (parallel/mesh.py MeshConfig axes); -1 = absorb remainder.
+    mesh_dp: int = -1
+    mesh_fsdp: int = 1
+    mesh_tp: int = 1
+    mesh_cp: int = 1
+    mesh_ep: int = 1
+    mesh_pp: int = 1
+    mesh_dcn_axis: str = "dp"
+
+    # Multi-host (TPU pod / multi-slice).
+    num_machines: int = 1
+    machine_rank: int = 0
+    main_process_ip: Optional[str] = None
+    main_process_port: int = 8476
+
+    # TPU pod orchestration (gcloud) — reference: commands/tpu.py.
+    tpu_name: Optional[str] = None
+    tpu_zone: Optional[str] = None
+
+    # CPU emulation for debugging (the framework's "fake backend").
+    use_cpu_emulation: bool = False
+    emulated_device_count: int = 8
+
+    extra: dict = field(default_factory=dict, repr=False)
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d.pop("extra", None)
+        d = {k: v for k, v in d.items() if v is not None}
+        return d
+
+    def save(self, config_file: Optional[str] = None) -> Path:
+        path = Path(config_file) if config_file else default_config_file()
+        path.parent.mkdir(parents=True, exist_ok=True)
+        with open(path, "w") as f:
+            if path.suffix == ".json":
+                json.dump(self.to_dict(), f, indent=2)
+            else:
+                yaml.safe_dump(self.to_dict(), f, default_flow_style=False)
+        return path
+
+    def launch_env(self) -> dict[str, str]:
+        """Env-var encoding consumed by PartialState / MeshConfig.from_env
+        (reference: utils/launch.py prepare_*_env :184-313)."""
+        from ...utils.environment import env_var
+
+        env = {
+            env_var("MIXED_PRECISION"): self.mixed_precision,
+            env_var("MESH_DP"): str(self.mesh_dp),
+            env_var("MESH_FSDP"): str(self.mesh_fsdp),
+            env_var("MESH_TP"): str(self.mesh_tp),
+            env_var("MESH_CP"): str(self.mesh_cp),
+            env_var("MESH_EP"): str(self.mesh_ep),
+            env_var("MESH_PP"): str(self.mesh_pp),
+            env_var("MESH_DCN_AXIS"): self.mesh_dcn_axis,
+        }
+        if self.debug:
+            env[env_var("DEBUG")] = "true"
+        if self.num_machines > 1 and self.main_process_ip:
+            env[env_var("COORDINATOR_ADDRESS")] = f"{self.main_process_ip}:{self.main_process_port}"
+            env[env_var("NUM_PROCESSES")] = str(self.num_machines)
+            env[env_var("PROCESS_ID")] = str(self.machine_rank)
+        if self.use_cpu_emulation:
+            env["JAX_PLATFORMS"] = "cpu"
+            flags = os.environ.get("XLA_FLAGS", "")
+            env["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count={self.emulated_device_count}".strip()
+            )
+        return env

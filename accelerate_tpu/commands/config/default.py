@@ -1,0 +1,13 @@
+"""Non-interactive default config (reference: commands/config/default.py
+write_basic_config :142 vicinity)."""
+
+from __future__ import annotations
+
+from .config_args import ClusterConfig
+
+
+def write_basic_config(mixed_precision: str = "bf16", config_file=None):
+    """Single-host, all-devices-data-parallel default; bf16 because TPU
+    matmul throughput doubles and the MXU natively accumulates f32."""
+    cfg = ClusterConfig(mixed_precision=mixed_precision)
+    return cfg.save(config_file)

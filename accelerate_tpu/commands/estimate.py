@@ -1,0 +1,99 @@
+"""`accelerate-tpu estimate-memory` (reference: commands/estimate.py :309).
+
+The reference materializes a meta-model from the HF Hub and tabulates
+per-dtype sizes via ``calculate_maximum_sizes``. Here the abstract tree
+comes from ``jax.eval_shape`` over the built-in model families (no network
+needed; this environment has no egress), and the table adds the numbers a
+TPU user actually plans HBM around: params, gradients, Adam moments (fp32
+master + 2 moments), and the per-chip share under an FSDP mesh axis.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def _model_registry():
+    from ..models.bert import BertConfig, BertForSequenceClassification
+    from ..models.gpt2 import GPT2Config, GPT2LMHeadModel
+    from ..models.llama import LlamaConfig, LlamaForCausalLM
+
+    def llama(name):
+        return lambda: LlamaForCausalLM(getattr(LlamaConfig, name)())
+
+    reg = {
+        "llama3-8b": llama("llama3_8b"),
+        "llama-tiny": llama("tiny"),
+    }
+    for attr in ("llama2_7b", "llama2_13b", "llama3_70b"):
+        if hasattr(LlamaConfig, attr):
+            reg[attr.replace("_", "-")] = llama(attr)
+    if hasattr(GPT2Config, "gpt2"):
+        reg["gpt2"] = lambda: GPT2LMHeadModel(GPT2Config.gpt2())
+    if hasattr(BertConfig, "base"):
+        reg["bert-base"] = lambda: BertForSequenceClassification(BertConfig.base())
+    return reg
+
+
+def _fmt(nbytes: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if nbytes < 1024 or unit == "TiB":
+            return f"{nbytes:.2f} {unit}" if unit != "B" else f"{int(nbytes)} B"
+        nbytes /= 1024
+    return f"{nbytes:.2f} TiB"
+
+
+def estimate_command(args) -> int:
+    import jax.numpy as jnp
+
+    from ..big_modeling import init_empty_weights
+    from ..utils.modeling import calculate_maximum_sizes, compute_module_sizes
+
+    registry = _model_registry()
+    if args.model_name not in registry:
+        print(f"Unknown model {args.model_name!r}. Available: {', '.join(sorted(registry))}")
+        return 2
+    module = registry[args.model_name]()
+    abstract = init_empty_weights(module)
+    n_params = sum(
+        int(__import__("numpy").prod(l.shape))
+        for l in __import__("jax").tree_util.tree_leaves(abstract))
+
+    dtypes = {"float32": jnp.float32, "bfloat16": jnp.bfloat16, "int8": "int8", "int4": "int4"}
+    selected = [d for d in args.dtypes if d in dtypes]
+    print(f"Model: {args.model_name}  ({n_params / 1e9:.2f} B params)")
+    header = f"{'dtype':>9} | {'largest layer':>14} | {'total size':>11} | {'training (Adam)':>16}"
+    if args.fsdp > 1:
+        header += f" | per-chip (fsdp={args.fsdp})"
+    print(header)
+    print("-" * len(header))
+    for name in selected:
+        dt = dtypes[name]
+        total, (largest, _) = calculate_maximum_sizes(
+            abstract, no_split=[r"layers_\d+", r"h_\d+"], dtype=dt)
+        # Training: bf16/fp32 params + same-dtype grads + fp32 master + 2 fp32
+        # Adam moments (optax adamw); reference uses 4x fp32 params heuristic
+        # (commands/estimate.py table).
+        param_f32 = compute_module_sizes(abstract, dtype=jnp.float32)[""]
+        training = total * 2 + param_f32 * 3 if name in ("float32", "bfloat16") else float("nan")
+        row = f"{name:>9} | {_fmt(largest):>14} | {_fmt(total):>11} | "
+        row += f"{_fmt(training):>16}" if training == training else f"{'n/a (inference)':>16}"
+        if args.fsdp > 1 and training == training:
+            row += f" | {_fmt(training / args.fsdp):>14}"
+        print(row)
+    return 0
+
+
+def estimate_command_parser(subparsers=None):
+    description = "Estimate HBM needed for inference/training of a model family"
+    if subparsers is not None:
+        parser = subparsers.add_parser("estimate-memory", description=description)
+    else:
+        parser = argparse.ArgumentParser("accelerate-tpu estimate-memory", description=description)
+    parser.add_argument("model_name", help="Built-in model name (e.g. llama3-8b)")
+    parser.add_argument("--dtypes", nargs="+", default=["float32", "bfloat16", "int8", "int4"])
+    parser.add_argument("--fsdp", type=int, default=1,
+                        help="Also print the per-chip share under this FSDP axis size")
+    if subparsers is not None:
+        parser.set_defaults(func=estimate_command)
+    return parser

@@ -1,0 +1,148 @@
+"""`accelerate-tpu launch` (reference: commands/launch.py :140-1184).
+
+TPU-first redesign of the launch layer. The reference forks one process per
+GPU via torch elastic (`multi_gpu_launcher` :774) or per TPU core via
+`xmp.spawn` (`tpu_launcher` :862). JAX inverts this: **one process per
+host**, all local chips driven by that process, multi-host rendezvous via
+`jax.distributed.initialize`. So:
+
+* single host  → one subprocess with mesh/precision env (reference
+  `simple_launcher` :762 is the right shape, not the elastic agent)
+* TPU pod      → same command on every host; host identity comes from TPU
+  metadata (JAX autodetects) or explicit coordinator env vars; the
+  `--gcloud` path SSHes the command to all pod workers like the reference's
+  `tpu_pod_launcher` :893 does via xla_dist
+* debugging    → `--use_cpu_emulation` runs the script on N virtual CPU
+  devices (the framework's fake backend; SURVEY.md §4 takeaway)
+
+Everything is communicated through ``ACCELERATE_TPU_*`` env vars, mirroring
+the reference's env-var bridge (utils/launch.py :184-313).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+
+from .config.config_args import ClusterConfig, load_config_from_file
+
+
+def launch_command_parser(subparsers=None):
+    description = "Launch a training script on this host's TPU devices (or a pod)"
+    if subparsers is not None:
+        parser = subparsers.add_parser("launch", description=description, allow_abbrev=False)
+    else:
+        parser = argparse.ArgumentParser("accelerate-tpu launch", description=description,
+                                         allow_abbrev=False)
+    parser.add_argument("--config_file", default=None, help="Config YAML to launch with")
+    parser.add_argument("--mixed_precision", default=None, choices=["no", "bf16", "fp16"])
+    parser.add_argument("--debug", action="store_true", default=None,
+                        help="Enable collective shape checking (reference: launch --debug)")
+    # Mesh overrides.
+    parser.add_argument("--dp", type=int, default=None, help="data-parallel mesh axis")
+    parser.add_argument("--fsdp", type=int, default=None, help="param-shard (ZeRO/FSDP) mesh axis")
+    parser.add_argument("--tp", type=int, default=None, help="tensor-parallel mesh axis")
+    parser.add_argument("--cp", type=int, default=None, help="context-parallel mesh axis")
+    parser.add_argument("--ep", type=int, default=None, help="expert-parallel mesh axis")
+    parser.add_argument("--pp", type=int, default=None, help="pipeline-parallel mesh axis")
+    # Multi-host.
+    parser.add_argument("--num_machines", type=int, default=None, help="number of hosts")
+    parser.add_argument("--machine_rank", type=int, default=None, help="this host's id")
+    parser.add_argument("--main_process_ip", default=None)
+    parser.add_argument("--main_process_port", type=int, default=None)
+    parser.add_argument("--gcloud", action="store_true",
+                        help="Run the command on every worker of --tpu_name via gcloud ssh "
+                             "(reference: tpu_pod_launcher :893)")
+    parser.add_argument("--tpu_name", default=None)
+    parser.add_argument("--tpu_zone", default=None)
+    # Debug backend.
+    parser.add_argument("--use_cpu_emulation", action="store_true", default=None,
+                        help="Run on N virtual CPU devices instead of TPU")
+    parser.add_argument("--emulated_device_count", type=int, default=None)
+    parser.add_argument("--module", action="store_true",
+                        help="Interpret the script as a python module (python -m)")
+    parser.add_argument("training_script", help="Script to launch")
+    parser.add_argument("training_script_args", nargs=argparse.REMAINDER,
+                        help="Arguments passed through to the script")
+    if subparsers is not None:
+        parser.set_defaults(func=launch_command)
+    return parser
+
+
+_OVERRIDES = [
+    ("mixed_precision", "mixed_precision"), ("debug", "debug"),
+    ("dp", "mesh_dp"), ("fsdp", "mesh_fsdp"), ("tp", "mesh_tp"),
+    ("cp", "mesh_cp"), ("ep", "mesh_ep"), ("pp", "mesh_pp"),
+    ("num_machines", "num_machines"), ("machine_rank", "machine_rank"),
+    ("main_process_ip", "main_process_ip"), ("main_process_port", "main_process_port"),
+    ("tpu_name", "tpu_name"), ("tpu_zone", "tpu_zone"),
+    ("use_cpu_emulation", "use_cpu_emulation"),
+    ("emulated_device_count", "emulated_device_count"),
+]
+
+
+def _resolve_config(args) -> ClusterConfig:
+    """Config file + CLI flags → effective config (reference:
+    _validate_launch_command :972 merge semantics — CLI wins)."""
+    cfg = load_config_from_file(args.config_file)
+    for arg_name, cfg_name in _OVERRIDES:
+        val = getattr(args, arg_name, None)
+        if val is not None:
+            setattr(cfg, cfg_name, val)
+    return cfg
+
+
+def _build_command(args) -> list[str]:
+    cmd = [sys.executable]
+    if args.module:
+        cmd += ["-m", args.training_script]
+    else:
+        cmd += [args.training_script]
+    return cmd + list(args.training_script_args)
+
+
+def simple_launcher(args, cfg: ClusterConfig) -> int:
+    """One subprocess on this host (reference: simple_launcher :762)."""
+    env = {**os.environ, **cfg.launch_env()}
+    cmd = _build_command(args)
+    proc = subprocess.run(cmd, env=env)
+    return proc.returncode
+
+
+def gcloud_pod_launcher(args, cfg: ClusterConfig) -> int:
+    """Replicate the command onto every pod worker via `gcloud compute tpus
+    tpu-vm ssh --worker=all` (reference: tpu_pod_launcher :893 /
+    commands/tpu.py). On the workers, JAX's TPU runtime autodetects host
+    identity, so no per-worker env differs."""
+    if not cfg.tpu_name:
+        print("--gcloud requires --tpu_name (or tpu_name in the config file)", file=sys.stderr)
+        return 2
+    inner_env = " ".join(f"{k}={v!r}" for k, v in cfg.launch_env().items())
+    inner_cmd = " ".join(_build_command(args))
+    remote = f"cd {os.getcwd()} && {inner_env} {inner_cmd}"
+    cmd = ["gcloud", "compute", "tpus", "tpu-vm", "ssh", cfg.tpu_name,
+           "--worker=all", f"--command={remote}"]
+    if cfg.tpu_zone:
+        cmd.insert(5, f"--zone={cfg.tpu_zone}")
+    print("Running:", " ".join(cmd))
+    return subprocess.run(cmd).returncode
+
+
+def launch_command(args) -> int:
+    cfg = _resolve_config(args)
+    if args.gcloud or (cfg.compute_environment == "TPU_POD" and cfg.tpu_name
+                       and cfg.machine_rank == 0):
+        return gcloud_pod_launcher(args, cfg)
+    return simple_launcher(args, cfg)
+
+
+def main():
+    parser = launch_command_parser()
+    args = parser.parse_args()
+    return launch_command(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main() or 0)

@@ -1,0 +1,52 @@
+"""`accelerate-tpu merge-weights` — consolidate a sharded/distributed
+checkpoint into plain safetensors (reference: commands/merge.py :69 over
+merge_fsdp_weights, utils/fsdp_utils.py:274).
+
+Works on either layout this framework writes:
+* an orbax/tensorstore model dir from ``Accelerator.save_state``
+* a sharded safetensors export from ``Accelerator.save_model``
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from pathlib import Path
+
+
+def merge_command(args) -> int:
+    import numpy as np
+
+    from ..checkpointing import flatten_params, load_array_tree, load_safetensors_model
+
+    src = Path(args.checkpoint_dir)
+    if not src.exists():
+        print(f"{src} does not exist")
+        return 2
+    if (src / "model.safetensors.index.json").exists() or (src / "model.safetensors").exists():
+        tree = load_safetensors_model(str(src))
+    else:
+        tree = load_array_tree(str(src))
+
+    from safetensors.numpy import save_file
+
+    out = Path(args.output_path)
+    out.parent.mkdir(parents=True, exist_ok=True)
+    flat = {k: np.ascontiguousarray(np.asarray(v)) for k, v in flatten_params(tree).items()}
+    save_file(flat, str(out))
+    total = sum(v.nbytes for v in flat.values())
+    print(f"Merged {len(flat)} tensors ({total / 2**20:.1f} MiB) -> {out}")
+    return 0
+
+
+def merge_command_parser(subparsers=None):
+    description = "Consolidate a sharded checkpoint into a single safetensors file"
+    if subparsers is not None:
+        parser = subparsers.add_parser("merge-weights", description=description)
+    else:
+        parser = argparse.ArgumentParser("accelerate-tpu merge-weights", description=description)
+    parser.add_argument("checkpoint_dir", help="save_state model dir or sharded safetensors dir")
+    parser.add_argument("output_path", help="Output .safetensors path")
+    if subparsers is not None:
+        parser.set_defaults(func=merge_command)
+    return parser

@@ -1,0 +1,43 @@
+"""`accelerate-tpu test` — sanity-check the install by running the omnibus
+correctness script on emulated devices (reference: commands/test.py :66
+runs test_utils/scripts/test_script.py under accelerate-launch)."""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+
+
+def test_command(args) -> int:
+    env = dict(os.environ)
+    if args.cpu:
+        env["ACCELERATE_TPU_TEST_CPU"] = "1"
+        env["ACCELERATE_TPU_TEST_DEVICES"] = str(args.num_devices)
+        env["JAX_PLATFORMS"] = "cpu"
+        flags = env.get("XLA_FLAGS", "")
+        if "--xla_force_host_platform_device_count" not in flags:
+            env["XLA_FLAGS"] = f"{flags} --xla_force_host_platform_device_count={args.num_devices}".strip()
+    cmd = [sys.executable, "-m", "accelerate_tpu.test_utils.scripts.test_script"]
+    print("Running:", " ".join(cmd))
+    rc = subprocess.run(cmd, env=env).returncode
+    print("Test is a success! You are ready for your distributed training!" if rc == 0
+          else f"Test FAILED (exit {rc})")
+    return rc
+
+
+def test_command_parser(subparsers=None):
+    description = "Run the omnibus correctness script to validate the setup"
+    if subparsers is not None:
+        parser = subparsers.add_parser("test", description=description)
+    else:
+        parser = argparse.ArgumentParser("accelerate-tpu test", description=description)
+    parser.add_argument("--cpu", action="store_true", default=True,
+                        help="Run on emulated CPU devices (default; use --no-cpu for real TPU)")
+    parser.add_argument("--no-cpu", dest="cpu", action="store_false")
+    parser.add_argument("--num_devices", type=int, default=8,
+                        help="Emulated device count under --cpu")
+    if subparsers is not None:
+        parser.set_defaults(func=test_command)
+    return parser

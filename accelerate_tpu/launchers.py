@@ -1,0 +1,71 @@
+"""In-process launchers (reference: src/accelerate/launchers.py —
+notebook_launcher :40, debug_launcher :269).
+
+The reference forks one process per device (`xmp.spawn` on TPU :135-150,
+elastic on GPU :231-245) because torch needs a process per accelerator.
+JAX drives every local chip from ONE process, so "launching" from a
+notebook is environment setup, not forking — which also sidesteps the
+reference's fork-after-CUDA-init failure modes (launchers.py:177-186).
+Multi-host notebooks (one kernel per host) pass coordinator details.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+def notebook_launcher(
+    function,
+    args=(),
+    num_processes: Optional[int] = None,
+    mixed_precision: str = "no",
+    use_port: str = "29500",
+    master_addr: Optional[str] = None,
+    node_rank: int = 0,
+    num_nodes: int = 1,
+    debug: bool = False,
+    **mesh_axes: int,
+):
+    """Run ``function(*args)`` configured for this host's devices.
+
+    ``num_processes`` is accepted for API parity; on JAX it must equal the
+    host count (devices are not processes). ``mesh_axes`` (dp/fsdp/tp/cp/
+    ep/pp) seed the mesh env exactly like `accelerate-tpu launch` flags.
+    """
+    from .utils.environment import env_var, patch_environment
+
+    env: dict[str, str] = {}
+    if num_nodes > 1:
+        if master_addr is None:
+            raise ValueError("multi-node notebook_launcher needs master_addr")
+        env[env_var("COORDINATOR_ADDRESS")] = f"{master_addr}:{use_port}"
+        env[env_var("NUM_PROCESSES")] = str(num_nodes)
+        env[env_var("PROCESS_ID")] = str(node_rank)
+    if mixed_precision != "no":
+        env[env_var("MIXED_PRECISION")] = mixed_precision
+    if debug:
+        env[env_var("DEBUG")] = "true"
+    for ax, size in mesh_axes.items():
+        if ax in ("dp", "fsdp", "tp", "cp", "ep", "pp"):
+            env[env_var(f"MESH_{ax.upper()}")] = str(size)
+    env[env_var("FORK_LAUNCHED")] = "false"
+    try:
+        with patch_environment(**env):
+            return function(*args)
+    finally:
+        from .state import AcceleratorState, GradientState, PartialState
+
+        AcceleratorState._reset_state()
+        GradientState._reset_state()
+        PartialState._reset_state()
+
+
+def debug_launcher(function, args=(), num_processes: int = 8):
+    """Run ``function`` on N emulated CPU devices (reference: debug_launcher
+    :269 forks CPU workers with a file-store rendezvous; here emulation is
+    in-process via the host-platform device count)."""
+    from .test_utils import use_emulated_devices
+
+    use_emulated_devices(num_processes)
+    return notebook_launcher(function, args)

@@ -1,0 +1,42 @@
+"""Deterministic tiny training fixtures (reference:
+test_utils/training.py — RegressionDataset :22, RegressionModel :50)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def RegressionData(n: int = 64, seed: int = 0):
+    """List of {'x','y'} samples with a fixed linear ground truth."""
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 4)).astype(np.float32)
+    w = np.array([[1.0], [-2.0], [0.5], [3.0]], dtype=np.float32)
+    y = x @ w + 0.1 * rng.normal(size=(n, 1)).astype(np.float32)
+    return [{"x": x[i], "y": y[i]} for i in range(n)]
+
+
+def init_mlp(seed: int = 0, din: int = 4, dh: int = 16, dout: int = 1):
+    import jax
+    import jax.numpy as jnp
+
+    k1, k2 = jax.random.split(jax.random.PRNGKey(seed))
+    return {
+        "w1": jax.random.normal(k1, (din, dh)) * 0.3,
+        "b1": jnp.zeros((dh,)),
+        "w2": jax.random.normal(k2, (dh, dout)) * 0.3,
+        "b2": jnp.zeros((dout,)),
+    }
+
+
+def mlp_apply(params, x):
+    import jax.numpy as jnp
+
+    h = jnp.tanh(x @ params["w1"] + params["b1"])
+    return h @ params["w2"] + params["b2"]
+
+
+def mse_loss(params, batch):
+    import jax.numpy as jnp
+
+    pred = mlp_apply(params, batch["x"])
+    return jnp.mean((pred - batch["y"]) ** 2)

@@ -1,0 +1,153 @@
+"""CLI + config + launcher tests (reference: tests/test_cli.py,
+tests/test_configs/*, test_sagemaker arg-construction pattern)."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+import yaml
+
+from accelerate_tpu.commands.config.config_args import ClusterConfig, load_config_from_file
+from accelerate_tpu.commands.config.default import write_basic_config
+from accelerate_tpu.commands.launch import _resolve_config, launch_command_parser
+from accelerate_tpu.utils.environment import env_var
+
+
+class TestClusterConfig:
+    def test_roundtrip_yaml(self, tmp_path):
+        cfg = ClusterConfig(mixed_precision="bf16", mesh_tp=4, num_machines=2,
+                            main_process_ip="10.0.0.1")
+        path = cfg.save(str(tmp_path / "c.yaml"))
+        loaded = load_config_from_file(str(path))
+        assert loaded.mixed_precision == "bf16"
+        assert loaded.mesh_tp == 4
+        assert loaded.num_machines == 2
+
+    def test_roundtrip_json(self, tmp_path):
+        cfg = ClusterConfig(mesh_fsdp=8)
+        path = cfg.save(str(tmp_path / "c.json"))
+        assert load_config_from_file(str(path)).mesh_fsdp == 8
+
+    def test_unknown_keys_preserved_not_fatal(self, tmp_path):
+        p = tmp_path / "c.yaml"
+        p.write_text(yaml.safe_dump({"mixed_precision": "fp16", "future_knob": 1}))
+        cfg = load_config_from_file(str(p))
+        assert cfg.mixed_precision == "fp16"
+        assert cfg.extra == {"future_knob": 1}
+
+    def test_missing_explicit_file_raises(self):
+        with pytest.raises(FileNotFoundError):
+            load_config_from_file("/nonexistent/cfg.yaml")
+
+    def test_launch_env_mesh_and_precision(self):
+        cfg = ClusterConfig(mixed_precision="bf16", mesh_tp=2, mesh_fsdp=4)
+        env = cfg.launch_env()
+        assert env[env_var("MESH_TP")] == "2"
+        assert env[env_var("MESH_FSDP")] == "4"
+        assert env[env_var("MIXED_PRECISION")] == "bf16"
+
+    def test_launch_env_multihost(self):
+        cfg = ClusterConfig(num_machines=4, machine_rank=2, main_process_ip="10.0.0.1")
+        env = cfg.launch_env()
+        assert env[env_var("COORDINATOR_ADDRESS")] == "10.0.0.1:8476"
+        assert env[env_var("NUM_PROCESSES")] == "4"
+        assert env[env_var("PROCESS_ID")] == "2"
+
+    def test_write_basic_config(self, tmp_path):
+        path = write_basic_config(config_file=str(tmp_path / "d.yaml"))
+        assert load_config_from_file(str(path)).mixed_precision == "bf16"
+
+
+class TestLaunchResolution:
+    def test_cli_overrides_config(self, tmp_path):
+        cfg_path = tmp_path / "c.yaml"
+        ClusterConfig(mixed_precision="no", mesh_tp=1).save(str(cfg_path))
+        parser = launch_command_parser()
+        args = parser.parse_args(["--config_file", str(cfg_path), "--mixed_precision", "bf16",
+                                  "--tp", "2", "script.py"])
+        cfg = _resolve_config(args)
+        assert cfg.mixed_precision == "bf16"
+        assert cfg.mesh_tp == 2
+
+    def test_script_args_passthrough(self):
+        parser = launch_command_parser()
+        args = parser.parse_args(["train.py", "--lr", "3", "--epochs", "2"])
+        assert args.training_script == "train.py"
+        assert args.training_script_args == ["--lr", "3", "--epochs", "2"]
+
+
+def _run_cli(*argv, env_extra=None, cwd=None):
+    env = {**os.environ, **(env_extra or {})}
+    return subprocess.run(
+        [sys.executable, "-m", "accelerate_tpu.commands.accelerate_cli", *argv],
+        capture_output=True, text=True, env=env, cwd=cwd or os.path.dirname(os.path.dirname(__file__)))
+
+
+class TestCLISubprocess:
+    def test_help_lists_all_subcommands(self):
+        out = _run_cli("--help")
+        for cmd in ["config", "env", "estimate-memory", "launch", "merge-weights", "test"]:
+            assert cmd in out.stdout
+
+    def test_config_default_and_env(self, tmp_path):
+        env = {"ACCELERATE_TPU_CONFIG_DIR": str(tmp_path)}
+        out = _run_cli("config", "--default", env_extra=env)
+        assert out.returncode == 0, out.stderr
+        assert (tmp_path / "default_config.yaml").exists()
+        out = _run_cli("env", env_extra=env)
+        assert out.returncode == 0, out.stderr
+        assert "accelerate_tpu version" in out.stdout
+        assert "mixed_precision" in out.stdout
+
+    def test_estimate_memory_tiny(self):
+        out = _run_cli("estimate-memory", "llama-tiny", "--dtypes", "float32", "bfloat16")
+        assert out.returncode == 0, out.stderr
+        assert "float32" in out.stdout and "bfloat16" in out.stdout
+
+    def test_estimate_memory_unknown_model(self):
+        out = _run_cli("estimate-memory", "not-a-model")
+        assert out.returncode == 2
+        assert "Available" in out.stdout
+
+    def test_launch_simple_passes_env(self, tmp_path):
+        probe = tmp_path / "probe.py"
+        probe.write_text("import os\nprint(os.environ['" + env_var("MESH_TP") + "'])\n"
+                         "print(os.environ['" + env_var("MIXED_PRECISION") + "'])\n")
+        out = _run_cli("launch", "--tp", "2", "--mixed_precision", "bf16", str(probe))
+        assert out.returncode == 0, out.stderr
+        assert out.stdout.splitlines()[:2] == ["2", "bf16"]
+
+    def test_merge_weights_sharded_safetensors(self, tmp_path):
+        import json
+
+        from safetensors.numpy import load_file, save_file
+
+        d = tmp_path / "src"
+        d.mkdir()
+        save_file({"a.w": np.ones((2, 2), np.float32)}, str(d / "model-00001-of-00002.safetensors"))
+        save_file({"b.w": np.zeros((3,), np.float32)}, str(d / "model-00002-of-00002.safetensors"))
+        (d / "model.safetensors.index.json").write_text(json.dumps({
+            "weight_map": {"a.w": "model-00001-of-00002.safetensors",
+                           "b.w": "model-00002-of-00002.safetensors"}}))
+        out_path = tmp_path / "merged.safetensors"
+        out = _run_cli("merge-weights", str(d), str(out_path))
+        assert out.returncode == 0, out.stderr
+        merged = load_file(str(out_path))
+        assert set(merged) == {"a.w", "b.w"}
+
+
+class TestLaunchers:
+    def test_notebook_launcher_sets_mesh_env(self):
+        from accelerate_tpu.launchers import notebook_launcher
+
+        captured = {}
+
+        def fn():
+            captured["tp"] = os.environ.get(env_var("MESH_TP"))
+            return 7
+
+        result = notebook_launcher(fn, tp=2)
+        assert result == 7
+        assert captured["tp"] == "2"
