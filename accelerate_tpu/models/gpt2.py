@@ -22,6 +22,7 @@ class GPT2Config:
     max_position_embeddings: int = 1024
     layer_norm_eps: float = 1e-5
     use_flash_attention: bool = True
+    attention_backend: str = "auto"  # see llama.multi_head_attention
 
     @classmethod
     def xl(cls):
@@ -50,7 +51,9 @@ class GPT2Block(nn.Module):
         qkv = nn.Dense(3 * H * D, name="qkv", dtype=x.dtype, param_dtype=jnp.float32)(h)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         q, k, v = (t.reshape(B, S, H, D) for t in (q, k, v))
-        attn = multi_head_attention(q, k, v, causal=True, use_flash=cfg.use_flash_attention)
+        attn = multi_head_attention(
+            q, k, v, causal=True, use_flash=cfg.use_flash_attention, backend=cfg.attention_backend
+        )
         attn = nn.Dense(cfg.hidden_size, name="attn_out", dtype=x.dtype, param_dtype=jnp.float32)(
             attn.reshape(B, S, H * D)
         )

@@ -41,6 +41,9 @@ class LlamaConfig:
     tie_word_embeddings: bool = False
     remat: bool = False
     use_flash_attention: bool = True
+    # 'auto' uses ring/Ulysses context parallelism when the ambient mesh has
+    # cp > 1 (ops/ring_attention.py), flash/einsum otherwise.
+    attention_backend: str = "auto"
 
     @classmethod
     def llama3_8b(cls, **overrides):
@@ -94,12 +97,44 @@ def apply_rotary(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray):
     return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
 
 
-def multi_head_attention(q, k, v, causal: bool = True, use_flash: bool = True, segment_ids=None):
-    """Dispatch: Pallas flash kernel on TPU, XLA einsum elsewhere
-    (both live in ops/attention.py)."""
+def multi_head_attention(
+    q, k, v, causal: bool = True, use_flash: bool = True, segment_ids=None, backend: str = "auto"
+):
+    """Dispatch between the attention implementations in ops/.
+
+    backend semantics:
+      * 'auto'    — context-parallel (ring/Ulysses) when the ambient mesh has
+                    cp > 1 and the sequence is evenly cp-shardable (a growing
+                    generate() sequence quietly falls back); else flash when
+                    available, else einsum.
+      * 'ring' / 'ulysses' — always route through the CP entry point, which
+                    raises on non-shardable shapes instead of silently
+                    changing memory asymptotics; a *trivial* cp axis (mesh
+                    property, not a shape accident) still means single-device
+                    attention. Incompatible with segment_ids.
+      * 'flash'   — Pallas kernel when the platform/shape supports it, einsum
+                    otherwise (availability is a hardware property).
+      * 'einsum'  — always the XLA einsum path.
+    """
     from ..ops.attention import _einsum_attention, flash_attention, flash_attention_available
 
-    if use_flash and segment_ids is None and flash_attention_available(q):
+    if backend not in ("auto", "ring", "ulysses", "flash", "einsum"):
+        raise ValueError(
+            f"unknown attention_backend {backend!r}; expected auto/ring/ulysses/flash/einsum"
+        )
+    if backend in ("auto", "ring", "ulysses"):
+        from ..ops.ring_attention import _axis_size, _resolve_mesh, context_parallel_attention
+
+        if segment_ids is not None and backend != "auto":
+            raise ValueError(f"attention_backend={backend!r} does not support segment_ids")
+        mesh = _resolve_mesh(None)
+        cp = _axis_size(mesh, "cp")
+        if backend != "auto" or (cp > 1 and segment_ids is None and q.shape[1] % cp == 0):
+            if cp > 1:
+                return context_parallel_attention(
+                    q, k, v, mesh=mesh, causal=causal, strategy=backend, use_flash=use_flash
+                )
+    if backend != "einsum" and use_flash and segment_ids is None and flash_attention_available(q):
         return flash_attention(q, k, v, causal=causal)
     return _einsum_attention(q, k, v, causal=causal, segment_ids=segment_ids)
 
@@ -126,7 +161,9 @@ class LlamaAttention(nn.Module):
             k = jnp.repeat(k, rep, axis=2)
             v = jnp.repeat(v, rep, axis=2)
 
-        out = multi_head_attention(q, k, v, causal=causal, use_flash=cfg.use_flash_attention)
+        out = multi_head_attention(
+            q, k, v, causal=causal, use_flash=cfg.use_flash_attention, backend=cfg.attention_backend
+        )
         out = out.reshape(B, S, n_q * hd)
         return dense(cfg.hidden_size, "o_proj")(out)
 

@@ -1,0 +1,14 @@
+from .attention import flash_attention, flash_attention_available
+from .ring_attention import (
+    context_parallel_attention,
+    ring_attention,
+    ulysses_attention,
+)
+
+__all__ = [
+    "flash_attention",
+    "flash_attention_available",
+    "context_parallel_attention",
+    "ring_attention",
+    "ulysses_attention",
+]
