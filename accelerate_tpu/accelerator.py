@@ -434,6 +434,8 @@ class Accelerator:
             self.mesh,
             fsdp_plugin=self.state.fsdp_plugin,
             tp_plugin=self.state.tp_plugin,
+            pp_plugin=self.state.pp_plugin,
+            ep_plugin=self.state.ep_plugin,
         )
         if device_placement if device_placement is not None else self.device_placement:
             model.params = shard_params(model.params, shardings)

@@ -250,14 +250,7 @@ def _axis_size(mesh, axis_name: str) -> int:
 
 
 def _resolve_mesh(mesh):
-    """Explicit mesh, or the ambient one from AcceleratorState if set up."""
-    if mesh is not None:
-        return mesh
-    try:
-        from ..state import AcceleratorState
+    """Explicit mesh, else the shared ambient resolver (state.current_mesh)."""
+    from ..state import current_mesh
 
-        if AcceleratorState._shared_state:
-            return AcceleratorState().mesh
-    except Exception:
-        pass
-    return None
+    return current_mesh(mesh)

@@ -1,1 +1,3 @@
 from .mesh import MeshConfig, make_mesh, mesh_batch_size_multiple
+from .pipeline import pipeline_apply, stack_layer_params, unstack_layer_params
+from .sharding import ShardingRules, infer_param_shardings, replicated_sharding, shard_params, sharding_summary

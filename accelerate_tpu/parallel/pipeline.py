@@ -1,0 +1,231 @@
+"""Pipeline parallelism over the ``pp`` mesh axis, GSPMD-native.
+
+The reference delegates pipeline parallelism to external engines: Megatron's
+pipeline schedule for training (reference: utils/megatron_lm.py:1035-1056
+calls megatron's `train_step`) and torch.distributed.pipelining's
+`ScheduleGPipe` for inference (reference: inference.py:73-96). Both are
+imperative runtimes that move tensors between process groups with explicit
+send/recv.
+
+The TPU-native design needs neither a schedule runtime nor send/recv:
+
+* Layer parameters are **stacked** along a leading layer axis ``[L, ...]``
+  and sharded over ``pp`` — device group ``p`` holds layers
+  ``[p*L/pp, (p+1)*L/pp)``, i.e. one *stage*.
+* Activations live in a ``[pp, microbatch, ...]`` staging buffer, also
+  sharded over ``pp`` on dim 0 — slot ``p`` is the microbatch currently
+  being processed by stage ``p``.
+* One pipeline **tick** = all stages apply their layers in parallel
+  (a ``vmap`` over the stage dim — pure local compute, since both params
+  and activations are sharded the same way) followed by ``jnp.roll`` of
+  the buffer along the stage dim, which XLA lowers to a single
+  ``collective-permute`` riding ICI between neighboring stages.
+* The GPipe schedule is just a ``lax.scan`` over ``M + pp - 1`` ticks:
+  microbatch ``t`` is injected into slot 0 at tick ``t``; stage ``pp-1``
+  emits its output at tick ``t + pp - 1``. The bubble fraction is the
+  classic ``(pp-1)/(M+pp-1)``.
+
+Because the whole schedule is one differentiable jitted expression,
+**training "just works"**: `jax.grad` through the scan replays the ticks in
+reverse and the roll's transpose is the opposite-direction
+collective-permute — exactly the backward pipeline Megatron hand-codes.
+Composition with dp/fsdp/tp/cp is free: those axes shard the microbatch /
+hidden dims of the same arrays and XLA schedules their collectives
+independently.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def _resolve_mesh(mesh):
+    from ..state import current_mesh
+
+    return current_mesh(mesh)
+
+
+def _stage_count(mesh) -> int:
+    return dict(mesh.shape).get("pp", 1)
+
+
+def _activation_spec(mesh, ndim_after_batch: int):
+    """Spec for the [pp, mb, seq, ...] staging buffer: pp on dim0, batch axes
+    on the microbatch dim, cp on the sequence dim (when present)."""
+    batch_axes = tuple(ax for ax in ("dp", "fsdp") if dict(mesh.shape).get(ax, 1) > 1)
+    cp_ax = "cp" if dict(mesh.shape).get("cp", 1) > 1 else None
+    trailing: list = [None] * ndim_after_batch
+    if trailing and cp_ax is not None:
+        trailing[0] = cp_ax
+    return P("pp", batch_axes or None, *trailing)
+
+
+def num_layers_of(stacked_params) -> int:
+    """Leading (layer) dim shared by every leaf of a stacked-layer pytree."""
+    leaves = jax.tree_util.tree_leaves(stacked_params)
+    if not leaves:
+        raise ValueError("empty stacked params")
+    L = leaves[0].shape[0]
+    for leaf in leaves:
+        if leaf.shape[0] != L:
+            raise ValueError(
+                f"stacked params leaves disagree on layer dim: {leaf.shape[0]} vs {L}"
+            )
+    return L
+
+
+def pipeline_apply(
+    block_fn: Callable,
+    stacked_params,
+    x: jnp.ndarray,
+    extras=None,
+    *,
+    mesh=None,
+    num_microbatches: Optional[int] = None,
+    remat: bool = False,
+):
+    """Run ``x`` through ``L`` stacked layers with GPipe microbatch pipelining.
+
+    Args:
+      block_fn: ``(layer_params, x, extras) -> x`` — one layer. ``extras`` is
+        a pytree of per-example side inputs (e.g. positions) with the same
+        leading batch dim as ``x``; they ride along the pipeline unmodified.
+      stacked_params: pytree whose leaves are ``[L, ...]`` (layer-major).
+        Shard dim 0 over ``pp`` (see `parallel.sharding.infer_param_shardings`).
+      x: ``[batch, ...]`` activations entering layer 0.
+      extras: optional pytree of ``[batch, ...]`` side inputs.
+      mesh: the ambient mesh (defaults to PartialState's).
+      num_microbatches: GPipe microbatch count ``M`` (default: ``pp``; more
+        microbatches shrink the bubble at the cost of smaller per-stage
+        matmuls). Must divide ``batch``.
+      remat: rematerialize each stage application in the backward pass.
+
+    Returns ``[batch, ...]`` activations after layer ``L-1``.
+    """
+    mesh = _resolve_mesh(mesh)
+    pp = _stage_count(mesh) if mesh is not None else 1
+    L = num_layers_of(stacked_params)
+    extras = extras if extras is not None else ()
+
+    def _scan_layers(params, h, exs):
+        def body(carry, p_layer):
+            return block_fn(p_layer, carry, exs), None
+
+        h, _ = jax.lax.scan(body, h, params)
+        return h
+
+    if pp <= 1:
+        # No pipeline axis: plain scan over layers (still the memory-friendly
+        # stacked form — one compiled block body for all L layers).
+        fn = jax.checkpoint(_scan_layers) if remat else _scan_layers
+        return fn(stacked_params, x, extras)
+
+    if L % pp != 0:
+        raise ValueError(f"num_layers={L} not divisible by pp={pp}")
+    M = int(num_microbatches or pp)
+    B = x.shape[0]
+    if B % M != 0:
+        raise ValueError(f"batch={B} not divisible by num_microbatches={M}")
+    mb = B // M
+
+    def constrain(t, spec):
+        return jax.lax.with_sharding_constraint(t, NamedSharding(mesh, spec))
+
+    # Stage-major params: [L, ...] -> [pp, L/pp, ...]. The reshape splits the
+    # pp-sharded layer dim into (sharded pp, local L/pp) — layout-preserving,
+    # no communication.
+    p_stages = jax.tree_util.tree_map(
+        lambda leaf: leaf.reshape((pp, L // pp) + leaf.shape[1:]), stacked_params
+    )
+
+    # Microbatched inputs [M, mb, ...]; each microbatch is itself dp-sharded.
+    act_spec = _activation_spec(mesh, x.ndim - 1)
+    mb_spec = P(None, *tuple(act_spec)[1:])  # act_spec minus the leading 'pp'
+    x_mb = constrain(x.reshape((M, mb) + x.shape[1:]), mb_spec)
+    extras_mb = jax.tree_util.tree_map(
+        lambda e: e.reshape((M, mb) + e.shape[1:]), extras
+    )
+
+    # Staging buffers: slot p = microbatch inside stage p.
+    state = constrain(jnp.zeros((pp, mb) + x.shape[1:], x.dtype), act_spec)
+    state_ex = jax.tree_util.tree_map(
+        lambda e: jnp.zeros((pp, mb) + e.shape[1:], e.dtype), extras
+    )
+    outputs = constrain(jnp.zeros((M, mb) + x.shape[1:], x.dtype), mb_spec)
+
+    stage_fn = jax.checkpoint(_scan_layers) if remat else _scan_layers
+
+    def tick(carry, t):
+        state, state_ex, outputs = carry
+        # Inject microbatch t into stage 0 (clamp during the drain phase —
+        # stages just chew on stale data that is never emitted).
+        idx = jnp.minimum(t, M - 1)
+        inj = jax.lax.dynamic_index_in_dim(x_mb, idx, axis=0, keepdims=False)
+        state = constrain(state.at[0].set(inj), act_spec)
+        state_ex = jax.tree_util.tree_map(
+            lambda s, full: s.at[0].set(
+                jax.lax.dynamic_index_in_dim(full, idx, axis=0, keepdims=False)
+            ),
+            state_ex,
+            extras_mb,
+        )
+        # All stages apply their layers in parallel: vmap over the stage dim
+        # pairs stage p's params with stage p's activations — local compute.
+        state = jax.vmap(stage_fn)(p_stages, state, state_ex)
+        state = constrain(state, act_spec)
+        # Stage pp-1 finished microbatch t-(pp-1); write it out (writes during
+        # fill land at clamped index 0 and are overwritten by the real one).
+        out_idx = jnp.maximum(t - (pp - 1), 0)
+        outputs = jax.lax.dynamic_update_index_in_dim(outputs, state[-1], out_idx, axis=0)
+        # Advance the pipeline: roll along pp = one collective-permute hop.
+        state = jnp.roll(state, 1, axis=0)
+        state_ex = jax.tree_util.tree_map(lambda s: jnp.roll(s, 1, axis=0), state_ex)
+        return (state, state_ex, outputs), None
+
+    (_, _, outputs), _ = jax.lax.scan(
+        tick, (state, state_ex, outputs), jnp.arange(M + pp - 1)
+    )
+    return outputs.reshape((B,) + x.shape[1:])
+
+
+# ----------------------------------------------------------------------
+# Sequential <-> stacked parameter layout conversion
+# ----------------------------------------------------------------------
+
+def stack_layer_params(params: dict, prefix: str = "layers_") -> Any:
+    """Collect ``{prefix}0..{prefix}{L-1}`` sibling subtrees into one stacked
+    pytree with ``[L, ...]`` leaves (the pipeline layout). Non-layer siblings
+    are returned unchanged alongside, under the key ``prefix.rstrip('_')``.
+
+    Converts checkpoints between the sequential model layout
+    (`models.llama.LlamaModel`: ``layers_0 .. layers_{n-1}``) and the
+    pipelined layout.
+    """
+    layer_keys = sorted(
+        (k for k in params if k.startswith(prefix) and k[len(prefix):].isdigit()),
+        key=lambda k: int(k[len(prefix):]),
+    )
+    if not layer_keys:
+        raise ValueError(f"no '{prefix}N' subtrees in {list(params)}")
+    expect = [f"{prefix}{i}" for i in range(len(layer_keys))]
+    if layer_keys != expect:
+        raise ValueError(f"non-contiguous layer keys: {layer_keys}")
+    stacked = jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves, axis=0), *(params[k] for k in layer_keys)
+    )
+    rest = {k: v for k, v in params.items() if k not in layer_keys}
+    return stacked, rest
+
+
+def unstack_layer_params(stacked, prefix: str = "layers_") -> dict:
+    """Inverse of `stack_layer_params`: ``[L, ...]`` leaves -> L subtrees."""
+    L = num_layers_of(stacked)
+    return {
+        f"{prefix}{i}": jax.tree_util.tree_map(lambda leaf: leaf[i], stacked)
+        for i in range(L)
+    }

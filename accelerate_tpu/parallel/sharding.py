@@ -49,19 +49,24 @@ def _spec_for_leaf(
     tp_dim: Optional[int],
     min_size_to_shard: int,
     prefer_last_dim_fsdp: bool = False,
+    stack_axis: Optional[str] = None,
+    stack_axis_size: int = 1,
 ):
     """Compose a PartitionSpec for one parameter leaf.
 
-    TP (if a rule matched) claims ``tp_dim``; FSDP then shards the largest
-    remaining dimension divisible by the fsdp axis size.
+    A stacked-layout axis (``pp`` for pipeline stages, ``ep`` for experts)
+    claims dim 0 first; TP (if a rule matched) claims ``tp_dim``; FSDP then
+    shards the largest remaining dimension divisible by the fsdp axis size.
     """
     from jax.sharding import PartitionSpec
 
     ndim = len(shape)
     spec: list = [None] * ndim
+    if stack_axis is not None and ndim > 0 and stack_axis_size > 1 and shape[0] % stack_axis_size == 0:
+        spec[0] = stack_axis
     if tp_size > 1 and tp_dim is not None and ndim > 0:
         d = tp_dim % ndim
-        if shape[d] % tp_size == 0:
+        if spec[d] is None and shape[d] % tp_size == 0:
             spec[d] = "tp"
 
     if fsdp_size > 1 and int(np.prod(shape) if ndim else 1) >= min_size_to_shard:
@@ -111,12 +116,24 @@ class ShardingRules:
         return None
 
 
+# Parameter subtrees whose dim 0 is a stacked layout axis: pipeline stages
+# (leaves [L, ...], models/llama.py PipelinedLlamaForCausalLM) and MoE experts
+# (leaves [E, ...], ops/moe.py). Matched against the '/'-joined leaf path.
+DEFAULT_STACK_RULES: list[tuple[str, str]] = [
+    (r"(^|/)(blocks|stacked_layers|stages)(/|$)", "pp"),
+    (r"(^|/)(experts|expert_)(/|$|\w)", "ep"),
+]
+
+
 def infer_param_shardings(
     params,
     mesh,
     fsdp_plugin=None,
     tp_plugin=None,
+    pp_plugin=None,
+    ep_plugin=None,
     extra_rules: Optional[list[tuple[str, Any]]] = None,
+    stack_rules: Optional[list[tuple[str, str]]] = None,
 ):
     """Pytree of NamedSharding for every parameter leaf.
 
@@ -129,6 +146,8 @@ def infer_param_shardings(
 
     fsdp_size = mesh.shape.get("fsdp", 1)
     tp_size = mesh.shape.get("tp", 1)
+    pp_size = mesh.shape.get("pp", 1) if pp_plugin is not None else 1
+    ep_size = mesh.shape.get("ep", 1) if ep_plugin is not None else 1
     min_size = getattr(fsdp_plugin, "min_weight_size_to_shard", 2**14) if fsdp_plugin is not None else 2**62
     if fsdp_plugin is None:
         fsdp_size_eff = 1
@@ -141,11 +160,26 @@ def infer_param_shardings(
         rules=(getattr(tp_plugin, "rules", None) or []) + (extra_rules or []),
         use_defaults=True,
     ) if (tp_plugin is not None and tp_size > 1) else None
+    active_stack_rules = [
+        (pat, ax)
+        for pat, ax in (stack_rules if stack_rules is not None else DEFAULT_STACK_RULES)
+        if {"pp": pp_size, "ep": ep_size}.get(ax, 1) > 1
+    ]
 
     def _leaf_spec(path, leaf):
         shape = tuple(getattr(leaf, "shape", ()) or ())
-        tp_dim = rules.tp_dim_for(_leaf_path_str(path)) if rules is not None else None
-        spec = _spec_for_leaf(shape, fsdp_size_eff, tp_size if rules is not None else 1, tp_dim, min_size)
+        path_str = _leaf_path_str(path)
+        tp_dim = rules.tp_dim_for(path_str) if rules is not None else None
+        stack_axis = None
+        for pat, ax in active_stack_rules:
+            if re.search(pat, path_str, flags=re.IGNORECASE):
+                stack_axis = ax
+                break
+        spec = _spec_for_leaf(
+            shape, fsdp_size_eff, tp_size if rules is not None else 1, tp_dim, min_size,
+            stack_axis=stack_axis,
+            stack_axis_size={"pp": pp_size, "ep": ep_size}.get(stack_axis, 1),
+        )
         return NamedSharding(mesh, spec)
 
     return jax.tree_util.tree_map_with_path(_leaf_spec, params)

@@ -43,6 +43,31 @@ def is_initialized() -> bool:
     return PartialState._shared_state != {}
 
 
+def current_mesh(mesh=None):
+    """The ambient device mesh, or None.
+
+    Resolution order: an explicit ``mesh`` argument, the mesh of an active
+    ``with mesh:`` context, then `AcceleratorState`'s mesh. The single
+    resolver used by every mesh-aware op (pipeline, ring attention, MoE) so
+    they all agree on what "ambient" means.
+    """
+    if mesh is not None:
+        return mesh
+    try:
+        from jax._src import mesh as mesh_lib
+
+        phys = mesh_lib.thread_resources.env.physical_mesh
+        if phys is not None and not phys.empty:
+            return phys
+    except Exception:
+        pass
+    if AcceleratorState._shared_state:
+        m = AcceleratorState().mesh
+        if m is not None:
+            return m
+    return None
+
+
 class PartialState:
     """One-per-process truth about the distributed environment (reference: state.py:114).
 
