@@ -1,5 +1,6 @@
 from .bert import BertConfig, BertForSequenceClassification, classification_loss
 from .gpt2 import GPT2Config, GPT2LMHeadModel
 from .llama import LlamaConfig, LlamaForCausalLM, LlamaModel, PipelinedLlamaForCausalLM, causal_lm_loss
+from .mixtral import MixtralConfig, MixtralForCausalLM, mixtral_lm_loss
 from .resnet import ResNet, ResNetConfig
 from .simple import MLP, RegressionModel

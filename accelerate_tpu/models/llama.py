@@ -335,19 +335,25 @@ class PipelinedLlamaForCausalLM:
     __call__ = apply
 
 
+def masked_next_token_ce(logits, batch):
+    """Next-token cross-entropy over a batch with optional ``labels`` (-100 =
+    ignored, HF convention). Shared by every causal-LM loss builder."""
+    targets = batch.get("labels", None)
+    if targets is None:
+        targets = jnp.pad(batch["input_ids"][:, 1:], ((0, 0), (0, 1)), constant_values=-100)
+    mask = (targets != -100).astype(jnp.float32)
+    safe_targets = jnp.where(targets == -100, 0, targets)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, safe_targets[..., None], axis=-1)[..., 0]
+    return (nll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+
 def causal_lm_loss(apply_fn):
     """Build a loss_fn(params, batch[, rng]) for Accelerator.backward /
     compile_train_step: next-token cross-entropy with optional loss mask."""
 
     def loss_fn(params, batch, rng=None):
         logits = apply_fn({"params": params}, batch["input_ids"])
-        targets = batch.get("labels", None)
-        if targets is None:
-            targets = jnp.pad(batch["input_ids"][:, 1:], ((0, 0), (0, 1)), constant_values=-100)
-        mask = (targets != -100).astype(jnp.float32)
-        safe_targets = jnp.where(targets == -100, 0, targets)
-        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-        nll = -jnp.take_along_axis(logp, safe_targets[..., None], axis=-1)[..., 0]
-        return (nll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+        return masked_next_token_ce(logits, batch)
 
     return loss_fn

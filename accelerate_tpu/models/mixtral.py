@@ -1,0 +1,174 @@
+"""Mixtral-family sparse-MoE decoder, TPU-first.
+
+The reference framework has no MoE model or runtime (its only MoE touchpoint
+forwards module names to DeepSpeed, reference: accelerator.py:1736); this
+family exercises the net-new expert-parallel path end-to-end: Llama backbone
+(RMSNorm / RoPE / GQA attention shared from models/llama.py) with the MLP
+replaced by the GShard-style sparse expert layer in ops/moe.py, expert
+weights stacked ``[E, ...]`` and sharded over the ``ep`` mesh axis.
+
+The model returns ``(logits, aux)`` where ``aux`` carries the router losses;
+use :func:`mixtral_lm_loss` to fold them into training.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from .llama import LlamaAttention, LlamaConfig, RMSNorm
+
+
+@dataclasses.dataclass
+class MixtralConfig(LlamaConfig):
+    num_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    router_aux_coef: float = 0.02
+    router_z_coef: float = 0.001
+    # multiplicative jitter on router logits during training (Switch §2.2);
+    # active only when the caller provides a 'router' rng collection.
+    router_noise_eps: float = 0.0
+    # None = one routing group per data shard (ops/moe.py default_num_groups)
+    num_expert_groups: Optional[int] = None
+
+    @classmethod
+    def mixtral_8x7b(cls, **overrides):
+        cfg = cls(
+            vocab_size=32000, hidden_size=4096, intermediate_size=14336,
+            num_hidden_layers=32, num_attention_heads=32, num_key_value_heads=8,
+            max_position_embeddings=32768, rope_theta=1e6,
+            num_experts=8, top_k=2,
+        )
+        return dataclasses.replace(cfg, **overrides)
+
+    @classmethod
+    def tiny_moe(cls, **overrides):
+        cfg = cls(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=128, num_experts=4, top_k=2,
+            num_expert_groups=1,
+        )
+        return dataclasses.replace(cfg, **overrides)
+
+
+class MixtralSparseMLP(nn.Module):
+    """Router + stacked SwiGLU experts; dispatch via ops.moe."""
+
+    config: MixtralConfig
+
+    @nn.compact
+    def __call__(self, x):
+        from ..ops.moe import moe_mlp_apply
+
+        cfg = self.config
+        router_noise_rng = (
+            self.make_rng("router")
+            if cfg.router_noise_eps > 0.0 and self.has_rng("router")
+            else None
+        )
+        D, F, E = cfg.hidden_size, cfg.intermediate_size, cfg.num_experts
+        router = self.param("router", nn.initializers.lecun_normal(), (D, E), jnp.float32)
+        init = nn.initializers.lecun_normal(batch_axis=(0,))
+
+        class Experts(nn.Module):
+            @nn.compact
+            def __call__(self_inner):
+                return {
+                    "gate_proj": self_inner.param("gate_proj", init, (E, D, F), jnp.float32),
+                    "up_proj": self_inner.param("up_proj", init, (E, D, F), jnp.float32),
+                    "down_proj": self_inner.param("down_proj", init, (E, F, D), jnp.float32),
+                }
+
+        experts = Experts(name="experts")()
+        return moe_mlp_apply(
+            experts,
+            router,
+            x,
+            top_k=cfg.top_k,
+            capacity_factor=cfg.capacity_factor,
+            num_groups=cfg.num_expert_groups,
+            router_noise_rng=router_noise_rng,
+            router_noise_eps=cfg.router_noise_eps,
+        )
+
+
+class MixtralBlock(nn.Module):
+    config: MixtralConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.config
+        h = x + LlamaAttention(cfg, name="self_attn")(
+            RMSNorm(cfg.rms_norm_eps, name="input_norm")(x), positions
+        )
+        mlp_out, aux = MixtralSparseMLP(cfg, name="mlp")(
+            RMSNorm(cfg.rms_norm_eps, name="post_attn_norm")(h)
+        )
+        return h + mlp_out, aux
+
+
+class MixtralForCausalLM(nn.Module):
+    config: MixtralConfig
+
+    @nn.compact
+    def __call__(self, input_ids, positions=None):
+        cfg = self.config
+        if positions is None:
+            positions = jnp.arange(input_ids.shape[1])[None, :].astype(jnp.int32)
+            positions = jnp.broadcast_to(positions, input_ids.shape)
+        embed = nn.Embed(cfg.vocab_size, cfg.hidden_size, name="embed_tokens", param_dtype=jnp.float32)
+        x = embed(input_ids)
+        block_cls = MixtralBlock
+        if cfg.remat:
+            block_cls = nn.remat(
+                MixtralBlock, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+            )
+        lb = jnp.zeros((), jnp.float32)
+        zl = jnp.zeros((), jnp.float32)
+        for i in range(cfg.num_hidden_layers):
+            x, aux = block_cls(cfg, name=f"layers_{i}")(x, positions)
+            lb = lb + aux["load_balance_loss"]
+            zl = zl + aux["router_z_loss"]
+        x = RMSNorm(cfg.rms_norm_eps, name="norm")(x)
+        if cfg.tie_word_embeddings:
+            emb = self.variables["params"]["embed_tokens"]["embedding"]
+            logits = x @ emb.T.astype(x.dtype)
+        else:
+            logits = nn.Dense(
+                cfg.vocab_size, use_bias=False, name="lm_head", dtype=x.dtype, param_dtype=jnp.float32
+            )(x)
+        n = cfg.num_hidden_layers
+        return logits, {"load_balance_loss": lb / n, "router_z_loss": zl / n}
+
+    def init_params(self, rng, batch_size=1, seq_len=8):
+        dummy = jnp.zeros((batch_size, seq_len), jnp.int32)
+        return self.init(rng, dummy)["params"]
+
+
+def mixtral_lm_loss(apply_fn, config: MixtralConfig):
+    """Next-token cross-entropy + Switch router losses, weighted per config.
+
+    The per-step rng (provided by ``compile_train_step``) feeds the 'router'
+    rng collection, activating router jitter when
+    ``config.router_noise_eps > 0``.
+    """
+    from .llama import masked_next_token_ce
+
+    def loss_fn(params, batch, rng=None):
+        variables = params if isinstance(params, dict) and "params" in params else {"params": params}
+        rngs = {"router": rng} if (rng is not None and config.router_noise_eps > 0.0) else {}
+        logits, aux = apply_fn(variables, batch["input_ids"], rngs=rngs)
+        ce = masked_next_token_ce(logits, batch)
+        return (
+            ce
+            + config.router_aux_coef * aux["load_balance_loss"]
+            + config.router_z_coef * aux["router_z_loss"]
+        )
+
+    return loss_fn

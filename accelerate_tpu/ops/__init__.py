@@ -1,4 +1,5 @@
 from .attention import flash_attention, flash_attention_available
+from .moe import expert_capacity, moe_mlp_apply, top_k_routing
 from .ring_attention import (
     context_parallel_attention,
     ring_attention,
@@ -8,6 +9,9 @@ from .ring_attention import (
 __all__ = [
     "flash_attention",
     "flash_attention_available",
+    "expert_capacity",
+    "moe_mlp_apply",
+    "top_k_routing",
     "context_parallel_attention",
     "ring_attention",
     "ulysses_attention",

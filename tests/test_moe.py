@@ -1,0 +1,215 @@
+"""MoE routing + expert parallelism on the 8-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from accelerate_tpu.ops.moe import expert_capacity, moe_mlp_apply, top_k_routing
+from accelerate_tpu.parallel.mesh import MeshConfig
+
+
+class TestRouting:
+    def test_dispatch_respects_capacity(self):
+        rng = jax.random.PRNGKey(0)
+        logits = jax.random.normal(rng, (2, 32, 4))
+        C = 4  # deliberately tight: 32 tokens * k2 / 4 experts = 16 wanted slots
+        dispatch, combine, aux = top_k_routing(logits, top_k=2, capacity=C)
+        per_expert = dispatch.sum(axis=(1, 3))  # [G, E]
+        assert (per_expert <= C).all()
+        # every used slot holds at most one token
+        slot_load = dispatch.sum(axis=1)  # [G, E, C]
+        assert (slot_load <= 1.0).all()
+
+    def test_combine_weights_match_normalized_gates(self):
+        logits = jax.random.normal(jax.random.PRNGKey(1), (1, 16, 4))
+        # ample capacity: nothing dropped
+        dispatch, combine, aux = top_k_routing(logits, top_k=2, capacity=32)
+        assert float(dispatch.sum()) == 16 * 2
+        # combine weights per token sum to 1 (normalized top-2 gates)
+        np.testing.assert_allclose(np.asarray(combine.sum(axis=(2, 3))[0]), np.ones(16), rtol=1e-5)
+
+    def test_first_choices_beat_second_choices(self):
+        """With capacity 1, an expert's slot goes to a token choosing it 1st
+        over a later token choosing it 2nd... but 1st choices of EARLIER slots
+        win: slot-major priority means all top-1 assignments outrank top-2."""
+        # Token 0: top-1 = expert 0. Token 1: top-1 = expert 1, top-2 = expert 0.
+        logits = jnp.array([[[5.0, 0.0, -5.0], [2.0, 5.0, -5.0]]])  # [1, 2, 3]
+        dispatch, combine, _ = top_k_routing(logits, top_k=2, capacity=1)
+        # expert 0 slot 0 must hold token 0 (its 1st choice), not token 1 (2nd choice)
+        assert float(dispatch[0, 0, 0, 0]) == 1.0
+        assert float(dispatch[0, 1, 0, 0]) == 0.0
+
+    def test_aux_losses_uniform_router(self):
+        """A perfectly uniform router gives the minimum load-balance loss 1.0."""
+        logits = jnp.zeros((1, 64, 8))
+        _, _, aux = top_k_routing(logits, top_k=1, capacity=64)
+        assert abs(float(aux["load_balance_loss"]) - 1.0) < 1e-5
+        np.testing.assert_allclose(np.asarray(aux["expert_fraction"]).sum(), 1.0, rtol=1e-5)
+
+    def test_switch_mode_router_gets_task_gradient(self):
+        """top_k=1 must keep the raw router prob as the gate — normalizing
+        would collapse it to 1.0 and cut the router out of the task loss."""
+        D, F, E = 8, 16, 4
+        k1, k2, k3, k4, k5 = jax.random.split(jax.random.PRNGKey(0), 5)
+        experts = {
+            "gate_proj": jax.random.normal(k1, (E, D, F)) * 0.3,
+            "up_proj": jax.random.normal(k2, (E, D, F)) * 0.3,
+            "down_proj": jax.random.normal(k3, (E, F, D)) * 0.3,
+        }
+        router = jax.random.normal(k4, (D, E)) * 0.3
+        x = jax.random.normal(k5, (2, 8, D))
+
+        def task_loss(router):
+            out, _ = moe_mlp_apply(
+                experts, router, x, top_k=1, capacity_factor=2.0, num_groups=1, mesh=None
+            )
+            return jnp.sum(out ** 2)
+
+        g = jax.grad(task_loss)(router)
+        assert float(jnp.abs(g).max()) > 1e-3, "router got no task-loss gradient in Switch mode"
+
+    def test_capacity_helper(self):
+        assert expert_capacity(128, 8, 2, 1.0) == 32
+        assert expert_capacity(10, 8, 1, 1.0) == 8  # floor of 8
+        assert expert_capacity(100, 4, 2, 1.25) % 8 == 0
+
+
+class TestMoEMLP:
+    def _params(self, rng, E, D, F):
+        k1, k2, k3, k4 = jax.random.split(rng, 4)
+        s = D ** -0.5
+        return (
+            {
+                "gate_proj": jax.random.normal(k1, (E, D, F)) * s,
+                "up_proj": jax.random.normal(k2, (E, D, F)) * s,
+                "down_proj": jax.random.normal(k3, (E, F, D)) * (F ** -0.5),
+            },
+            jax.random.normal(k4, (D, E)) * s,
+        )
+
+    def test_single_expert_equals_dense_mlp(self):
+        """E=1, ample capacity: the MoE layer must equal the dense SwiGLU."""
+        D, F = 16, 32
+        experts, router = self._params(jax.random.PRNGKey(0), 1, D, F)
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 8, D))
+        out, aux = moe_mlp_apply(
+            experts, router, x, top_k=1, capacity_factor=2.0, num_groups=1, mesh=None
+        )
+        wg, wu, wd = experts["gate_proj"][0], experts["up_proj"][0], experts["down_proj"][0]
+        ref = (jax.nn.silu(x @ wg) * (x @ wu)) @ wd
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-4, atol=1e-5)
+
+    def test_dropped_tokens_get_zero_output(self):
+        D, F = 8, 16
+        experts, _ = self._params(jax.random.PRNGKey(0), 2, D, F)
+        # router forces every token to expert 0 with capacity for only a few
+        router = jnp.zeros((D, 2)).at[:, 0].set(1.0) * 100.0
+        x = jnp.ones((1, 64, D))
+        out, _ = moe_mlp_apply(
+            experts, router, x, top_k=1, capacity_factor=0.25, num_groups=1, mesh=None
+        )
+        # capacity = max(8, ceil(64*0.25/2)=8) = 8 slots on expert 0; the other
+        # 56 identical tokens are dropped -> exactly 8 rows non-zero
+        nonzero = np.abs(np.asarray(out[0])).sum(-1) > 1e-6
+        assert nonzero.sum() == 8
+
+    def test_group_count_validation(self):
+        experts, router = self._params(jax.random.PRNGKey(0), 2, 8, 16)
+        with pytest.raises(ValueError, match="not divisible"):
+            moe_mlp_apply(
+                experts, router, jnp.ones((1, 10, 8)),
+                top_k=1, capacity_factor=1.0, num_groups=3, mesh=None,
+            )
+
+    def test_ep_sharded_matches_unsharded(self):
+        """The ep-sharded MoE (all_to_all path) must be numerically identical
+        to the single-device computation."""
+        D, F, E = 16, 32, 4
+        experts, router = self._params(jax.random.PRNGKey(0), E, D, F)
+        x = jax.random.normal(jax.random.PRNGKey(1), (4, 8, D))
+        ref, _ = moe_mlp_apply(
+            experts, router, x, top_k=2, capacity_factor=2.0, num_groups=1, mesh=None
+        )
+        mesh = MeshConfig(dp=2, ep=4).build()
+        with mesh:
+            out, _ = jax.jit(
+                lambda e, r, x: moe_mlp_apply(
+                    e, r, x, top_k=2, capacity_factor=2.0, num_groups=1, mesh=mesh
+                )
+            )(experts, router, x)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-4, atol=1e-5)
+
+
+class TestMixtral:
+    def test_forward_and_shapes(self):
+        from accelerate_tpu.models.mixtral import MixtralConfig, MixtralForCausalLM
+
+        cfg = MixtralConfig.tiny_moe(use_flash_attention=False)
+        model = MixtralForCausalLM(cfg)
+        params = model.init_params(jax.random.PRNGKey(0), batch_size=2, seq_len=16)
+        logits, aux = model.apply({"params": params}, jnp.zeros((2, 16), jnp.int32))
+        assert logits.shape == (2, 16, cfg.vocab_size)
+        assert np.isfinite(float(aux["load_balance_loss"]))
+        # expert params are stacked [E, ...]
+        mlp = params["layers_0"]["mlp"]
+        assert mlp["experts"]["gate_proj"].shape == (cfg.num_experts, cfg.hidden_size, cfg.intermediate_size)
+
+    def test_expert_sharding_rules(self):
+        from accelerate_tpu.models.mixtral import MixtralConfig, MixtralForCausalLM
+        from accelerate_tpu.parallel.sharding import infer_param_shardings
+        from accelerate_tpu.utils import ExpertParallelPlugin, TensorParallelPlugin
+
+        cfg = MixtralConfig.tiny_moe(use_flash_attention=False)
+        model = MixtralForCausalLM(cfg)
+        params = model.init_params(jax.random.PRNGKey(0))
+        mesh = MeshConfig(dp=2, ep=2, tp=2).build()
+        sh = infer_param_shardings(
+            params, mesh,
+            tp_plugin=TensorParallelPlugin(tp_size=2),
+            ep_plugin=ExpertParallelPlugin(ep_size=2, num_experts=cfg.num_experts),
+        )
+        gate = sh["layers_0"]["mlp"]["experts"]["gate_proj"].spec
+        assert gate[0] == "ep", gate
+        assert "tp" in tuple(gate), gate
+        down = sh["layers_0"]["mlp"]["experts"]["down_proj"].spec
+        assert down[0] == "ep", down
+        router = sh["layers_0"]["mlp"]["router"].spec
+        assert "ep" not in tuple(router), router
+
+    def test_router_noise_changes_routing(self):
+        from accelerate_tpu.models.mixtral import MixtralConfig, MixtralForCausalLM
+
+        cfg = MixtralConfig.tiny_moe(use_flash_attention=False, router_noise_eps=0.5)
+        model = MixtralForCausalLM(cfg)
+        params = model.init_params(jax.random.PRNGKey(0), batch_size=2, seq_len=16)
+        ids = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, cfg.vocab_size)
+        base, _ = model.apply({"params": params}, ids)
+        noisy1, _ = model.apply({"params": params}, ids, rngs={"router": jax.random.PRNGKey(7)})
+        noisy2, _ = model.apply({"params": params}, ids, rngs={"router": jax.random.PRNGKey(8)})
+        assert not np.allclose(np.asarray(base), np.asarray(noisy1)), "noise rng had no effect"
+        assert not np.allclose(np.asarray(noisy1), np.asarray(noisy2))
+
+    def test_end_to_end_training_decreases_loss(self):
+        import optax
+
+        from accelerate_tpu import Accelerator, Model
+        from accelerate_tpu.data_loader import make_global_batch
+        from accelerate_tpu.models.mixtral import MixtralConfig, MixtralForCausalLM, mixtral_lm_loss
+        from accelerate_tpu.utils import ExpertParallelPlugin
+
+        cfg = MixtralConfig.tiny_moe(use_flash_attention=False, num_expert_groups=None)
+        model_def = MixtralForCausalLM(cfg)
+        params = model_def.init_params(jax.random.PRNGKey(0), batch_size=2, seq_len=16)
+        acc = Accelerator(
+            mesh_config=MeshConfig(dp=2, ep=4),
+            ep_plugin=ExpertParallelPlugin(ep_size=4, num_experts=cfg.num_experts),
+        )
+        model, opt = acc.prepare(Model(model_def, params), optax.adamw(3e-3))
+        step = acc.compile_train_step(mixtral_lm_loss(model_def.apply, cfg))
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, cfg.vocab_size, (8, 16)).astype(np.int32)
+        batch = make_global_batch({"input_ids": ids}, acc.mesh)
+        with acc.mesh:
+            losses = [float(step(batch)["loss"]) for _ in range(10)]
+        assert losses[-1] < losses[0], losses
