@@ -21,7 +21,9 @@ from .big_modeling import (
     load_checkpoint_in_model,
 )
 from .data_loader import NumpyDataLoader, prepare_data_loader, skip_first_batches
+from .inference import PipelinedInferencer, prepare_pipeline
 from .launchers import debug_launcher, notebook_launcher
+from .local_sgd import LocalSGD
 from .logging import get_logger
 from .optimizer import AcceleratedOptimizer
 from .precision import Policy, policy_for

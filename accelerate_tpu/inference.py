@@ -1,0 +1,141 @@
+"""Stage-parallel (pipelined) inference — PiPPy capability parity.
+
+Reference: inference.py (185 LoC) — ``prepare_pippy`` traces the torch
+model, splits it at device-map boundaries, wraps it in
+``torch.distributed.pipelining``'s ``ScheduleGPipe`` (reference:
+inference.py:73-96) and pads microbatches so uneven batch sizes work
+(reference: inference.py:99-121).
+
+Here the heavy machinery already exists: a pipelined model (stacked layers
+sharded over ``pp``; see parallel/pipeline.py) *is* the split+schedule, and
+jit compiles it once for all stages. What this module adds is the
+user-facing wrapper:
+
+* microbatch padding — arbitrary batch sizes get edge-padded up to a
+  multiple of the microbatch count and sliced back after the forward;
+* a jitted, eval-mode forward with the model's precision policy applied;
+* conversion from a sequential checkpoint layout when needed.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def pad_batch_to_multiple(args, multiple: int):
+    """Edge-pad the leading (batch) dim of every array leaf up to a multiple.
+
+    Returns ``(padded_args, original_batch)``. Mirrors the reference's
+    microbatch padding (reference: inference.py:99-121) — padding rows repeat
+    the last example, so shapes stay static and the padded rows are sliced
+    off after the forward.
+    """
+    leaves = [l for l in jax.tree_util.tree_leaves(args) if hasattr(l, "shape") and l.ndim > 0]
+    if not leaves:
+        return args, None
+    batch = leaves[0].shape[0]
+    rem = batch % multiple
+    if rem == 0:
+        return args, batch
+    pad = multiple - rem
+
+    def _pad(leaf):
+        if not hasattr(leaf, "shape") or leaf.ndim == 0 or leaf.shape[0] != batch:
+            return leaf
+        edge = jnp.repeat(leaf[-1:], pad, axis=0)
+        return jnp.concatenate([leaf, edge], axis=0)
+
+    return jax.tree_util.tree_map(_pad, args), batch
+
+
+class PipelinedInferencer:
+    """Callable wrapper: padded, jitted, stage-parallel forward."""
+
+    def __init__(self, apply_fn: Callable, params, num_microbatches: int, policy=None, mesh=None):
+        self.params = params
+        self.num_microbatches = int(num_microbatches)
+        self.mesh = mesh
+        self.policy = policy
+
+        def fwd(params, args, kwargs):
+            p = policy.cast_to_compute(params) if policy is not None else params
+            out = apply_fn(p, *args, **kwargs)
+            return policy.cast_to_output(out) if policy is not None else out
+
+        self._jit_fwd = jax.jit(fwd)
+
+    def __call__(self, *args, **kwargs):
+        # Pad args and kwargs as ONE pytree so batch-dim arrays passed by
+        # keyword (attention masks, positions) stay aligned with the inputs.
+        (args, kwargs), batch = pad_batch_to_multiple((args, kwargs), self.num_microbatches)
+        ctx = self.mesh if self.mesh is not None else contextlib.nullcontext()
+        with ctx:
+            out = self._jit_fwd(self.params, args, kwargs)
+        if batch is None:
+            return out
+        padded_batch = batch + (-batch) % self.num_microbatches
+        if padded_batch == batch:
+            return out
+        return jax.tree_util.tree_map(
+            lambda l: l[:batch]
+            if hasattr(l, "shape") and l.ndim > 0 and l.shape[0] == padded_batch
+            else l,
+            out,
+        )
+
+
+def prepare_pipeline(
+    model,
+    params=None,
+    accelerator=None,
+    num_microbatches: Optional[int] = None,
+):
+    """Build a stage-parallel inference callable (reference: prepare_pippy,
+    inference.py:124).
+
+    ``model`` is a pipelined model object (``.apply`` over stacked layers —
+    e.g. `models.llama.PipelinedLlamaForCausalLM`) or any
+    ``apply_fn(params, *args)``. Params default to ``model.params`` /
+    the prepared model's; the mesh and precision policy come from
+    ``accelerator`` when given. The returned callable accepts ANY batch size:
+    inputs are edge-padded to a multiple of the microbatch count and outputs
+    sliced back.
+    """
+    apply_fn = None
+    if hasattr(model, "apply_fn"):  # accelerate_tpu Model / AcceleratedModel
+        apply_fn = model.apply_fn
+        params = params if params is not None else model.params
+    elif hasattr(model, "apply"):
+        raw_apply = model.apply
+
+        def apply_fn(p, *args, **kwargs):
+            variables = p if isinstance(p, dict) and "params" in p else {"params": p}
+            return raw_apply(variables, *args, **kwargs)
+
+    elif callable(model):
+        apply_fn = model
+    else:
+        raise TypeError(f"prepare_pipeline cannot wrap {type(model)}")
+    if params is None:
+        raise ValueError("prepare_pipeline needs params (pass params= or a prepared Model)")
+
+    policy = accelerator.policy if accelerator is not None else getattr(model, "policy", None)
+    mesh = accelerator.mesh if accelerator is not None else getattr(model, "mesh", None)
+    if num_microbatches is None:
+        # Match what the pipeline will actually use: the model's own count,
+        # then the accelerator's pp plugin, then the pp axis size (the
+        # pipeline_apply default when num_microbatches is unset).
+        num_microbatches = getattr(model, "num_microbatches", None)
+        if num_microbatches is None and accelerator is not None:
+            pp_plugin = accelerator.state.pp_plugin
+            if pp_plugin is not None and pp_plugin.num_microbatches > 1:
+                num_microbatches = pp_plugin.num_microbatches
+        if num_microbatches is None and mesh is not None:
+            num_microbatches = max(dict(mesh.shape).get("pp", 1), 1)
+        if num_microbatches is None:
+            num_microbatches = 1
+    return PipelinedInferencer(apply_fn, params, num_microbatches, policy=policy, mesh=mesh)

@@ -1,0 +1,220 @@
+"""Local SGD: data-parallel training with infrequent parameter averaging.
+
+Reference: local_sgd.py:19-103 — wraps a torch loop, calls
+``model.no_sync()`` to skip DDP's per-step gradient all-reduce and every
+``local_sgd_steps`` averages parameters with ``reduce(mean)``. The win is
+communication *frequency*: one collective per N steps instead of per step,
+which matters when the interconnect is slow relative to compute (multi-slice
+DCN, preemptible pods).
+
+TPU-native design — divergent replicas as a batch dimension:
+
+Under GSPMD, replicated parameters are definitionally identical on every dp
+shard, so "skip the sync" cannot be expressed by omitting a collective the
+way DDP's ``no_sync`` does. Instead the replicas are made *explicit*: every
+param/opt-state leaf gains a leading ``[dp, ...]`` dim sharded over the
+``dp`` mesh axis, the per-shard optimizer step runs under ``vmap`` over that
+dim (pure local compute — each device updates its own replica, zero
+communication), and the periodic average is one ``mean`` over the stacked
+dim (a single all-reduce, the only collective in the whole scheme). Both
+phases are ordinary jitted GSPMD programs, so Local SGD composes with the
+rest of the framework instead of needing a DDP-style comm hook.
+
+Usage (API shape mirrors the reference)::
+
+    with LocalSGD(accelerator, model, optimizer, loss_fn,
+                  local_sgd_steps=8) as lsgd:
+        for batch in dl:
+            metrics = lsgd.step(batch)   # per-shard local update
+    # exiting averages replicas once more and writes back to `model`
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class LocalSGD:
+    """Context manager running per-dp-shard local steps with periodic
+    parameter averaging (reference: local_sgd.py:19)."""
+
+    def __init__(
+        self,
+        accelerator,
+        model,
+        optimizer,
+        loss_fn: Callable,
+        local_sgd_steps: int = 8,
+        enabled: bool = True,
+        max_grad_norm: Optional[float] = None,
+    ):
+        if accelerator.state.mixed_precision == "fp16":
+            raise ValueError(
+                "LocalSGD does not support fp16 loss scaling; use bf16 "
+                "(the TPU-native precision) instead."
+            )
+        self.accelerator = accelerator
+        self.model = model
+        self.optimizer = optimizer
+        self.loss_fn = loss_fn
+        self.local_sgd_steps = int(local_sgd_steps)
+        mesh = accelerator.mesh
+        self.dp = int(dict(mesh.shape).get("dp", 1)) if mesh is not None else 1
+        self.enabled = bool(enabled) and self.dp > 1
+        self.max_grad_norm = max_grad_norm
+        self._step_count = 0
+        self._stacked_params = None
+        self._stacked_opt = None
+        self._local_step_jit = None
+        self._average_jit = None
+        self._fallback_step = None
+
+    # ------------------------------------------------------------------
+
+    def __enter__(self):
+        if not self.enabled:
+            # Degenerate (dp==1 or disabled): plain fused train step
+            # (reference: enabled=False is a no-op wrapper, local_sgd.py:55).
+            self._fallback_step = self.accelerator.compile_train_step(
+                self.loss_fn, model=self.model, optimizer=self.optimizer,
+                max_grad_norm=self.max_grad_norm, donate=False,
+            )
+            return self
+
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = self.accelerator.mesh
+        dp = self.dp
+        policy = self.accelerator.policy
+        tx = self.optimizer.tx
+        loss_fn = self.loss_fn
+        accepts_rng = self.accelerator._loss_fn_accepts_rng(loss_fn)
+        max_grad_norm = self.max_grad_norm
+
+        def _stack_spec(leaf_sharding):
+            spec = tuple(leaf_sharding.spec) if hasattr(leaf_sharding, "spec") else ()
+            return NamedSharding(mesh, P("dp", *spec))
+
+        param_shardings = self.model.param_shardings
+        stacked_shardings = jax.tree_util.tree_map(
+            _stack_spec, param_shardings,
+            is_leaf=lambda x: hasattr(x, "spec"),
+        )
+
+        def _stack(params):
+            return jax.tree_util.tree_map(
+                lambda p, s: jax.device_put(jnp.broadcast_to(p[None], (dp,) + p.shape), s),
+                params, stacked_shardings,
+            )
+
+        self._stacked_params = _stack(self.model.params)
+        if self.optimizer.opt_state is not None:
+            # Preserve accumulated optimizer state (Adam moments etc.) —
+            # replicate it into each shard's replica.
+            self._stacked_opt = jax.jit(
+                lambda o: jax.tree_util.tree_map(
+                    lambda l: jnp.broadcast_to(l[None], (dp,) + jnp.shape(l)), o
+                )
+            )(self.optimizer.opt_state)
+        else:
+            self._stacked_opt = jax.jit(jax.vmap(tx.init))(self._stacked_params)
+
+        def per_shard_update(params, batch, rng):
+            def compute(p):
+                cp = policy.cast_to_compute(p)
+                out = loss_fn(cp, batch, rng) if accepts_rng else loss_fn(cp, batch)
+                loss = out[0] if isinstance(out, tuple) else out
+                return loss.astype(jnp.float32)
+
+            return jax.value_and_grad(compute)(params)
+
+        def local_step(stacked_params, stacked_opt, batch, rng):
+            import optax
+
+            rngs = jax.random.split(rng, dp)
+            losses, grads = jax.vmap(per_shard_update, in_axes=(0, 0, 0))(
+                stacked_params, batch, rngs
+            )
+            if max_grad_norm is not None:
+                def clip_one(g):
+                    leaves = jax.tree_util.tree_leaves(g)
+                    gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(l.astype(jnp.float32))) for l in leaves))
+                    factor = jnp.minimum(1.0, max_grad_norm / (gnorm + 1e-6))
+                    return jax.tree_util.tree_map(lambda l: (l * factor).astype(l.dtype), g)
+
+                grads = jax.vmap(clip_one)(grads)
+
+            def update_one(g, o, p):
+                updates, new_o = tx.update(g, o, p)
+                return optax.apply_updates(p, updates), new_o
+
+            new_params, new_opt = jax.vmap(update_one)(grads, stacked_opt, stacked_params)
+            return new_params, new_opt, losses.mean()
+
+        def average(stacked_params):
+            return jax.tree_util.tree_map(
+                lambda p: jnp.broadcast_to(jnp.mean(p, axis=0, keepdims=True), p.shape),
+                stacked_params,
+            )
+
+        self._local_step_jit = jax.jit(local_step, donate_argnums=(0, 1))
+        self._average_jit = jax.jit(average, donate_argnums=(0,), out_shardings=stacked_shardings)
+        return self
+
+    def step(self, batch):
+        """One local training step. ``batch`` leaves are ``[global_batch, ...]``
+        (split evenly across dp shards) and must have
+        ``global_batch % dp == 0``."""
+        if not self.enabled:
+            return self._fallback_step(batch)
+
+        dp = self.dp
+
+        def to_sharded(leaf):
+            leaf = jnp.asarray(leaf)
+            if leaf.shape[0] % dp != 0:
+                raise ValueError(
+                    f"batch dim {leaf.shape[0]} not divisible by dp={dp}"
+                )
+            return leaf.reshape((dp, leaf.shape[0] // dp) + leaf.shape[1:])
+
+        batch = jax.tree_util.tree_map(to_sharded, batch)
+        rng = self.accelerator.next_rng_key()
+        self._stacked_params, self._stacked_opt, loss = self._local_step_jit(
+            self._stacked_params, self._stacked_opt, batch, rng
+        )
+        self._step_count += 1
+        if self._step_count % self.local_sgd_steps == 0:
+            self._sync()
+        return {"loss": loss}
+
+    def _sync(self):
+        self._stacked_params = self._average_jit(self._stacked_params)
+
+    def __exit__(self, exc_type, exc, tb):
+        if not self.enabled:
+            return False
+        self._sync()
+        # Write the consensus replica back to the prepared model, restoring
+        # its original (unstacked) shardings, and hand the optimizer its
+        # state back (replica-averaged for float leaves — e.g. Adam moments —
+        # shard 0's value for integer leaves like step counts).
+        mean_params = jax.tree_util.tree_map(lambda p: p[0], self._stacked_params)
+        self.model.load_state_dict(mean_params)
+        self.optimizer.opt_state = jax.jit(
+            lambda o: jax.tree_util.tree_map(
+                lambda l: jnp.mean(l, axis=0)
+                if jnp.issubdtype(jnp.asarray(l).dtype, jnp.inexact)
+                else l[0],
+                o,
+            )
+        )(self._stacked_opt)
+        self._stacked_params = self._stacked_opt = None
+        return False
+
+    @property
+    def num_local_steps(self) -> int:
+        return self._step_count

@@ -1,0 +1,223 @@
+"""LocalSGD (divergent-replica averaging) + pipelined inference wrapper."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from accelerate_tpu import Accelerator, LocalSGD, MeshConfig, Model, prepare_pipeline
+
+
+def _regression_setup(acc, features=8):
+    import flax.linen as nn
+
+    model_def = nn.Dense(1, param_dtype=jnp.float32)
+    params = model_def.init(jax.random.PRNGKey(0), jnp.zeros((1, features)))["params"]
+    model, opt = acc.prepare(Model(model_def, params), optax.sgd(0.1))
+
+    def loss_fn(p, batch):
+        pred = model_def.apply({"params": p}, batch["x"])
+        return jnp.mean((pred - batch["y"]) ** 2)
+
+    return model, opt, loss_fn
+
+
+def _batch(n=16, features=8, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, features)).astype(np.float32)
+    w = np.arange(features, dtype=np.float32)
+    y = (x @ w)[:, None] + 0.5
+    return {"x": x, "y": y}
+
+
+class TestLocalSGD:
+    def test_learns_and_syncs(self):
+        acc = Accelerator(mesh_config=MeshConfig(dp=8))
+        model, opt, loss_fn = _regression_setup(acc)
+        batch = _batch()
+        with LocalSGD(acc, model, opt, loss_fn, local_sgd_steps=4) as lsgd:
+            losses = [float(lsgd.step(batch)["loss"]) for _ in range(16)]
+            assert lsgd.num_local_steps == 16
+        assert losses[-1] < losses[0] * 0.2, losses
+        # after exit the model params hold the consensus (finite, unstacked)
+        leaves = jax.tree_util.tree_leaves(model.params)
+        assert all(np.all(np.isfinite(np.asarray(l))) for l in leaves)
+
+    def test_replicas_diverge_between_syncs_and_converge_at_sync(self):
+        acc = Accelerator(mesh_config=MeshConfig(dp=8))
+        model, opt, loss_fn = _regression_setup(acc)
+        lsgd = LocalSGD(acc, model, opt, loss_fn, local_sgd_steps=1000)
+        with lsgd:
+            # different data per shard -> replicas must diverge
+            rng = np.random.default_rng(1)
+            batch = {k: v for k, v in _batch(16).items()}
+            batch["y"] = batch["y"] + rng.normal(size=batch["y"].shape).astype(np.float32) * 5
+            lsgd.step(batch)
+            stacked = jax.tree_util.tree_leaves(lsgd._stacked_params)[0]
+            replicas = np.asarray(stacked)
+            assert not np.allclose(replicas[0], replicas[1]), "replicas did not diverge"
+            lsgd._sync()
+            stacked = np.asarray(jax.tree_util.tree_leaves(lsgd._stacked_params)[0])
+            np.testing.assert_allclose(stacked[0], stacked[1], rtol=1e-6)
+
+    def test_matches_plain_training_when_syncing_every_step(self):
+        """local_sgd_steps=1 with identical per-shard data == plain DP SGD."""
+        acc = Accelerator(mesh_config=MeshConfig(dp=8))
+        model, opt, loss_fn = _regression_setup(acc)
+        init_params = jax.tree_util.tree_map(np.asarray, model.params)
+        batch = _batch(8)
+        # every shard sees the same single example repeated
+        rep = {k: np.tile(v[:1], (8,) + (1,) * (v.ndim - 1)) for k, v in batch.items()}
+        with LocalSGD(acc, model, opt, loss_fn, local_sgd_steps=1) as lsgd:
+            lsgd.step(rep)
+        # reference: one SGD step on that example
+        def ref_loss(p):
+            return loss_fn(p, {k: jnp.asarray(v[:1]) for k, v in rep.items()})
+
+        g = jax.grad(ref_loss)(init_params)
+        expect = jax.tree_util.tree_map(lambda p, g: p - 0.1 * g, init_params, g)
+        for a, b in zip(jax.tree_util.tree_leaves(model.params), jax.tree_util.tree_leaves(expect)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+
+    def test_preserves_adam_state_across_context(self):
+        """Entering/leaving LocalSGD must not zero accumulated Adam moments."""
+        acc = Accelerator(mesh_config=MeshConfig(dp=8))
+        import flax.linen as nn
+
+        model_def = nn.Dense(1, param_dtype=jnp.float32)
+        params = model_def.init(jax.random.PRNGKey(0), jnp.zeros((1, 8)))["params"]
+        model, opt = acc.prepare(Model(model_def, params), optax.adam(0.01))
+
+        def loss_fn(p, batch):
+            return jnp.mean((model_def.apply({"params": p}, batch["x"]) - batch["y"]) ** 2)
+
+        # accumulate some moments with the plain fused step first
+        from accelerate_tpu.data_loader import make_global_batch
+
+        step = acc.compile_train_step(loss_fn, donate=False)
+        gbatch = make_global_batch(_batch(16), acc.mesh)
+        for _ in range(3):
+            step(gbatch)
+        mu_before = np.asarray(jax.tree_util.tree_leaves(opt.opt_state[0].mu)[0])
+        assert np.abs(mu_before).max() > 0
+        with LocalSGD(acc, model, opt, loss_fn, local_sgd_steps=2) as lsgd:
+            for _ in range(4):
+                lsgd.step(_batch(16))
+        mu_after = np.asarray(jax.tree_util.tree_leaves(opt.opt_state[0].mu)[0])
+        count_after = int(np.asarray(opt.opt_state[0].count))
+        assert np.abs(mu_after).max() > 0, "Adam moments were reset"
+        assert count_after >= 3 + 4, f"step count lost: {count_after}"
+
+    def test_disabled_falls_back_to_fused_step(self):
+        acc = Accelerator(mesh_config=MeshConfig(dp=8))
+        model, opt, loss_fn = _regression_setup(acc)
+        from accelerate_tpu.data_loader import make_global_batch
+
+        batch = make_global_batch(_batch(16), acc.mesh)
+        with LocalSGD(acc, model, opt, loss_fn, enabled=False) as lsgd:
+            m = lsgd.step(batch)
+        assert np.isfinite(float(m["loss"]))
+
+    def test_rejects_fp16(self):
+        acc = Accelerator(mesh_config=MeshConfig(dp=8), mixed_precision="fp16")
+        model, opt, loss_fn = _regression_setup(acc)
+        with pytest.raises(ValueError, match="fp16"):
+            LocalSGD(acc, model, opt, loss_fn)
+
+
+class TestPipelinedInference:
+    def test_padding_and_parity(self):
+        from accelerate_tpu.models.llama import (
+            LlamaConfig,
+            LlamaForCausalLM,
+            PipelinedLlamaForCausalLM,
+        )
+
+        cfg = LlamaConfig.tiny(num_hidden_layers=4, use_flash_attention=False)
+        seq = LlamaForCausalLM(cfg)
+        params = seq.init_params(jax.random.PRNGKey(0), batch_size=2, seq_len=16)
+        pipe = PipelinedLlamaForCausalLM(cfg, num_microbatches=4)
+        pipe_params = PipelinedLlamaForCausalLM.from_sequential_params(params)
+
+        mesh = MeshConfig(dp=2, pp=4).build()
+        fwd = prepare_pipeline(pipe, params=pipe_params, num_microbatches=4)
+        fwd.mesh = mesh
+        ids = jax.random.randint(jax.random.PRNGKey(1), (6, 16), 0, cfg.vocab_size)  # 6 % 4 != 0
+        out = fwd(ids)
+        assert out.shape == (6, 16, cfg.vocab_size)
+        ref = seq.apply({"params": params}, ids)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
+
+    def test_wraps_prepared_model(self):
+        from accelerate_tpu.models.llama import LlamaConfig, PipelinedLlamaForCausalLM
+        from accelerate_tpu.utils import PipelineParallelPlugin
+
+        cfg = LlamaConfig.tiny(num_hidden_layers=4, use_flash_attention=False)
+        pipe = PipelinedLlamaForCausalLM(cfg, num_microbatches=2)
+        params = pipe.init_params(jax.random.PRNGKey(0), seq_len=16)
+        acc = Accelerator(
+            mesh_config=MeshConfig(dp=2, pp=4),
+            pp_plugin=PipelineParallelPlugin(pp_size=4, num_microbatches=2),
+        )
+        model = acc.prepare(Model(pipe.apply, params))
+        fwd = prepare_pipeline(model, accelerator=acc, num_microbatches=2)
+        out = fwd(jnp.zeros((3, 16), jnp.int32))
+        assert out.shape == (3, 16, cfg.vocab_size)
+
+    def test_microbatch_count_resolved_from_pipeline_defaults(self):
+        """A pipelined model with num_microbatches=None uses M=pp inside
+        pipeline_apply; prepare_pipeline must pad to the same multiple."""
+        from accelerate_tpu.models.llama import LlamaConfig, PipelinedLlamaForCausalLM
+        from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+        from accelerate_tpu.utils import PipelineParallelPlugin
+
+        cfg = LlamaConfig.tiny(num_hidden_layers=4, use_flash_attention=False)
+        pipe = PipelinedLlamaForCausalLM(cfg)  # num_microbatches=None -> M=pp=4
+        params = pipe.init_params(jax.random.PRNGKey(0), seq_len=16)
+        acc = Accelerator(
+            mesh_config=MeshConfig(dp=2, pp=4),
+            pp_plugin=PipelineParallelPlugin(pp_size=4),
+        )
+        fwd = prepare_pipeline(pipe, params=params, accelerator=acc)
+        assert fwd.num_microbatches == 4
+        out = fwd(jnp.zeros((6, 16), jnp.int32))  # 6 % 4 != 0: must pad, not crash
+        assert out.shape == (6, 16, cfg.vocab_size)
+
+    def test_kwargs_are_padded_too(self):
+        calls = {}
+
+        def apply_fn(params, ids, positions=None):
+            calls["shapes"] = (ids.shape, positions.shape)
+            return ids * positions
+
+        from accelerate_tpu.inference import PipelinedInferencer
+
+        fwd = PipelinedInferencer(apply_fn, params={}, num_microbatches=4)
+        ids = jnp.ones((6, 3), jnp.int32)
+        out = fwd(ids, positions=jnp.ones((6, 3), jnp.int32))
+        assert calls["shapes"] == ((8, 3), (8, 3)), calls
+        assert out.shape == (6, 3)
+
+    def test_unpad_only_touches_batch_dim_leaves(self):
+        def apply_fn(params, ids):
+            # aux vector whose dim happens to exceed the batch: must NOT be cut
+            return {"logits": ids, "aux": jnp.arange(16.0)}
+
+        from accelerate_tpu.inference import PipelinedInferencer
+
+        fwd = PipelinedInferencer(apply_fn, params={}, num_microbatches=4)
+        out = fwd(jnp.ones((6, 3), jnp.int32))
+        assert out["logits"].shape == (6, 3)
+        assert out["aux"].shape == (16,)
+
+    def test_pad_batch_helper(self):
+        from accelerate_tpu.inference import pad_batch_to_multiple
+
+        args = (jnp.arange(10).reshape(5, 2), jnp.arange(5))
+        padded, orig = pad_batch_to_multiple(args, 4)
+        assert orig == 5
+        assert padded[0].shape == (8, 2) and padded[1].shape == (8,)
+        np.testing.assert_array_equal(np.asarray(padded[0][5:]), np.tile(np.asarray(args[0][-1:]), (3, 1)))
+        same, orig2 = pad_batch_to_multiple(args, 5)
+        assert orig2 == 5 and same[0].shape == (5, 2)
