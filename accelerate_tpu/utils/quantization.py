@@ -1,0 +1,263 @@
+"""Weight-only int8 / int4 quantization (bitsandbytes capability parity).
+
+Reference: utils/bnb.py (467 LoC) — ``load_and_quantize_model`` swaps
+``nn.Linear`` modules for bitsandbytes CUDA kernels (``replace_with_bnb_layers``,
+reference: utils/bnb.py:274) doing fused int8/NF4 dequant-matmul.
+
+TPU-native design: quantization is a *parameter transformation*, not a module
+swap. Eligible kernel leaves become :class:`QuantizedTensor` pytree nodes
+(int8 per-channel or int4 block-wise, symmetric) and the apply function is
+wrapped so leaves dequantize lazily inside jit — XLA fuses the
+``convert(int) * scale`` into the consuming dot's operand, which is the
+standard TPU weight-only-quant pattern; weights at rest (HBM/host DRAM)
+stay integer. No custom kernels needed: ``jnp.int4`` is a native packed
+dtype on TPU.
+
+Layout conventions (flax): a kernel leaf ``[..., in, out]`` quantizes
+per-output-channel (int8: one scale per ``[..., out]``) or block-wise along
+the contraction dim (int4: one scale per ``[..., in/block, out]``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass
+class QuantizationConfig:
+    """bnb-parity config (reference: BnbQuantizationConfig, utils/bnb.py).
+
+    ``skip_modules`` are regexes matched against the '/'-joined leaf path;
+    the head stays full precision by default (reference keeps ``lm_head`` in
+    fp16 for output quality). ``min_weight_size`` keeps tiny leaves (norms,
+    biases) untouched regardless.
+    """
+
+    load_in_8bit: bool = False
+    load_in_4bit: bool = False
+    block_size: int = 64            # int4 contraction-dim block
+    compute_dtype: Any = jnp.bfloat16
+    skip_modules: Optional[list[str]] = None
+    min_weight_size: int = 4096
+
+    def __post_init__(self):
+        if self.load_in_8bit and self.load_in_4bit:
+            raise ValueError("Choose one of load_in_8bit / load_in_4bit")
+        if not (self.load_in_8bit or self.load_in_4bit):
+            raise ValueError("Set load_in_8bit=True or load_in_4bit=True")
+        if self.skip_modules is None:
+            self.skip_modules = ["lm_head"]
+
+    @property
+    def bits(self) -> int:
+        return 8 if self.load_in_8bit else 4
+
+
+@jax.tree_util.register_pytree_node_class
+class QuantizedTensor:
+    """An integer-quantized weight + scales, transparent to jit.
+
+    ``q``: int8 ``[..., in, out]`` or int4 ``[..., in, out]``;
+    ``scale``: f32 — int8: ``[..., 1, out]``; int4: ``[..., in/bs, 1, out]``
+    applied after a reshape of ``q`` to ``[..., in/bs, bs, out]``.
+    """
+
+    def __init__(self, q, scale, bits: int, block_size: int = 0):
+        self.q = q
+        self.scale = scale
+        self.bits = int(bits)
+        self.block_size = int(block_size)
+
+    @property
+    def shape(self):
+        return tuple(self.q.shape)
+
+    @property
+    def dtype(self):  # dtype the leaf dequantizes to (for size accounting)
+        return self.scale.dtype
+
+    @property
+    def ndim(self):
+        return self.q.ndim
+
+    def dequantize(self, dtype=jnp.bfloat16):
+        if self.bits == 8:
+            return (self.q.astype(jnp.float32) * self.scale).astype(dtype)
+        shape = self.q.shape
+        blocked = self.q.reshape(shape[:-2] + (shape[-2] // self.block_size, self.block_size, shape[-1]))
+        deq = blocked.astype(jnp.float32) * self.scale
+        return deq.reshape(shape).astype(dtype)
+
+    def nbytes(self) -> int:
+        qb = int(np.prod(self.q.shape)) * (1 if self.bits == 8 else 0.5)
+        return int(qb + self.scale.size * self.scale.dtype.itemsize)
+
+    def tree_flatten(self):
+        return (self.q, self.scale), (self.bits, self.block_size)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], aux[0], aux[1])
+
+    def __repr__(self):
+        return f"QuantizedTensor(int{self.bits}, shape={self.shape}, block={self.block_size})"
+
+
+def quantize_tensor(w, bits: int = 8, block_size: int = 64) -> QuantizedTensor:
+    """Symmetric quantization of a kernel leaf ``[..., in, out]``."""
+    w = jnp.asarray(w)
+    if w.ndim < 2:
+        raise ValueError(f"quantize_tensor expects ndim>=2, got {w.shape}")
+    f = w.astype(jnp.float32)
+    if bits == 8:
+        amax = jnp.max(jnp.abs(f), axis=-2, keepdims=True)      # [..., 1, out]
+        scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+        q = jnp.clip(jnp.round(f / scale), -127, 127).astype(jnp.int8)
+        return QuantizedTensor(q, scale, 8)
+    if bits == 4:
+        n_in = f.shape[-2]
+        if n_in % block_size != 0:
+            # shrink the block to the largest divisor (keeps exactness)
+            bs = block_size
+            while n_in % bs != 0:
+                bs //= 2
+            block_size = max(bs, 1)
+        blocked = f.reshape(f.shape[:-2] + (n_in // block_size, block_size, f.shape[-1]))
+        amax = jnp.max(jnp.abs(blocked), axis=-2, keepdims=True)  # [..., nb, 1, out]
+        scale = jnp.where(amax > 0, amax / 7.0, 1.0)
+        q = jnp.clip(jnp.round(blocked / scale), -8, 7).astype(jnp.int4)
+        return QuantizedTensor(q.reshape(f.shape), scale, 4, block_size)
+    raise ValueError(f"bits must be 4 or 8, got {bits}")
+
+
+def _is_quantized(x) -> bool:
+    return isinstance(x, QuantizedTensor)
+
+
+def quantize_params(params, config: QuantizationConfig):
+    """Quantize every eligible kernel leaf of a param pytree.
+
+    Eligible: ndim >= 2, size >= ``min_weight_size``, path not matching any
+    ``skip_modules`` regex (reference: keep_in_fp32 + skip list semantics,
+    utils/bnb.py:44-120).
+    """
+    skip = [re.compile(p) for p in config.skip_modules or []]
+
+    def _leaf(path, leaf):
+        if _is_quantized(leaf):
+            return leaf
+        shape = tuple(getattr(leaf, "shape", ()) or ())
+        if len(shape) < 2 or int(np.prod(shape)) < config.min_weight_size:
+            return leaf
+        path_str = "/".join(
+            str(getattr(k, "key", getattr(k, "idx", getattr(k, "name", k)))) for k in path
+        )
+        if any(p.search(path_str) for p in skip):
+            return leaf
+        return quantize_tensor(leaf, bits=config.bits, block_size=config.block_size)
+
+    return jax.tree_util.tree_map_with_path(_leaf, params, is_leaf=_is_quantized)
+
+
+def dequantize_params(params, dtype=jnp.bfloat16):
+    """Materialize every QuantizedTensor leaf back to a dense array."""
+    return jax.tree_util.tree_map(
+        lambda l: l.dequantize(dtype) if _is_quantized(l) else l,
+        params,
+        is_leaf=_is_quantized,
+    )
+
+
+def quantized_nbytes(params) -> int:
+    """Total at-rest bytes of a (partially) quantized tree."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(params, is_leaf=_is_quantized):
+        if _is_quantized(leaf):
+            total += leaf.nbytes()
+        elif hasattr(leaf, "nbytes"):
+            total += int(leaf.nbytes)
+    return total
+
+
+def quantizing_apply(apply_fn, compute_dtype=jnp.bfloat16):
+    """Wrap an apply so QuantizedTensor leaves dequantize lazily inside jit.
+
+    Under jit the dequant (``convert * scale``) fuses into the consuming
+    matmul; the dense copy exists transiently per-op, never at rest.
+    """
+
+    def wrapped(params, *args, **kwargs):
+        return apply_fn(dequantize_params(params, compute_dtype), *args, **kwargs)
+
+    return wrapped
+
+
+def load_and_quantize_model(
+    module,
+    checkpoint: Optional[str] = None,
+    params=None,
+    quantization_config: Optional[QuantizationConfig] = None,
+    dtype=None,
+):
+    """bnb-parity one-call entry (reference: load_and_quantize_model,
+    utils/bnb.py:44): load weights, quantize eligible leaves shard-by-shard
+    (host RSS stays ~one full-precision shard), return
+    ``(quantized_params, apply_fn)`` where ``apply_fn(params, *args)``
+    dequantizes lazily inside jit.
+    """
+    if quantization_config is None:
+        raise ValueError("quantization_config is required")
+    if (checkpoint is None) == (params is None):
+        raise ValueError("pass exactly one of checkpoint / params")
+
+    if checkpoint is not None:
+        from ..big_modeling import _checkpoint_shards, _nest
+        from safetensors import safe_open
+
+        skip = [re.compile(p) for p in quantization_config.skip_modules or []]
+        flat: dict = {}
+        for shard_path, keys in _checkpoint_shards(checkpoint):
+            with safe_open(shard_path, framework="numpy") as f:
+                for key in keys:
+                    arr = f.get_tensor(key)
+                    if dtype is not None:
+                        arr = arr.astype(dtype)
+                    # Quantize eligible tensors AS THEY STREAM so only the
+                    # int8/int4 form accumulates: host RSS peaks at ~one
+                    # full-precision shard, never the whole model.
+                    path_str = key.replace(".", "/")
+                    if (
+                        arr.ndim >= 2
+                        and arr.size >= quantization_config.min_weight_size
+                        and not any(p.search(path_str) for p in skip)
+                    ):
+                        flat[key] = quantize_tensor(
+                            arr, bits=quantization_config.bits,
+                            block_size=quantization_config.block_size,
+                        )
+                    else:
+                        flat[key] = arr
+        qparams = _nest(flat)
+    else:
+        if dtype is not None:
+            params = jax.tree_util.tree_map(lambda l: jnp.asarray(l, dtype), params)
+        qparams = quantize_params(params, quantization_config)
+
+    if hasattr(module, "apply"):
+        raw_apply = module.apply
+
+        def base_apply(p, *args, **kwargs):
+            variables = p if isinstance(p, dict) and "params" in p else {"params": p}
+            return raw_apply(variables, *args, **kwargs)
+
+    elif callable(module):
+        base_apply = module
+    else:
+        raise TypeError(f"cannot derive an apply fn from {type(module)}")
+    return qparams, quantizing_apply(base_apply, quantization_config.compute_dtype)

@@ -1,0 +1,158 @@
+"""Weight-only int8/int4 quantization (bnb capability parity)."""
+
+import os
+import tempfile
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from accelerate_tpu.utils.quantization import (
+    QuantizationConfig,
+    QuantizedTensor,
+    dequantize_params,
+    load_and_quantize_model,
+    quantize_params,
+    quantize_tensor,
+    quantized_nbytes,
+    quantizing_apply,
+)
+
+
+class TestQuantizeTensor:
+    def test_int8_round_trip_accuracy(self):
+        w = jax.random.normal(jax.random.PRNGKey(0), (64, 128))
+        qt = quantize_tensor(w, bits=8)
+        assert qt.q.dtype == jnp.int8 and qt.scale.shape == (1, 128)
+        err = np.abs(np.asarray(qt.dequantize(jnp.float32)) - np.asarray(w))
+        # per-channel symmetric int8: error bounded by scale/2 per channel
+        bound = np.asarray(qt.scale)[0] / 2 + 1e-7
+        assert (err <= bound[None, :]).all()
+
+    def test_int4_blockwise_round_trip(self):
+        w = jax.random.normal(jax.random.PRNGKey(1), (128, 64))
+        qt = quantize_tensor(w, bits=4, block_size=32)
+        assert qt.q.dtype == jnp.int4
+        assert qt.scale.shape == (4, 1, 64)
+        err = np.abs(np.asarray(qt.dequantize(jnp.float32)) - np.asarray(w))
+        scale = np.asarray(qt.scale)  # [4,1,64]
+        bound = np.repeat(scale, 32, axis=1).reshape(128, 64) / 2 + 1e-7
+        assert (err <= bound).all()
+
+    def test_int4_block_shrinks_to_divisor(self):
+        w = jax.random.normal(jax.random.PRNGKey(2), (48, 16))  # 48 % 64 != 0
+        qt = quantize_tensor(w, bits=4, block_size=64)
+        assert qt.block_size in (16, 48) or 48 % qt.block_size == 0
+        assert np.isfinite(np.asarray(qt.dequantize(jnp.float32))).all()
+
+    def test_stacked_leading_dims(self):
+        """Stacked layers [L, in, out] quantize per-layer-per-channel."""
+        w = jax.random.normal(jax.random.PRNGKey(3), (4, 32, 16))
+        qt = quantize_tensor(w, bits=8)
+        assert qt.scale.shape == (4, 1, 16)
+        err = np.abs(np.asarray(qt.dequantize(jnp.float32)) - np.asarray(w))
+        assert err.max() < np.abs(np.asarray(w)).max() / 64
+
+    def test_zero_channel_safe(self):
+        w = jnp.zeros((16, 8)).at[:, 0].set(1.0)
+        qt = quantize_tensor(w, bits=8)
+        np.testing.assert_allclose(np.asarray(qt.dequantize(jnp.float32)), np.asarray(w), atol=1e-6)
+
+    def test_pytree_transparency(self):
+        qt = quantize_tensor(jnp.ones((16, 8)), bits=8)
+        moved = jax.tree_util.tree_map(lambda x: x, {"k": qt})
+        assert isinstance(moved["k"], QuantizedTensor)
+        out = jax.jit(lambda t: t.dequantize().sum())(qt)
+        assert np.isclose(float(out), 128.0, rtol=1e-3)
+
+
+class TestQuantizeParams:
+    def _params(self):
+        k = jax.random.PRNGKey(0)
+        return {
+            "model": {
+                "layer": {"kernel": jax.random.normal(k, (128, 64)), "bias": jnp.zeros((64,))},
+                "norm": {"scale": jnp.ones((64,))},
+            },
+            "lm_head": {"kernel": jax.random.normal(k, (64, 256))},
+        }
+
+    def test_eligibility_rules(self):
+        cfg = QuantizationConfig(load_in_8bit=True, min_weight_size=1024)
+        q = quantize_params(self._params(), cfg)
+        assert isinstance(q["model"]["layer"]["kernel"], QuantizedTensor)
+        assert not isinstance(q["model"]["layer"]["bias"], QuantizedTensor)   # 1-D
+        assert not isinstance(q["model"]["norm"]["scale"], QuantizedTensor)   # tiny
+        assert not isinstance(q["lm_head"]["kernel"], QuantizedTensor)        # skipped
+
+    def test_idempotent(self):
+        cfg = QuantizationConfig(load_in_8bit=True, min_weight_size=1024)
+        q1 = quantize_params(self._params(), cfg)
+        q2 = quantize_params(q1, cfg)
+        assert isinstance(q2["model"]["layer"]["kernel"], QuantizedTensor)
+        assert q2["model"]["layer"]["kernel"].bits == 8
+
+    def test_size_accounting(self):
+        cfg = QuantizationConfig(load_in_8bit=True, min_weight_size=1024, skip_modules=[])
+        p = self._params()
+        dense_bytes = sum(l.nbytes for l in jax.tree_util.tree_leaves(p))
+        q = quantize_params(p, cfg)
+        assert quantized_nbytes(q) < dense_bytes * 0.45  # f32 -> ~int8 + scales
+
+    def test_config_validation(self):
+        with pytest.raises(ValueError, match="one of"):
+            QuantizationConfig(load_in_8bit=True, load_in_4bit=True)
+        with pytest.raises(ValueError, match="Set load_in"):
+            QuantizationConfig()
+
+
+class TestQuantizedForward:
+    def test_llama_quantized_forward_close_to_dense(self):
+        from accelerate_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+        cfg = LlamaConfig.tiny(use_flash_attention=False)
+        model = LlamaForCausalLM(cfg)
+        params = model.init_params(jax.random.PRNGKey(0), batch_size=1, seq_len=16)
+        qcfg = QuantizationConfig(load_in_8bit=True, min_weight_size=1024)
+        qparams = quantize_params(params, qcfg)
+
+        def base_apply(p, ids):
+            return model.apply({"params": p}, ids)
+
+        fwd = jax.jit(quantizing_apply(base_apply, jnp.float32))
+        ids = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, cfg.vocab_size)
+        ref = base_apply(params, ids)
+        out = fwd(qparams, ids)
+        # int8 weight-only: logits close in relative terms
+        rel = np.abs(np.asarray(out) - np.asarray(ref)).max() / (np.abs(np.asarray(ref)).max() + 1e-6)
+        assert rel < 0.1, rel
+
+    def test_load_and_quantize_from_checkpoint(self):
+        import flax.linen as nn
+        from safetensors.numpy import save_file
+
+        from accelerate_tpu.checkpointing import flatten_params
+
+        model = nn.Dense(32, param_dtype=jnp.float32)
+        params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 128)))["params"]
+        with tempfile.TemporaryDirectory() as td:
+            path = os.path.join(td, "model.safetensors")
+            save_file({k: np.ascontiguousarray(v) for k, v in flatten_params(params).items()}, path)
+            qcfg = QuantizationConfig(load_in_8bit=True, min_weight_size=1024, skip_modules=[])
+            qparams, apply_fn = load_and_quantize_model(
+                model, checkpoint=path, quantization_config=qcfg
+            )
+        assert isinstance(qparams["kernel"], QuantizedTensor)
+        x = jnp.ones((2, 128))
+        out = apply_fn(qparams, x)
+        ref = model.apply({"params": params}, x)
+        rel = np.abs(np.asarray(out, np.float32) - np.asarray(ref)).max() / np.abs(np.asarray(ref)).max()
+        assert rel < 0.05, rel
+
+    def test_dequantize_params_materializes(self):
+        cfg = QuantizationConfig(load_in_4bit=True, min_weight_size=64, skip_modules=[])
+        p = {"w": jax.random.normal(jax.random.PRNGKey(0), (64, 32))}
+        q = quantize_params(p, cfg)
+        d = dequantize_params(q, jnp.float32)
+        assert d["w"].shape == (64, 32) and d["w"].dtype == jnp.float32
