@@ -372,17 +372,30 @@ def save_model(accelerator, model, save_directory: str, max_shard_size: str = "1
     accelerator.wait_for_everyone()
 
 
-def load_safetensors_model(save_directory: str) -> dict:
-    """Load a safetensors export back into a nested param pytree."""
-    from safetensors.numpy import load_file
+def load_safetensors_model(save_directory: str, threads: int = 8) -> dict:
+    """Load a safetensors export back into a nested param pytree.
+
+    Uses the native parallel reader (native/io.py) — one thread per tensor
+    stripe — falling back to safetensors' sequential loader without it.
+    """
+    from .native.io import fast_load_safetensors
 
     d = Path(save_directory)
     index_path = d / SAFE_WEIGHTS_INDEX_NAME
     flat: dict = {}
+
+    def _load_one(path):
+        try:
+            return fast_load_safetensors(str(path), threads=threads)
+        except ValueError:  # exotic dtype the fast path doesn't map
+            from safetensors.numpy import load_file
+
+            return load_file(path)
+
     if index_path.exists():
         index = json.loads(index_path.read_text())
         for name in sorted(set(index["weight_map"].values())):
-            flat.update(load_file(d / name))
+            flat.update(_load_one(d / name))
     else:
-        flat = load_file(d / "model.safetensors")
+        flat = _load_one(d / "model.safetensors")
     return unflatten_params(flat)

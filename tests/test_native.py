@@ -1,0 +1,200 @@
+"""Native host-IO runtime: parallel reads, prefetch ring, token loader."""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+from accelerate_tpu.native import PrefetchRing, available, parallel_read
+from accelerate_tpu.native.io import TokenBinDataLoader, fast_load_safetensors
+
+
+@pytest.fixture(scope="module")
+def token_file():
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "tokens.bin")
+        tokens = np.arange(10_000, dtype=np.int32)
+        tokens.tofile(path)
+        yield path, tokens
+
+
+class TestParallelRead:
+    def test_native_lib_builds(self):
+        assert available(), "native lib should compile in this environment"
+
+    def test_regions_round_trip(self, token_file):
+        path, tokens = token_file
+        # read 50 scattered 400-byte regions
+        rng = np.random.default_rng(0)
+        offsets = rng.integers(0, tokens.nbytes - 400, 50).astype(np.int64)
+        sizes = np.full(50, 400, np.int64)
+        dests = [np.empty(400, np.uint8) for _ in range(50)]
+        parallel_read(path, offsets, sizes, dests, threads=8)
+        raw = tokens.tobytes()
+        for off, d in zip(offsets, dests):
+            assert d.tobytes() == raw[off : off + 400]
+
+    def test_validation(self, token_file):
+        path, _ = token_file
+        with pytest.raises(ValueError, match="equal length"):
+            parallel_read(path, [0], [4, 8], [np.empty(8, np.uint8)])
+        with pytest.raises(ValueError, match="smaller"):
+            parallel_read(path, [0], [400], [np.empty(4, np.uint8)])
+
+    def test_missing_file_raises(self):
+        with pytest.raises(IOError):
+            parallel_read("/nonexistent/file.bin", [0], [4], [np.empty(4, np.uint8)])
+
+
+class TestPrefetchRing:
+    def test_ordered_exact_batches(self, token_file):
+        path, tokens = token_file
+        sample_bytes = 16 * 4
+        offsets = (np.arange(40, dtype=np.int64) * sample_bytes)
+        ring = PrefetchRing(path, offsets, sample_bytes, batch_size=8, depth=3, threads=4)
+        assert ring.num_batches == 5
+        seen = []
+        for buf, valid in ring:
+            assert valid == 8
+            seen.append(buf.view(np.int32).reshape(8, 16).copy())
+        assert len(seen) == 5
+        got = np.concatenate(seen).reshape(-1)
+        np.testing.assert_array_equal(got, tokens[: 40 * 16])
+
+    def test_shuffled_schedule_respected(self, token_file):
+        path, tokens = token_file
+        sample_bytes = 8 * 4
+        order = np.array([5, 0, 3, 1], dtype=np.int64)
+        ring = PrefetchRing(path, order * sample_bytes, sample_bytes, batch_size=2)
+        batches = [buf.view(np.int32).reshape(2, 8)[:v].copy() for buf, v in ring]
+        flat = np.concatenate(batches)
+        for row, idx in zip(flat, order):
+            np.testing.assert_array_equal(row, tokens[idx * 8 : idx * 8 + 8])
+
+    def test_partial_final_batch(self, token_file):
+        path, _ = token_file
+        offsets = (np.arange(5, dtype=np.int64) * 32)
+        ring = PrefetchRing(path, offsets, 32, batch_size=2)
+        valids = [v for _, v in ring]
+        assert valids == [2, 2, 1]
+
+    def test_python_fallback_matches(self, token_file, monkeypatch):
+        path, tokens = token_file
+        sample_bytes = 8 * 4
+        offsets = np.arange(6, dtype=np.int64) * sample_bytes
+        ring = PrefetchRing(path, offsets, sample_bytes, batch_size=3)
+        native = [(b.copy(), v) for b, v in ring]
+        ring_py = PrefetchRing(path, offsets, sample_bytes, batch_size=3)
+        ring_py._lib = None
+        fallback = [(b.copy(), v) for b, v in ring_py._python_iter()]
+        assert len(native) == len(fallback)
+        for (a, va), (b, vb) in zip(native, fallback):
+            assert va == vb
+            np.testing.assert_array_equal(a[: va * sample_bytes], b[: va * sample_bytes])
+
+
+class TestTokenBinDataLoader:
+    def test_epoch_coverage_and_shapes(self, token_file):
+        path, tokens = token_file
+        dl = TokenBinDataLoader(path, seq_len=64, batch_size=4, shuffle=False)
+        batches = list(dl)
+        assert all(b["input_ids"].shape == (4, 64) for b in batches)
+        got = np.concatenate([b["input_ids"] for b in batches]).reshape(-1)
+        n = len(got)
+        np.testing.assert_array_equal(got, tokens[:n])
+        assert len(batches) == len(dl)
+
+    def test_sharding_disjoint_and_complete(self, token_file):
+        path, _ = token_file
+        all_rows = []
+        for rank in range(4):
+            dl = TokenBinDataLoader(
+                path, seq_len=32, batch_size=2, shuffle=True, seed=7,
+                num_processes=4, process_index=rank,
+            )
+            all_rows += [tuple(r) for b in dl for r in b["input_ids"]]
+        # disjoint across ranks
+        assert len(all_rows) == len(set(all_rows))
+
+    def test_shuffle_determinism_and_epoch_change(self, token_file):
+        path, _ = token_file
+        dl = TokenBinDataLoader(path, seq_len=32, batch_size=4, shuffle=True, seed=3)
+        e0a = np.concatenate([b["input_ids"] for b in dl])
+        dl2 = TokenBinDataLoader(path, seq_len=32, batch_size=4, shuffle=True, seed=3)
+        e0b = np.concatenate([b["input_ids"] for b in dl2])
+        np.testing.assert_array_equal(e0a, e0b)
+        dl2.set_epoch(1)
+        e1 = np.concatenate([b["input_ids"] for b in dl2])
+        assert not np.array_equal(e0a, e1)
+
+    def test_resume_skips_consumed_batches(self, token_file):
+        path, _ = token_file
+        dl = TokenBinDataLoader(path, seq_len=32, batch_size=4, shuffle=True, seed=5)
+        it = iter(dl)
+        consumed = [next(it)["input_ids"].copy() for _ in range(3)]
+        state = dl.state_dict()
+        rest_after_resume = []
+        dl2 = TokenBinDataLoader(path, seq_len=32, batch_size=4, shuffle=True, seed=5)
+        dl2.load_state_dict(state)
+        rest_after_resume = [b["input_ids"].copy() for b in dl2]
+        full = [b["input_ids"].copy() for b in TokenBinDataLoader(
+            path, seq_len=32, batch_size=4, shuffle=True, seed=5)]
+        np.testing.assert_array_equal(
+            np.concatenate(rest_after_resume), np.concatenate(full[3:])
+        )
+
+    def test_feeds_train_step(self, token_file):
+        import jax
+        import optax
+
+        from accelerate_tpu import Accelerator, MeshConfig, Model
+        from accelerate_tpu.data_loader import make_global_batch
+        from accelerate_tpu.models.llama import LlamaConfig, LlamaForCausalLM, causal_lm_loss
+
+        path, _ = token_file
+        cfg = LlamaConfig.tiny(vocab_size=16384, use_flash_attention=False)
+        model_def = LlamaForCausalLM(cfg)
+        params = model_def.init_params(jax.random.PRNGKey(0), batch_size=2, seq_len=32)
+        acc = Accelerator(mesh_config=MeshConfig(dp=8))
+        model, opt = acc.prepare(Model(model_def, params), optax.adamw(1e-3))
+        step = acc.compile_train_step(causal_lm_loss(model_def.apply))
+        dl = TokenBinDataLoader(path, seq_len=32, batch_size=8, shuffle=True)
+        for i, batch in enumerate(dl):
+            m = step(make_global_batch(batch, acc.mesh))
+            if i >= 2:
+                break
+        assert np.isfinite(float(m["loss"]))
+
+
+class TestFastSafetensors:
+    def test_matches_safe_open(self):
+        from safetensors.numpy import save_file
+        from safetensors import safe_open
+
+        rng = np.random.default_rng(0)
+        tensors = {
+            "a.weight": rng.normal(size=(128, 64)).astype(np.float32),
+            "a.bias": rng.normal(size=(64,)).astype(np.float32),
+            "b.weight": rng.integers(-100, 100, (32, 16)).astype(np.int32),
+            "c.half": rng.normal(size=(8, 8)).astype(np.float16),
+        }
+        with tempfile.TemporaryDirectory() as td:
+            path = os.path.join(td, "m.safetensors")
+            save_file(tensors, path)
+            loaded = fast_load_safetensors(path, threads=4)
+            assert set(loaded) == set(tensors)
+            for k in tensors:
+                np.testing.assert_array_equal(loaded[k], tensors[k])
+
+    def test_bf16(self):
+        import ml_dtypes
+        from safetensors.numpy import save_file
+
+        w = np.arange(64, dtype=np.float32).reshape(8, 8).astype(ml_dtypes.bfloat16)
+        with tempfile.TemporaryDirectory() as td:
+            path = os.path.join(td, "m.safetensors")
+            save_file({"w": w}, path)
+            loaded = fast_load_safetensors(path)
+            assert loaded["w"].dtype == ml_dtypes.bfloat16
+            np.testing.assert_array_equal(loaded["w"], w)
