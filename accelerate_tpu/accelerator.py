@@ -701,6 +701,9 @@ class Accelerator:
         tx = optimizer.tx
         has_scale = optimizer.loss_scale is not None
         scaler_kwargs = optimizer.scaler_kwargs
+        from .ops.quant import fp8_meta_mask, has_fp8_meta
+
+        fp8_mask = fp8_meta_mask(model.params) if has_fp8_meta(model.params) else None
 
         def loss_and_grads(params, microbatch, rng, scale):
             def compute(p):
@@ -745,10 +748,30 @@ class Accelerator:
 
             gnorm = None
             if max_grad_norm is not None:
-                leaves = jax.tree_util.tree_leaves(grads)
+                # fp8 statistics leaves carry updated amax/scale values in
+                # their "gradients" (ops/quant.py): they must neither enter
+                # the norm nor be scaled by the clip factor.
+                if fp8_mask is not None:
+                    leaves = [
+                        g
+                        for g, is_meta in zip(
+                            jax.tree_util.tree_leaves(grads),
+                            jax.tree_util.tree_leaves(fp8_mask),
+                        )
+                        if not is_meta
+                    ]
+                else:
+                    leaves = jax.tree_util.tree_leaves(grads)
                 gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in leaves))
                 factor = jnp.minimum(1.0, max_grad_norm / (gnorm + 1e-6))
-                grads = jax.tree_util.tree_map(lambda g: (g * factor).astype(g.dtype), grads)
+                if fp8_mask is not None:
+                    grads = jax.tree_util.tree_map(
+                        lambda g, is_meta: g if is_meta else (g * factor).astype(g.dtype),
+                        grads,
+                        fp8_mask,
+                    )
+                else:
+                    grads = jax.tree_util.tree_map(lambda g: (g * factor).astype(g.dtype), grads)
 
             updates, new_opt_state = tx.update(grads, opt_state, params)
             new_params = optax.apply_updates(params, updates)

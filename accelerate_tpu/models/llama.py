@@ -44,6 +44,15 @@ class LlamaConfig:
     # 'auto' uses ring/Ulysses context parallelism when the ambient mesh has
     # cp > 1 (ops/ring_attention.py), flash/einsum otherwise.
     attention_backend: str = "auto"
+    # fp8 projections (ops/quant.py Fp8Dense, delayed scaling): the TE-swap
+    # equivalent (reference: utils/transformer_engine.py:40-49). Pair with
+    # Accelerator(mixed_precision="fp8") — the fp8 statistics params are
+    # partitioned out of the optimizer automatically.
+    use_fp8: bool = False
+    fp8_margin: int = 0
+    fp8_amax_history_len: int = 16
+    fp8_amax_compute_algo: str = "max"
+    fp8_format: str = "HYBRID"  # HYBRID: e4m3 fwd / e5m2 bwd
 
     @classmethod
     def llama3_8b(cls, **overrides):
@@ -67,6 +76,26 @@ class LlamaConfig:
     @property
     def head_dim(self):
         return self.hidden_size // self.num_attention_heads
+
+
+def _dense_factory(cfg: "LlamaConfig", compute_dtype):
+    """Projection-layer constructor honoring ``cfg.use_fp8``."""
+    if not cfg.use_fp8:
+        return lambda feats, name: nn.Dense(
+            feats, use_bias=False, name=name, dtype=compute_dtype, param_dtype=jnp.float32
+        )
+    from ..ops.quant import E4M3, E5M2, Fp8Dense
+
+    fwd, bwd = {
+        "HYBRID": (E4M3, E5M2),
+        "E4M3": (E4M3, E4M3),
+        "E5M2": (E5M2, E5M2),
+    }[cfg.fp8_format]
+    return lambda feats, name: Fp8Dense(
+        feats, use_bias=False, name=name, dtype=compute_dtype,
+        margin=cfg.fp8_margin, amax_history_len=cfg.fp8_amax_history_len,
+        amax_compute_algo=cfg.fp8_amax_compute_algo, fwd_dtype=fwd, bwd_dtype=bwd,
+    )
 
 
 class RMSNorm(nn.Module):
@@ -147,7 +176,7 @@ class LlamaAttention(nn.Module):
         cfg = self.config
         B, S, _ = x.shape
         n_q, n_kv, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
-        dense = lambda feats, name: nn.Dense(feats, use_bias=False, name=name, dtype=x.dtype, param_dtype=jnp.float32)
+        dense = _dense_factory(cfg, x.dtype)
         q = dense(n_q * hd, "q_proj")(x).reshape(B, S, n_q, hd)
         k = dense(n_kv * hd, "k_proj")(x).reshape(B, S, n_kv, hd)
         v = dense(n_kv * hd, "v_proj")(x).reshape(B, S, n_kv, hd)
@@ -174,7 +203,7 @@ class LlamaMLP(nn.Module):
     @nn.compact
     def __call__(self, x):
         cfg = self.config
-        dense = lambda feats, name: nn.Dense(feats, use_bias=False, name=name, dtype=x.dtype, param_dtype=jnp.float32)
+        dense = _dense_factory(cfg, x.dtype)
         gate = dense(cfg.intermediate_size, "gate_proj")(x)
         up = dense(cfg.intermediate_size, "up_proj")(x)
         return dense(cfg.hidden_size, "down_proj")(jax.nn.silu(gate) * up)
@@ -223,6 +252,8 @@ class LlamaForCausalLM(nn.Module):
             embed = self.variables["params"]["model"]["embed_tokens"]["embedding"]
             logits = x @ embed.T.astype(x.dtype)
         else:
+            # The lm_head stays high-precision even under fp8 — its output
+            # feeds the softmax directly (standard TE practice).
             logits = nn.Dense(cfg.vocab_size, use_bias=False, name="lm_head", dtype=x.dtype,
                               param_dtype=jnp.float32)(x)
         return logits

@@ -36,13 +36,27 @@ def _source_tag() -> str:
         return hashlib.sha256(f.read()).hexdigest()[:16]
 
 
+def _is_stamped(so_path: str, tag: str) -> bool:
+    """True if the binary embeds the current source hash. Checked on the raw
+    bytes (no dlopen) so a stale/tampered cache is never executed."""
+    try:
+        with open(so_path, "rb") as f:
+            return f"ATPU_HASH:{tag}".encode() in f.read()
+    except OSError:
+        return False
+
+
 def _build() -> str:
     os.makedirs(_BUILD_DIR, exist_ok=True)
-    so_path = os.path.join(_BUILD_DIR, f"libatpu_native_{_source_tag()}.so")
-    if os.path.exists(so_path):
+    tag = _source_tag()
+    so_path = os.path.join(_BUILD_DIR, f"libatpu_native_{tag}.so")
+    if os.path.exists(so_path) and _is_stamped(so_path, tag):
         return so_path
     tmp = so_path + f".tmp.{os.getpid()}"
-    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread", _SRC, "-o", tmp]
+    cmd = [
+        "g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+        f'-DATPU_SOURCE_HASH="{tag}"', _SRC, "-o", tmp,
+    ]
     subprocess.run(cmd, check=True, capture_output=True, text=True)
     os.replace(tmp, so_path)  # atomic: concurrent builders race harmlessly
     return so_path
@@ -111,6 +125,13 @@ def parallel_read(path: str, offsets, sizes, dests: Sequence[np.ndarray], thread
             for off, size, dest in zip(offsets, sizes, dests):
                 f.seek(int(off))
                 buf = f.read(int(size))
+                if len(buf) != int(size):
+                    # Same contract as the native path: a truncated region is
+                    # an IO error, never silently-garbage weights.
+                    raise IOError(
+                        f"{path}: short read at offset {int(off)} "
+                        f"({len(buf)} of {int(size)} bytes)"
+                    )
                 dest.view(np.uint8).reshape(-1)[: len(buf)] = np.frombuffer(buf, np.uint8)
         return
     ptrs = (ctypes.c_void_p * len(dests))(
@@ -195,7 +216,13 @@ class PrefetchRing:
                 out = np.empty(self.batch_size * self.sample_bytes, np.uint8)
                 for i, off in enumerate(idx):
                     f.seek(int(off))
+                    buf = f.read(self.sample_bytes)
+                    if len(buf) != self.sample_bytes:
+                        raise IOError(
+                            f"{self.path}: short read at offset {int(off)} "
+                            f"({len(buf)} of {self.sample_bytes} bytes)"
+                        )
                     out[i * self.sample_bytes : (i + 1) * self.sample_bytes] = np.frombuffer(
-                        f.read(self.sample_bytes), np.uint8
+                        buf, np.uint8
                     )
                 yield out, len(idx)

@@ -133,6 +133,14 @@ struct Ring {
 
 extern "C" {
 
+// Source-hash stamp, injected at build time (-DATPU_SOURCE_HASH="...").
+// The loader greps the binary for the "ATPU_HASH:<hash>" literal before
+// dlopen-ing, so a stale or tampered cache is rebuilt instead of trusted.
+#ifndef ATPU_SOURCE_HASH
+#define ATPU_SOURCE_HASH "unstamped"
+#endif
+const char* atpu_source_hash() { return "ATPU_HASH:" ATPU_SOURCE_HASH; }
+
 // Parallel gather of n regions from path into dests. Returns 0 / -1.
 int atpu_par_read(const char* path, const int64_t* offsets, const int64_t* sizes,
                   unsigned char* const* dests, int64_t n, int threads) {

@@ -136,6 +136,9 @@ class TokenBinDataLoader:
     def load_state_dict(self, state: dict):
         self.epoch = int(state.get("epoch", 0))
         self._skip_batches = int(state.get("skip_batches", 0))
+        # Restored progress counts as seen so a save-before-iterating
+        # round-trips instead of reporting a stale or zero position.
+        self._batches_seen = self._skip_batches
 
     def _schedule(self) -> np.ndarray:
         """This process's sample byte offsets for the current epoch."""

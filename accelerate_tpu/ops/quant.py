@@ -1,0 +1,297 @@
+"""Delayed-scaling FP8 training: fp8 matmuls with amax-history scale tracking.
+
+TPU-native replacement for the reference's TransformerEngine integration
+(reference: src/accelerate/utils/transformer_engine.py:26-137 swaps
+torch.nn.Linear for te.Linear under an fp8_autocast; MS-AMP path at
+accelerator.py:1992). The design maps TE's recipe onto JAX's functional
+model:
+
+* The dot executes on true fp8 operands — e4m3 forward / e5m2 for the
+  incoming gradient (TE "HYBRID" format) — with an fp32 accumulator, via
+  ``lax.dot_general`` on ``float8_e4m3fn`` / ``float8_e5m2`` arrays. XLA
+  lowers these to native fp8 MXU ops where the TPU generation supports it
+  and to widened matmuls elsewhere, so the same program runs everywhere.
+* TE's mutable "fp8 meta" tensors (amax history + scale per operand) become
+  ordinary parameters of :class:`Fp8Dense`. Their *gradients* are hijacked
+  to carry the updated statistics out of the backward pass — the standard
+  JAX trick for threading side-band state through ``custom_vjp`` — and an
+  optax partition (:func:`wrap_optimizer_for_fp8`) applies them as
+  overwrites instead of SGD steps. No mutable module state, no autocast
+  context: the whole recipe lives inside the compiled train step.
+* Scaling is *delayed* exactly like TE's DelayedScaling: quantization uses
+  the scale computed from the amax history of previous steps; the current
+  step's amaxes only enter the history for future steps.
+
+``FP8RecipeKwargs`` (utils/dataclasses.py) configures margin / history
+length / amax algorithm; ``Accelerator(mixed_precision="fp8")`` applies the
+optimizer partition automatically when the model contains fp8 meta params.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+E4M3 = jnp.float8_e4m3fn
+E5M2 = jnp.float8_e5m2
+
+#: Parameter names that carry fp8 statistics rather than weights. Used to
+#: partition the optimizer and to exclude these leaves from grad clipping.
+FP8_META_NAMES = frozenset(
+    {
+        "input_scale",
+        "kernel_scale",
+        "grad_scale",
+        "input_amax_history",
+        "kernel_amax_history",
+        "grad_amax_history",
+    }
+)
+
+_META_SCALES = ("input_scale", "kernel_scale", "grad_scale")
+_META_HISTS = ("input_amax_history", "kernel_amax_history", "grad_amax_history")
+
+
+def _amax(x) -> jnp.ndarray:
+    return jnp.max(jnp.abs(x.astype(jnp.float32)))
+
+
+def _quantize(x, scale, dtype):
+    """Quantize to fp8 with a divisor ``scale``: q ≈ x / scale."""
+    fp8_max = float(jnp.finfo(dtype).max)
+    q = x.astype(jnp.float32) / jnp.maximum(scale, 1e-12)
+    return jnp.clip(q, -fp8_max, fp8_max).astype(dtype)
+
+
+def _rolled(history, new_amax):
+    """Push ``new_amax`` into slot 0 of the history ring."""
+    return jnp.roll(history, 1).at[0].set(new_amax)
+
+
+def _next_scale(history, prev_scale, dtype, margin: int, algo: str):
+    """Delayed-scaling update: divisor so the history's amax maps to fp8
+    max, with 2**margin headroom. Zero/non-finite history keeps the old
+    scale (TE semantics: don't rescale until real data flows)."""
+    amax = jnp.max(history) if algo == "max" else history[0]
+    fp8_max = float(jnp.finfo(dtype).max)
+    proposed = amax / fp8_max * (2.0 ** margin)
+    ok = (amax > 0) & jnp.isfinite(amax)
+    return jnp.where(ok, proposed, prev_scale).astype(jnp.float32)
+
+
+@functools.lru_cache(maxsize=None)
+def _fp8_matmul_fn(fwd_dtype_name: str, bwd_dtype_name: str, margin: int, algo: str):
+    """Build the custom-VJP fp8 matmul for one recipe configuration."""
+    fwd_dtype = jnp.dtype(fwd_dtype_name).type
+    bwd_dtype = jnp.dtype(bwd_dtype_name).type
+
+    @jax.custom_vjp
+    def fp8_matmul(x, kernel, meta):
+        y, _ = _fwd(x, kernel, meta)
+        return y
+
+    def _fwd(x, kernel, meta):
+        qx = _quantize(x, meta["input_scale"], fwd_dtype)
+        qk = _quantize(kernel, meta["kernel_scale"], fwd_dtype)
+        y = jax.lax.dot_general(
+            qx, qk, (((x.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        y = (y * (meta["input_scale"] * meta["kernel_scale"])).astype(x.dtype)
+        # Empty arrays carry the primal dtypes into the backward pass (raw
+        # dtype objects are not valid residual leaves).
+        x_tag = jnp.zeros((0,), x.dtype)
+        k_tag = jnp.zeros((0,), kernel.dtype)
+        residuals = (qx, qk, meta, _amax(x), _amax(kernel), x_tag, k_tag)
+        return y, residuals
+
+    def _bwd(residuals, dy):
+        qx, qk, meta, amax_x, amax_k, x_tag, k_tag = residuals
+        x_dtype, k_dtype = x_tag.dtype, k_tag.dtype
+        g_scale = meta["grad_scale"]
+        qdy = _quantize(dy, g_scale, bwd_dtype)
+        # dx = dy @ kernel.T ; dk = x.T @ dy — both on fp8 operands.
+        dx = jax.lax.dot_general(
+            qdy, qk, (((qdy.ndim - 1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * (g_scale * meta["kernel_scale"])
+        batch_axes = tuple(range(qx.ndim - 1))
+        dk = jax.lax.dot_general(
+            qx, qdy, ((batch_axes, batch_axes), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * (meta["input_scale"] * g_scale)
+
+        new_hists = {
+            "input_amax_history": _rolled(meta["input_amax_history"], amax_x),
+            "kernel_amax_history": _rolled(meta["kernel_amax_history"], amax_k),
+            "grad_amax_history": _rolled(meta["grad_amax_history"], _amax(dy)),
+        }
+        # The meta "cotangents" are the *next values* of the statistics;
+        # overwrite_with_cotangent() applies them verbatim.
+        dmeta = {
+            **new_hists,
+            "input_scale": _next_scale(
+                new_hists["input_amax_history"], meta["input_scale"], fwd_dtype, margin, algo
+            ),
+            "kernel_scale": _next_scale(
+                new_hists["kernel_amax_history"], meta["kernel_scale"], fwd_dtype, margin, algo
+            ),
+            "grad_scale": _next_scale(
+                new_hists["grad_amax_history"], g_scale, bwd_dtype, margin, algo
+            ),
+        }
+        return dx.astype(x_dtype), dk.astype(k_dtype), dmeta
+
+    fp8_matmul.defvjp(_fwd, _bwd)
+    return fp8_matmul
+
+
+def fp8_matmul(
+    x,
+    kernel,
+    meta: dict,
+    *,
+    fwd_dtype=E4M3,
+    bwd_dtype=E5M2,
+    margin: int = 0,
+    amax_compute_algo: str = "max",
+):
+    """``x @ kernel`` on fp8 operands with delayed scaling.
+
+    ``meta`` holds the six statistics leaves named in :data:`FP8_META_NAMES`.
+    Gradients w.r.t. ``meta`` carry the updated statistics (not descent
+    directions); pair with :func:`wrap_optimizer_for_fp8`.
+    """
+    fn = _fp8_matmul_fn(
+        jnp.dtype(fwd_dtype).name, jnp.dtype(bwd_dtype).name, int(margin), amax_compute_algo
+    )
+    return fn(x, kernel, meta)
+
+
+try:  # flax is a hard dependency of the model zoo, soft here
+    import flax.linen as nn
+
+    class Fp8Dense(nn.Module):
+        """Drop-in ``nn.Dense`` executing its matmul in fp8.
+
+        Parity target: TransformerEngine's ``te.Linear`` swap (reference:
+        utils/transformer_engine.py:40-49). The six statistics live as
+        parameters next to the kernel; see the module docstring for how
+        their updates flow.
+        """
+
+        features: int
+        use_bias: bool = False
+        dtype: Any = None
+        param_dtype: Any = jnp.float32
+        kernel_init: Any = nn.initializers.lecun_normal()
+        bias_init: Any = nn.initializers.zeros_init()
+        margin: int = 0
+        amax_history_len: int = 16
+        amax_compute_algo: str = "max"
+        fwd_dtype: Any = E4M3
+        bwd_dtype: Any = E5M2
+
+        @nn.compact
+        def __call__(self, x):
+            d_in = x.shape[-1]
+            kernel = self.param(
+                "kernel", self.kernel_init, (d_in, self.features), self.param_dtype
+            )
+            meta = {
+                name: self.param(name, nn.initializers.ones, (), jnp.float32)
+                for name in _META_SCALES
+            }
+            meta.update(
+                {
+                    name: self.param(
+                        name, nn.initializers.zeros, (self.amax_history_len,), jnp.float32
+                    )
+                    for name in _META_HISTS
+                }
+            )
+            if self.dtype is not None:
+                x = x.astype(self.dtype)
+            y = fp8_matmul(
+                x, kernel, meta,
+                fwd_dtype=self.fwd_dtype, bwd_dtype=self.bwd_dtype,
+                margin=self.margin, amax_compute_algo=self.amax_compute_algo,
+            )
+            if self.use_bias:
+                bias = self.param("bias", self.bias_init, (self.features,), self.param_dtype)
+                y = y + bias.astype(y.dtype)
+            return y
+
+except ImportError:  # pragma: no cover
+    Fp8Dense = None
+
+
+# ---------------------------------------------------------------------------
+# Optimizer integration
+# ---------------------------------------------------------------------------
+
+def _leaf_name(path) -> str | None:
+    if not path:
+        return None
+    last = path[-1]
+    return getattr(last, "key", None) or getattr(last, "name", None)
+
+
+def fp8_meta_mask(params):
+    """Bool pytree: True on fp8 statistics leaves (by parameter name)."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, _: _leaf_name(path) in FP8_META_NAMES, params
+    )
+
+
+def has_fp8_meta(params) -> bool:
+    return any(jax.tree_util.tree_leaves(fp8_meta_mask(params)))
+
+
+def overwrite_with_cotangent():
+    """optax transformation that *replaces* a param with its incoming
+    "gradient" — which, for fp8 meta leaves, is the next statistic value."""
+    import optax
+
+    def init_fn(params):
+        del params
+        return optax.EmptyState()
+
+    def update_fn(updates, state, params=None):
+        if params is None:
+            raise ValueError("overwrite_with_cotangent requires params")
+        # apply_updates adds: new = p + (g - p) = g.
+        return jax.tree_util.tree_map(lambda g, p: g - p, updates, params), state
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def recipe_to_config_kwargs(recipe) -> dict:
+    """Translate an ``FP8RecipeKwargs`` handler into model-config fields
+    (``LlamaConfig(**recipe_to_config_kwargs(recipe))``)."""
+    return {
+        "use_fp8": True,
+        "fp8_margin": recipe.margin,
+        "fp8_amax_history_len": recipe.amax_history_len,
+        "fp8_amax_compute_algo": recipe.amax_compute_algo,
+        "fp8_format": recipe.fp8_format,
+    }
+
+
+def wrap_optimizer_for_fp8(tx, params):
+    """Partition ``tx`` so fp8 statistics are overwritten, everything else
+    optimized normally. No-op (returns ``tx``) without fp8 meta leaves."""
+    import optax
+
+    if not has_fp8_meta(params):
+        return tx
+    labels = jax.tree_util.tree_map_with_path(
+        lambda path, _: "fp8_meta" if _leaf_name(path) in FP8_META_NAMES else "default",
+        params,
+    )
+    return optax.multi_transform(
+        {"default": tx, "fp8_meta": overwrite_with_cotangent()}, labels
+    )

@@ -78,7 +78,18 @@ class AcceleratedOptimizer:
         opt_state leaves that mirror params (mu/nu) inherit the param
         shardings via jit's sharding propagation: we init under jit with
         out_shardings left to GSPMD.
+
+        Models containing fp8 statistics params (ops/quant.py Fp8Dense) get
+        the optimizer partitioned automatically: statistics leaves are
+        overwritten with their updated values, never Adam-stepped.
         """
+        from .ops.quant import wrap_optimizer_for_fp8
+
+        if not getattr(self, "_fp8_wrapped", False):
+            wrapped = wrap_optimizer_for_fp8(self.tx, params)
+            if wrapped is not self.tx:
+                self.tx = wrapped
+                self._fp8_wrapped = True
         if self.param_shardings is not None:
             init = jax.jit(self.tx.init)
             self.opt_state = init(params)

@@ -37,6 +37,9 @@ logger = logging.getLogger(__name__)
 # Only used on the host-platform testing path.
 _CPU_DEVICE_COUNT_FLAG = "--xla_force_host_platform_device_count"
 
+# One-time flag: the ambient-mesh probe failed (jax internals moved).
+_mesh_probe_warned = False
+
 
 def is_initialized() -> bool:
     """Whether a PartialState has been constructed (reference: state.py:102)."""
@@ -54,13 +57,27 @@ def current_mesh(mesh=None):
     if mesh is not None:
         return mesh
     try:
-        from jax._src import mesh as mesh_lib
+        # jax.interpreters.pxla.thread_resources is the closest thing to a
+        # public accessor for the `with mesh:` context (deprecated alias of
+        # jax._src.mesh.thread_resources; get_abstract_mesh() only covers
+        # use_mesh, not the context manager).
+        import warnings
 
-        phys = mesh_lib.thread_resources.env.physical_mesh
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", DeprecationWarning)
+            from jax.interpreters.pxla import thread_resources
+
+        phys = thread_resources.env.physical_mesh
         if phys is not None and not phys.empty:
             return phys
     except Exception:
-        pass
+        global _mesh_probe_warned
+        if not _mesh_probe_warned:
+            _mesh_probe_warned = True
+            logger.warning(
+                "cannot resolve the ambient `with mesh:` context on this jax "
+                "version; pass mesh= explicitly to mesh-aware ops"
+            )
     if AcceleratorState._shared_state:
         m = AcceleratorState().mesh
         if m is not None:

@@ -1,0 +1,173 @@
+"""Backend selection that survives broken or hanging PJRT plugins.
+
+Some sandboxes pre-register an experimental TPU platform via ``sitecustomize``
+that (a) overrides ``JAX_PLATFORMS=cpu`` set in the environment and (b) can
+block forever inside backend initialization when the device tunnel is down.
+Two consequences drive the design here:
+
+- The only reliable CPU override is ``jax.config.update("jax_platforms",
+  "cpu")`` applied in-process *before the first device query*.
+- Asking "is the default backend usable at all?" must happen in a throwaway
+  subprocess with a hard timeout, so a hung PJRT client cannot take the
+  asking process down with it.
+
+Every driver-facing entrypoint (``bench.py``, ``__graft_entry__``, the CLI)
+routes its backend decisions through this module.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+
+_DEVICE_COUNT_FLAG = "--xla_force_host_platform_device_count"
+
+# Cached result of probe_default_backend() for this process.
+_probe_cache: dict[float, str | None] = {}
+
+
+def request_virtual_cpu_devices(n: int) -> None:
+    """Ask XLA's host platform for ``n`` virtual devices.
+
+    Takes effect only if the CPU client has not been created yet; setting the
+    flag after that is a silent no-op, so call this as early as possible.
+    An existing smaller request is raised to ``n``; never shrunk.
+    """
+    import re
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    match = re.search(rf"{_DEVICE_COUNT_FLAG}=(\d+)", flags)
+    if match is None:
+        os.environ["XLA_FLAGS"] = f"{flags} {_DEVICE_COUNT_FLAG}={n}".strip()
+    elif int(match.group(1)) < n:
+        os.environ["XLA_FLAGS"] = (
+            flags[: match.start()] + f"{_DEVICE_COUNT_FLAG}={n}" + flags[match.end():]
+        )
+
+
+# Env vars that make the sandbox's sitecustomize dial the TPU relay at
+# *interpreter start* (before any user code). A CPU-pinned process never
+# needs that dial, and it can hang for minutes when the tunnel is flaky —
+# dropping the trigger vars makes every child interpreter start instantly.
+_ACCELERATOR_BOOTSTRAP_VARS = ("PALLAS_AXON_POOL_IPS",)
+
+
+def force_cpu_platform(num_virtual_devices: int | None = None) -> None:
+    """Pin this process (and children) to the host CPU platform.
+
+    Safe to call after ``import jax`` as long as no device query has run yet.
+    Sets the env var too so spawned subprocesses inherit the pin (it is
+    insufficient on its own under the sitecustomize override, but harmless),
+    and drops the accelerator-bootstrap vars so children skip the TPU dial.
+    """
+    if num_virtual_devices:
+        request_virtual_cpu_devices(num_virtual_devices)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    for var in _ACCELERATOR_BOOTSTRAP_VARS:
+        os.environ.pop(var, None)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def probe_backend_info(timeout: float = 60.0) -> dict | None:
+    """Full default-backend report from a throwaway subprocess, or None.
+
+    Initializing the default backend can hang irrecoverably in-process when
+    the platform plugin's transport is down; only a process boundary lets us
+    enforce a timeout. Returns ``{"platform", "device_count", "devices",
+    "process_count"}`` on success, ``None`` on crash or timeout. Cached per
+    timeout value for the life of this process.
+    """
+    if timeout in _probe_cache:
+        return _probe_cache[timeout]
+    env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    code = (
+        "import jax, json, sys\n"
+        "info = {'platform': jax.default_backend(),"
+        " 'device_count': jax.device_count(),"
+        " 'devices': [str(d) for d in jax.devices()],"
+        " 'process_count': jax.process_count()}\n"
+        "sys.stdout.write('ATPU_PROBE=' + json.dumps(info))\n"
+    )
+    rc, stdout = run_with_group_timeout(
+        [sys.executable, "-c", code], timeout=timeout, env=env
+    )
+    result = None
+    if rc == 0:
+        marker = stdout.rfind("ATPU_PROBE=")
+        if marker >= 0:
+            import json
+
+            try:
+                result = json.loads(stdout[marker + len("ATPU_PROBE="):])
+            except ValueError:
+                result = None
+    _probe_cache[timeout] = result
+    return result
+
+
+def run_with_group_timeout(
+    cmd: list[str], timeout: float, env: dict | None = None
+) -> tuple[int | None, str]:
+    """Run ``cmd`` in its own process group with a hard timeout.
+
+    Plain ``subprocess.run(timeout=...)`` kills only the direct child and
+    then blocks in ``communicate`` while the child's own children (the
+    platform plugin forks helpers during its relay dial) keep the pipe open
+    — the timeout becomes a hang. Killing the whole group enforces it.
+    Returns ``(returncode or None on timeout, stdout)``.
+    """
+    try:
+        proc = subprocess.Popen(
+            cmd, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            text=True, env=env, start_new_session=True,
+        )
+    except OSError:
+        return None, ""
+    try:
+        stdout, _ = proc.communicate(timeout=timeout)
+        return proc.returncode, stdout or ""
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+        try:
+            stdout, _ = proc.communicate(timeout=10)
+        except (subprocess.TimeoutExpired, ValueError):
+            stdout = ""
+        return None, stdout or ""
+
+
+def probe_default_backend(timeout: float = 60.0) -> str | None:
+    """The default backend's platform name, or None if it cannot initialize
+    within ``timeout`` (see :func:`probe_backend_info`)."""
+    info = probe_backend_info(timeout=timeout)
+    return info["platform"] if info else None
+
+
+def resolve_backend(prefer_accelerator: bool = True, probe_timeout: float = 60.0) -> str:
+    """Decide which platform this process should use, without ever hanging.
+
+    If an env pin (``ACCELERATE_TPU_PLATFORM`` or ``JAX_PLATFORMS``) names a
+    platform, honor it via ``jax.config`` and skip probing. Otherwise probe
+    the default backend out-of-process; a usable accelerator wins, anything
+    else falls back to a pinned CPU platform. Returns the platform name this
+    process ends up on.
+    """
+    pinned = os.environ.get("ACCELERATE_TPU_PLATFORM") or os.environ.get("JAX_PLATFORMS")
+    if pinned:
+        pinned = pinned.strip().lower()
+        import jax
+
+        jax.config.update("jax_platforms", pinned)  # full list: keeps fallback chains
+        return pinned.split(",")[0]
+    if prefer_accelerator:
+        platform = probe_default_backend(timeout=probe_timeout)
+        if platform and platform != "cpu":
+            return platform
+    force_cpu_platform()
+    return "cpu"

@@ -14,6 +14,10 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Child interpreters (CLI subprocess tests) inherit this env; without the
+# pool var the sitecustomize skips its TPU-relay dial at startup, which can
+# otherwise hang a fresh interpreter for minutes when the tunnel is flaky.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 
 import jax  # noqa: E402
 

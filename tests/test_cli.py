@@ -79,10 +79,14 @@ class TestLaunchResolution:
 
 
 def _run_cli(*argv, env_extra=None, cwd=None):
+    # JAX_PLATFORMS=cpu is inherited from conftest; accelerate_tpu/__init__
+    # mirrors it into jax.config in the child so the pin actually holds.
+    # timeout kills the child on expiry — a hung CLI must fail, not wedge CI.
     env = {**os.environ, **(env_extra or {})}
     return subprocess.run(
         [sys.executable, "-m", "accelerate_tpu.commands.accelerate_cli", *argv],
-        capture_output=True, text=True, env=env, cwd=cwd or os.path.dirname(os.path.dirname(__file__)))
+        capture_output=True, text=True, env=env, timeout=120,
+        cwd=cwd or os.path.dirname(os.path.dirname(__file__)))
 
 
 class TestCLISubprocess:
