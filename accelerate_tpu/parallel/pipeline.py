@@ -146,7 +146,13 @@ def pipeline_apply(
     # Microbatched inputs [M, mb, ...]; each microbatch is itself dp-sharded.
     act_spec = _activation_spec(mesh, x.ndim - 1)
     mb_spec = P(None, *tuple(act_spec)[1:])  # act_spec minus the leading 'pp'
-    x_mb = constrain(x.reshape((M, mb) + x.shape[1:]), mb_spec)
+    # Constrain *before* the microbatch reshape: the constraint's transpose
+    # then lands on dx in the [B, ...] layout the embedding backward already
+    # uses. Constraining the reshaped [M, mb, ...] instead pins the cotangent
+    # to a microbatch-split layout its consumers cannot use, and the SPMD
+    # partitioner falls back to replicate-then-repartition (involuntary full
+    # rematerialization) on every pipeline step.
+    x_mb = constrain(x, P(*tuple(act_spec)[1:])).reshape((M, mb) + x.shape[1:])
     extras_mb = jax.tree_util.tree_map(
         lambda e: e.reshape((M, mb) + e.shape[1:]), extras
     )
