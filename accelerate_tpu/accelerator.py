@@ -27,6 +27,7 @@ import contextlib
 import functools
 import inspect
 import math
+import warnings
 from typing import Any, Callable, Optional
 
 import jax
@@ -448,12 +449,31 @@ class Accelerator:
         return wrapped
 
     def prepare_optimizer(self, tx, device_placement: Optional[bool] = None):
-        """Wrap an optax transformation (reference: prepare_optimizer :2082)."""
+        """Wrap an optax transformation (reference: prepare_optimizer :2082).
+
+        With ``fsdp_plugin.cpu_offload=True`` (or a DeepSpeed config naming a
+        cpu offload device — reference: accelerator.py:1806-1809) the
+        optimizer state lives in pinned host memory between steps
+        (parallel/host_offload.py).
+        """
+        fsdp = self.state.fsdp_plugin
+        offload = bool(fsdp is not None and fsdp.cpu_offload)
+        if offload:
+            from .parallel.host_offload import supports_host_memory
+
+            if not supports_host_memory():
+                warnings.warn(
+                    "fsdp_plugin.cpu_offload=True but this backend exposes no "
+                    "pinned_host memory space; optimizer state stays in device memory.",
+                    stacklevel=2,
+                )
+                offload = False
         opt = AcceleratedOptimizer(
             tx,
             scaler_kwargs=self.scaler_handler,
             use_loss_scaling=self._use_loss_scaling,
             mesh=self.mesh,
+            offload_to_host=offload,
         )
         self._optimizers.append(opt)
         return opt
@@ -688,6 +708,16 @@ class Accelerator:
 
         Returns ``step(batch) -> metrics`` operating on the bound model/
         optimizer state in-place.
+
+        With ``fsdp_plugin.activation_checkpointing=True`` the whole loss
+        computation is rematerialized (``jax.checkpoint`` with the
+        dots-saveable policy) regardless of any model-level remat config
+        (reference: accelerator.py:1485-1499 applies FSDP activation
+        checkpointing to the wrapped module). With
+        ``fsdp_plugin.cpu_offload=True`` the step is split into a grad
+        executable (no optimizer state resident) and an update executable
+        (no activations live), with the state streamed from/to pinned host
+        memory at the boundary (parallel/host_offload.py).
         """
         model = model or self._models[0]
         optimizer = optimizer or self._optimizers[0]
@@ -701,6 +731,9 @@ class Accelerator:
         tx = optimizer.tx
         has_scale = optimizer.loss_scale is not None
         scaler_kwargs = optimizer.scaler_kwargs
+        fsdp = self.state.fsdp_plugin
+        remat_loss = bool(fsdp is not None and fsdp.activation_checkpointing)
+        offload = optimizer.offload_to_host
         from .ops.quant import fp8_meta_mask, has_fp8_meta
 
         fp8_mask = fp8_meta_mask(model.params) if has_fp8_meta(model.params) else None
@@ -715,12 +748,14 @@ class Accelerator:
                     scaled = scaled * scale.astype(scaled.dtype)
                 return scaled.astype(jnp.float32), loss
 
+            if remat_loss:
+                compute = jax.checkpoint(
+                    compute, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+                )
             (scaled, loss), grads = jax.value_and_grad(compute, has_aux=True)(params)
             return loss, grads
 
-        def train_step(params, opt_state, loss_scale, batch, rng):
-            import optax
-
+        def grad_phase(params, loss_scale, batch, rng):
             scale = loss_scale.scale if has_scale else None
             if accum > 1:
                 def scan_body(carry, microbatch):
@@ -737,6 +772,10 @@ class Accelerator:
                 loss = loss_sum / accum
             else:
                 loss, grads = loss_and_grads(params, batch, rng, scale)
+            return grads, loss
+
+        def update_phase(params, opt_state, loss_scale, grads, loss):
+            import optax
 
             if has_scale:
                 from .precision import grads_finite, unscale_grads, update_loss_scale
@@ -794,9 +833,11 @@ class Accelerator:
                 metrics["finite"] = finite
             return new_params, new_opt_state, new_scale, metrics
 
-        jitted = jax.jit(train_step, donate_argnums=(0, 1) if donate else ())
+        def train_step(params, opt_state, loss_scale, batch, rng):
+            grads, loss = grad_phase(params, loss_scale, batch, rng)
+            return update_phase(params, opt_state, loss_scale, grads, loss)
 
-        def step(batch):
+        def _check_accum_shape(batch):
             if accum > 1:
                 bad = [
                     np.shape(leaf)
@@ -809,13 +850,8 @@ class Accelerator:
                         f"leaf to have a leading microbatch dim of {accum}; got leading dims "
                         f"{[s[0] if s else None for s in bad]}. Reshape to [accum, micro, ...]."
                     )
-            rng = self.next_rng_key()
-            new_params, new_opt_state, new_scale, metrics = jitted(
-                model.params, optimizer.opt_state, optimizer.loss_scale, batch, rng
-            )
-            model.params = new_params
-            optimizer.opt_state = new_opt_state
-            optimizer.loss_scale = new_scale
+
+        def _record(metrics):
             if has_scale:
                 # Don't sync here: record the device-side finite flag; the
                 # steps_applied/step_was_skipped properties drain it lazily.
@@ -825,7 +861,49 @@ class Accelerator:
                 optimizer._steps_applied += 1
             return metrics
 
-        step._jitted = jitted  # expose for AOT/benchmark introspection
+        if not offload:
+            jitted = jax.jit(train_step, donate_argnums=(0, 1) if donate else ())
+
+            def step(batch):
+                _check_accum_shape(batch)
+                rng = self.next_rng_key()
+                new_params, new_opt_state, new_scale, metrics = jitted(
+                    model.params, optimizer.opt_state, optimizer.loss_scale, batch, rng
+                )
+                model.params = new_params
+                optimizer.opt_state = new_opt_state
+                optimizer.loss_scale = new_scale
+                return _record(metrics)
+
+            step._jitted = jitted  # expose for AOT/benchmark introspection
+            return step
+
+        # Host-offloaded optimizer state: two executables. The grad phase
+        # never sees the optimizer state, so HBM peaks at params +
+        # activations + grads; the update phase holds params + grads + state
+        # but no activations. Grads are donated into the update.
+        from .parallel.host_offload import to_device, to_host
+
+        jitted_grads = jax.jit(grad_phase)
+        jitted_update = jax.jit(
+            update_phase, donate_argnums=(0, 1, 3) if donate else ()
+        )
+
+        def step(batch):
+            _check_accum_shape(batch)
+            rng = self.next_rng_key()
+            grads, loss = jitted_grads(model.params, optimizer.loss_scale, batch, rng)
+            opt_in = to_device(optimizer.opt_state, self.mesh)
+            new_params, new_opt_state, new_scale, metrics = jitted_update(
+                model.params, opt_in, optimizer.loss_scale, grads, loss
+            )
+            model.params = new_params
+            optimizer.opt_state = to_host(new_opt_state, self.mesh)
+            optimizer.loss_scale = new_scale
+            return _record(metrics)
+
+        step._jitted = jitted_update  # expose for AOT/benchmark introspection
+        step._jitted_grads = jitted_grads
         return step
 
     # ------------------------------------------------------------------

@@ -49,11 +49,13 @@ class AcceleratedOptimizer:
         scaler_kwargs: Optional[GradScalerKwargs] = None,
         use_loss_scaling: bool = False,
         mesh=None,
+        offload_to_host: bool = False,
     ):
         self.tx = tx
         self.gradient_state = GradientState()
         self.mesh = mesh
         self.param_shardings = param_shardings
+        self.offload_to_host = offload_to_host
         self.opt_state = None
         self.acc_grads = None
         self._accumulated = 0
@@ -95,6 +97,10 @@ class AcceleratedOptimizer:
             self.opt_state = init(params)
         else:
             self.opt_state = self.tx.init(params)
+        if self.offload_to_host:
+            from .parallel.host_offload import to_host
+
+            self.opt_state = to_host(self.opt_state, self.mesh)
         self.acc_grads = None
         self._accumulated = 0
 
@@ -191,9 +197,19 @@ class AcceleratedOptimizer:
             )
         else:
             inv_scale = jnp.asarray(1.0, jnp.float32)
+        if self.offload_to_host:
+            # Stream the state HBM-ward only for the (FLOP-light) update; the
+            # backward that produced acc_grads ran without it resident.
+            from .parallel.host_offload import to_device, to_host
+
+            opt_in = to_device(self.opt_state, self.mesh)
+        else:
+            opt_in = self.opt_state
         params, opt_state, new_scale, finite = self._apply_jit(
-            self._model.params, self.opt_state, self.acc_grads, self.loss_scale, inv_scale
+            self._model.params, opt_in, self.acc_grads, self.loss_scale, inv_scale
         )
+        if self.offload_to_host:
+            opt_state = to_host(opt_state, self.mesh)
         self._grads_already_unscaled = False
         self._model.params = params
         self.opt_state = opt_state
@@ -215,6 +231,10 @@ class AcceleratedOptimizer:
 
     def load_state_dict(self, sd):
         self.opt_state = sd["opt_state"]
+        if self.offload_to_host:
+            from .parallel.host_offload import to_host
+
+            self.opt_state = to_host(self.opt_state, self.mesh)
         self._steps_applied = sd.get("steps_applied", 0)
         if "loss_scale" in sd and sd["loss_scale"] is not None:
             ls = sd["loss_scale"]

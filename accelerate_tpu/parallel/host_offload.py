@@ -1,0 +1,113 @@
+"""Host-DRAM offload for training state (ZeRO-offload equivalent).
+
+The reference offloads optimizer state to CPU through FSDP's ``CPUOffload``
+config (reference: src/accelerate/utils/dataclasses.py:1260-1606) and
+DeepSpeed's ``DeepSpeedCPUAdam`` (reference: accelerator.py:1806-1809) —
+both rely on torch keeping a second copy of the state in host memory and a
+C++ Adam stepping it there.
+
+The TPU-native design uses XLA memory spaces instead: every optimizer-state
+leaf keeps its *sharding* (the GSPMD layout over the mesh) but lives in the
+``pinned_host`` memory space between steps, so HBM holds no optimizer state
+while the forward/backward runs. `Accelerator.compile_train_step` splits the
+step into two executables when offload is on:
+
+* **grad phase** — forward + backward only. Peak HBM = params + activations
+  + grads; the optimizer state never enters the executable.
+* **update phase** — clip + optimizer update. The state is streamed
+  HBM-ward for the (FLOP-light) update and streamed back out after. Peak
+  HBM = params + grads + state; no activations are live.
+
+Transfers happen at the executable boundary via ``jax.device_put`` (PJRT
+DMA, async) rather than in-graph placement annotations: the in-graph form
+(``annotate_device_placement``) cannot express replicated leaves on every
+backend, while boundary transfers work uniformly on TPU and on the CPU
+emulation mesh the test suite runs on.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+HOST_MEMORY_KIND = "pinned_host"
+DEVICE_MEMORY_KIND = "device"
+
+
+def supports_host_memory() -> bool:
+    """True if the backend exposes a ``pinned_host`` memory space."""
+    try:
+        kinds = {m.kind for m in jax.devices()[0].addressable_memories()}
+    except Exception:  # pragma: no cover - exotic PJRT plugins
+        return False
+    return HOST_MEMORY_KIND in kinds
+
+
+def _with_memory_kind(sharding, kind: str, mesh=None):
+    """``sharding`` with its memory kind swapped.
+
+    Leaves that were created eagerly (e.g. optax step counters) carry an
+    uncommitted SingleDeviceSharding; moving those between memory spaces
+    would *commit* them to one device and poison later jits with
+    mixed-device arguments. With a mesh available they are normalized to a
+    mesh-wide replicated sharding instead.
+    """
+    if mesh is not None and not isinstance(sharding, NamedSharding):
+        return NamedSharding(mesh, PartitionSpec(), memory_kind=kind)
+    return sharding.with_memory_kind(kind)
+
+
+def memory_kind_of(leaf) -> str | None:
+    """The memory kind a jax array lives in (None for non-arrays)."""
+    if isinstance(leaf, jax.Array):
+        return leaf.sharding.memory_kind or DEVICE_MEMORY_KIND
+    return None
+
+
+def shardings_like(tree, kind: str, mesh=None):
+    """Per-leaf shardings of ``tree`` with the memory kind swapped to
+    ``kind``; non-array leaves map to None (left untouched by put_tree)."""
+    return jax.tree_util.tree_map(
+        lambda leaf: _with_memory_kind(leaf.sharding, kind, mesh)
+        if isinstance(leaf, jax.Array)
+        else None,
+        tree,
+    )
+
+
+def put_tree(tree, kind: str, mesh=None):
+    """Move every array leaf of ``tree`` to the ``kind`` memory space,
+    preserving its sharding. Non-array leaves (step counters unpacked as
+    Python ints, None) pass through untouched."""
+    arrays, shardings = [], []
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    idx = []
+    for i, leaf in enumerate(leaves):
+        if isinstance(leaf, jax.Array) and (leaf.sharding.memory_kind or DEVICE_MEMORY_KIND) != kind:
+            idx.append(i)
+            arrays.append(leaf)
+            shardings.append(_with_memory_kind(leaf.sharding, kind, mesh))
+    if arrays:
+        moved = jax.device_put(arrays, shardings)
+        for i, new in zip(idx, moved):
+            leaves[i] = new
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def to_host(tree, mesh=None):
+    """Stream every array leaf to pinned host memory (keeps sharding)."""
+    return put_tree(tree, HOST_MEMORY_KIND, mesh)
+
+
+def to_device(tree, mesh=None):
+    """Stream every array leaf back to device (HBM) memory."""
+    return put_tree(tree, DEVICE_MEMORY_KIND, mesh)
+
+
+def tree_memory_kinds(tree) -> set:
+    """Set of memory kinds occupied by the array leaves of ``tree``."""
+    return {
+        memory_kind_of(leaf)
+        for leaf in jax.tree_util.tree_leaves(tree)
+        if isinstance(leaf, jax.Array)
+    }

@@ -26,6 +26,15 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+@pytest.fixture
+def mesh_8():
+    """An 8-device (fsdp=4, tp=2) mesh over the virtual CPU devices."""
+    import numpy as np
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(jax.devices()).reshape(4, 2), ("fsdp", "tp"))
+
+
 @pytest.fixture(autouse=True)
 def reset_state():
     """Reset the state singletons between tests (reference: AccelerateTestCase,

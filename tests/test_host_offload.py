@@ -1,0 +1,195 @@
+"""Host-offloaded optimizer state + plugin-driven activation checkpointing.
+
+The reference exposes these as FSDP ``CPUOffload`` / ``apply_activation_
+checkpointing`` (reference: src/accelerate/accelerator.py:1485-1499) and as
+DeepSpeed's ZeRO-offload (reference: accelerator.py:1806-1809). Here the
+knobs live on FullyShardedDataParallelPlugin and are honored by
+Accelerator.prepare_optimizer / compile_train_step via
+parallel/host_offload.py (XLA memory spaces, not a torch CPU twin copy).
+"""
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from accelerate_tpu import Accelerator, MeshConfig, Model
+from accelerate_tpu.data_loader import make_global_batch
+from accelerate_tpu.models.llama import LlamaConfig, LlamaForCausalLM, causal_lm_loss
+from accelerate_tpu.parallel.host_offload import (
+    supports_host_memory,
+    to_device,
+    to_host,
+    tree_memory_kinds,
+)
+from accelerate_tpu.utils import DeepSpeedPlugin, FullyShardedDataParallelPlugin
+
+pytestmark = pytest.mark.skipif(
+    not supports_host_memory(), reason="backend has no pinned_host memory space"
+)
+
+
+def tiny_llama():
+    cfg = LlamaConfig.tiny(use_flash_attention=False)
+    model_def = LlamaForCausalLM(cfg)
+    params = model_def.init_params(jax.random.PRNGKey(0), batch_size=2, seq_len=16)
+    return cfg, model_def, params
+
+
+def token_batch(cfg, mesh, batch=8, seq=16, seed=0):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, cfg.vocab_size, size=(batch, seq)).astype(np.int32)
+    return make_global_batch({"input_ids": ids}, mesh)
+
+
+class TestHostOffloadHelpers:
+    def test_roundtrip_preserves_sharding_and_values(self, mesh_8):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        x = jax.device_put(
+            jax.numpy.arange(64.0).reshape(8, 8), NamedSharding(mesh_8, P("fsdp", None))
+        )
+        tree = {"x": x, "n": 3}
+        host = to_host(tree, mesh_8)
+        assert tree_memory_kinds(host) == {"pinned_host"}
+        assert host["n"] == 3
+        back = to_device(host, mesh_8)
+        assert tree_memory_kinds(back) == {"device"}
+        assert back["x"].sharding.spec == x.sharding.spec
+        np.testing.assert_array_equal(np.asarray(back["x"]), np.asarray(x))
+
+    def test_uncommitted_scalar_normalized_to_mesh(self, mesh_8):
+        # Eagerly-created scalars (optax step counters) must not be committed
+        # to a single device by the offload roundtrip.
+        count = jax.numpy.zeros((), jax.numpy.int32)
+        back = to_device(to_host({"count": count}, mesh_8), mesh_8)["count"]
+        assert len(back.sharding.device_set) == len(mesh_8.devices.flat)
+
+
+class TestOffloadedTraining:
+    def test_fused_step_trains_with_host_resident_state(self, reset_state):
+        cfg, model_def, params = tiny_llama()
+        acc = Accelerator(
+            mixed_precision="bf16",
+            mesh_config=MeshConfig(fsdp=4, tp=2, devices=jax.devices()),
+            fsdp_plugin=FullyShardedDataParallelPlugin(
+                min_weight_size_to_shard=1, cpu_offload=True
+            ),
+        )
+        model, opt = acc.prepare(Model(model_def, params), optax.adamw(1e-3))
+        assert opt.offload_to_host
+        step = acc.compile_train_step(causal_lm_loss(model_def.apply), max_grad_norm=1.0)
+        assert tree_memory_kinds(opt.opt_state) == {"pinned_host"}
+
+        batch = token_batch(cfg, acc.mesh)
+        losses = [float(step(batch)["loss"]) for _ in range(5)]
+        assert losses[-1] < losses[0]
+        assert tree_memory_kinds(opt.opt_state) == {"pinned_host"}
+        assert tree_memory_kinds(model.params) == {"device"}
+
+    def test_matches_device_resident_training(self, reset_state):
+        # Offload changes where the state lives, not what the step computes.
+        def run(offload):
+            from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+            for s in (AcceleratorState, GradientState, PartialState):
+                s._reset_state()
+            cfg, model_def, params = tiny_llama()
+            acc = Accelerator(
+                mesh_config=MeshConfig(fsdp=4, tp=2, devices=jax.devices()),
+                fsdp_plugin=FullyShardedDataParallelPlugin(
+                    min_weight_size_to_shard=1, cpu_offload=offload
+                ),
+            )
+            model, opt = acc.prepare(Model(model_def, params), optax.adamw(1e-3))
+            step = acc.compile_train_step(causal_lm_loss(model_def.apply))
+            batch = token_batch(cfg, acc.mesh)
+            return [float(step(batch)["loss"]) for _ in range(3)]
+
+        np.testing.assert_allclose(run(False), run(True), rtol=1e-5)
+
+    def test_eager_step_path(self, reset_state):
+        cfg, model_def, params = tiny_llama()
+        acc = Accelerator(
+            mesh_config=MeshConfig(fsdp=4, tp=2, devices=jax.devices()),
+            fsdp_plugin=FullyShardedDataParallelPlugin(
+                min_weight_size_to_shard=1, cpu_offload=True
+            ),
+        )
+        model, opt = acc.prepare(Model(model_def, params), optax.adamw(1e-3))
+        loss_fn = causal_lm_loss(model_def.apply)
+        batch = token_batch(cfg, acc.mesh)
+        first = float(acc.backward(loss_fn, batch))
+        opt.step()
+        assert tree_memory_kinds(opt.opt_state) == {"pinned_host"}
+        opt.zero_grad()
+        acc.backward(loss_fn, batch)
+        opt.step()
+        assert float(acc.backward(loss_fn, batch)) < first
+
+    def test_state_dict_roundtrip_reoffloads(self, reset_state):
+        cfg, model_def, params = tiny_llama()
+        acc = Accelerator(
+            mesh_config=MeshConfig(fsdp=4, tp=2, devices=jax.devices()),
+            fsdp_plugin=FullyShardedDataParallelPlugin(
+                min_weight_size_to_shard=1, cpu_offload=True
+            ),
+        )
+        model, opt = acc.prepare(Model(model_def, params), optax.adamw(1e-3))
+        step = acc.compile_train_step(causal_lm_loss(model_def.apply))
+        step(token_batch(cfg, acc.mesh))
+        sd = opt.state_dict()
+        opt.load_state_dict({"opt_state": to_device(sd["opt_state"], acc.mesh)})
+        assert tree_memory_kinds(opt.opt_state) == {"pinned_host"}
+
+    def test_deepspeed_offload_translation(self, reset_state):
+        cfg, model_def, params = tiny_llama()
+        acc = Accelerator(
+            mesh_config=MeshConfig(fsdp=8, devices=jax.devices()),
+            deepspeed_plugin=DeepSpeedPlugin(zero_stage=2, offload_optimizer_device="cpu"),
+        )
+        model, opt = acc.prepare(Model(model_def, params), optax.adamw(1e-3))
+        assert opt.offload_to_host
+        step = acc.compile_train_step(causal_lm_loss(model_def.apply))
+        loss = float(step(token_batch(cfg, acc.mesh))["loss"])
+        assert np.isfinite(loss)
+        assert tree_memory_kinds(opt.opt_state) == {"pinned_host"}
+
+
+class TestActivationCheckpointing:
+    def test_plugin_remat_matches_baseline_loss(self, reset_state):
+        def run(act_ckpt):
+            from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+            for s in (AcceleratorState, GradientState, PartialState):
+                s._reset_state()
+            cfg, model_def, params = tiny_llama()
+            acc = Accelerator(
+                mesh_config=MeshConfig(fsdp=4, tp=2, devices=jax.devices()),
+                fsdp_plugin=FullyShardedDataParallelPlugin(
+                    min_weight_size_to_shard=1, activation_checkpointing=act_ckpt
+                ),
+            )
+            model, opt = acc.prepare(Model(model_def, params), optax.adamw(1e-3))
+            step = acc.compile_train_step(causal_lm_loss(model_def.apply))
+            batch = token_batch(cfg, acc.mesh)
+            return [float(step(batch)["loss"]) for _ in range(3)]
+
+        np.testing.assert_allclose(run(False), run(True), rtol=1e-5)
+
+    def test_remat_appears_in_jaxpr(self, reset_state):
+        cfg, model_def, params = tiny_llama()
+        acc = Accelerator(
+            mesh_config=MeshConfig(fsdp=4, tp=2, devices=jax.devices()),
+            fsdp_plugin=FullyShardedDataParallelPlugin(
+                min_weight_size_to_shard=1, activation_checkpointing=True
+            ),
+        )
+        model, opt = acc.prepare(Model(model_def, params), optax.adamw(1e-3))
+        step = acc.compile_train_step(causal_lm_loss(model_def.apply))
+        batch = token_batch(cfg, acc.mesh)
+        rng = jax.random.PRNGKey(0)
+        jaxpr = jax.make_jaxpr(
+            lambda p, o, s, b, r: step._jitted.__wrapped__(p, o, s, b, r)
+        )(model.params, opt.opt_state, opt.loss_scale, batch, rng)
+        assert "remat" in str(jaxpr)
