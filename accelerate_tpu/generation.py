@@ -1,0 +1,154 @@
+"""KV-cached autoregressive decoding.
+
+The reference's headline big-model numbers are per-token generation
+latencies (reference: benchmarks/big_model_inference/README.md:26-45),
+which presuppose cached decode; torch gets it from transformers'
+``model.generate``. The TPU-native equivalent is built here from the model
+families' cache-threading support (models/llama.py ``init_kv_cache`` /
+``cache=``/``cache_pos=`` arguments):
+
+* ``greedy_generate`` — the fully-compiled path for device-resident params:
+  one jitted prefill (writes the prompt's KV into the cache and emits the
+  first token) + ONE jitted ``lax.scan`` over all decode steps. Each decode
+  step attends single-query against the static-shape cache, so XLA compiles
+  exactly two executables per (model, length, eos) combination — cached
+  across calls, a repeat generate pays zero retrace.
+
+* `big_modeling.StreamedModel.generate` uses the same cache threading
+  per-block for weights that stream from host/disk (one compiled decode
+  step per block kind).
+
+Cache capability is registered in ONE place — `big_modeling.
+cache_factory_for` — which both this module and the streamed executor
+consult.
+
+Greedy only (argmax): matches the reference benchmark's deterministic
+setting. Sampling is a drop-in replacement of the argmax.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def supports_kv_cache(module) -> bool:
+    """True if this model family threads a KV cache (cache=/cache_pos=).
+    Single registry: big_modeling.cache_factory_for."""
+    from .big_modeling import cache_factory_for
+
+    return cache_factory_for(module) is not None
+
+
+_generate_cache: dict = {}
+
+
+def _compiled_generate(module, max_new_tokens: int, eos_token_id, cache_dtype):
+    """(prefill, decode) jitted pair for this (model config, length, eos,
+    dtype) — cached so repeat generate calls reuse the same jitted function
+    objects (and therefore jax.jit's executable cache) instead of retracing
+    fresh closures every call.
+
+    Keyed on the config's *field values* (the apply computation depends only
+    on them), not the module object: model configs are plain mutable
+    dataclasses and not hashable.
+    """
+    import dataclasses
+
+    cfg = getattr(module, "config", None)
+    key = None
+    if cfg is not None and dataclasses.is_dataclass(cfg):
+        key = (
+            type(module).__name__,
+            dataclasses.astuple(cfg),
+            max_new_tokens,
+            eos_token_id,
+            jnp.dtype(cache_dtype).name,
+        )
+        hit = _generate_cache.get(key)
+        if hit is not None:
+            return hit
+
+    @jax.jit
+    def prefill(params, ids, cache):
+        logits, cache = module.apply({"params": params}, ids, cache=cache, cache_pos=0)
+        return jnp.argmax(logits[:, -1], axis=-1).astype(ids.dtype), cache
+
+    @jax.jit
+    def decode(params, first_tok, cache, start_pos):
+        # (No donation: the final cache is discarded, not an output, so the
+        # input buffers cannot alias anything — XLA reuses the scan carry
+        # buffers in place regardless.)
+        def body(carry, _):
+            tok, cache, pos, done = carry
+            logits, cache = module.apply(
+                {"params": params}, tok[:, None], cache=cache, cache_pos=pos
+            )
+            nxt = jnp.argmax(logits[:, -1], axis=-1).astype(tok.dtype)
+            if eos_token_id is not None:
+                nxt = jnp.where(done, jnp.asarray(eos_token_id, tok.dtype), nxt)
+                done = done | (nxt == eos_token_id)
+            # Emit the *computed* token: the scan runs max_new_tokens - 1
+            # steps and first_tok supplies the head, so no forward's output
+            # is ever discarded.
+            return (nxt, cache, pos + 1, done), nxt
+
+        done0 = jnp.zeros((first_tok.shape[0],), bool)
+        if eos_token_id is not None:
+            done0 = first_tok == eos_token_id
+        (_, _, _, _), toks = jax.lax.scan(
+            body, (first_tok, cache, start_pos, done0), None,
+            length=max_new_tokens - 1,
+        )
+        return jnp.concatenate([first_tok[:, None], toks.T], axis=1)
+
+    if key is not None:
+        if len(_generate_cache) >= 64:  # bound growth; configs rarely churn
+            _generate_cache.pop(next(iter(_generate_cache)))
+        _generate_cache[key] = (prefill, decode)
+    return prefill, decode
+
+
+def greedy_generate(
+    module,
+    params,
+    input_ids,
+    max_new_tokens: int = 20,
+    eos_token_id: Optional[int] = None,
+    cache_dtype=None,
+):
+    """Greedy decoding with a KV cache, fully compiled (prefill + scan).
+
+    Args:
+      module: a cache-threading model (see :func:`supports_kv_cache`).
+      params: parameter pytree.
+      input_ids: [B, S] int prompt.
+      max_new_tokens: decode steps (static — sets the cache length).
+      eos_token_id: sequences that emit it keep emitting it (ragged stop
+        inside a static-shape scan).
+      cache_dtype: KV buffer dtype (default: bfloat16).
+
+    Returns [B, S + max_new_tokens] ids.
+    """
+    from .big_modeling import cache_factory_for
+
+    factory = cache_factory_for(module)
+    if factory is None:
+        raise TypeError(
+            f"{type(module).__name__} does not thread a KV cache; use the model's "
+            "full-forward generate or add cache support to the family "
+            "(big_modeling.cache_factory_for)."
+        )
+    ids = jnp.asarray(input_ids)
+    if max_new_tokens <= 0:
+        return ids
+    B, S = ids.shape
+    dtype = cache_dtype or jnp.bfloat16
+    cache = factory(B, S + max_new_tokens, dtype)
+
+    prefill, decode = _compiled_generate(module, max_new_tokens, eos_token_id, dtype)
+    first_tok, cache = prefill(params, ids, cache)
+    new_toks = decode(params, first_tok, cache, jnp.asarray(S, jnp.int32))
+    return jnp.concatenate([ids, new_toks], axis=1)

@@ -168,11 +168,46 @@ def multi_head_attention(
     return _einsum_attention(q, k, v, causal=causal, segment_ids=segment_ids)
 
 
+def init_kv_cache(config: "LlamaConfig", batch_size: int, max_len: int, dtype=jnp.bfloat16):
+    """Per-layer KV cache: tuple of ``{"k", "v"}`` with [B, max_len, n_kv, hd]
+    buffers (KV heads stored *unrepeated* — GQA expansion happens at attention
+    time, so the cache is ``n_q/n_kv``× smaller than the score matrices)."""
+    shape = (batch_size, max_len, config.num_key_value_heads, config.head_dim)
+    return tuple(
+        {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+        for _ in range(config.num_hidden_layers)
+    )
+
+
+def _cached_attention(q, k_all, v_all, cache_pos, n_rep: int):
+    """Attention of q [B, S, H, hd] against the full cache [B, L, n_kv, hd].
+
+    Valid keys are those at global index <= cache_pos + (local query index):
+    one mask expression covers both prefill (S = prompt, cache_pos = 0, the
+    ordinary causal triangle) and decode (S = 1, cache_pos = t, attend to
+    everything written so far). Future cache slots hold zeros and are masked.
+
+    GQA is a *grouped* einsum — queries reshape to [B, S, n_kv, rep, hd] and
+    contract directly against the unrepeated cache, so per-token HBM traffic
+    scales with n_kv, never with a materialized n_q-wide K/V copy.
+    """
+    B, S, H, hd = q.shape
+    L = k_all.shape[1]
+    qg = (q * hd**-0.5).astype(jnp.float32).reshape(B, S, H // n_rep, n_rep, hd)
+    logits = jnp.einsum("bqgrd,bkgd->bgrqk", qg, k_all.astype(jnp.float32))
+    q_pos = cache_pos + jnp.arange(S, dtype=jnp.int32)
+    mask = jnp.arange(L, dtype=jnp.int32)[None, :] <= q_pos[:, None]
+    logits = jnp.where(mask[None, None, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bgrqk,bkgd->bqgrd", probs, v_all.astype(jnp.float32))
+    return out.reshape(B, S, H, hd).astype(q.dtype)
+
+
 class LlamaAttention(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, positions, causal=True):
+    def __call__(self, x, positions, causal=True, cache=None, cache_pos=None):
         cfg = self.config
         B, S, _ = x.shape
         n_q, n_kv, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
@@ -184,6 +219,18 @@ class LlamaAttention(nn.Module):
         cos, sin = rotary_embedding(positions, hd, cfg.rope_theta, dtype=x.dtype)
         q = apply_rotary(q, cos, sin)
         k = apply_rotary(k, cos, sin)
+
+        if cache is not None:
+            # KV-cached path (generate): write this call's keys/values into
+            # the cache at cache_pos, attend against the whole buffer.
+            start = (0, cache_pos, 0, 0)
+            new_cache = {
+                "k": jax.lax.dynamic_update_slice(cache["k"], k.astype(cache["k"].dtype), start),
+                "v": jax.lax.dynamic_update_slice(cache["v"], v.astype(cache["v"].dtype), start),
+            }
+            out = _cached_attention(q, new_cache["k"], new_cache["v"], cache_pos, n_q // n_kv)
+            out = out.reshape(B, S, n_q * hd)
+            return dense(cfg.hidden_size, "o_proj")(out), new_cache
 
         if n_kv != n_q:  # GQA: repeat kv heads
             rep = n_q // n_kv
@@ -213,11 +260,16 @@ class LlamaBlock(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, positions):
+    def __call__(self, x, positions, cache=None, cache_pos=None):
         cfg = self.config
-        h = x + LlamaAttention(cfg, name="self_attn")(RMSNorm(cfg.rms_norm_eps, name="input_norm")(x), positions)
+        attn_in = RMSNorm(cfg.rms_norm_eps, name="input_norm")(x)
+        attn = LlamaAttention(cfg, name="self_attn")(attn_in, positions, cache=cache, cache_pos=cache_pos)
+        new_cache = None
+        if cache is not None:
+            attn, new_cache = attn
+        h = x + attn
         h = h + LlamaMLP(cfg, name="mlp")(RMSNorm(cfg.rms_norm_eps, name="post_attn_norm")(h))
-        return h
+        return h if cache is None else (h, new_cache)
 
 
 class LlamaModel(nn.Module):
@@ -226,28 +278,40 @@ class LlamaModel(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, input_ids, positions=None):
+    def __call__(self, input_ids, positions=None, cache=None, cache_pos=None):
         cfg = self.config
         if positions is None:
-            positions = jnp.arange(input_ids.shape[1])[None, :].astype(jnp.int32)
+            start = 0 if cache_pos is None else cache_pos
+            positions = start + jnp.arange(input_ids.shape[1], dtype=jnp.int32)[None, :]
             positions = jnp.broadcast_to(positions, input_ids.shape)
         embed = nn.Embed(cfg.vocab_size, cfg.hidden_size, name="embed_tokens", param_dtype=jnp.float32)
         x = embed(input_ids)
         block_cls = LlamaBlock
         if cfg.remat:
             block_cls = nn.remat(LlamaBlock, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+        new_caches = []
         for i in range(cfg.num_hidden_layers):
-            x = block_cls(cfg, name=f"layers_{i}")(x, positions)
-        return RMSNorm(cfg.rms_norm_eps, name="norm")(x)
+            if cache is None:
+                x = block_cls(cfg, name=f"layers_{i}")(x, positions)
+            else:
+                x, layer_cache = block_cls(cfg, name=f"layers_{i}")(
+                    x, positions, cache=cache[i], cache_pos=cache_pos
+                )
+                new_caches.append(layer_cache)
+        x = RMSNorm(cfg.rms_norm_eps, name="norm")(x)
+        return x if cache is None else (x, tuple(new_caches))
 
 
 class LlamaForCausalLM(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, input_ids, positions=None):
+    def __call__(self, input_ids, positions=None, cache=None, cache_pos=None):
         cfg = self.config
-        x = LlamaModel(cfg, name="model")(input_ids, positions)
+        x = LlamaModel(cfg, name="model")(input_ids, positions, cache=cache, cache_pos=cache_pos)
+        new_cache = None
+        if cache is not None:
+            x, new_cache = x
         if cfg.tie_word_embeddings:
             embed = self.variables["params"]["model"]["embed_tokens"]["embedding"]
             logits = x @ embed.T.astype(x.dtype)
@@ -256,7 +320,7 @@ class LlamaForCausalLM(nn.Module):
             # feeds the softmax directly (standard TE practice).
             logits = nn.Dense(cfg.vocab_size, use_bias=False, name="lm_head", dtype=x.dtype,
                               param_dtype=jnp.float32)(x)
-        return logits
+        return logits if cache is None else (logits, new_cache)
 
     def init_params(self, rng, batch_size=1, seq_len=8):
         dummy = jnp.zeros((batch_size, seq_len), jnp.int32)

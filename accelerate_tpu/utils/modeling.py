@@ -357,7 +357,7 @@ def check_device_map(params, device_map: dict) -> None:
     check_device_map :1471 vicinity)."""
     names = list(named_parameters(params).keys())
     for name in names:
-        hits = [p for p in device_map if name == p or name.startswith(p + ".")]
+        hits = [p for p in device_map if p == "" or name == p or name.startswith(p + ".")]
         if not hits:
             raise ValueError(f"Parameter {name} not covered by device_map")
 

@@ -1,0 +1,157 @@
+"""Big-model inference latency benchmark (reference parity:
+benchmarks/big_model_inference/measures_util.py + README.md:26-45 — model
+load time, per-token generation latency, memory placement).
+
+Builds a Llama, exports it to sharded safetensors, then for each placement
+tier (all-HBM / host-offload / disk-offload) measures:
+
+* load time  — checkpoint -> WeightStore via load_checkpoint_and_dispatch
+* first call — generate end-to-end including XLA compiles
+* decode     — KV-cached per-token latency (the reference table's
+               "generation time per token")
+* no-cache   — full re-forward per token, for contrast
+
+Run: ``python benchmarks/big_model_inference.py [--size tiny|small|1b]
+[--tiers device,cpu,disk] [--tokens N]``. Prints a markdown table and one
+JSON line. Self-pinning: probes the default backend out-of-process and
+falls back to CPU (utils/platforms.py), so it never hangs on a dead TPU
+tunnel.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+SIZES = {
+    # hidden, inter, layers, heads, kv_heads, vocab
+    "tiny": (256, 512, 4, 4, 2, 2048),
+    "small": (1024, 2816, 8, 16, 8, 32000),
+    "1b": (2048, 5632, 22, 32, 4, 32000),
+}
+
+
+def build_and_save(size: str, ckpt_dir: str):
+    import types
+
+    import jax
+
+    from accelerate_tpu.checkpointing import save_model
+    from accelerate_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    h, inter, layers, heads, kv, vocab = SIZES[size]
+    cfg = LlamaConfig(
+        vocab_size=vocab, hidden_size=h, intermediate_size=inter,
+        num_hidden_layers=layers, num_attention_heads=heads,
+        num_key_value_heads=kv, max_position_embeddings=2048,
+        use_flash_attention=False,
+    )
+    module = LlamaForCausalLM(cfg)
+    params = module.init_params(jax.random.PRNGKey(0), batch_size=1, seq_len=8)
+    single = types.SimpleNamespace(is_main_process=True, wait_for_everyone=lambda: None)
+    save_model(single, params, ckpt_dir, max_shard_size="512MB")
+    n_params = sum(int(p.size) for p in jax.tree_util.tree_leaves(params))
+    del params
+    return module, n_params
+
+
+def bench_tier(module, ckpt_dir: str, tier: str, prompt_len: int, tokens: int,
+               offload_folder=None):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from accelerate_tpu.big_modeling import load_checkpoint_and_dispatch
+
+    device_map = {"": {"device": 0, "cpu": "cpu", "disk": "disk"}[tier]}
+    t0 = time.perf_counter()
+    streamed = load_checkpoint_and_dispatch(
+        module, ckpt_dir, device_map=device_map, offload_folder=offload_folder,
+        example_args=(jnp.zeros((1, 8), jnp.int32),),
+    )
+    load_s = time.perf_counter() - t0
+
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(
+        rng.integers(0, module.config.vocab_size, size=(1, prompt_len)), jnp.int32
+    )
+
+    # First call compiles one executable per block kind for THIS cache
+    # length (cache shape is part of the jit key, so the warm-up must use
+    # the same max_new_tokens as the timed run).
+    t0 = time.perf_counter()
+    out = streamed.generate(ids, max_new_tokens=tokens)
+    first_token_s = time.perf_counter() - t0  # includes compile
+
+    t0 = time.perf_counter()
+    out = streamed.generate(ids, max_new_tokens=tokens)
+    kv_per_token = (time.perf_counter() - t0) / tokens  # prefill amortized in
+
+    nocache_per_token = None
+    if tokens >= 2:
+        streamed.generate(ids, max_new_tokens=2, use_cache=False)  # compile warm-up
+        t0 = time.perf_counter()
+        streamed.generate(ids, max_new_tokens=2, use_cache=False)
+        nocache_per_token = (time.perf_counter() - t0) / 2
+
+    result = {
+        "tier": tier,
+        "load_s": round(load_s, 2),
+        "first_call_s": round(first_token_s, 2),
+        "kv_s_per_token": round(kv_per_token, 4),
+        "nocache_s_per_token": round(nocache_per_token, 4) if nocache_per_token else None,
+        "hbm_resident_bytes": streamed.hbm_resident_bytes,
+        "n_new_tokens": int(out.shape[1] - prompt_len),
+    }
+    streamed.close()
+    return result
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--size", default="tiny", choices=sorted(SIZES))
+    ap.add_argument("--tiers", default="device,cpu")
+    ap.add_argument("--tokens", type=int, default=16)
+    ap.add_argument("--prompt-len", type=int, default=64)
+    args = ap.parse_args()
+
+    from accelerate_tpu.utils.platforms import resolve_backend
+
+    platform = resolve_backend()
+    print(f"platform: {platform}", file=sys.stderr)
+
+    rows = []
+    with tempfile.TemporaryDirectory() as tmp:
+        ckpt = f"{tmp}/ckpt"
+        module, n_params = build_and_save(args.size, ckpt)
+        for tier in args.tiers.split(","):
+            offload = f"{tmp}/offload_{tier}" if tier == "disk" else None
+            rows.append(
+                bench_tier(module, ckpt, tier.strip(), args.prompt_len, args.tokens,
+                           offload_folder=offload)
+            )
+
+    print(f"\nLlama-{args.size} ({n_params/1e6:.0f}M params), "
+          f"prompt={args.prompt_len}, platform={platform}\n")
+    print("| Placement | Load time | First call (compile) | KV decode /token | No-cache /token | HBM resident |")
+    print("|:---------:|:---------:|:-----------:|:----------------:|:---------------:|:------------:|")
+    for r in rows:
+        nc = f"{r['nocache_s_per_token']:.3f}s" if r["nocache_s_per_token"] else "-"
+        print(f"| {r['tier']} | {r['load_s']:.1f}s | {r['first_call_s']:.2f}s "
+              f"| {r['kv_s_per_token']*1000:.1f}ms | {nc} "
+              f"| {r['hbm_resident_bytes']/2**30:.2f}GiB |")
+    print()
+    print(json.dumps({"metric": "big_model_kv_decode_s_per_token",
+                      "size": args.size, "platform": platform, "tiers": rows}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,123 @@
+"""KV-cached decoding: exactness vs full-forward greedy, cache threading,
+streamed-executor decode (reference capability: transformers' cached
+``model.generate`` under the big-model hooks; latency table at
+benchmarks/big_model_inference/README.md:26-45)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from accelerate_tpu.big_modeling import dispatch_model
+from accelerate_tpu.generation import greedy_generate, supports_kv_cache
+from accelerate_tpu.models.llama import (
+    LlamaConfig,
+    LlamaForCausalLM,
+    init_kv_cache,
+)
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = LlamaConfig.tiny(use_flash_attention=False)
+    m = LlamaForCausalLM(cfg)
+    params = m.init_params(jax.random.PRNGKey(0), batch_size=2, seq_len=8)
+    return cfg, m, params
+
+
+PROMPT = np.array([[3, 5, 7, 11, 2], [1, 4, 9, 16, 25]], np.int32)
+
+
+def naive_greedy(m, params, ids, n):
+    ids = jnp.asarray(ids)
+    for _ in range(n):
+        logits = m.apply({"params": params}, ids)
+        nxt = jnp.argmax(logits[:, -1], -1)[:, None].astype(ids.dtype)
+        ids = jnp.concatenate([ids, nxt], axis=1)
+    return np.asarray(ids)
+
+
+class TestCacheThreading:
+    def test_prefill_logits_match_full_forward(self, tiny):
+        cfg, m, params = tiny
+        cache = init_kv_cache(cfg, 2, PROMPT.shape[1], jnp.float32)
+        cached_logits, new_cache = m.apply(
+            {"params": params}, PROMPT, cache=cache, cache_pos=0
+        )
+        full_logits = m.apply({"params": params}, PROMPT)
+        np.testing.assert_allclose(
+            np.asarray(cached_logits), np.asarray(full_logits), rtol=1e-5, atol=1e-5
+        )
+        assert len(new_cache) == cfg.num_hidden_layers
+
+    def test_incremental_decode_matches_full_forward(self, tiny):
+        # Feed tokens one at a time through the cache; logits at each step
+        # must match the corresponding column of the full forward.
+        cfg, m, params = tiny
+        ids = PROMPT[:, :4]
+        full = np.asarray(m.apply({"params": params}, ids))
+        cache = init_kv_cache(cfg, 2, 4, jnp.float32)
+        for t in range(4):
+            step_logits, cache = m.apply(
+                {"params": params}, ids[:, t : t + 1], cache=cache, cache_pos=t
+            )
+            np.testing.assert_allclose(
+                np.asarray(step_logits)[:, 0], full[:, t], rtol=1e-4, atol=1e-4
+            )
+
+    def test_cache_stores_unrepeated_kv_heads(self, tiny):
+        cfg, m, params = tiny
+        cache = init_kv_cache(cfg, 2, 8)
+        assert cache[0]["k"].shape == (2, 8, cfg.num_key_value_heads, cfg.head_dim)
+
+
+class TestGreedyGenerate:
+    def test_matches_naive_full_forward(self, tiny):
+        cfg, m, params = tiny
+        ref = naive_greedy(m, params, PROMPT, 6)
+        out = greedy_generate(m, params, PROMPT, max_new_tokens=6, cache_dtype=jnp.float32)
+        np.testing.assert_array_equal(np.asarray(out), ref)
+
+    def test_eos_freezes_sequence(self, tiny):
+        cfg, m, params = tiny
+        ref = naive_greedy(m, params, PROMPT, 6)
+        eos = int(ref[0, PROMPT.shape[1] + 1])  # force an early stop on row 0
+        out = np.asarray(
+            greedy_generate(
+                m, params, PROMPT, max_new_tokens=6, eos_token_id=eos,
+                cache_dtype=jnp.float32,
+            )
+        )
+        stop = PROMPT.shape[1] + 2
+        assert (out[0, stop:] == eos).all()
+
+    def test_supports_probe_and_type_error(self, tiny):
+        cfg, m, params = tiny
+        assert supports_kv_cache(m)
+        with pytest.raises(TypeError):
+            greedy_generate(object(), params, PROMPT)
+
+
+class TestStreamedGenerate:
+    def test_cached_matches_full_forward_loop(self, tiny):
+        cfg, m, params = tiny
+        streamed = dispatch_model(m, params=params, device_map={"": "cpu"})
+        full = np.asarray(streamed.generate(jnp.asarray(PROMPT), 6, use_cache=False))
+        kv = np.asarray(streamed.generate(jnp.asarray(PROMPT), 6))
+        np.testing.assert_array_equal(kv, full)
+
+    def test_cached_matches_fused_generate(self, tiny):
+        cfg, m, params = tiny
+        streamed = dispatch_model(m, params=params, device_map={"": 0})
+        kv = np.asarray(streamed.generate(jnp.asarray(PROMPT), 5))
+        fused = np.asarray(
+            greedy_generate(m, params, PROMPT, max_new_tokens=5, cache_dtype=jnp.bfloat16)
+        )
+        np.testing.assert_array_equal(kv, fused)
+
+    def test_one_decode_executable_per_kind(self, tiny):
+        cfg, m, params = tiny
+        streamed = dispatch_model(m, params=params, device_map={"": 0})
+        streamed.generate(jnp.asarray(PROMPT), 5)
+        cached_keys = [k for k in streamed._jitted if k.endswith("/cached")]
+        assert sorted(cached_keys) == ["embed/cached", "head/cached", "layer/cached"]
