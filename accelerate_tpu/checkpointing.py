@@ -290,6 +290,18 @@ def load_accelerator_state(accelerator, input_dir: Optional[str] = None, load_kw
     if rng_file.exists():
         set_rng_state(json.loads(rng_file.read_text()), accelerator)
 
+    # Resume the automatic-naming counter past the loaded checkpoint, or the
+    # next save would overwrite checkpoint_0 while "latest" still resolves to
+    # a higher index (reference: load_state advances project_configuration
+    # .iteration from the loaded folder name, accelerator.py:3133 vicinity).
+    pc = accelerator.project_configuration
+    name = Path(src).name
+    if pc.automatic_checkpoint_naming and name.startswith(f"{CHECKPOINT_DIR_PREFIX}_"):
+        try:
+            pc.iteration = int(name.split("_")[-1]) + 1
+        except ValueError:
+            pass
+
     logger.info(f"Loaded accelerator state from {src}")
     return str(src)
 

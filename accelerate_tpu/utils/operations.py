@@ -191,16 +191,47 @@ def _process_allgather(t, tiled: bool):
     return out
 
 
+def _is_global_array(t) -> bool:
+    """A jax.Array spanning devices this process cannot address (i.e. a
+    GLOBAL view in a multi-process world, e.g. a dataloader batch)."""
+    return isinstance(t, jax.Array) and not t.is_fully_addressable
+
+
+def _replicate_global(t) -> np.ndarray:
+    """Materialize a global array's full value on every process.
+
+    ``device_get`` refuses arrays with non-addressable shards; for those,
+    ``process_allgather`` is documented to return the fully-replicated value
+    (one XLA all-gather riding the interconnect, compiled once per sharding
+    via jax's internal cache — works for any sharding type, not just
+    NamedSharding).
+    """
+    if getattr(t, "is_fully_replicated", False):
+        return np.asarray(jax.device_get(t))
+    from jax.experimental import multihost_utils
+
+    # tiled=True is mandatory for global arrays (and the replicated result
+    # is identical either way — no per-process axis is added).
+    return np.asarray(multihost_utils.process_allgather(t, tiled=True))
+
+
 @verify_operation
 def gather(tensor):
     """Gather each process's tensor, concatenated on dim 0 (reference: :306).
 
     Single-process multi-device runs return the (already global) value; in
-    multi-host runs each host contributes its local value.
+    multi-host runs each host contributes its local value. GLOBAL arrays
+    (sharded over all processes, e.g. dataloader batches) are already the
+    concatenation — they materialize to their full value on every process
+    instead of being re-concatenated P times.
     """
     state = PartialState()
     if state.num_processes > 1:
-        return recursively_apply(lambda t: _process_allgather(t, tiled=True), tensor)
+        return recursively_apply(
+            lambda t: _replicate_global(t) if _is_global_array(t)
+            else _process_allgather(t, tiled=True),
+            tensor,
+        )
     return tensor
 
 
@@ -223,14 +254,19 @@ def gather_object(object: Any):
 
 @verify_operation
 def broadcast(tensor, from_process: int = 0):
-    """Broadcast a pytree from one process to all (reference: :543)."""
+    """Broadcast a pytree from one process to all (reference: :543).
+
+    GLOBAL arrays are already consistent across the world (GSPMD invariant);
+    they materialize to their full local value instead of round-tripping
+    through a host-side broadcast (which cannot read them anyway)."""
     state = PartialState()
     if state.num_processes == 1:
         return tensor
     from jax.experimental import multihost_utils
 
     return recursively_apply(
-        lambda t: multihost_utils.broadcast_one_to_all(
+        lambda t: _replicate_global(t) if _is_global_array(t)
+        else multihost_utils.broadcast_one_to_all(
             np.asarray(jax.device_get(t)), is_source=state.process_index == from_process
         ),
         tensor,
@@ -319,12 +355,18 @@ def reduce(tensor, reduction: str = "sum", scale: float = 1.0):
 
     def _reduce(t):
         if state.num_processes > 1:
-            gathered = _process_allgather(t, tiled=False)  # [P, ...]
-            out = gathered.sum(axis=0)
+            if _is_global_array(t):
+                # A global array is ONE logical tensor (identical on every
+                # process), not a per-process contribution: cross-process
+                # reduction is the identity for both sum and mean.
+                out = _replicate_global(t)
+            else:
+                gathered = _process_allgather(t, tiled=False)  # [P, ...]
+                out = gathered.sum(axis=0)
+                if reduction == "mean":
+                    out = out / state.num_processes
         else:
             out = jnp.asarray(t)
-        if reduction == "mean":
-            out = out / state.num_processes
         return out * scale
 
     return recursively_apply(_reduce, tensor)

@@ -1,0 +1,154 @@
+"""Real multi-process lane: N processes, one jax.distributed world, launched
+through the actual CLI (reference pattern: tests/test_multigpu.py:50-52
+forking real workers + test_utils/scripts/test_script.py:770-829).
+
+Also covers the elastic-ish launch semantics: --max_restarts relaunch on
+failure and checkpoint auto-resume (reference: torch elastic max_restarts,
+launchers.py:49-54)."""
+
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _launch(args, timeout=600, env_extra=None):
+    env = {**os.environ}
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # CPU children must not dial the TPU relay
+    # Scripts may live outside the repo (tmp_path); keep the package importable.
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(env_extra or {})
+    cmd = [sys.executable, "-m", "accelerate_tpu.commands.accelerate_cli", "launch", *args]
+    return subprocess.run(
+        cmd, capture_output=True, text=True, timeout=timeout, cwd=str(REPO), env=env
+    )
+
+
+class TestMultiProcessLaunch:
+    def test_omnibus_two_processes(self):
+        res = _launch([
+            "--num_processes", "2", "--emulated_device_count", "2",
+            "--module", "accelerate_tpu.test_utils.scripts.test_script",
+        ])
+        assert res.returncode == 0, res.stdout[-3000:] + res.stderr[-3000:]
+        assert "All omnibus checks passed" in res.stdout
+        assert "2 process(es)" in res.stdout
+
+    def test_ops_two_processes(self):
+        res = _launch([
+            "--num_processes", "2", "--emulated_device_count", "2",
+            "--module", "accelerate_tpu.test_utils.scripts.test_ops_multiprocess",
+        ])
+        assert res.returncode == 0, res.stdout[-3000:] + res.stderr[-3000:]
+        assert "All multi-process ops checks passed" in res.stdout
+        for check in ("gather ok", "gather(global array) ok", "gather_object ok",
+                      "broadcast ok", "reduce ok", "pad_across_processes ok",
+                      "checkpoint round-trip ok"):
+            assert check in res.stdout, f"missing: {check}"
+
+
+CRASH_ONCE = """
+import os, sys
+marker = sys.argv[1]
+if not os.path.exists(marker):
+    open(marker, "w").write("crashed")
+    print("first attempt: crashing", flush=True)
+    sys.exit(3)
+print(f"recovered on restart {os.environ.get('ACCELERATE_TPU_RESTART_COUNT')}", flush=True)
+"""
+
+
+RESUME_TRAINER = """
+import os, sys
+import numpy as np
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+import optax
+
+from accelerate_tpu import Accelerator, Model, ProjectConfiguration
+from accelerate_tpu.test_utils.training import RegressionData, init_mlp, mlp_apply, mse_loss
+
+project_dir, crash_marker = sys.argv[1], sys.argv[2]
+acc = Accelerator(project_config=ProjectConfiguration(
+    project_dir=project_dir, automatic_checkpoint_naming=True, total_limit=3))
+
+class StepCounter:
+    step = 0
+    def state_dict(self): return {"step": self.step}
+    def load_state_dict(self, sd): self.step = sd["step"]
+
+counter = StepCounter()
+model = Model(mlp_apply, init_mlp())
+model, opt = acc.prepare(model, optax.sgd(0.05))
+acc.register_for_checkpointing(counter)
+try:
+    acc.load_state()
+    print(f"resumed at step {counter.step}", flush=True)
+except FileNotFoundError:
+    print("fresh start", flush=True)
+
+data = RegressionData(32)
+batch = {k: np.stack([s[k] for s in data[:16]]) for k in data[0]}
+while counter.step < 10:
+    acc.backward(mse_loss, batch)
+    opt.step()
+    opt.zero_grad()
+    counter.step += 1
+    if counter.step % 2 == 0:
+        acc.save_state()
+    if counter.step == 5 and not os.path.exists(crash_marker):
+        open(crash_marker, "w").write("crashed")
+        print("simulated preemption at step 5", flush=True)
+        os._exit(7)  # hard kill: no cleanup, like a real preemption
+print(f"finished at step {counter.step}", flush=True)
+"""
+
+
+class TestElasticLaunch:
+    def test_max_restarts_recovers(self, tmp_path):
+        script = tmp_path / "crash_once.py"
+        script.write_text(CRASH_ONCE)
+        marker = tmp_path / "marker"
+        res = _launch([
+            "--max_restarts", "2", "--restart_backoff", "0.1",
+            "--use_cpu_emulation", str(script), str(marker),
+        ])
+        assert res.returncode == 0, res.stdout + res.stderr
+        assert "recovered on restart 1" in res.stdout
+        assert "restart 1/2" in res.stderr
+
+    def test_restarts_exhausted_propagates_failure(self, tmp_path):
+        script = tmp_path / "always_crash.py"
+        script.write_text("import sys; sys.exit(9)\n")
+        res = _launch([
+            "--max_restarts", "1", "--restart_backoff", "0.1",
+            "--use_cpu_emulation", str(script),
+        ])
+        assert res.returncode == 9
+
+    def test_auto_resume_from_checkpoint(self, tmp_path):
+        script = tmp_path / "trainer.py"
+        script.write_text(RESUME_TRAINER)
+        project = tmp_path / "project"
+        marker = tmp_path / "crash_marker"
+        res = _launch([
+            "--max_restarts", "1", "--restart_backoff", "0.1",
+            "--use_cpu_emulation",
+            str(script), str(project), str(marker),
+        ], timeout=600)
+        assert res.returncode == 0, res.stdout[-3000:] + res.stderr[-3000:]
+        assert "simulated preemption at step 5" in res.stdout
+        # The relaunch resumed from the step-4 checkpoint, not from scratch.
+        assert "resumed at step 4" in res.stdout
+        assert "finished at step 10" in res.stdout
+        # Rotation kept at most 3 checkpoint dirs; resume continued the
+        # numbering past the loaded one instead of overwriting checkpoint_0.
+        ckpts = sorted((project / "checkpoints").glob("checkpoint_*"))
+        assert len(ckpts) <= 3
+        indices = sorted(int(p.name.split("_")[-1]) for p in ckpts)
+        assert indices[-1] >= 4, indices
