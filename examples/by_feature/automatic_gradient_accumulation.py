@@ -1,0 +1,72 @@
+"""Automatic gradient accumulation (reference: examples/by_feature/
+automatic_gradient_accumulation.py).
+
+Combines `find_executable_batch_size` with accumulation: when the observed
+batch size must shrink to fit memory, the accumulation step count grows to
+keep the EFFECTIVE batch size constant — the optimizer sees identical
+updates regardless of what fit on the chip.
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np
+import optax
+
+from accelerate_tpu import Accelerator, Model
+from accelerate_tpu.data_loader import make_global_batch
+from accelerate_tpu.models.bert import classification_loss
+from accelerate_tpu.utils import set_seed
+from accelerate_tpu.utils.memory import find_executable_batch_size
+from example_lib import build_model, common_parser, evaluate, get_dataloaders
+
+
+def training_function(args):
+    set_seed(args.seed)
+    accelerator = Accelerator(mixed_precision=args.mixed_precision)
+    model_def, params = build_model(args.seed)
+    observed_batch_size = args.batch_size  # the effective target
+
+    @find_executable_batch_size(starting_batch_size=observed_batch_size)
+    def inner_training_loop(batch_size):
+        accum = max(observed_batch_size // batch_size, 1)
+        accelerator.print(f"batch_size={batch_size} x accumulation={accum} "
+                          f"(effective {batch_size * accum})")
+        accelerator.free_memory()
+        train_dl, eval_dl = get_dataloaders(batch_size)
+        model, optimizer, train_dl, eval_dl = accelerator.prepare(
+            Model(model_def, params), optax.adamw(args.lr), train_dl, eval_dl
+        )
+        step = accelerator.compile_train_step(
+            classification_loss(model_def.apply), accumulation_steps=accum, max_grad_norm=1.0
+        )
+        for epoch in range(args.epochs):
+            losses, micro = [], []
+            for batch in train_dl:
+                if accum == 1:
+                    metrics = step(make_global_batch(batch, accelerator.mesh))
+                    losses.append(float(metrics["loss"]))
+                    continue
+                micro.append(batch)
+                if len(micro) < accum:
+                    continue
+                stacked = {
+                    key: np.stack([np.asarray(m[key]) for m in micro]) for key in micro[0]
+                }
+                metrics = step(make_global_batch(stacked, accelerator.mesh))
+                losses.append(float(metrics["loss"]))
+                micro = []
+            acc = evaluate(accelerator, model, eval_dl)
+            accelerator.print(f"epoch {epoch}: loss {np.mean(losses):.4f} acc {acc:.3f}")
+
+    inner_training_loop()
+
+
+def main():
+    training_function(common_parser(__doc__).parse_args())
+
+
+if __name__ == "__main__":
+    main()

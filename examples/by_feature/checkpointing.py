@@ -1,0 +1,79 @@
+"""Checkpoint save/resume (reference: examples/by_feature/checkpointing.py).
+
+Saves the whole training state (sharded params via orbax, optimizer,
+scheduler, dataloader position, RNG) every epoch with automatic naming +
+rotation, and resumes from ``--resume_from_checkpoint`` (or the latest, via
+load_state with no argument).
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np
+import optax
+
+from accelerate_tpu import Accelerator, Model, ProjectConfiguration
+from accelerate_tpu.data_loader import make_global_batch
+from accelerate_tpu.models.bert import classification_loss
+from accelerate_tpu.utils import set_seed
+from example_lib import build_model, common_parser, evaluate, get_dataloaders
+
+
+class EpochTracker:
+    epoch = 0
+
+    def state_dict(self):
+        return {"epoch": self.epoch}
+
+    def load_state_dict(self, sd):
+        self.epoch = sd["epoch"]
+
+
+def training_function(args):
+    set_seed(args.seed)
+    accelerator = Accelerator(
+        mixed_precision=args.mixed_precision,
+        project_config=ProjectConfiguration(
+            project_dir=args.project_dir, automatic_checkpoint_naming=True, total_limit=2
+        ),
+    )
+    model_def, params = build_model(args.seed)
+    train_dl, eval_dl = get_dataloaders(args.batch_size)
+    model, optimizer, train_dl, eval_dl = accelerator.prepare(
+        Model(model_def, params), optax.adamw(args.lr), train_dl, eval_dl
+    )
+    tracker = EpochTracker()
+    accelerator.register_for_checkpointing(tracker)
+    step = accelerator.compile_train_step(classification_loss(model_def.apply), max_grad_norm=1.0)
+
+    if args.resume_from_checkpoint:
+        accelerator.load_state(
+            None if args.resume_from_checkpoint == "latest" else args.resume_from_checkpoint
+        )
+        accelerator.print(f"resumed from epoch {tracker.epoch}")
+
+    while tracker.epoch < args.epochs:
+        losses = []
+        for batch in train_dl:
+            metrics = step(make_global_batch(batch, accelerator.mesh))
+            losses.append(float(metrics["loss"]))
+        tracker.epoch += 1
+        accelerator.save_state()
+        acc = evaluate(accelerator, model, eval_dl)
+        accelerator.print(
+            f"epoch {tracker.epoch}: loss {np.mean(losses):.4f} acc {acc:.3f} (state saved)"
+        )
+
+
+def main():
+    parser = common_parser(__doc__)
+    parser.add_argument("--project_dir", default="./ckpt_example")
+    parser.add_argument("--resume_from_checkpoint", default=None,
+                        help="'latest' or a checkpoint directory")
+    training_function(parser.parse_args())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,71 @@
+"""K-fold cross-validation (reference: examples/by_feature/cross_validation.py).
+
+Trains one model per fold and ensembles the held-out logits via
+gather_for_metrics, reporting the averaged-ensemble accuracy on a final
+test split.
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from accelerate_tpu import Accelerator, Model, NumpyDataLoader
+from accelerate_tpu.data_loader import make_global_batch
+from accelerate_tpu.models.bert import classification_loss
+from accelerate_tpu.utils import set_seed
+from example_lib import SyntheticMRPC, build_model, common_parser
+
+
+def training_function(args):
+    set_seed(args.seed)
+    accelerator = Accelerator(mixed_precision=args.mixed_precision)
+    data = SyntheticMRPC(256)
+    test = SyntheticMRPC(64, seed=9)
+    folds = np.array_split(np.arange(len(data)), args.num_folds)
+
+    test_logits = []
+    for fold_id in range(args.num_folds):
+        train_idx = np.concatenate([f for i, f in enumerate(folds) if i != fold_id])
+        train_dl = NumpyDataLoader(
+            [data[int(i)] for i in train_idx], batch_size=args.batch_size,
+            shuffle=True, drop_last=True,
+        )
+        test_dl = NumpyDataLoader([test[i] for i in range(len(test))], batch_size=args.batch_size)
+        model_def, params = build_model(args.seed + fold_id)
+        model, optimizer, train_dl, test_dl = accelerator.prepare(
+            Model(model_def, params), optax.adamw(args.lr), train_dl, test_dl
+        )
+        step = accelerator.compile_train_step(
+            classification_loss(model_def.apply), max_grad_norm=1.0
+        )
+        for epoch in range(args.epochs):
+            for batch in train_dl:
+                step(make_global_batch(batch, accelerator.mesh))
+        fold_logits, labels = [], []
+        for batch in test_dl:
+            logits = model(batch["input_ids"], batch["attention_mask"], batch["token_type_ids"])
+            fold_logits.append(np.asarray(accelerator.gather_for_metrics(logits)))
+            labels.append(np.asarray(accelerator.gather_for_metrics(batch["labels"])))
+        test_logits.append(np.concatenate(fold_logits))
+        test_labels = np.concatenate(labels)
+        accelerator.free_memory()
+        accelerator.print(f"fold {fold_id} done")
+
+    ensemble = np.mean(test_logits, axis=0)
+    acc = (ensemble.argmax(-1) == test_labels).mean()
+    accelerator.print(f"ensemble accuracy over {args.num_folds} folds: {acc:.3f}")
+
+
+def main():
+    parser = common_parser(__doc__)
+    parser.add_argument("--num_folds", type=int, default=2)
+    training_function(parser.parse_args())
+
+
+if __name__ == "__main__":
+    main()

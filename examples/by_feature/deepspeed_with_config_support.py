@@ -1,0 +1,76 @@
+"""Training from a DeepSpeed ZeRO json (reference: examples/by_feature/
+deepspeed_with_config_support.py).
+
+The json is *translated*, not executed: stage 2 -> optimizer/grad sharding
+over the fsdp axis, stage 3 -> full param sharding, offload devices ->
+pinned-host optimizer state (parallel/host_offload.py). XLA is the engine;
+no DeepSpeed runtime exists on TPU.
+"""
+
+import json
+import sys
+import tempfile
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np
+import optax
+
+from accelerate_tpu import Accelerator, Model
+from accelerate_tpu.data_loader import make_global_batch
+from accelerate_tpu.models.bert import classification_loss
+from accelerate_tpu.utils import DeepSpeedPlugin, set_seed
+from example_lib import build_model, common_parser, evaluate, get_dataloaders
+
+DEFAULT_DS_CONFIG = {
+    "train_micro_batch_size_per_gpu": 16,
+    "gradient_clipping": 1.0,
+    "zero_optimization": {
+        "stage": 2,
+        "offload_optimizer": {"device": "cpu"},
+    },
+    "bf16": {"enabled": True},
+}
+
+
+def training_function(args):
+    set_seed(args.seed)
+    config_file = args.deepspeed_config_file
+    if config_file is None:
+        tmp = tempfile.NamedTemporaryFile("w", suffix=".json", delete=False)
+        json.dump(DEFAULT_DS_CONFIG, tmp)
+        tmp.close()
+        config_file = tmp.name
+    accelerator = Accelerator(
+        mixed_precision=args.mixed_precision,
+        deepspeed_plugin=DeepSpeedPlugin(config_file=config_file),
+    )
+    model_def, params = build_model(args.seed)
+    train_dl, eval_dl = get_dataloaders(args.batch_size)
+    model, optimizer, train_dl, eval_dl = accelerator.prepare(
+        Model(model_def, params), optax.adamw(args.lr), train_dl, eval_dl
+    )
+    step = accelerator.compile_train_step(classification_loss(model_def.apply))
+
+    accelerator.print(
+        f"translated ZeRO config: sharding={accelerator.state.fsdp_plugin.sharding_strategy} "
+        f"offload={optimizer.offload_to_host}"
+    )
+    for epoch in range(args.epochs):
+        losses = []
+        for batch in train_dl:
+            metrics = step(make_global_batch(batch, accelerator.mesh))
+            losses.append(float(metrics["loss"]))
+        acc = evaluate(accelerator, model, eval_dl)
+        accelerator.print(f"epoch {epoch}: loss {np.mean(losses):.4f} acc {acc:.3f}")
+
+
+def main():
+    parser = common_parser(__doc__)
+    parser.add_argument("--deepspeed_config_file", default=None)
+    training_function(parser.parse_args())
+
+
+if __name__ == "__main__":
+    main()

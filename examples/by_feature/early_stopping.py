@@ -1,0 +1,62 @@
+"""Early stopping across processes (reference: examples/by_feature/early_stopping.py).
+
+The stop decision must be GLOBAL: one process deciding alone would desync
+the collective world. `set_trigger` / `check_trigger` reduce the flag over
+all processes (reference: accelerator.py:2198-2255), so every process exits
+the loop on the same step.
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np
+import optax
+
+from accelerate_tpu import Accelerator, Model
+from accelerate_tpu.data_loader import make_global_batch
+from accelerate_tpu.models.bert import classification_loss
+from accelerate_tpu.utils import set_seed
+from example_lib import build_model, common_parser, evaluate, get_dataloaders
+
+
+def training_function(args):
+    set_seed(args.seed)
+    accelerator = Accelerator(mixed_precision=args.mixed_precision)
+    model_def, params = build_model(args.seed)
+    train_dl, eval_dl = get_dataloaders(args.batch_size)
+    model, optimizer, train_dl, eval_dl = accelerator.prepare(
+        Model(model_def, params), optax.adamw(args.lr), train_dl, eval_dl
+    )
+    step = accelerator.compile_train_step(classification_loss(model_def.apply), max_grad_norm=1.0)
+
+    best, patience_left = float("inf"), args.patience
+    for epoch in range(args.epochs):
+        losses = []
+        for batch in train_dl:
+            metrics = step(make_global_batch(batch, accelerator.mesh))
+            losses.append(float(metrics["loss"]))
+        epoch_loss = float(np.mean(losses))
+        if epoch_loss < best - args.min_delta:
+            best, patience_left = epoch_loss, args.patience
+        else:
+            patience_left -= 1
+            if patience_left <= 0:
+                accelerator.set_trigger()  # local decision...
+        acc = evaluate(accelerator, model, eval_dl)
+        accelerator.print(f"epoch {epoch}: loss {epoch_loss:.4f} acc {acc:.3f}")
+        if accelerator.check_trigger():  # ...reduced globally
+            accelerator.print(f"early stop at epoch {epoch} (no improvement)")
+            break
+
+
+def main():
+    parser = common_parser(__doc__)
+    parser.add_argument("--patience", type=int, default=1)
+    parser.add_argument("--min_delta", type=float, default=0.0)
+    training_function(parser.parse_args())
+
+
+if __name__ == "__main__":
+    main()

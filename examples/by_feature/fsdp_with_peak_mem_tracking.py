@@ -1,0 +1,71 @@
+"""FSDP with memory tracking (reference: examples/by_feature/
+fsdp_with_peak_mem_tracking.py).
+
+Params shard over the ``fsdp`` mesh axis (GSPMD largest-divisible-dim
+policy); live/peak HBM comes from the device memory stats the platform
+exposes. With ``--cpu_offload`` the optimizer state additionally lives in
+pinned host memory between steps (parallel/host_offload.py).
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import jax
+import numpy as np
+import optax
+
+from accelerate_tpu import Accelerator, Model
+from accelerate_tpu.data_loader import make_global_batch
+from accelerate_tpu.models.bert import classification_loss
+from accelerate_tpu.utils import FullyShardedDataParallelPlugin, set_seed
+from example_lib import build_model, common_parser, evaluate, get_dataloaders
+
+
+def device_memory_mb() -> float:
+    stats = jax.local_devices()[0].memory_stats() or {}
+    return stats.get("bytes_in_use", 0) / 2**20
+
+
+def training_function(args):
+    set_seed(args.seed)
+    accelerator = Accelerator(
+        mixed_precision=args.mixed_precision,
+        fsdp_plugin=FullyShardedDataParallelPlugin(
+            min_weight_size_to_shard=1,
+            cpu_offload=args.cpu_offload,
+            activation_checkpointing=args.activation_checkpointing,
+        ),
+    )
+    model_def, params = build_model(args.seed)
+    train_dl, eval_dl = get_dataloaders(args.batch_size)
+    model, optimizer, train_dl, eval_dl = accelerator.prepare(
+        Model(model_def, params), optax.adamw(args.lr), train_dl, eval_dl
+    )
+    step = accelerator.compile_train_step(classification_loss(model_def.apply), max_grad_norm=1.0)
+
+    for epoch in range(args.epochs):
+        before = device_memory_mb()
+        losses = []
+        for batch in train_dl:
+            metrics = step(make_global_batch(batch, accelerator.mesh))
+            losses.append(float(metrics["loss"]))
+        after = device_memory_mb()
+        acc = evaluate(accelerator, model, eval_dl)
+        accelerator.print(
+            f"epoch {epoch}: loss {np.mean(losses):.4f} acc {acc:.3f} "
+            f"hbm {before:.1f} -> {after:.1f} MiB "
+            f"(offload={'on' if optimizer.offload_to_host else 'off'})"
+        )
+
+
+def main():
+    parser = common_parser(__doc__)
+    parser.add_argument("--cpu_offload", action="store_true")
+    parser.add_argument("--activation_checkpointing", action="store_true")
+    training_function(parser.parse_args())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,67 @@
+"""Gradient accumulation (reference: examples/by_feature/gradient_accumulation.py).
+
+TPU-native twist: instead of a Python `with accelerator.accumulate(model):`
+loop around k backward calls, the fused train step takes batches with a
+leading [accum, micro, ...] dim and scans over them INSIDE one executable
+(`compile_train_step(accumulation_steps=k)`) — the accumulation loop
+compiles away. The eager `accumulate()` context manager also works and is
+shown in the omnibus tests; this example shows the fast path.
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np
+import optax
+
+from accelerate_tpu import Accelerator, Model
+from accelerate_tpu.data_loader import make_global_batch
+from accelerate_tpu.models.bert import classification_loss
+from accelerate_tpu.utils import set_seed
+from example_lib import build_model, common_parser, evaluate, get_dataloaders
+
+
+def training_function(args):
+    set_seed(args.seed)
+    accelerator = Accelerator(
+        mixed_precision=args.mixed_precision,
+        gradient_accumulation_steps=args.gradient_accumulation_steps,
+    )
+    model_def, params = build_model(args.seed)
+    train_dl, eval_dl = get_dataloaders(args.batch_size)
+    model, optimizer, train_dl, eval_dl = accelerator.prepare(
+        Model(model_def, params), optax.adamw(args.lr), train_dl, eval_dl
+    )
+    k = args.gradient_accumulation_steps
+    step = accelerator.compile_train_step(
+        classification_loss(model_def.apply), accumulation_steps=k, max_grad_norm=1.0
+    )
+
+    for epoch in range(args.epochs):
+        losses, micro = [], []
+        for batch in train_dl:
+            micro.append(batch)
+            if len(micro) < k:
+                continue
+            # Stack k microbatches into the [accum, micro, ...] layout the
+            # in-executable scan expects.
+            stacked = {
+                key: np.stack([np.asarray(m[key]) for m in micro]) for key in micro[0]
+            }
+            metrics = step(make_global_batch(stacked, accelerator.mesh))
+            losses.append(float(metrics["loss"]))
+            micro = []
+        acc = evaluate(accelerator, model, eval_dl)
+        accelerator.print(f"epoch {epoch}: loss {np.mean(losses):.4f} acc {acc:.3f}")
+
+
+def main():
+    parser = common_parser(__doc__)
+    parser.add_argument("--gradient_accumulation_steps", type=int, default=2)
+    training_function(parser.parse_args())
+
+
+if __name__ == "__main__":
+    main()

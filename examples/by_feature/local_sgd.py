@@ -1,0 +1,55 @@
+"""LocalSGD training (reference: examples/by_feature/local_sgd.py).
+
+Replicas over the ``dp`` axis take ``local_sgd_steps`` INDEPENDENT
+optimizer steps (no gradient sync) and then average parameters — trading
+per-step communication for periodic averaging. The TPU-native design stacks
+the divergent replicas along dp inside one jitted step (local_sgd.py) —
+there is no process-level no_sync; divergence lives inside the array.
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np
+import optax
+
+from accelerate_tpu import Accelerator, LocalSGD, Model
+from accelerate_tpu.data_loader import make_global_batch
+from accelerate_tpu.models.bert import classification_loss
+from accelerate_tpu.utils import set_seed
+from example_lib import build_model, common_parser, evaluate, get_dataloaders
+
+
+def training_function(args):
+    set_seed(args.seed)
+    accelerator = Accelerator(mixed_precision=args.mixed_precision)
+    model_def, params = build_model(args.seed)
+    train_dl, eval_dl = get_dataloaders(args.batch_size)
+    model, optimizer, train_dl, eval_dl = accelerator.prepare(
+        Model(model_def, params), optax.adamw(args.lr), train_dl, eval_dl
+    )
+    loss_fn = classification_loss(model_def.apply)
+
+    with LocalSGD(
+        accelerator, model, optimizer, loss_fn,
+        local_sgd_steps=args.local_sgd_steps, max_grad_norm=1.0,
+    ) as local_sgd:
+        for epoch in range(args.epochs):
+            losses = []
+            for batch in train_dl:
+                metrics = local_sgd.step(make_global_batch(batch, accelerator.mesh))
+                losses.append(float(metrics["loss"]))
+            acc = evaluate(accelerator, model, eval_dl)
+            accelerator.print(f"epoch {epoch}: loss {np.mean(losses):.4f} acc {acc:.3f}")
+
+
+def main():
+    parser = common_parser(__doc__)
+    parser.add_argument("--local_sgd_steps", type=int, default=4)
+    training_function(parser.parse_args())
+
+
+if __name__ == "__main__":
+    main()

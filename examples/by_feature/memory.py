@@ -1,0 +1,60 @@
+"""OOM-adaptive batch size (reference: examples/by_feature/memory.py).
+
+`find_executable_batch_size` retries the whole training function with a
+halved batch size whenever XLA reports RESOURCE_EXHAUSTED. Under jit a new
+batch size is just a new static shape — the step recompiles and the loop
+continues; no allocator state needs clearing (the reference's
+torch.cuda.empty_cache() dance has no TPU equivalent to need).
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np
+import optax
+
+from accelerate_tpu import Accelerator, Model
+from accelerate_tpu.data_loader import make_global_batch
+from accelerate_tpu.models.bert import classification_loss
+from accelerate_tpu.utils import set_seed
+from accelerate_tpu.utils.memory import find_executable_batch_size
+from example_lib import build_model, common_parser, evaluate, get_dataloaders
+
+
+def training_function(args):
+    set_seed(args.seed)
+    accelerator = Accelerator(mixed_precision=args.mixed_precision)
+    model_def, params = build_model(args.seed)
+    prepared = {}
+
+    @find_executable_batch_size(starting_batch_size=args.batch_size)
+    def inner_training_loop(batch_size):
+        accelerator.print(f"trying batch_size={batch_size}")
+        accelerator.free_memory(*prepared.values())
+        train_dl, eval_dl = get_dataloaders(batch_size)
+        model, optimizer, train_dl, eval_dl = accelerator.prepare(
+            Model(model_def, params), optax.adamw(args.lr), train_dl, eval_dl
+        )
+        prepared.update(model=model, optimizer=optimizer)
+        step = accelerator.compile_train_step(
+            classification_loss(model_def.apply), max_grad_norm=1.0
+        )
+        for epoch in range(args.epochs):
+            losses = []
+            for batch in train_dl:
+                metrics = step(make_global_batch(batch, accelerator.mesh))
+                losses.append(float(metrics["loss"]))
+            acc = evaluate(accelerator, model, eval_dl)
+            accelerator.print(f"epoch {epoch}: loss {np.mean(losses):.4f} acc {acc:.3f}")
+
+    inner_training_loop()
+
+
+def main():
+    training_function(common_parser(__doc__).parse_args())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,62 @@
+"""Exact metrics across processes (reference: examples/by_feature/
+multi_process_metrics.py).
+
+The last eval batch is padded to keep collectives shape-uniform;
+`gather_for_metrics` drops exactly the duplicated tail samples so metric
+denominators are exact. Run it multi-process to see the real thing:
+
+    accelerate-tpu launch --num_processes 2 --emulated_device_count 2 \
+        examples/by_feature/multi_process_metrics.py
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from accelerate_tpu import Accelerator, Model
+from accelerate_tpu.data_loader import make_global_batch
+from accelerate_tpu.models.bert import classification_loss
+from accelerate_tpu.utils import set_seed
+from example_lib import build_model, common_parser, get_dataloaders
+
+
+def training_function(args):
+    set_seed(args.seed)
+    accelerator = Accelerator(mixed_precision=args.mixed_precision)
+    model_def, params = build_model(args.seed)
+    # 100 eval samples: NOT divisible by the padded eval batching — the tail
+    # duplicates are what gather_for_metrics must drop.
+    train_dl, eval_dl = get_dataloaders(args.batch_size, n_eval=100)
+    model, optimizer, train_dl, eval_dl = accelerator.prepare(
+        Model(model_def, params), optax.adamw(args.lr), train_dl, eval_dl
+    )
+    step = accelerator.compile_train_step(classification_loss(model_def.apply), max_grad_norm=1.0)
+
+    for epoch in range(args.epochs):
+        for batch in train_dl:
+            step(make_global_batch(batch, accelerator.mesh))
+        all_preds, all_labels = [], []
+        for batch in eval_dl:
+            logits = model(batch["input_ids"], batch["attention_mask"], batch["token_type_ids"])
+            preds = accelerator.gather_for_metrics(jnp.argmax(logits, -1))
+            labels = accelerator.gather_for_metrics(batch["labels"])
+            all_preds.append(np.asarray(preds))
+            all_labels.append(np.asarray(labels))
+        preds, labels = np.concatenate(all_preds), np.concatenate(all_labels)
+        assert len(labels) == 100, f"metric denominator must be exact, got {len(labels)}"
+        accelerator.print(
+            f"epoch {epoch}: accuracy {(preds == labels).mean():.3f} over exactly {len(labels)} samples"
+        )
+
+
+def main():
+    training_function(common_parser(__doc__).parse_args())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,57 @@
+"""Profiling a training loop (reference: examples/by_feature/profiler.py).
+
+ProfileKwargs drives jax.profiler with the reference's schedule semantics
+(wait/warmup/active cycles): traces land under ``--trace_dir`` as
+TensorBoard-loadable protos (xplane), covering exactly the scheduled steps.
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np
+import optax
+
+from accelerate_tpu import Accelerator, Model
+from accelerate_tpu.data_loader import make_global_batch
+from accelerate_tpu.models.bert import classification_loss
+from accelerate_tpu.utils import ProfileKwargs, set_seed
+from example_lib import build_model, common_parser, get_dataloaders
+
+
+def training_function(args):
+    set_seed(args.seed)
+    profile_kwargs = ProfileKwargs(
+        schedule_option={"wait": 1, "warmup": 1, "active": 2, "repeat": 1},
+        output_trace_dir=args.trace_dir,
+    )
+    accelerator = Accelerator(
+        mixed_precision=args.mixed_precision, kwargs_handlers=[profile_kwargs]
+    )
+    model_def, params = build_model(args.seed)
+    train_dl, _ = get_dataloaders(args.batch_size)
+    model, optimizer, train_dl = accelerator.prepare(
+        Model(model_def, params), optax.adamw(args.lr), train_dl
+    )
+    step = accelerator.compile_train_step(classification_loss(model_def.apply), max_grad_norm=1.0)
+
+    with accelerator.profile() as prof:
+        losses = []
+        for i, batch in enumerate(train_dl):
+            metrics = step(make_global_batch(batch, accelerator.mesh))
+            losses.append(float(metrics["loss"]))
+            prof.step()
+            if i >= 5:
+                break
+    accelerator.print(f"profiled {len(losses)} steps, trace in {args.trace_dir}")
+
+
+def main():
+    parser = common_parser(__doc__)
+    parser.add_argument("--trace_dir", default="./profile_trace")
+    training_function(parser.parse_args())
+
+
+if __name__ == "__main__":
+    main()

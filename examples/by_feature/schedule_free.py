@@ -1,0 +1,55 @@
+"""Schedule-free training (reference: examples/by_feature/schedule_free.py).
+
+The reference wraps facebookresearch/schedule_free's AdamWScheduleFree;
+the optax-native equivalent is ``optax.contrib.schedule_free_adamw`` — no
+LR schedule object at all, and evaluation uses the averaged (x) parameters
+via ``schedule_free_eval_params``.
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np
+import optax
+
+from accelerate_tpu import Accelerator, Model
+from accelerate_tpu.data_loader import make_global_batch
+from accelerate_tpu.models.bert import classification_loss
+from accelerate_tpu.utils import set_seed
+from example_lib import build_model, common_parser, evaluate, get_dataloaders
+
+
+def training_function(args):
+    set_seed(args.seed)
+    accelerator = Accelerator(mixed_precision=args.mixed_precision)
+    model_def, params = build_model(args.seed)
+    train_dl, eval_dl = get_dataloaders(args.batch_size)
+    tx = optax.contrib.schedule_free_adamw(learning_rate=args.lr, warmup_steps=8)
+    model, optimizer, train_dl, eval_dl = accelerator.prepare(
+        Model(model_def, params), tx, train_dl, eval_dl
+    )
+    step = accelerator.compile_train_step(classification_loss(model_def.apply), max_grad_norm=1.0)
+
+    for epoch in range(args.epochs):
+        losses = []
+        for batch in train_dl:
+            metrics = step(make_global_batch(batch, accelerator.mesh))
+            losses.append(float(metrics["loss"]))
+        # Evaluate with the schedule-free AVERAGED params, then restore.
+        train_params = model.params
+        model.params = optax.contrib.schedule_free_eval_params(
+            optimizer.opt_state, train_params
+        )
+        acc = evaluate(accelerator, model, eval_dl)
+        model.params = train_params
+        accelerator.print(f"epoch {epoch}: loss {np.mean(losses):.4f} eval-avg acc {acc:.3f}")
+
+
+def main():
+    training_function(common_parser(__doc__).parse_args())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,64 @@
+"""Experiment tracking (reference: examples/by_feature/tracking.py).
+
+`log_with="all"` initializes every tracker whose backend is importable
+(W&B, TensorBoard, MLflow, Comet, Aim, ClearML, DVCLive) plus the
+zero-dependency JSONL tracker, which always works — metrics land in
+``<project_dir>/<run>/metrics.jsonl`` and can be tailed or parsed without
+any service.
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np
+import optax
+
+from accelerate_tpu import Accelerator, Model
+from accelerate_tpu.data_loader import make_global_batch
+from accelerate_tpu.models.bert import classification_loss
+from accelerate_tpu.utils import set_seed
+from example_lib import build_model, common_parser, evaluate, get_dataloaders
+
+
+def training_function(args):
+    set_seed(args.seed)
+    accelerator = Accelerator(
+        mixed_precision=args.mixed_precision,
+        log_with=args.log_with,
+        project_dir=args.project_dir,
+    )
+    accelerator.init_trackers(
+        "example_tracking", config={"lr": args.lr, "batch_size": args.batch_size}
+    )
+    model_def, params = build_model(args.seed)
+    train_dl, eval_dl = get_dataloaders(args.batch_size)
+    model, optimizer, train_dl, eval_dl = accelerator.prepare(
+        Model(model_def, params), optax.adamw(args.lr), train_dl, eval_dl
+    )
+    step = accelerator.compile_train_step(classification_loss(model_def.apply), max_grad_norm=1.0)
+
+    global_step = 0
+    for epoch in range(args.epochs):
+        losses = []
+        for batch in train_dl:
+            metrics = step(make_global_batch(batch, accelerator.mesh))
+            losses.append(float(metrics["loss"]))
+            global_step += 1
+            accelerator.log({"train_loss": losses[-1]}, step=global_step)
+        acc = evaluate(accelerator, model, eval_dl)
+        accelerator.log({"eval_accuracy": acc, "epoch": epoch}, step=global_step)
+        accelerator.print(f"epoch {epoch}: loss {np.mean(losses):.4f} acc {acc:.3f}")
+    accelerator.end_training()
+
+
+def main():
+    parser = common_parser(__doc__)
+    parser.add_argument("--log_with", default="jsonl", help='"jsonl", "all", or a tracker name')
+    parser.add_argument("--project_dir", default="./tracking_example")
+    training_function(parser.parse_args())
+
+
+if __name__ == "__main__":
+    main()

@@ -42,19 +42,26 @@ def training_function(args):
     accelerator = Accelerator(mixed_precision=args.mixed_precision)
     cfg = ResNetConfig.tiny(num_classes=3)
     model_def = ResNet(cfg)
-    params = model_def.init(
-        jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)), train=False
-    )["params"]
+    variables = model_def.init_variables(jax.random.PRNGKey(0))
+    params, batch_stats = variables["params"], variables["batch_stats"]
+
+    # BatchNorm statistics are not optimizer state: freeze them at their
+    # init values (mean 0 / var 1) and close over them, so the optimizer
+    # pytree holds only the trainable params.
+    def apply_fn(p, pixel_values):
+        return model_def.apply(
+            {"params": p, "batch_stats": batch_stats}, pixel_values, train=False
+        )
 
     train_dl = NumpyDataLoader(SyntheticImages(256), batch_size=args.batch_size, shuffle=True, drop_last=True)
     eval_dl = NumpyDataLoader(SyntheticImages(64, seed=1), batch_size=args.batch_size)
     model, optimizer, train_dl, eval_dl = accelerator.prepare(
-        Model(model_def, params, apply_kwargs={"train": False}),
+        Model(apply_fn, params),
         optax.adamw(args.lr), train_dl, eval_dl,
     )
 
     def loss_fn(p, batch):
-        logits = model_def.apply({"params": p}, batch["pixel_values"], train=False)
+        logits = apply_fn(p, batch["pixel_values"])
         logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
         return -jnp.take_along_axis(logp, batch["labels"][:, None], axis=-1).mean()
 
@@ -63,7 +70,7 @@ def training_function(args):
         losses = [float(step(make_global_batch(b, accelerator.mesh))["loss"]) for b in train_dl]
         correct = total = 0
         for batch in eval_dl:
-            logits = model(batch["pixel_values"], train=False)
+            logits = model(batch["pixel_values"])
             preds = accelerator.gather_for_metrics(jnp.argmax(logits, -1))
             labels = accelerator.gather_for_metrics(batch["labels"])
             correct += int((np.asarray(preds) == np.asarray(labels)).sum())
