@@ -927,17 +927,24 @@ class Accelerator:
         else:
             data = self.gather(input_data)
 
-        try:
-            if self.gradient_state.end_of_dataloader and self.gradient_state.remainder > 0:
-                def _adjust_samples(tensor):
-                    return tensor[: self.gradient_state.remainder]
+        if self.gradient_state.end_of_dataloader and self.gradient_state.remainder > 0:
+            remainder = self.gradient_state.remainder
 
-                if use_gather_object or not all_tensors:
-                    return _adjust_samples(data)
-                return recursively_apply(_adjust_samples, data)
-            return data
-        except Exception:
-            return data
+            def _adjust_samples(tensor):
+                # Gathered objects may be ragged lists (np.ndim would choke
+                # converting them); arrays slice on their batch dim, 0-d
+                # scalars pass through (the remainder describes a batch dim
+                # they don't have).
+                if isinstance(tensor, (list, tuple)):
+                    return tensor[:remainder]
+                if getattr(tensor, "ndim", 0) == 0:
+                    return tensor
+                return tensor[:remainder]
+
+            if use_gather_object or not all_tensors:
+                return _adjust_samples(data)
+            return recursively_apply(_adjust_samples, data)
+        return data
 
     def reduce(self, tensor, reduction="sum", scale=1.0):
         return reduce(tensor, reduction, scale)

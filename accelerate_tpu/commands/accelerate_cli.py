@@ -29,6 +29,7 @@ def _subcommand_registrars():
         "launch": _lazy(".launch", "launch_command_parser"),
         "merge-weights": _lazy(".merge", "merge_command_parser"),
         "test": _lazy(".test", "test_command_parser"),
+        "tpu-config": _lazy(".tpu", "tpu_command_parser"),
     }
 
 

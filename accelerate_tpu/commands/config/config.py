@@ -81,6 +81,12 @@ def config_command_parser(subparsers=None):
         "--default", action="store_true",
         help="Skip the questionnaire; write a sensible single-host default")
     parser.add_argument("--mixed_precision", default="bf16", choices=["no", "bf16", "fp16"])
+    # Nested subcommands (reference: config/{default,update}.py). The bare
+    # `accelerate-tpu config` still runs the questionnaire.
+    sub = parser.add_subparsers(dest="config_subcommand")
+    from .update import update_command_parser
+
+    update_command_parser(subparsers=sub)
     if subparsers is not None:
         parser.set_defaults(func=config_command)
     return parser

@@ -1,0 +1,50 @@
+"""`accelerate-tpu config update` (reference: commands/config/update.py).
+
+Rewrite an existing config file with the current schema: values the file
+already sets are kept, fields added since it was written get their
+defaults, and unknown keys are reported and dropped.
+"""
+
+from __future__ import annotations
+
+import argparse
+from pathlib import Path
+
+from .config_args import default_config_file, load_config_from_file
+
+
+def update_config(args) -> str:
+    config_file = args.config_file
+    if config_file is None:
+        if not default_config_file().exists():
+            raise FileNotFoundError(
+                f"No config file at {default_config_file()}; run `accelerate-tpu config` first."
+            )
+        config_file = str(default_config_file())
+    elif not Path(config_file).exists():
+        raise FileNotFoundError(f"The config file {config_file} doesn't exist.")
+    cfg = load_config_from_file(config_file)
+    if cfg.extra:
+        print(f"Dropping unknown keys: {sorted(cfg.extra)}")
+        cfg.extra = {}
+    cfg.save(config_file)
+    return config_file
+
+
+def update_command_parser(subparsers=None):
+    description = "Update an existing config file to the current schema, keeping its values"
+    if subparsers is not None:
+        parser = subparsers.add_parser("update", description=description)
+    else:
+        parser = argparse.ArgumentParser("accelerate-tpu config update", description=description)
+    parser.add_argument("--config_file", default=None,
+                        help="Config file to update (default: the default config path)")
+    if subparsers is not None:
+        parser.set_defaults(func=update_config_command)
+    return parser
+
+
+def update_config_command(args) -> int:
+    path = update_config(args)
+    print(f"Successfully updated the configuration at {path}.")
+    return 0

@@ -35,6 +35,67 @@ def _model_registry():
     return reg
 
 
+def _abstract_from_path(path: str):
+    """Abstract param tree from a local checkpoint, no weights read.
+
+    Accepts a ``.safetensors`` file, a directory of shards (with or without
+    ``model.safetensors.index.json``), or a HF-style ``config.json``
+    describing a llama-family model. Safetensors headers carry every
+    tensor's shape/dtype, so the whole estimate costs a few KiB of reads —
+    the no-egress equivalent of the reference's Hub meta-model
+    (reference: commands/estimate.py builds from the Hub)."""
+    import os
+
+    import jax
+
+    from ..big_modeling import _nest
+    from ..native.io import _st_dtype, read_safetensors_header
+
+    def from_shards(paths):
+        flat = {}
+        for p in paths:
+            header, _ = read_safetensors_header(p)
+            for key, meta in header.items():
+                flat[key] = jax.ShapeDtypeStruct(
+                    tuple(meta["shape"]), _st_dtype(meta["dtype"])
+                )
+        return _nest(flat)
+
+    if os.path.isfile(path) and path.endswith(".safetensors"):
+        return from_shards([path])
+    if os.path.isdir(path):
+        shards = sorted(
+            os.path.join(path, f) for f in os.listdir(path) if f.endswith(".safetensors")
+        )
+        if shards:
+            return from_shards(shards)
+        path = os.path.join(path, "config.json")
+    if os.path.isfile(path) and path.endswith(".json"):
+        import json
+
+        from ..models.llama import LlamaConfig, LlamaForCausalLM
+
+        cfg_dict = json.loads(open(path).read())
+        model_type = cfg_dict.get("model_type")
+        if model_type not in ("llama", "mistral"):
+            print(
+                f"config.json has model_type={model_type!r}; only llama-family configs "
+                "(llama, mistral) can be estimated from a config — pass the checkpoint's "
+                ".safetensors directory instead."
+            )
+            return None
+        fields = (
+            "vocab_size", "hidden_size", "intermediate_size", "num_hidden_layers",
+            "num_attention_heads", "num_key_value_heads", "max_position_embeddings",
+            "tie_word_embeddings",
+        )
+        kwargs = {k: cfg_dict[k] for k in fields if k in cfg_dict}
+        from ..big_modeling import init_empty_weights
+
+        return init_empty_weights(LlamaForCausalLM(LlamaConfig(**kwargs)))
+    return None
+
+
 def _fmt(nbytes: float) -> str:
     for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
         if nbytes < 1024 or unit == "TiB":
@@ -50,11 +111,18 @@ def estimate_command(args) -> int:
     from ..utils.modeling import calculate_maximum_sizes, compute_module_sizes
 
     registry = _model_registry()
-    if args.model_name not in registry:
-        print(f"Unknown model {args.model_name!r}. Available: {', '.join(sorted(registry))}")
-        return 2
-    module = registry[args.model_name]()
-    abstract = init_empty_weights(module)
+    if args.model_name in registry:
+        module = registry[args.model_name]()
+        abstract = init_empty_weights(module)
+    else:
+        abstract = _abstract_from_path(args.model_name)
+        if abstract is None:
+            print(
+                f"Unknown model {args.model_name!r}. Pass a built-in name "
+                f"({', '.join(sorted(registry))}), a .safetensors file/directory, "
+                "or a llama-style config.json."
+            )
+            return 2
     n_params = sum(
         int(__import__("numpy").prod(l.shape))
         for l in __import__("jax").tree_util.tree_leaves(abstract))
@@ -69,8 +137,10 @@ def estimate_command(args) -> int:
     print("-" * len(header))
     for name in selected:
         dt = dtypes[name]
+        # [._] + optional dotted prefix covers both flax naming (layers_0)
+        # and HF checkpoint naming (model.layers.0).
         total, (largest, _) = calculate_maximum_sizes(
-            abstract, no_split=[r"layers_\d+", r"h_\d+"], dtype=dt)
+            abstract, no_split=[r"(.*\.)?layers[._]\d+", r"(.*\.)?h[._]\d+"], dtype=dt)
         # Training: bf16/fp32 params + same-dtype grads + fp32 master + 2 fp32
         # Adam moments (optax adamw); reference uses 4x fp32 params heuristic
         # (commands/estimate.py table).
@@ -90,7 +160,11 @@ def estimate_command_parser(subparsers=None):
         parser = subparsers.add_parser("estimate-memory", description=description)
     else:
         parser = argparse.ArgumentParser("accelerate-tpu estimate-memory", description=description)
-    parser.add_argument("model_name", help="Built-in model name (e.g. llama3-8b)")
+    parser.add_argument(
+        "model_name",
+        help="Built-in model name (e.g. llama3-8b), a .safetensors checkpoint "
+             "file/directory, or a llama-style config.json",
+    )
     parser.add_argument("--dtypes", nargs="+", default=["float32", "bfloat16", "int8", "int4"])
     parser.add_argument("--fsdp", type=int, default=1,
                         help="Also print the per-chip share under this FSDP axis size")

@@ -1,0 +1,92 @@
+"""`accelerate-tpu tpu-config` (reference: commands/tpu.py:1-157).
+
+Pod bring-up: run setup commands on every worker of a TPU pod slice via
+``gcloud compute tpus tpu-vm ssh --worker=all`` — install dependencies,
+sync code, prepare directories — before `accelerate-tpu launch` runs the
+actual job. ``--debug`` prints the gcloud invocation instead of executing
+it (the reference's behavior), which is also what the tests assert on.
+"""
+
+from __future__ import annotations
+
+import argparse
+import subprocess
+import sys
+
+from .config.config_args import load_config_from_file
+
+_description = "Run setup commands across all workers of a TPU pod before launching"
+
+
+def tpu_command_parser(subparsers=None):
+    if subparsers is not None:
+        parser = subparsers.add_parser("tpu-config", description=_description)
+    else:
+        parser = argparse.ArgumentParser("accelerate-tpu tpu-config", description=_description)
+    config_args = parser.add_argument_group("Config Arguments")
+    config_args.add_argument("--config_file", default=None, help="Config YAML to read pod identity from")
+    config_args.add_argument("--tpu_name", default=None, help="TPU pod name (falls back to the config file)")
+    config_args.add_argument("--tpu_zone", default=None, help="TPU zone (falls back to the config file)")
+    pod_args = parser.add_argument_group("Pod Arguments")
+    pod_args.add_argument("--command_file", default=None,
+                          help="File with one setup command per line")
+    pod_args.add_argument("--command", action="append", nargs="+",
+                          help="A setup command; repeatable")
+    pod_args.add_argument("--install_accelerate", action="store_true",
+                          help="pip-install this framework on every worker first")
+    pod_args.add_argument("--accelerate_spec", default="accelerate-tpu",
+                          help="pip requirement spec used with --install_accelerate "
+                               "(a version pin, wheel path, or VCS URL)")
+    pod_args.add_argument("--use_alpha", action="store_true",
+                          help="Use `gcloud alpha` instead of `gcloud`")
+    pod_args.add_argument("--debug", action="store_true",
+                          help="Print the gcloud command instead of running it")
+    if subparsers is not None:
+        parser.set_defaults(func=tpu_command_launcher)
+    return parser
+
+
+def tpu_command_launcher(args) -> int:
+    cfg = load_config_from_file(args.config_file) if args.config_file else load_config_from_file()
+    tpu_name = args.tpu_name or cfg.tpu_name
+    tpu_zone = args.tpu_zone or cfg.tpu_zone
+    if not tpu_name:
+        print("tpu-config needs --tpu_name (or tpu_name in the config file)", file=sys.stderr)
+        return 2
+
+    commands: list[str] = []
+    if args.command_file:
+        with open(args.command_file) as f:
+            commands += [line for line in f.read().splitlines() if line.strip()]
+    for cmd in args.command or []:
+        commands.append(" ".join(cmd) if isinstance(cmd, list) else cmd)
+    if args.install_accelerate:
+        commands.insert(0, f"pip install -U {args.accelerate_spec}")
+    if not commands:
+        print("Nothing to run: pass --command and/or --command_file "
+              "(or --install_accelerate)", file=sys.stderr)
+        return 2
+
+    remote = "; ".join(commands)
+    cmd = [
+        "gcloud", *(["alpha"] if args.use_alpha else []),
+        "compute", "tpus", "tpu-vm", "ssh", tpu_name,
+        *(["--zone", tpu_zone] if tpu_zone else []),
+        "--command", remote, "--worker", "all",
+    ]
+    if args.debug:
+        print(f"Running {' '.join(cmd)}")
+        return 0
+    rc = subprocess.run(cmd).returncode
+    if rc == 0:
+        print("Successfully set up pod.")
+    return rc
+
+
+def main():
+    parser = tpu_command_parser()
+    return tpu_command_launcher(parser.parse_args())
+
+
+if __name__ == "__main__":
+    sys.exit(main() or 0)

@@ -326,7 +326,7 @@ class FullyShardedDataParallelPlugin(KwargsHandler):
     sync_module_states: bool = True             # parity no-op (GSPMD arrays are globally consistent)
     forward_prefetch: bool = True               # parity no-op (XLA overlaps automatically)
     backward_prefetch: bool = True              # parity no-op
-    param_dtype: Optional[str] = None           # e.g. "bfloat16" to keep sharded master in bf16
+    param_dtype: Optional[str] = None           # not applied: see __post_init__ warning
     auto_wrap_policy: Optional[Any] = None      # parity no-op: sharding is per-leaf, not per-wrap
 
     def __post_init__(self):
@@ -341,6 +341,21 @@ class FullyShardedDataParallelPlugin(KwargsHandler):
             self.min_weight_size_to_shard = 1 << 62  # nothing shards
         if self.sharding_strategy == "SHARD_GRAD_OP":
             self.reshard_after_forward = False
+        # Knobs with no consumer must say so, not look functional.
+        if self.param_dtype is not None:
+            warnings.warn(
+                "FullyShardedDataParallelPlugin.param_dtype is not applied: master "
+                "params stay fp32 and the compute dtype comes from mixed_precision. "
+                "Set Accelerator(mixed_precision=...) instead.",
+                stacklevel=2,
+            )
+        if self.auto_wrap_policy is not None:
+            warnings.warn(
+                "FullyShardedDataParallelPlugin.auto_wrap_policy is ignored: GSPMD "
+                "sharding is decided per-leaf by size/shape rules "
+                "(min_weight_size_to_shard, shard_largest_dim), not by module wrapping.",
+                stacklevel=2,
+            )
 
 
 @dataclass

@@ -83,14 +83,19 @@ def find_executable_batch_size(
         clear_device_cache(garbage_collection=True)
         params = list(inspect.signature(function).parameters.keys())
         if len(params) < (len(args) + 1):
-            arg_str = ", ".join([f"{arg}={value}" for arg, value in zip(params[1:], args[1:])])
+            # The decorator owns the batch_size slot; a caller-supplied value
+            # would silently shift every other argument by one.
+            shown = ", ".join(f"{name}={value}" for name, value in zip(params[1:], args[1:]))
             raise TypeError(
-                f"Batch size was passed into `{function.__name__}` as the first argument when called."
-                f"Remove this as the decorator already does so: `{function.__name__}({arg_str})`"
+                f"`{function.__name__}` is wrapped by find_executable_batch_size, which supplies "
+                f"batch_size itself — call it without one: `{function.__name__}({shown})`"
             )
         while True:
             if batch_size == 0:
-                raise RuntimeError("No executable batch size found, reached zero.")
+                raise RuntimeError(
+                    "OOM retries exhausted: the batch size reached 0 and the step still "
+                    "does not fit. The model/activations alone exceed device memory."
+                )
             try:
                 return function(batch_size, *args, **kwargs)
             except Exception as e:

@@ -70,8 +70,9 @@ def recursively_apply(func: Callable, data, *args, test_type: Callable = is_tens
         return func(data, *args, **kwargs)
     elif error_on_other_type:
         raise TypeError(
-            f"Unsupported types ({type(data)}) passed to `{func.__name__}`. Only nested "
-            f"list/tuple/dicts of objects that are valid for `{test_type.__name__}` should be passed."
+            f"`{func.__name__}` cannot handle a leaf of type {type(data).__name__}: it walks "
+            f"nested lists/tuples/dicts and applies only to leaves accepted by "
+            f"`{test_type.__name__}`."
         )
     return data
 

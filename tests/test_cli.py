@@ -92,7 +92,8 @@ def _run_cli(*argv, env_extra=None, cwd=None):
 class TestCLISubprocess:
     def test_help_lists_all_subcommands(self):
         out = _run_cli("--help")
-        for cmd in ["config", "env", "estimate-memory", "launch", "merge-weights", "test"]:
+        for cmd in ["config", "env", "estimate-memory", "launch", "merge-weights", "test",
+                    "tpu-config"]:
             assert cmd in out.stdout
 
     def test_config_default_and_env(self, tmp_path):
@@ -113,7 +114,66 @@ class TestCLISubprocess:
     def test_estimate_memory_unknown_model(self):
         out = _run_cli("estimate-memory", "not-a-model")
         assert out.returncode == 2
-        assert "Available" in out.stdout
+        assert "built-in name" in out.stdout
+
+    def test_estimate_memory_from_config_json(self, tmp_path):
+        import json
+
+        cfg = tmp_path / "config.json"
+        cfg.write_text(json.dumps({
+            "model_type": "llama", "vocab_size": 256, "hidden_size": 64,
+            "intermediate_size": 128, "num_hidden_layers": 2,
+            "num_attention_heads": 4, "num_key_value_heads": 2,
+        }))
+        out = _run_cli("estimate-memory", str(cfg), "--dtypes", "bfloat16")
+        assert out.returncode == 0, out.stderr
+        assert "bfloat16" in out.stdout
+
+    def test_estimate_memory_from_safetensors_dir(self, tmp_path):
+        import numpy as np
+        from safetensors.numpy import save_file
+
+        save_file({"model.layers.0.w": np.zeros((8, 8), np.float32),
+                   "model.layers.1.w": np.zeros((8, 8), np.float32)},
+                  str(tmp_path / "model.safetensors"))
+        out = _run_cli("estimate-memory", str(tmp_path), "--dtypes", "float32")
+        assert out.returncode == 0, out.stderr
+        assert "float32" in out.stdout
+
+    def test_tpu_config_debug_prints_gcloud(self):
+        out = _run_cli("tpu-config", "--tpu_name", "pod1", "--tpu_zone", "us-central2-b",
+                       "--command", "echo hi", "--install_accelerate", "--debug")
+        assert out.returncode == 0, out.stderr
+        assert "gcloud compute tpus tpu-vm ssh pod1 --zone us-central2-b" in out.stdout
+        assert "pip install" in out.stdout and "echo hi" in out.stdout
+        assert "--worker all" in out.stdout
+
+    def test_tpu_config_requires_name_and_commands(self, tmp_path):
+        # Isolate the config dir: a developer's real default config could
+        # name a live pod, and this test must never reach gcloud.
+        env = {"ACCELERATE_TPU_CONFIG_DIR": str(tmp_path)}
+        out = _run_cli("tpu-config", "--command", "echo hi", env_extra=env)
+        assert out.returncode == 2
+        out = _run_cli("tpu-config", "--tpu_name", "pod1", env_extra=env)
+        assert out.returncode == 2
+
+    def test_config_update_migrates_schema(self, tmp_path):
+        import yaml
+
+        cfg_file = tmp_path / "cfg.yaml"
+        out = _run_cli("config", "--default", "--config_file", str(cfg_file))
+        assert out.returncode == 0, out.stderr
+        data = yaml.safe_load(cfg_file.read_text())
+        data.pop("mesh_tp")
+        data["mixed_precision"] = "fp16"  # a kept user value
+        data["obsolete_key"] = 1
+        cfg_file.write_text(yaml.safe_dump(data))
+        out = _run_cli("config", "update", "--config_file", str(cfg_file))
+        assert out.returncode == 0, out.stderr
+        updated = yaml.safe_load(cfg_file.read_text())
+        assert updated["mesh_tp"] == 1          # new field gains its default
+        assert updated["mixed_precision"] == "fp16"  # old value preserved
+        assert "obsolete_key" not in updated
 
     def test_launch_simple_passes_env(self, tmp_path):
         probe = tmp_path / "probe.py"
