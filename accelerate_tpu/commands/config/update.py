@@ -45,6 +45,12 @@ def update_command_parser(subparsers=None):
 
 
 def update_config_command(args) -> int:
-    path = update_config(args)
+    import sys
+
+    try:
+        path = update_config(args)
+    except FileNotFoundError as e:
+        print(str(e), file=sys.stderr)
+        return 2
     print(f"Successfully updated the configuration at {path}.")
     return 0

@@ -75,15 +75,16 @@ def _abstract_from_path(path: str):
 
         from ..models.llama import LlamaConfig, LlamaForCausalLM
 
-        cfg_dict = json.loads(open(path).read())
+        from pathlib import Path
+
+        cfg_dict = json.loads(Path(path).read_text())
         model_type = cfg_dict.get("model_type")
         if model_type not in ("llama", "mistral"):
-            print(
+            raise ValueError(
                 f"config.json has model_type={model_type!r}; only llama-family configs "
                 "(llama, mistral) can be estimated from a config — pass the checkpoint's "
                 ".safetensors directory instead."
             )
-            return None
         fields = (
             "vocab_size", "hidden_size", "intermediate_size", "num_hidden_layers",
             "num_attention_heads", "num_key_value_heads", "max_position_embeddings",
@@ -115,7 +116,11 @@ def estimate_command(args) -> int:
         module = registry[args.model_name]()
         abstract = init_empty_weights(module)
     else:
-        abstract = _abstract_from_path(args.model_name)
+        try:
+            abstract = _abstract_from_path(args.model_name)
+        except ValueError as e:
+            print(str(e))
+            return 2
         if abstract is None:
             print(
                 f"Unknown model {args.model_name!r}. Pass a built-in name "

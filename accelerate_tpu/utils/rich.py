@@ -1,0 +1,17 @@
+"""Rich traceback install (reference: src/accelerate/utils/rich.py:15-24).
+
+Opt-in: set ``ACCELERATE_TPU_ENABLE_RICH=1`` (and have ``rich`` installed)
+to activate pretty tracebacks. Imported by ``accelerate_tpu.utils`` so the
+env var is honored without any explicit import.
+"""
+
+from __future__ import annotations
+
+import os
+
+from .imports import is_rich_available
+
+if os.environ.get("ACCELERATE_TPU_ENABLE_RICH", "0") == "1" and is_rich_available():
+    from rich.traceback import install
+
+    install(show_locals=False)

@@ -1,0 +1,30 @@
+"""Process-aware tqdm (reference: src/accelerate/utils/tqdm.py:21-37).
+
+``tqdm(main_process_only=True, ...)`` renders the bar only on the main
+process, so an N-process launch prints one bar instead of N interleaved
+ones.
+"""
+
+from __future__ import annotations
+
+from .imports import is_tqdm_available
+
+
+def tqdm(*args, main_process_only: bool = True, **kwargs):
+    """Drop-in ``tqdm.auto.tqdm`` that only displays on the main process.
+
+    Positional/keyword arguments pass straight through; ``disable`` set by
+    the caller wins over the process gate.
+    """
+    if not is_tqdm_available():
+        raise ImportError(
+            "accelerate_tpu.utils.tqdm requires the tqdm package; install tqdm "
+            "or iterate without a progress bar."
+        )
+    from tqdm.auto import tqdm as _tqdm
+
+    if main_process_only and "disable" not in kwargs:
+        from ..state import PartialState
+
+        kwargs["disable"] = not PartialState().is_main_process
+    return _tqdm(*args, **kwargs)
