@@ -119,7 +119,9 @@ def estimate_command(args) -> int:
         try:
             abstract = _abstract_from_path(args.model_name)
         except ValueError as e:
-            print(str(e))
+            import sys
+
+            print(str(e), file=sys.stderr)
             return 2
         if abstract is None:
             print(

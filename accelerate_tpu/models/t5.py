@@ -1,0 +1,271 @@
+"""T5-style encoder-decoder, TPU-first.
+
+Widens the model-family inventory to the encoder-decoder shape the
+reference exercises through Megatron's per-model train steps (reference:
+utils/megatron_lm.py:446-864 — Bert/GPT/**T5**) and its T0pp big-model
+benchmark rows (reference: benchmarks/big_model_inference/README.md:35).
+
+T5 specifics kept: relative position bias (bucketed, shared across layers
+per stack), pre-layernorm blocks with RMS-style T5 LayerNorm (no bias, no
+mean subtraction), cross-attention in the decoder, tied input embeddings
+scaled at the head. Parameter naming follows the TP sharding rules
+(query/key/value/attn_out, intermediate/mlp_out), so tensor parallelism
+applies without extra configuration.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass
+class T5Config:
+    vocab_size: int = 32128
+    hidden_size: int = 512
+    intermediate_size: int = 2048
+    num_layers: int = 6           # encoder layers (decoder uses the same count)
+    num_heads: int = 8
+    head_dim: int = 64
+    relative_attention_num_buckets: int = 32
+    relative_attention_max_distance: int = 128
+    layer_norm_eps: float = 1e-6
+    dropout_rate: float = 0.1
+    use_flash_attention: bool = False  # bias-ful attention: einsum path
+
+    @classmethod
+    def small(cls, **overrides):
+        return dataclasses.replace(cls(), **overrides)
+
+    @classmethod
+    def tiny(cls, **overrides):
+        cfg = cls(vocab_size=512, hidden_size=64, intermediate_size=128,
+                  num_layers=2, num_heads=4, head_dim=16,
+                  relative_attention_num_buckets=8, relative_attention_max_distance=32)
+        return dataclasses.replace(cfg, **overrides)
+
+
+class T5LayerNorm(nn.Module):
+    """T5's RMS layer norm: no mean subtraction, no bias."""
+
+    eps: float = 1e-6
+
+    @nn.compact
+    def __call__(self, x):
+        dtype = x.dtype
+        x32 = x.astype(jnp.float32)
+        var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],), jnp.float32)
+        return (x32 * jax.lax.rsqrt(var + self.eps) * scale).astype(dtype)
+
+
+def relative_position_bucket(relative_position, bidirectional: bool, num_buckets: int,
+                             max_distance: int):
+    """T5's log-bucketed relative positions (exact port of the published
+    bucketing math — it is the spec, not an implementation choice)."""
+    ret = jnp.zeros_like(relative_position)
+    n = -relative_position
+    if bidirectional:
+        num_buckets //= 2
+        ret += (n < 0).astype(jnp.int32) * num_buckets
+        n = jnp.abs(n)
+    else:
+        n = jnp.maximum(n, 0)
+    max_exact = num_buckets // 2
+    is_small = n < max_exact
+    val_if_large = max_exact + (
+        jnp.log(n.astype(jnp.float32) / max_exact + 1e-6)
+        / jnp.log(max_distance / max_exact)
+        * (num_buckets - max_exact)
+    ).astype(jnp.int32)
+    val_if_large = jnp.minimum(val_if_large, num_buckets - 1)
+    return ret + jnp.where(is_small, n, val_if_large)
+
+
+class T5Attention(nn.Module):
+    config: T5Config
+    causal: bool = False
+    has_relative_bias: bool = False
+    deterministic: bool = True
+
+    @nn.compact
+    def __call__(self, x, kv=None, mask=None, position_bias=None):
+        """Self-attention when ``kv`` is None, cross-attention otherwise.
+
+        Returns (out, position_bias) — the bias is computed only by the
+        first layer of a stack (``has_relative_bias``) and shared onward,
+        exactly T5's layout.
+        """
+        cfg = self.config
+        B, S_q, _ = x.shape
+        source = x if kv is None else kv
+        S_k = source.shape[1]
+        H, D = cfg.num_heads, cfg.head_dim
+        dense = lambda feats, name: nn.Dense(
+            feats, use_bias=False, name=name, dtype=x.dtype, param_dtype=jnp.float32
+        )
+        q = dense(H * D, "query")(x).reshape(B, S_q, H, D)
+        k = dense(H * D, "key")(source).reshape(B, S_k, H, D)
+        v = dense(H * D, "value")(source).reshape(B, S_k, H, D)
+
+        # T5 does NOT scale q by 1/sqrt(d) (folded into init).
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+
+        if position_bias is None and self.has_relative_bias:
+            rel = jnp.arange(S_k)[None, :] - jnp.arange(S_q)[:, None]
+            buckets = relative_position_bucket(
+                rel, bidirectional=not self.causal,
+                num_buckets=cfg.relative_attention_num_buckets,
+                max_distance=cfg.relative_attention_max_distance,
+            )
+            bias_table = nn.Embed(
+                cfg.relative_attention_num_buckets, H,
+                name="relative_attention_bias", param_dtype=jnp.float32,
+            )
+            position_bias = bias_table(buckets).transpose(2, 0, 1)[None]  # [1, H, S_q, S_k]
+        if position_bias is not None:
+            logits = logits + position_bias
+
+        big_neg = jnp.finfo(jnp.float32).min
+        if self.causal:
+            causal_mask = jnp.arange(S_q)[:, None] >= jnp.arange(S_k)[None, :]
+            logits = jnp.where(causal_mask[None, None], logits, big_neg)
+        if mask is not None:
+            logits = jnp.where(mask[:, None, None, :].astype(bool), logits, big_neg)
+
+        probs = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
+        probs = nn.Dropout(cfg.dropout_rate, deterministic=self.deterministic)(probs)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(B, S_q, H * D)
+        return dense(cfg.hidden_size, "attn_out")(out), position_bias
+
+
+class T5MLP(nn.Module):
+    config: T5Config
+    deterministic: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        h = nn.Dense(cfg.intermediate_size, use_bias=False, name="intermediate",
+                     dtype=x.dtype, param_dtype=jnp.float32)(x)
+        h = jax.nn.relu(h)
+        h = nn.Dropout(cfg.dropout_rate, deterministic=self.deterministic)(h)
+        return nn.Dense(cfg.hidden_size, use_bias=False, name="mlp_out",
+                        dtype=x.dtype, param_dtype=jnp.float32)(h)
+
+
+class T5EncoderBlock(nn.Module):
+    config: T5Config
+    has_relative_bias: bool = False
+    deterministic: bool = True
+
+    @nn.compact
+    def __call__(self, x, mask=None, position_bias=None):
+        cfg = self.config
+        det = self.deterministic
+        drop = nn.Dropout(cfg.dropout_rate, deterministic=det)
+        attn, position_bias = T5Attention(
+            cfg, causal=False, has_relative_bias=self.has_relative_bias,
+            deterministic=det, name="attention"
+        )(T5LayerNorm(cfg.layer_norm_eps, name="attn_norm")(x), mask=mask,
+          position_bias=position_bias)
+        x = x + drop(attn)
+        x = x + drop(T5MLP(cfg, deterministic=det, name="mlp")(
+            T5LayerNorm(cfg.layer_norm_eps, name="mlp_norm")(x)))
+        return x, position_bias
+
+
+class T5DecoderBlock(nn.Module):
+    config: T5Config
+    has_relative_bias: bool = False
+    deterministic: bool = True
+
+    @nn.compact
+    def __call__(self, x, enc, self_mask=None, cross_mask=None, position_bias=None):
+        cfg = self.config
+        det = self.deterministic
+        drop = nn.Dropout(cfg.dropout_rate, deterministic=det)
+        attn, position_bias = T5Attention(
+            cfg, causal=True, has_relative_bias=self.has_relative_bias,
+            deterministic=det, name="self_attention"
+        )(T5LayerNorm(cfg.layer_norm_eps, name="self_norm")(x), mask=self_mask,
+          position_bias=position_bias)
+        x = x + drop(attn)
+        cross, _ = T5Attention(cfg, causal=False, deterministic=det, name="cross_attention")(
+            T5LayerNorm(cfg.layer_norm_eps, name="cross_norm")(x), kv=enc, mask=cross_mask
+        )
+        x = x + drop(cross)
+        x = x + drop(T5MLP(cfg, deterministic=det, name="mlp")(
+            T5LayerNorm(cfg.layer_norm_eps, name="mlp_norm")(x)))
+        return x, position_bias
+
+
+class T5ForConditionalGeneration(nn.Module):
+    config: T5Config
+
+    @nn.compact
+    def __call__(self, input_ids, decoder_input_ids, attention_mask=None,
+                 decoder_attention_mask=None, deterministic=True):
+        cfg = self.config
+        drop = nn.Dropout(cfg.dropout_rate, deterministic=deterministic)
+        embed = nn.Embed(cfg.vocab_size, cfg.hidden_size, name="shared_embedding",
+                         param_dtype=jnp.float32)
+
+        # Encoder stack: relative bias from layer 0, shared onward.
+        x = drop(embed(input_ids))
+        bias = None
+        for i in range(cfg.num_layers):
+            x, bias = T5EncoderBlock(cfg, has_relative_bias=(i == 0),
+                                     deterministic=deterministic,
+                                     name=f"encoder_layer_{i}")(x, attention_mask, bias)
+        enc = drop(T5LayerNorm(cfg.layer_norm_eps, name="encoder_norm")(x))
+
+        # Decoder stack.
+        y = drop(embed(decoder_input_ids))
+        dbias = None
+        for i in range(cfg.num_layers):
+            y, dbias = T5DecoderBlock(cfg, has_relative_bias=(i == 0),
+                                      deterministic=deterministic,
+                                      name=f"decoder_layer_{i}")(
+                y, enc, decoder_attention_mask, attention_mask, dbias)
+        y = drop(T5LayerNorm(cfg.layer_norm_eps, name="decoder_norm")(y))
+
+        # Tied head with T5's 1/sqrt(d) rescale.
+        kernel = self.variables["params"]["shared_embedding"]["embedding"]
+        return (y * (cfg.hidden_size ** -0.5)) @ kernel.T.astype(y.dtype)
+
+    def init_params(self, rng, batch_size=1, src_len=8, tgt_len=8):
+        src = jnp.zeros((batch_size, src_len), jnp.int32)
+        tgt = jnp.zeros((batch_size, tgt_len), jnp.int32)
+        return self.init(rng, src, tgt)["params"]
+
+
+def seq2seq_lm_loss(apply_fn):
+    """loss_fn for Accelerator: teacher-forced cross-entropy. The batch
+    carries ``input_ids``, ``labels``, and optionally masks;
+    ``decoder_input_ids`` are the labels shifted right with pad=0 (T5's
+    decoder_start_token)."""
+
+    def loss_fn(params, batch, rng=None):
+        variables = params if isinstance(params, dict) and "params" in params else {"params": params}
+        labels = batch["labels"]
+        decoder_input_ids = jnp.pad(labels[:, :-1], ((0, 0), (1, 0)))
+        kwargs = {}
+        if rng is not None:
+            kwargs = {"deterministic": False, "rngs": {"dropout": rng}}
+        logits = apply_fn(
+            variables, batch["input_ids"], decoder_input_ids,
+            batch.get("attention_mask"), batch.get("decoder_attention_mask"), **kwargs
+        )
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+        mask = batch.get("decoder_attention_mask")
+        if mask is not None:
+            nll = nll * mask
+            return nll.sum() / jnp.maximum(mask.sum(), 1)
+        return nll.mean()
+
+    return loss_fn

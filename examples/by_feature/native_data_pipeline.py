@@ -33,8 +33,9 @@ def training_function(args):
     cfg = LlamaConfig.tiny(use_flash_attention=False)
 
     # A flat token binary: the pretraining on-disk format (e.g. tokenized
-    # corpus shards). 2^18 tokens ~ 1 MiB of int32.
-    n_tokens = 1 << 18
+    # corpus shards). Small on purpose: this demonstrates the path, not IO
+    # scale.
+    n_tokens = 1 << 14
     rng = np.random.default_rng(args.seed)
     tokens = rng.integers(0, cfg.vocab_size, n_tokens).astype(np.int32)
     with tempfile.NamedTemporaryFile(suffix=".bin", delete=False) as f:
@@ -75,6 +76,7 @@ def _run(args, accelerator, cfg, tokens, bin_path):
     it = iter(loader)
     next(it), next(it)
     saved = loader.state_dict()
+    it.close()  # release the prefetch ring (threads, fd, buffers) promptly
     resumed = TokenBinDataLoader(
         bin_path, seq_len=args.seq_len, batch_size=args.batch_size,
         num_processes=accelerator.num_processes,
@@ -91,6 +93,8 @@ def _run(args, accelerator, cfg, tokens, bin_path):
     losses = []
     for epoch in range(args.epochs):
         for batch in loader:
+            if len(losses) >= args.steps:
+                break
             metrics = step(make_global_batch(batch, accelerator.mesh))
             losses.append(float(metrics["loss"]))
     accelerator.print(f"trained {len(losses)} steps from the token binary: "
@@ -100,6 +104,7 @@ def _run(args, accelerator, cfg, tokens, bin_path):
 def main():
     parser = common_parser(__doc__)
     parser.add_argument("--seq_len", type=int, default=128)
+    parser.add_argument("--steps", type=int, default=16)
     training_function(parser.parse_args())
 
 
