@@ -1,0 +1,136 @@
+"""Tracker layer tests (reference: tests/test_tracking.py, 535 LoC — per-
+integration logging assertions + custom-tracker API checks)."""
+
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.tracking import (
+    GeneralTracker,
+    JSONLTracker,
+    TensorBoardTracker,
+    filter_trackers,
+    resolve_trackers,
+)
+
+
+class TestJSONLTracker:
+    def test_config_and_metrics_roundtrip(self, tmp_path):
+        t = JSONLTracker("run1", str(tmp_path))
+        t.store_init_configuration({"lr": 1e-3, "layers": 2})
+        t.log({"loss": 1.5}, step=1)
+        t.log({"loss": np.float32(0.5), "acc": jax.numpy.asarray(0.9)}, step=2)
+        t.finish()
+        lines = [json.loads(l) for l in (tmp_path / "run1.metrics.jsonl").read_text().splitlines()]
+        assert lines[0] == {"_type": "config", "config": {"lr": 1e-3, "layers": 2}}
+        assert lines[1]["loss"] == 1.5 and lines[1]["step"] == 1
+        # Non-JSON leaves (np/jax scalars) must be coerced, not crash.
+        assert abs(lines[2]["acc"] - 0.9) < 1e-6
+
+    def test_run_name_slash_safe(self, tmp_path):
+        t = JSONLTracker("group/run", str(tmp_path))
+        t.log({"x": 1}, step=0)
+        t.finish()
+        assert (tmp_path / "group_run.metrics.jsonl").exists()
+
+
+class TestFilterTrackers:
+    def test_unknown_name_raises(self):
+        with pytest.raises(ValueError, match="Unknown tracker"):
+            filter_trackers(["definitely-not-a-tracker"], logging_dir=".")
+
+    def test_jsonl_always_available(self, tmp_path):
+        assert filter_trackers(["jsonl"], str(tmp_path)) == ["jsonl"]
+
+    def test_all_skips_unavailable_without_error(self, tmp_path):
+        names = filter_trackers("all", str(tmp_path))
+        assert "jsonl" in names
+
+    def test_dir_requiring_tracker_skipped_without_dir(self):
+        assert filter_trackers(["jsonl"], logging_dir=None) == []
+
+    def test_instances_pass_through(self, tmp_path):
+        t = JSONLTracker("x", str(tmp_path))
+        out = filter_trackers([t], str(tmp_path))
+        assert out == [t]
+        t.finish()
+
+
+class CustomTracker(GeneralTracker):
+    """Reference pattern: user-defined tracker instance (tests custom-tracker
+    API contract, reference test_tracking.py custom tracker class)."""
+
+    name = "custom"
+    requires_logging_directory = False
+
+    def __init__(self):
+        super().__init__()
+        self.logged = []
+        self.config = None
+
+    @property
+    def tracker(self):
+        return self.logged
+
+    def store_init_configuration(self, values):
+        self.config = dict(values)
+
+    def log(self, values, step=None, **kwargs):
+        self.logged.append((step, dict(values)))
+
+
+class TestAcceleratorIntegration:
+    def test_init_log_end(self, tmp_path, reset_state):
+        acc = Accelerator(log_with="jsonl", project_dir=str(tmp_path))
+        acc.init_trackers("proj", config={"seed": 1})
+        acc.log({"loss": 2.0}, step=0)
+        acc.log({"loss": 1.0}, step=1)
+        acc.end_training()
+        files = list(tmp_path.rglob("*.metrics.jsonl"))
+        assert files, "JSONL tracker wrote nothing"
+        lines = [json.loads(l) for l in files[0].read_text().splitlines()]
+        assert lines[0]["_type"] == "config"
+        assert [l["loss"] for l in lines[1:]] == [2.0, 1.0]
+
+    def test_custom_tracker_instance(self, reset_state):
+        tracker = CustomTracker()
+        acc = Accelerator(log_with=tracker)
+        acc.init_trackers("proj", config={"a": 1})
+        acc.log({"m": 3.0}, step=5)
+        assert tracker.config == {"a": 1}
+        assert tracker.logged == [(5, {"m": 3.0})]
+
+    def test_get_tracker(self, tmp_path, reset_state):
+        acc = Accelerator(log_with="jsonl", project_dir=str(tmp_path))
+        acc.init_trackers("proj")
+        t = acc.get_tracker("jsonl")
+        assert isinstance(t, JSONLTracker)
+
+    def test_missing_api_raises(self):
+        class Broken(GeneralTracker):
+            name = "broken"
+            requires_logging_directory = False
+
+        with pytest.raises(NotImplementedError, match="missing"):
+            Broken()
+
+
+class TestResolveTrackers:
+    def test_default_is_jsonl(self, tmp_path):
+        trackers = resolve_trackers(None, "run", str(tmp_path), config={"x": 1})
+        assert len(trackers) == 1 and isinstance(trackers[0], JSONLTracker)
+        trackers[0].finish()
+
+    def test_tensorboard_if_available(self, tmp_path):
+        from accelerate_tpu.utils.imports import is_tensorboard_available
+
+        if not is_tensorboard_available():
+            pytest.skip("tensorboard not installed")
+        trackers = resolve_trackers(["tensorboard"], "run", str(tmp_path))
+        assert trackers and trackers[0].name == "tensorboard"
+        trackers[0].log({"loss": 1.0}, step=0)
+        trackers[0].finish()
+        assert any(tmp_path.rglob("events.*")), "no tensorboard event files written"
