@@ -83,7 +83,7 @@ class CustomTracker(GeneralTracker):
 
 
 class TestAcceleratorIntegration:
-    def test_init_log_end(self, tmp_path, reset_state):
+    def test_init_log_end(self, tmp_path):
         acc = Accelerator(log_with="jsonl", project_dir=str(tmp_path))
         acc.init_trackers("proj", config={"seed": 1})
         acc.log({"loss": 2.0}, step=0)
@@ -95,7 +95,7 @@ class TestAcceleratorIntegration:
         assert lines[0]["_type"] == "config"
         assert [l["loss"] for l in lines[1:]] == [2.0, 1.0]
 
-    def test_custom_tracker_instance(self, reset_state):
+    def test_custom_tracker_instance(self):
         tracker = CustomTracker()
         acc = Accelerator(log_with=tracker)
         acc.init_trackers("proj", config={"a": 1})
@@ -103,7 +103,7 @@ class TestAcceleratorIntegration:
         assert tracker.config == {"a": 1}
         assert tracker.logged == [(5, {"m": 3.0})]
 
-    def test_get_tracker(self, tmp_path, reset_state):
+    def test_get_tracker(self, tmp_path):
         acc = Accelerator(log_with="jsonl", project_dir=str(tmp_path))
         acc.init_trackers("proj")
         t = acc.get_tracker("jsonl")
