@@ -306,12 +306,17 @@ class LlamaForCausalLM(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, input_ids, positions=None, cache=None, cache_pos=None):
+    def __call__(self, input_ids, positions=None, cache=None, cache_pos=None,
+                 return_hidden=False):
         cfg = self.config
         x = LlamaModel(cfg, name="model")(input_ids, positions, cache=cache, cache_pos=cache_pos)
         new_cache = None
         if cache is not None:
             x, new_cache = x
+        if return_hidden:
+            # Pre-head normed hidden states (fused LM-head losses compute
+            # logits chunk-by-chunk themselves; ops/fused_loss.py).
+            return x if cache is None else (x, new_cache)
         if cfg.tie_word_embeddings:
             embed = self.variables["params"]["model"]["embed_tokens"]["embedding"]
             logits = x @ embed.T.astype(x.dtype)
@@ -430,14 +435,22 @@ class PipelinedLlamaForCausalLM:
     __call__ = apply
 
 
-def masked_next_token_ce(logits, batch):
-    """Next-token cross-entropy over a batch with optional ``labels`` (-100 =
-    ignored, HF convention). Shared by every causal-LM loss builder."""
+def _targets_and_mask(batch):
+    """Shared label semantics for every causal-LM loss: next-token shift when
+    no explicit labels, -100 = ignored (HF convention). Returns
+    (safe_targets, float mask) with -100 slots zeroed out."""
     targets = batch.get("labels", None)
     if targets is None:
         targets = jnp.pad(batch["input_ids"][:, 1:], ((0, 0), (0, 1)), constant_values=-100)
     mask = (targets != -100).astype(jnp.float32)
     safe_targets = jnp.where(targets == -100, 0, targets)
+    return safe_targets, mask
+
+
+def masked_next_token_ce(logits, batch):
+    """Next-token cross-entropy over a batch with optional ``labels`` (-100 =
+    ignored, HF convention). Shared by every causal-LM loss builder."""
+    safe_targets, mask = _targets_and_mask(batch)
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     nll = -jnp.take_along_axis(logp, safe_targets[..., None], axis=-1)[..., 0]
     return (nll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
@@ -450,5 +463,32 @@ def causal_lm_loss(apply_fn):
     def loss_fn(params, batch, rng=None):
         logits = apply_fn({"params": params}, batch["input_ids"])
         return masked_next_token_ce(logits, batch)
+
+    return loss_fn
+
+
+def fused_causal_lm_loss(module: "LlamaForCausalLM", num_chunks: int = 8):
+    """Memory-efficient loss: the [tokens, vocab] logits are never
+    materialized — the LM head runs chunked over the vocabulary with an
+    online softmax (ops/fused_loss.py). Numerics match `causal_lm_loss`
+    to fp32-accumulation tolerance; peak activation memory drops by
+    ~vocab/num_chunks at the head."""
+    from ..ops.fused_loss import chunked_softmax_xent
+
+    cfg = module.config
+
+    def loss_fn(params, batch, rng=None):
+        p = params["params"] if isinstance(params, dict) and "params" in params else params
+        h = module.apply({"params": p}, batch["input_ids"], return_hidden=True)  # [B,S,H]
+        if cfg.tie_word_embeddings:
+            kernel = p["model"]["embed_tokens"]["embedding"].T
+        else:
+            kernel = p["lm_head"]["kernel"]
+        safe, mask = _targets_and_mask(batch)
+        B, S, H = h.shape
+        return chunked_softmax_xent(
+            h.reshape(B * S, H), kernel.astype(h.dtype),
+            safe.reshape(-1), mask.reshape(-1), num_chunks,
+        )
 
     return loss_fn

@@ -1,0 +1,124 @@
+"""Memory-efficient LM-head cross-entropy (chunked over the vocabulary).
+
+The standard causal-LM loss materializes ``[tokens, vocab]`` logits twice
+(bf16 matmul output + fp32 softmax) — at Llama scale that is the single
+largest activation in the train step and pure HBM traffic (reference
+equivalent: Megatron's fused vocab-parallel cross-entropy kernel, which the
+reference reaches through the Megatron engine; SURVEY.md §2.5).
+
+``chunked_softmax_xent`` never forms the full logits: a ``lax.scan`` over
+vocabulary chunks keeps a running (max, sum-exp, true-logit) triple —
+online-softmax over the vocab dim — and the custom VJP recomputes each
+chunk's logits in the backward to emit ``dh`` and ``dW`` chunk by chunk.
+Peak activation memory drops from O(tokens x vocab) to
+O(tokens x vocab / num_chunks); matmul FLOPs are unchanged (the MXU work is
+identical, just tiled).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _chunk_logits(h, w_c):
+    """[N, H] x [H, C] -> [N, C] with fp32 accumulation on the MXU."""
+    return jax.lax.dot_general(
+        h, w_c, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def chunked_softmax_xent(h, kernel, targets, mask, num_chunks: int = 8):
+    """Mean masked cross-entropy of ``softmax(h @ kernel)`` vs ``targets``.
+
+    Args:
+      h: [N, H] hidden states (any float dtype; logits accumulate in fp32).
+      kernel: [H, V] head weights. num_chunks must divide V.
+      targets: [N] int class ids (already made safe — no -100 sentinels).
+      mask: [N] float weights (0 drops a token).
+      num_chunks: vocab tiles; higher = less memory, same FLOPs.
+
+    Returns scalar: sum(nll * mask) / max(sum(mask), 1).
+    """
+    loss, _ = _forward(h, kernel, targets, mask, num_chunks)
+    return loss
+
+
+def _forward(h, kernel, targets, mask, num_chunks):
+    N, H = h.shape
+    V = kernel.shape[1]
+    if V % num_chunks:
+        raise ValueError(f"vocab {V} not divisible by num_chunks {num_chunks}")
+    C = V // num_chunks
+    w_chunks = kernel.reshape(H, num_chunks, C).transpose(1, 0, 2)  # [K, H, C]
+
+    def body(carry, inputs):
+        m, l, t = carry
+        k, w_c = inputs
+        logits = _chunk_logits(h, w_c)                       # [N, C] fp32
+        m_new = jnp.maximum(m, logits.max(axis=-1))
+        l = l * jnp.exp(m - m_new) + jnp.exp(logits - m_new[:, None]).sum(axis=-1)
+        local = targets - k * C
+        in_chunk = (local >= 0) & (local < C)
+        safe_local = jnp.clip(local, 0, C - 1)
+        t = t + jnp.where(
+            in_chunk, jnp.take_along_axis(logits, safe_local[:, None], axis=1)[:, 0], 0.0
+        )
+        return (m_new, l, t), None
+
+    m0 = jnp.full((N,), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((N,), jnp.float32)
+    t0 = jnp.zeros((N,), jnp.float32)
+    (m, l, t), _ = jax.lax.scan(
+        body, (m0, l0, t0), (jnp.arange(num_chunks), w_chunks)
+    )
+    lse = m + jnp.log(l)
+    nll = lse - t
+    denom = jnp.maximum(mask.sum(), 1.0)
+    loss = (nll * mask).sum() / denom
+    return loss, (lse, denom)
+
+
+def _fwd(h, kernel, targets, mask, num_chunks):
+    loss, (lse, denom) = _forward(h, kernel, targets, mask, num_chunks)
+    return loss, (h, kernel, targets, mask, lse, denom)
+
+
+def _bwd(num_chunks, res, g):
+    h, kernel, targets, mask, lse, denom = res
+    N, H = h.shape
+    V = kernel.shape[1]
+    C = V // num_chunks
+    w_chunks = kernel.reshape(H, num_chunks, C).transpose(1, 0, 2)
+    # d(loss)/d(logit_ic) = (softmax_ic - onehot_ic) * mask_i / denom * g
+    scale = (g * mask / denom).astype(jnp.float32)           # [N]
+
+    def body(dh, inputs):
+        k, w_c = inputs
+        logits = _chunk_logits(h, w_c)                       # recompute [N, C]
+        p = jnp.exp(logits - lse[:, None])
+        local = targets - k * C
+        in_chunk = (local >= 0) & (local < C)
+        onehot = (
+            jax.nn.one_hot(jnp.clip(local, 0, C - 1), C, dtype=jnp.float32)
+            * in_chunk[:, None]
+        )
+        dlogits = (p - onehot) * scale[:, None]              # [N, C] fp32
+        dh = dh + jax.lax.dot_general(
+            dlogits, w_c, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dw_c = jax.lax.dot_general(
+            h, dlogits, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )                                                    # [H, C]
+        return dh, dw_c
+
+    dh0 = jnp.zeros((N, H), jnp.float32)
+    dh, dw_chunks = jax.lax.scan(body, dh0, (jnp.arange(num_chunks), w_chunks))
+    dkernel = dw_chunks.transpose(1, 0, 2).reshape(H, V)
+    return dh.astype(h.dtype), dkernel.astype(kernel.dtype), None, None
+
+
+chunked_softmax_xent.defvjp(_fwd, _bwd)
