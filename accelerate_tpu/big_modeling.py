@@ -282,10 +282,11 @@ def cache_factory_for(module) -> Optional[Callable]:
     """``(batch, max_len, dtype=bf16) -> per-layer KV cache tuple`` for model
     families with cache threading; None otherwise. Layer caches pair with
     the ``kind == "layer"`` specs in order."""
+    from .models.gpt2 import GPT2LMHeadModel
     from .models.llama import LlamaForCausalLM, init_kv_cache
 
-    if isinstance(module, LlamaForCausalLM):
-        cfg = module.config
+    if isinstance(module, (LlamaForCausalLM, GPT2LMHeadModel)):
+        cfg = module.config  # GPT2Config duck-types the kv-cache fields
 
         def factory(batch, max_len, dtype=jnp.bfloat16):
             return init_kv_cache(cfg, batch, max_len, dtype)
@@ -313,10 +314,31 @@ def _gpt2_block_specs(cfg) -> list[BlockSpec]:
         h = nn.LayerNorm(epsilon=cfg.layer_norm_eps).apply({"params": ptrees[0]}, x)
         return h @ ptrees[1]["embedding"].T.astype(h.dtype)
 
-    specs = [BlockSpec("embed", ("wte", "wpe"), embed_apply, kind="embed")]
+    # KV-cached decode forms (StreamedModel.generate).
+    def embed_cached(ptrees, args, cache, pos):
+        (input_ids,) = args
+        wte = ptrees[0]["embedding"]
+        wpe = ptrees[1]["embedding"]
+        positions = pos + jnp.arange(input_ids.shape[1], dtype=jnp.int32)
+        x = wte[input_ids] + wpe[positions][None, :]
+        return (x,), None
+
+    def layer_cached(ptrees, args, cache, pos):
+        (x,) = args
+        x, new_cache = block.apply({"params": ptrees[0]}, x, cache=cache, cache_pos=pos)
+        return (x,), new_cache
+
+    def head_cached(ptrees, args, cache, pos):
+        (x,) = args
+        return (head_apply(ptrees, x),), None
+
+    specs = [BlockSpec("embed", ("wte", "wpe"), embed_apply, kind="embed",
+                       cached_apply=embed_cached)]
     for i in range(cfg.num_hidden_layers):
-        specs.append(BlockSpec(f"h_{i}", (f"h_{i}",), layer_apply, kind="layer"))
-    specs.append(BlockSpec("head", ("ln_f", "wte"), head_apply, kind="head"))
+        specs.append(BlockSpec(f"h_{i}", (f"h_{i}",), layer_apply, kind="layer",
+                               cached_apply=layer_cached))
+    specs.append(BlockSpec("head", ("ln_f", "wte"), head_apply, kind="head",
+                           cached_apply=head_cached))
     return specs
 
 
@@ -337,12 +359,14 @@ class StreamedModel:
 
     def __init__(self, specs: list[BlockSpec], store: WeightStore,
                  execution_device=None, prefetch: bool = True,
-                 cache_factory: Optional[Callable] = None):
+                 cache_factory: Optional[Callable] = None,
+                 position_bound: Optional[int] = None):
         self.specs = specs
         self.store = store
         self.device = execution_device if execution_device is not None else jax.local_devices()[0]
         self.prefetch = prefetch
         self.cache_factory = cache_factory
+        self.position_bound = position_bound  # learned-position table size, if any
         self._jitted: dict[str, Callable] = {}
         self._pool: Optional[ThreadPoolExecutor] = None
         self._resident_cache: dict[str, Any] = {}
@@ -460,6 +484,12 @@ class StreamedModel:
             return ids
 
         B, S = ids.shape
+        if self.position_bound is not None and S + max_new_tokens > self.position_bound:
+            raise ValueError(
+                f"prompt + max_new_tokens = {S + max_new_tokens} exceeds the model's "
+                f"position table ({self.position_bound}); learned-position lookups "
+                "would silently clamp."
+            )
         caches = list(self.cache_factory(B, S + max_new_tokens))
         caches = [jax.device_put(c, self.device) for c in caches]
         tok = self._cached_pass((jax.device_put(ids, self.device),), caches, 0)
@@ -621,7 +651,9 @@ def dispatch_model(
     if exec_dev is None:
         dev_ids = [d for d in store.placement.values() if isinstance(d, int)]
         exec_dev = jax.local_devices()[dev_ids[0] if dev_ids else 0]
-    return StreamedModel(specs, store, exec_dev, cache_factory=cache_factory_for(module))
+    bound = getattr(getattr(module, "config", None), "max_position_embeddings", None)
+    return StreamedModel(specs, store, exec_dev, cache_factory=cache_factory_for(module),
+                         position_bound=bound)
 
 
 def load_checkpoint_and_dispatch(

@@ -111,6 +111,17 @@ def _compiled_generate(module, max_new_tokens: int, eos_token_id, cache_dtype):
     return prefill, decode
 
 
+def _check_position_bound(module, total_len: int):
+    """Learned-position models silently clamp indices past their table (the
+    wpe lookup clips under jit) — turn that corruption into an error."""
+    bound = getattr(getattr(module, "config", None), "max_position_embeddings", None)
+    if bound is not None and total_len > bound:
+        raise ValueError(
+            f"prompt + max_new_tokens = {total_len} exceeds "
+            f"max_position_embeddings = {bound} for {type(module).__name__}"
+        )
+
+
 def greedy_generate(
     module,
     params,
@@ -145,6 +156,7 @@ def greedy_generate(
     if max_new_tokens <= 0:
         return ids
     B, S = ids.shape
+    _check_position_bound(module, S + max_new_tokens)
     dtype = cache_dtype or jnp.bfloat16
     cache = factory(B, S + max_new_tokens, dtype)
 

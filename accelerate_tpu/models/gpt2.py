@@ -10,7 +10,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from .llama import multi_head_attention
+from .llama import multi_head_attention, update_kv_cache_and_attend
 
 
 @dataclasses.dataclass
@@ -38,12 +38,20 @@ class GPT2Config:
     def head_dim(self):
         return self.hidden_size // self.num_attention_heads
 
+    @property
+    def num_key_value_heads(self):
+        # No GQA in GPT-2; duck-types llama.init_kv_cache.
+        return self.num_attention_heads
+
 
 class GPT2Block(nn.Module):
+    """Pre-LN GPT-2 block. ``cache``/``cache_pos`` switch to KV-cached
+    decode (same threading contract as LlamaBlock)."""
+
     config: GPT2Config
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, cache=None, cache_pos=None):
         cfg = self.config
         B, S, _ = x.shape
         H, D = cfg.num_attention_heads, cfg.head_dim
@@ -51,9 +59,13 @@ class GPT2Block(nn.Module):
         qkv = nn.Dense(3 * H * D, name="qkv", dtype=x.dtype, param_dtype=jnp.float32)(h)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         q, k, v = (t.reshape(B, S, H, D) for t in (q, k, v))
-        attn = multi_head_attention(
-            q, k, v, causal=True, use_flash=cfg.use_flash_attention, backend=cfg.attention_backend
-        )
+        new_cache = None
+        if cache is not None:
+            attn, new_cache = update_kv_cache_and_attend(cache, q, k, v, cache_pos, 1)
+        else:
+            attn = multi_head_attention(
+                q, k, v, causal=True, use_flash=cfg.use_flash_attention, backend=cfg.attention_backend
+            )
         attn = nn.Dense(cfg.hidden_size, name="attn_out", dtype=x.dtype, param_dtype=jnp.float32)(
             attn.reshape(B, S, H * D)
         )
@@ -62,25 +74,34 @@ class GPT2Block(nn.Module):
         h = nn.Dense(4 * cfg.hidden_size, name="fc1", dtype=x.dtype, param_dtype=jnp.float32)(h)
         h = jax.nn.gelu(h)
         h = nn.Dense(cfg.hidden_size, name="fc2", dtype=x.dtype, param_dtype=jnp.float32)(h)
-        return x + h
+        out = x + h
+        return out if cache is None else (out, new_cache)
 
 
 class GPT2LMHeadModel(nn.Module):
     config: GPT2Config
 
     @nn.compact
-    def __call__(self, input_ids):
+    def __call__(self, input_ids, cache=None, cache_pos=None):
         cfg = self.config
         B, S = input_ids.shape
         wte = nn.Embed(cfg.vocab_size, cfg.hidden_size, name="wte", param_dtype=jnp.float32)
         wpe = nn.Embed(cfg.max_position_embeddings, cfg.hidden_size, name="wpe", param_dtype=jnp.float32)
-        x = wte(input_ids) + wpe(jnp.broadcast_to(jnp.arange(S)[None], (B, S)))
+        start = 0 if cache_pos is None else cache_pos
+        positions = start + jnp.arange(S, dtype=jnp.int32)
+        x = wte(input_ids) + wpe(jnp.broadcast_to(positions[None], (B, S)))
+        new_caches = []
         for i in range(cfg.num_hidden_layers):
-            x = GPT2Block(cfg, name=f"h_{i}")(x)
+            if cache is None:
+                x = GPT2Block(cfg, name=f"h_{i}")(x)
+            else:
+                x, layer_cache = GPT2Block(cfg, name=f"h_{i}")(x, cache=cache[i], cache_pos=cache_pos)
+                new_caches.append(layer_cache)
         x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="ln_f", param_dtype=jnp.float32)(x)
         # tied head
         embed = self.variables["params"]["wte"]["embedding"]
-        return x @ embed.T.astype(x.dtype)
+        logits = x @ embed.T.astype(x.dtype)
+        return logits if cache is None else (logits, tuple(new_caches))
 
     def init_params(self, rng, batch_size=1, seq_len=8):
         dummy = jnp.zeros((batch_size, seq_len), jnp.int32)

@@ -203,6 +203,19 @@ def _cached_attention(q, k_all, v_all, cache_pos, n_rep: int):
     return out.reshape(B, S, H, hd).astype(q.dtype)
 
 
+def update_kv_cache_and_attend(cache, q, k, v, cache_pos, n_rep: int):
+    """Write this call's K/V into the cache at ``cache_pos`` and attend q
+    against the whole buffer. Shared by every cached attention (Llama, GPT-2).
+    Returns (out [B,S,H,hd], new_cache)."""
+    start = (0, cache_pos, 0, 0)
+    new_cache = {
+        "k": jax.lax.dynamic_update_slice(cache["k"], k.astype(cache["k"].dtype), start),
+        "v": jax.lax.dynamic_update_slice(cache["v"], v.astype(cache["v"].dtype), start),
+    }
+    out = _cached_attention(q, new_cache["k"], new_cache["v"], cache_pos, n_rep)
+    return out, new_cache
+
+
 class LlamaAttention(nn.Module):
     config: LlamaConfig
 
@@ -221,14 +234,8 @@ class LlamaAttention(nn.Module):
         k = apply_rotary(k, cos, sin)
 
         if cache is not None:
-            # KV-cached path (generate): write this call's keys/values into
-            # the cache at cache_pos, attend against the whole buffer.
-            start = (0, cache_pos, 0, 0)
-            new_cache = {
-                "k": jax.lax.dynamic_update_slice(cache["k"], k.astype(cache["k"].dtype), start),
-                "v": jax.lax.dynamic_update_slice(cache["v"], v.astype(cache["v"].dtype), start),
-            }
-            out = _cached_attention(q, new_cache["k"], new_cache["v"], cache_pos, n_q // n_kv)
+            # KV-cached path (generate).
+            out, new_cache = update_kv_cache_and_attend(cache, q, k, v, cache_pos, n_q // n_kv)
             out = out.reshape(B, S, n_q * hd)
             return dense(cfg.hidden_size, "o_proj")(out), new_cache
 

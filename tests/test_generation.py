@@ -121,3 +121,27 @@ class TestStreamedGenerate:
         streamed.generate(jnp.asarray(PROMPT), 5)
         cached_keys = [k for k in streamed._jitted if k.endswith("/cached")]
         assert sorted(cached_keys) == ["embed/cached", "head/cached", "layer/cached"]
+
+
+class TestGPT2Generate:
+    @pytest.fixture(scope="class")
+    def gpt2(self):
+        from accelerate_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+
+        cfg = GPT2Config.tiny(use_flash_attention=False)
+        m = GPT2LMHeadModel(cfg)
+        params = m.init_params(jax.random.PRNGKey(0), batch_size=2, seq_len=8)
+        return cfg, m, params
+
+    def test_fused_matches_naive(self, gpt2):
+        cfg, m, params = gpt2
+        ref = naive_greedy(m, params, PROMPT, 6)
+        out = greedy_generate(m, params, PROMPT, max_new_tokens=6, cache_dtype=jnp.float32)
+        np.testing.assert_array_equal(np.asarray(out), ref)
+
+    def test_streamed_cached_matches(self, gpt2):
+        cfg, m, params = gpt2
+        streamed = dispatch_model(m, params=params, device_map={"": "cpu"})
+        full = np.asarray(streamed.generate(jnp.asarray(PROMPT), 5, use_cache=False))
+        kv = np.asarray(streamed.generate(jnp.asarray(PROMPT), 5))
+        np.testing.assert_array_equal(kv, full)
