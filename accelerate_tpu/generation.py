@@ -22,8 +22,12 @@ Cache capability is registered in ONE place — `big_modeling.
 cache_factory_for` — which both this module and the streamed executor
 consult.
 
-Greedy only (argmax): matches the reference benchmark's deterministic
-setting. Sampling is a drop-in replacement of the argmax.
+``generate`` is greedy by default (the reference benchmark's deterministic
+setting) and supports ancestral sampling with temperature / top-k / top-p
+(``do_sample=True``) — the transformers-generate surface the reference's
+users rely on. ``greedy_generate`` is the benchmark-stable greedy alias.
+Transformers conventions honored: ``top_k`` of None or 0 disables the
+filter; k is clamped to the vocabulary size.
 """
 
 from __future__ import annotations
@@ -45,7 +49,38 @@ def supports_kv_cache(module) -> bool:
 _generate_cache: dict = {}
 
 
-def _compiled_generate(module, max_new_tokens: int, eos_token_id, cache_dtype):
+def _make_selector(sampling):
+    """Token-selection fn (logits [B, V], rng) -> [B] ids. ``sampling`` is
+    None for greedy, else a (temperature, top_k, top_p) triple (static —
+    baked into the executable)."""
+    if sampling is None:
+        return lambda logits, rng: jnp.argmax(logits, axis=-1)
+    temperature, top_k, top_p = sampling
+
+    def select(logits, rng):
+        logits = logits.astype(jnp.float32) / max(temperature, 1e-6)
+        if top_k is not None and top_k > 0:
+            k = min(top_k, logits.shape[-1])
+            kth = jax.lax.top_k(logits, k)[0][:, -1:]
+            logits = jnp.where(logits < kth, -jnp.inf, logits)
+        if top_p is not None:
+            sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+            probs = jax.nn.softmax(sorted_logits, axis=-1)
+            cum = jnp.cumsum(probs, axis=-1)
+            # Keep the smallest prefix with cumulative mass >= top_p (always
+            # keep the best token).
+            keep = jnp.concatenate(
+                [jnp.ones_like(cum[:, :1], bool), cum[:, :-1] < top_p], axis=-1
+            )
+            cutoff = jnp.min(jnp.where(keep, sorted_logits, jnp.inf), axis=-1, keepdims=True)
+            logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+        return jax.random.categorical(rng, logits, axis=-1)
+
+    return select
+
+
+def _compiled_generate(module, max_new_tokens: int, eos_token_id, cache_dtype,
+                       sampling=None):
     """(prefill, decode) jitted pair for this (model config, length, eos,
     dtype) — cached so repeat generate calls reuse the same jitted function
     objects (and therefore jax.jit's executable cache) instead of retracing
@@ -66,40 +101,44 @@ def _compiled_generate(module, max_new_tokens: int, eos_token_id, cache_dtype):
             max_new_tokens,
             eos_token_id,
             jnp.dtype(cache_dtype).name,
+            sampling,
         )
         hit = _generate_cache.get(key)
         if hit is not None:
             return hit
 
-    @jax.jit
-    def prefill(params, ids, cache):
-        logits, cache = module.apply({"params": params}, ids, cache=cache, cache_pos=0)
-        return jnp.argmax(logits[:, -1], axis=-1).astype(ids.dtype), cache
+    select = _make_selector(sampling)
 
     @jax.jit
-    def decode(params, first_tok, cache, start_pos):
+    def prefill(params, ids, cache, rng):
+        logits, cache = module.apply({"params": params}, ids, cache=cache, cache_pos=0)
+        return select(logits[:, -1], rng).astype(ids.dtype), cache
+
+    @jax.jit
+    def decode(params, first_tok, cache, start_pos, rng):
         # (No donation: the final cache is discarded, not an output, so the
         # input buffers cannot alias anything — XLA reuses the scan carry
         # buffers in place regardless.)
         def body(carry, _):
-            tok, cache, pos, done = carry
+            tok, cache, pos, done, rng = carry
             logits, cache = module.apply(
                 {"params": params}, tok[:, None], cache=cache, cache_pos=pos
             )
-            nxt = jnp.argmax(logits[:, -1], axis=-1).astype(tok.dtype)
+            rng, sub = jax.random.split(rng)
+            nxt = select(logits[:, -1], sub).astype(tok.dtype)
             if eos_token_id is not None:
                 nxt = jnp.where(done, jnp.asarray(eos_token_id, tok.dtype), nxt)
                 done = done | (nxt == eos_token_id)
             # Emit the *computed* token: the scan runs max_new_tokens - 1
             # steps and first_tok supplies the head, so no forward's output
             # is ever discarded.
-            return (nxt, cache, pos + 1, done), nxt
+            return (nxt, cache, pos + 1, done, rng), nxt
 
         done0 = jnp.zeros((first_tok.shape[0],), bool)
         if eos_token_id is not None:
             done0 = first_tok == eos_token_id
-        (_, _, _, _), toks = jax.lax.scan(
-            body, (first_tok, cache, start_pos, done0), None,
+        (_, _, _, _, _), toks = jax.lax.scan(
+            body, (first_tok, cache, start_pos, done0, rng), None,
             length=max_new_tokens - 1,
         )
         return jnp.concatenate([first_tok[:, None], toks.T], axis=1)
@@ -122,15 +161,23 @@ def _check_position_bound(module, total_len: int):
         )
 
 
-def greedy_generate(
+def generate(
     module,
     params,
     input_ids,
     max_new_tokens: int = 20,
     eos_token_id: Optional[int] = None,
     cache_dtype=None,
+    do_sample: bool = False,
+    temperature: float = 1.0,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
+    rng=None,
 ):
-    """Greedy decoding with a KV cache, fully compiled (prefill + scan).
+    """KV-cached decoding, fully compiled (prefill + scan): greedy by
+    default, ancestral sampling with temperature / top-k / top-p when
+    ``do_sample=True`` (the transformers-generate surface the reference's
+    users rely on).
 
     Args:
       module: a cache-threading model (see :func:`supports_kv_cache`).
@@ -140,6 +187,10 @@ def greedy_generate(
       eos_token_id: sequences that emit it keep emitting it (ragged stop
         inside a static-shape scan).
       cache_dtype: KV buffer dtype (default: bfloat16).
+      do_sample: sample instead of argmax.
+      temperature / top_k / top_p: sampling knobs (static — each combination
+        compiles once).
+      rng: jax PRNG key for sampling (default PRNGKey(0)).
 
     Returns [B, S + max_new_tokens] ids.
     """
@@ -160,7 +211,18 @@ def greedy_generate(
     dtype = cache_dtype or jnp.bfloat16
     cache = factory(B, S + max_new_tokens, dtype)
 
-    prefill, decode = _compiled_generate(module, max_new_tokens, eos_token_id, dtype)
-    first_tok, cache = prefill(params, ids, cache)
-    new_toks = decode(params, first_tok, cache, jnp.asarray(S, jnp.int32))
+    sampling = (float(temperature), top_k, top_p) if do_sample else None
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    prefill, decode = _compiled_generate(module, max_new_tokens, eos_token_id, dtype,
+                                         sampling=sampling)
+    rng, pre_rng = jax.random.split(rng)
+    first_tok, cache = prefill(params, ids, cache, pre_rng)
+    new_toks = decode(params, first_tok, cache, jnp.asarray(S, jnp.int32), rng)
     return jnp.concatenate([ids, new_toks], axis=1)
+
+
+def greedy_generate(module, params, input_ids, max_new_tokens: int = 20,
+                    eos_token_id: Optional[int] = None, cache_dtype=None):
+    """Greedy alias of :func:`generate` (kept as the benchmark-stable name)."""
+    return generate(module, params, input_ids, max_new_tokens=max_new_tokens,
+                    eos_token_id=eos_token_id, cache_dtype=cache_dtype)

@@ -145,3 +145,46 @@ class TestGPT2Generate:
         full = np.asarray(streamed.generate(jnp.asarray(PROMPT), 5, use_cache=False))
         kv = np.asarray(streamed.generate(jnp.asarray(PROMPT), 5))
         np.testing.assert_array_equal(kv, full)
+
+
+class TestSampling:
+    def test_temperature_zero_ish_matches_greedy(self, tiny):
+        from accelerate_tpu.generation import generate
+
+        cfg, m, params = tiny
+        greedy = greedy_generate(m, params, PROMPT, max_new_tokens=6, cache_dtype=jnp.float32)
+        cold = generate(m, params, PROMPT, max_new_tokens=6, cache_dtype=jnp.float32,
+                        do_sample=True, temperature=1e-4, rng=jax.random.PRNGKey(7))
+        np.testing.assert_array_equal(np.asarray(cold), np.asarray(greedy))
+
+    def test_sampling_is_seeded_and_varies(self, tiny):
+        from accelerate_tpu.generation import generate
+
+        cfg, m, params = tiny
+        a = generate(m, params, PROMPT, max_new_tokens=8, do_sample=True,
+                     temperature=1.5, rng=jax.random.PRNGKey(0), cache_dtype=jnp.float32)
+        b = generate(m, params, PROMPT, max_new_tokens=8, do_sample=True,
+                     temperature=1.5, rng=jax.random.PRNGKey(0), cache_dtype=jnp.float32)
+        c = generate(m, params, PROMPT, max_new_tokens=8, do_sample=True,
+                     temperature=1.5, rng=jax.random.PRNGKey(1), cache_dtype=jnp.float32)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert not (np.asarray(a) == np.asarray(c)).all()
+
+    def test_top_k_restricts_support(self, tiny):
+        from accelerate_tpu.generation import generate
+
+        cfg, m, params = tiny
+        # top_k=1 is greedy regardless of temperature.
+        greedy = greedy_generate(m, params, PROMPT, max_new_tokens=6, cache_dtype=jnp.float32)
+        k1 = generate(m, params, PROMPT, max_new_tokens=6, do_sample=True, temperature=5.0,
+                      top_k=1, rng=jax.random.PRNGKey(3), cache_dtype=jnp.float32)
+        np.testing.assert_array_equal(np.asarray(k1), np.asarray(greedy))
+
+    def test_top_p_tiny_is_greedy(self, tiny):
+        from accelerate_tpu.generation import generate
+
+        cfg, m, params = tiny
+        greedy = greedy_generate(m, params, PROMPT, max_new_tokens=6, cache_dtype=jnp.float32)
+        p0 = generate(m, params, PROMPT, max_new_tokens=6, do_sample=True, temperature=5.0,
+                      top_p=1e-9, rng=jax.random.PRNGKey(3), cache_dtype=jnp.float32)
+        np.testing.assert_array_equal(np.asarray(p0), np.asarray(greedy))
