@@ -284,8 +284,9 @@ def cache_factory_for(module) -> Optional[Callable]:
     the ``kind == "layer"`` specs in order."""
     from .models.gpt2 import GPT2LMHeadModel
     from .models.llama import LlamaForCausalLM, init_kv_cache
+    from .models.mixtral import MixtralForCausalLM
 
-    if isinstance(module, (LlamaForCausalLM, GPT2LMHeadModel)):
+    if isinstance(module, (LlamaForCausalLM, GPT2LMHeadModel, MixtralForCausalLM)):
         cfg = module.config  # GPT2Config duck-types the kv-cache fields
 
         def factory(batch, max_len, dtype=jnp.bfloat16):

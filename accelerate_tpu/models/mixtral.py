@@ -58,9 +58,16 @@ class MixtralConfig(LlamaConfig):
 
 
 class MixtralSparseMLP(nn.Module):
-    """Router + stacked SwiGLU experts; dispatch via ops.moe."""
+    """Router + stacked SwiGLU experts; dispatch via ops.moe.
+
+    ``no_drop=True`` sizes expert capacity so no token is ever dropped —
+    the decode-path setting: capacity dropping is a *training* throughput
+    trade (static shapes under load imbalance), and token counts differ
+    between prefill/decode and a full forward, so only the no-drop setting
+    makes cached generation faithful to the model."""
 
     config: MixtralConfig
+    no_drop: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -86,12 +93,15 @@ class MixtralSparseMLP(nn.Module):
                 }
 
         experts = Experts(name="experts")()
+        # capacity = ceil(top_k * T * factor / E): factor = E guarantees
+        # top_k * T slots, i.e. zero drops.
+        capacity_factor = float(cfg.num_experts) if self.no_drop else cfg.capacity_factor
         return moe_mlp_apply(
             experts,
             router,
             x,
             top_k=cfg.top_k,
-            capacity_factor=cfg.capacity_factor,
+            capacity_factor=capacity_factor,
             num_groups=cfg.num_expert_groups,
             router_noise_rng=router_noise_rng,
             router_noise_eps=cfg.router_noise_eps,
@@ -102,25 +112,32 @@ class MixtralBlock(nn.Module):
     config: MixtralConfig
 
     @nn.compact
-    def __call__(self, x, positions):
+    def __call__(self, x, positions, cache=None, cache_pos=None):
         cfg = self.config
-        h = x + LlamaAttention(cfg, name="self_attn")(
-            RMSNorm(cfg.rms_norm_eps, name="input_norm")(x), positions
+        attn = LlamaAttention(cfg, name="self_attn")(
+            RMSNorm(cfg.rms_norm_eps, name="input_norm")(x), positions,
+            cache=cache, cache_pos=cache_pos,
         )
-        mlp_out, aux = MixtralSparseMLP(cfg, name="mlp")(
+        new_cache = None
+        if cache is not None:
+            attn, new_cache = attn
+        h = x + attn
+        mlp_out, aux = MixtralSparseMLP(cfg, no_drop=cache is not None, name="mlp")(
             RMSNorm(cfg.rms_norm_eps, name="post_attn_norm")(h)
         )
-        return h + mlp_out, aux
+        out = h + mlp_out
+        return (out, aux) if cache is None else (out, aux, new_cache)
 
 
 class MixtralForCausalLM(nn.Module):
     config: MixtralConfig
 
     @nn.compact
-    def __call__(self, input_ids, positions=None):
+    def __call__(self, input_ids, positions=None, cache=None, cache_pos=None):
         cfg = self.config
         if positions is None:
-            positions = jnp.arange(input_ids.shape[1])[None, :].astype(jnp.int32)
+            start = 0 if cache_pos is None else cache_pos
+            positions = start + jnp.arange(input_ids.shape[1], dtype=jnp.int32)[None, :]
             positions = jnp.broadcast_to(positions, input_ids.shape)
         embed = nn.Embed(cfg.vocab_size, cfg.hidden_size, name="embed_tokens", param_dtype=jnp.float32)
         x = embed(input_ids)
@@ -131,8 +148,15 @@ class MixtralForCausalLM(nn.Module):
             )
         lb = jnp.zeros((), jnp.float32)
         zl = jnp.zeros((), jnp.float32)
+        new_caches = []
         for i in range(cfg.num_hidden_layers):
-            x, aux = block_cls(cfg, name=f"layers_{i}")(x, positions)
+            if cache is None:
+                x, aux = block_cls(cfg, name=f"layers_{i}")(x, positions)
+            else:
+                x, aux, layer_cache = block_cls(cfg, name=f"layers_{i}")(
+                    x, positions, cache=cache[i], cache_pos=cache_pos
+                )
+                new_caches.append(layer_cache)
             lb = lb + aux["load_balance_loss"]
             zl = zl + aux["router_z_loss"]
         x = RMSNorm(cfg.rms_norm_eps, name="norm")(x)
@@ -144,6 +168,10 @@ class MixtralForCausalLM(nn.Module):
                 cfg.vocab_size, use_bias=False, name="lm_head", dtype=x.dtype, param_dtype=jnp.float32
             )(x)
         n = cfg.num_hidden_layers
+        if cache is not None:
+            # Decode path: router losses are a training quantity; return the
+            # generation contract (logits, new_cache).
+            return logits, tuple(new_caches)
         return logits, {"load_balance_loss": lb / n, "router_z_loss": zl / n}
 
     def init_params(self, rng, batch_size=1, seq_len=8):
