@@ -1,28 +1,25 @@
 """`accelerate-tpu config` — interactive wizard writing the default YAML
-(reference: commands/config/config.py :99 + cluster.py questionnaire :54,
-menu UI collapsed into plain prompts)."""
+(reference: commands/config/config.py :99 + cluster.py questionnaire :54;
+multiple-choice questions go through the cursor menu in ../menu.py)."""
 
 from __future__ import annotations
 
 import argparse
 from typing import Optional
 
+from ..menu import select
 from .config_args import ClusterConfig, default_config_file
 from .default import write_basic_config
 
 
 def _ask(question: str, default: str, choices: Optional[list[str]] = None) -> str:
-    suffix = f" [{'/'.join(choices)}] ({default})" if choices else f" ({default})"
+    if choices:
+        return select(question, choices, default=default)
     try:
-        answer = input(f"{question}{suffix}: ").strip()
+        answer = input(f"{question} ({default}): ").strip()
     except EOFError:
         answer = ""
-    if not answer:
-        return default
-    if choices and answer not in choices:
-        print(f"  invalid choice {answer!r}, using {default!r}")
-        return default
-    return answer
+    return answer or default
 
 
 def _ask_int(question: str, default: int) -> int:

@@ -206,8 +206,45 @@ def gcloud_pod_launcher(args, cfg: ClusterConfig) -> int:
     return subprocess.run(cmd).returncode
 
 
+def validate_launch(args, cfg: ClusterConfig) -> list[str]:
+    """Pre-flight checks before any process is spawned (reference:
+    _validate_launch_command :972). Returns a list of human-readable
+    problems; empty means launch."""
+    problems = []
+    if not args.module and not os.path.exists(args.training_script):
+        problems.append(f"training script not found: {args.training_script}")
+    for axis in ("mesh_fsdp", "mesh_tp", "mesh_cp", "mesh_ep", "mesh_pp"):
+        val = getattr(cfg, axis)
+        if val is not None and val < 1:
+            problems.append(f"{axis} must be >= 1, got {val}")
+    if cfg.mesh_dp is not None and cfg.mesh_dp < -1 or cfg.mesh_dp == 0:
+        problems.append(f"mesh_dp must be positive or -1 (all remaining), got {cfg.mesh_dp}")
+    if args.num_processes is not None and args.num_processes < 1:
+        problems.append(f"--num_processes must be >= 1, got {args.num_processes}")
+    if args.max_restarts < 0:
+        problems.append(f"--max_restarts must be >= 0, got {args.max_restarts}")
+    n_machines = cfg.num_machines or 1
+    if cfg.machine_rank is not None and not 0 <= cfg.machine_rank < n_machines:
+        problems.append(
+            f"machine_rank {cfg.machine_rank} out of range for num_machines {n_machines}")
+    if n_machines > 1 and not cfg.main_process_ip and not cfg.tpu_name:
+        problems.append(
+            "multi-host launch needs a rendezvous: set main_process_ip/port "
+            "(or tpu_name for TPU-metadata autodetection)")
+    if args.num_processes and args.num_processes > 1 and n_machines > 1:
+        problems.append(
+            "--num_processes (local CPU emulation) and num_machines > 1 "
+            "(real multi-host) are mutually exclusive")
+    return problems
+
+
 def launch_command(args) -> int:
     cfg = _resolve_config(args)
+    problems = validate_launch(args, cfg)
+    if problems:
+        for p in problems:
+            print(f"[accelerate-tpu launch] error: {p}", file=sys.stderr)
+        return 2
     if args.gcloud or (cfg.compute_environment == "TPU_POD" and cfg.tpu_name
                        and cfg.machine_rank == 0):
         # Pod preemption is the main restart customer — wrap this path too.

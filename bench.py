@@ -134,7 +134,7 @@ def _tpu_run_main() -> int:
     return 0
 
 
-def _tpu_subprocess(timeout: float = 900.0) -> tuple[dict | None, str | None]:
+def _tpu_subprocess(timeout: float = 480.0) -> tuple[dict | None, str | None]:
     """Run the TPU benchmark in a fresh interpreter with a hard timeout.
 
     The parent never initializes a backend itself: backend init can hang
@@ -171,7 +171,9 @@ def main() -> int:
     pin = (
         os.environ.get("ACCELERATE_TPU_PLATFORM") or os.environ.get("JAX_PLATFORMS") or ""
     ).split(",")[0].strip().lower()
-    platform = pin or probe_default_backend(timeout=120.0)
+    # Budgets are chosen so the worst case (probe timeout + one wedged TPU
+    # attempt + CPU smoke) stays under ~10 minutes of wall clock.
+    platform = pin or probe_default_backend(timeout=90.0)
     on_tpu = platform is not None and platform != "cpu"
 
     if on_tpu:

@@ -202,6 +202,67 @@ class TestCLISubprocess:
         assert set(merged) == {"a.w", "b.w"}
 
 
+class TestLaunchValidation:
+    """validate_launch is pure over (args, cfg) — no subprocess needed
+    (reference: _validate_launch_command :972)."""
+
+    def _args(self, tmp_path, **over):
+        from accelerate_tpu.commands.launch import launch_command_parser
+
+        script = tmp_path / "train.py"
+        script.write_text("pass\n")
+        parser = launch_command_parser()
+        args = parser.parse_args([str(script)])
+        for k, v in over.items():
+            setattr(args, k, v)
+        return args
+
+    def _problems(self, tmp_path, cfg_over=None, **arg_over):
+        from accelerate_tpu.commands.config.config_args import ClusterConfig
+        from accelerate_tpu.commands.launch import validate_launch
+
+        cfg = ClusterConfig()
+        for k, v in (cfg_over or {}).items():
+            setattr(cfg, k, v)
+        return validate_launch(self._args(tmp_path, **arg_over), cfg)
+
+    def test_clean_launch_has_no_problems(self, tmp_path):
+        assert self._problems(tmp_path) == []
+
+    def test_missing_script(self, tmp_path):
+        problems = self._problems(tmp_path, training_script=str(tmp_path / "nope.py"))
+        assert any("not found" in p for p in problems)
+
+    def test_bad_mesh_axis(self, tmp_path):
+        problems = self._problems(tmp_path, cfg_over={"mesh_tp": 0})
+        assert any("mesh_tp" in p for p in problems)
+
+    def test_dp_minus_one_ok_zero_rejected(self, tmp_path):
+        assert self._problems(tmp_path, cfg_over={"mesh_dp": -1}) == []
+        assert any("mesh_dp" in p for p in self._problems(tmp_path, cfg_over={"mesh_dp": 0}))
+
+    def test_machine_rank_range(self, tmp_path):
+        problems = self._problems(
+            tmp_path, cfg_over={"num_machines": 2, "machine_rank": 5, "main_process_ip": "10.0.0.1"})
+        assert any("machine_rank" in p for p in problems)
+
+    def test_multihost_needs_rendezvous(self, tmp_path):
+        problems = self._problems(tmp_path, cfg_over={"num_machines": 2})
+        assert any("rendezvous" in p for p in problems)
+
+    def test_num_processes_conflicts_with_multihost(self, tmp_path):
+        problems = self._problems(
+            tmp_path, num_processes=2,
+            cfg_over={"num_machines": 2, "main_process_ip": "10.0.0.1"})
+        assert any("mutually exclusive" in p for p in problems)
+
+    def test_launch_command_rejects_invalid(self, tmp_path, capsys):
+        from accelerate_tpu.commands.launch import launch_command
+
+        args = self._args(tmp_path, training_script=str(tmp_path / "nope.py"))
+        assert launch_command(args) == 2
+
+
 class TestLaunchers:
     def test_notebook_launcher_sets_mesh_env(self):
         from accelerate_tpu.launchers import notebook_launcher

@@ -1,0 +1,91 @@
+"""Cursor-menu unit tests (reference parity: commands/menu/ selection UI).
+
+The key decoder and state stepper are pure, so the menu logic is tested
+without a terminal; the non-TTY fallback is driven through stdin monkeypatching.
+"""
+
+import io
+
+import pytest
+
+from accelerate_tpu.commands.menu import (
+    KEY_CANCEL,
+    KEY_DOWN,
+    KEY_ENTER,
+    KEY_UP,
+    MenuState,
+    decode_key,
+    select,
+    step_state,
+)
+
+
+class TestDecodeKey:
+    @pytest.mark.parametrize(
+        "seq,expected",
+        [
+            ("\x1b[A", KEY_UP),
+            ("\x1b[B", KEY_DOWN),
+            ("k", KEY_UP),
+            ("j", KEY_DOWN),
+            ("\r", KEY_ENTER),
+            ("\n", KEY_ENTER),
+            ("\x03", KEY_CANCEL),
+            ("q", KEY_CANCEL),
+            ("\x1b", KEY_CANCEL),
+            ("3", "3"),
+            ("x", "x"),
+        ],
+    )
+    def test_decode(self, seq, expected):
+        assert decode_key(seq) == expected
+
+
+class TestStepState:
+    def test_wraps_both_directions(self):
+        s = MenuState(n=3, pos=0)
+        s = step_state(s, KEY_UP)
+        assert s.pos == 2
+        s = step_state(s, KEY_DOWN)
+        assert s.pos == 0
+
+    def test_digit_jump(self):
+        s = MenuState(n=4, pos=0)
+        s = step_state(s, "3")
+        assert s.pos == 2
+
+    def test_digit_out_of_range_ignored(self):
+        s = MenuState(n=2, pos=1)
+        s = step_state(s, "9")
+        assert s.pos == 1
+
+    def test_enter_finishes(self):
+        s = step_state(MenuState(n=2, pos=1), KEY_ENTER)
+        assert s.done and not s.cancelled
+
+    def test_cancel_flags(self):
+        s = step_state(MenuState(n=2), KEY_CANCEL)
+        assert s.done and s.cancelled
+
+
+class TestFallbackSelect:
+    """Non-TTY path: numbered prompt over stdin."""
+
+    def _run(self, monkeypatch, typed: str, choices, default=None):
+        monkeypatch.setattr("sys.stdin", io.StringIO(typed))
+        return select("pick one", choices, default=default)
+
+    def test_picks_by_number(self, monkeypatch, capsys):
+        assert self._run(monkeypatch, "2\n", ["a", "b", "c"]) == "b"
+
+    def test_picks_by_name(self, monkeypatch, capsys):
+        assert self._run(monkeypatch, "c\n", ["a", "b", "c"]) == "c"
+
+    def test_empty_uses_default(self, monkeypatch, capsys):
+        assert self._run(monkeypatch, "\n", ["a", "b"], default="b") == "b"
+
+    def test_eof_uses_default(self, monkeypatch, capsys):
+        assert self._run(monkeypatch, "", ["a", "b"], default="a") == "a"
+
+    def test_garbage_uses_default(self, monkeypatch, capsys):
+        assert self._run(monkeypatch, "nope\n", ["a", "b"], default="b") == "b"
