@@ -72,18 +72,24 @@ def _pending_input(fd, timeout: float = 0.05) -> bool:
     return bool(ready)
 
 
-def _read_key(stream) -> str:
-    ch = stream.read(1)
+def _read_key(fd: int) -> str:
+    """Read one keypress directly from the fd.
+
+    Must be ``os.read``, not ``sys.stdin.read``: the TextIOWrapper's
+    read-ahead would pull an escape sequence's tail bytes into Python's
+    userspace buffer, where the ``select()`` below cannot see them — every
+    arrow key would then decode as a bare ESC (= cancel)."""
+    import os as _os
+
+    ch = _os.read(fd, 1).decode(errors="replace")
     if ch == "\x1b":
         # A CSI sequence delivers its remaining bytes immediately; a bare ESC
         # press delivers nothing more. Distinguish without blocking so ESC
         # cancels on its own and never swallows the next keypress.
-        if not _pending_input(stream.fileno()):
+        if not _pending_input(fd):
             return ch
-        nxt = stream.read(1)
-        if nxt == "[":
-            return ch + nxt + stream.read(1)
-        return ch + nxt  # ESC+x chord: unrecognized, ignored by step_state
+        rest = _os.read(fd, 2).decode(errors="replace")
+        return ch + rest  # "[A"-style CSI tail, or an ESC+x chord
     return ch
 
 
@@ -110,11 +116,13 @@ def _interactive_select(question: str, choices: list[str], default_index: int) -
         tty.setcbreak(fd)
         first = True
         while not state.done:
-            _render(question, choices, state.pos, first, out)
-            first = False
+            # cbreak keeps ISIG, so Ctrl-C arrives as KeyboardInterrupt —
+            # anywhere in the render/read cycle. It means "cancel".
             try:
-                key = decode_key(_read_key(sys.stdin))
-            except KeyboardInterrupt:  # cbreak keeps ISIG: Ctrl-C arrives as SIGINT
+                _render(question, choices, state.pos, first, out)
+                first = False
+                key = decode_key(_read_key(fd))
+            except KeyboardInterrupt:
                 key = KEY_CANCEL
             state = step_state(state, key)
     finally:

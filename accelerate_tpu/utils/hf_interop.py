@@ -1,0 +1,492 @@
+"""HuggingFace Transformers checkpoint interop.
+
+The reference framework operates directly on HF ``torch.nn.Module``s, so any
+Hub checkpoint "just works" (reference: big_modeling.py:504
+``load_checkpoint_and_dispatch`` + utils/modeling.py:1683
+``load_checkpoint_in_model``). This framework defines its own flax model
+families; capability parity therefore needs a *weight bridge*: bidirectional
+name/layout translation between HF state dicts (torch conventions:
+``Linear.weight`` is ``(out, in)``, dot-separated names) and our param
+pytrees (flax: ``kernel`` is ``(in, out)``, nested dicts).
+
+Supported families mirror ``accelerate_tpu.models``: llama, mixtral, gpt2,
+bert, t5. Each family is a table of bidirectional rules; conversion is pure
+numpy (no torch import needed when reading safetensors).
+
+    params = load_hf_checkpoint("/path/to/hf_llama_dir")       # dir with
+    #   config.json + *.safetensors -> (our_config, params pytree)
+    params = convert_hf_state_dict(sd, "llama", config=cfg)    # in-memory
+    sd = export_hf_state_dict(params, "llama", config=cfg)     # inverse
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Callable, Optional
+
+import numpy as np
+
+__all__ = [
+    "detect_family",
+    "config_from_hf",
+    "convert_hf_state_dict",
+    "export_hf_state_dict",
+    "load_hf_checkpoint",
+]
+
+
+# ---------------------------------------------------------------------------
+# Rule tables. Each rule: (hf_template, ours_template, op).
+#   - ``{i}``/``{j}`` match layer indices, ``{p}`` matches the projection
+#     alternatives listed in the 4th slot (if present).
+#   - op "t" transposes 2D weights (torch Linear <-> flax Dense, self-inverse);
+#     op "copy" passes through (embeddings, norms, biases, GPT-2's Conv1D
+#     weights, which are already (in, out)).
+# HF keys with no rule (tied heads, position-id buffers) are skipped on
+# import; our params with no rule raise on export (nothing may be dropped
+# silently in that direction).
+# ---------------------------------------------------------------------------
+
+_LLAMA_RULES = [
+    ("model.embed_tokens.weight", "model/embed_tokens/embedding", "copy", None),
+    ("model.layers.{i}.self_attn.{p}_proj.weight",
+     "model/layers_{i}/self_attn/{p}_proj/kernel", "t", ("q", "k", "v", "o")),
+    ("model.layers.{i}.mlp.{p}_proj.weight",
+     "model/layers_{i}/mlp/{p}_proj/kernel", "t", ("gate", "up", "down")),
+    ("model.layers.{i}.input_layernorm.weight",
+     "model/layers_{i}/input_norm/scale", "copy", None),
+    ("model.layers.{i}.post_attention_layernorm.weight",
+     "model/layers_{i}/post_attn_norm/scale", "copy", None),
+    ("model.norm.weight", "model/norm/scale", "copy", None),
+    ("lm_head.weight", "lm_head/kernel", "t", None),
+]
+
+# Mixtral: llama attention/norms + routed experts. Our MixtralForCausalLM is
+# flat (no "model" scope — models/mixtral.py:130), and the per-expert
+# w1/w2/w3 Linears are stacked into (E, in, out) tensors by the special-case
+# code below (our experts are a single batched einsum, not E separate
+# modules).
+_MIXTRAL_RULES = [
+    (hf_t, ours_t.removeprefix("model/"), op, alts)
+    for hf_t, ours_t, op, alts in _LLAMA_RULES if ".mlp." not in hf_t
+] + [
+    ("model.layers.{i}.block_sparse_moe.gate.weight",
+     "layers_{i}/mlp/router", "t", None),
+]
+_MIXTRAL_EXPERT_RE = re.compile(
+    r"model\.layers\.(\d+)\.block_sparse_moe\.experts\.(\d+)\.w([123])\.weight")
+# HF w1 = gate (F,D), w2 = down (D,F), w3 = up (F,D).
+_MIXTRAL_W_TO_NAME = {"1": "gate_proj", "2": "down_proj", "3": "up_proj"}
+
+_GPT2_RULES = [
+    ("wte.weight", "wte/embedding", "copy", None),
+    ("wpe.weight", "wpe/embedding", "copy", None),
+    ("h.{i}.ln_1.weight", "h_{i}/ln_1/scale", "copy", None),
+    ("h.{i}.ln_1.bias", "h_{i}/ln_1/bias", "copy", None),
+    # Conv1D weights are already (in, out): straight copy, fused qkv order
+    # matches (q|k|v concatenated on the output axis).
+    ("h.{i}.attn.c_attn.weight", "h_{i}/qkv/kernel", "copy", None),
+    ("h.{i}.attn.c_attn.bias", "h_{i}/qkv/bias", "copy", None),
+    ("h.{i}.attn.c_proj.weight", "h_{i}/attn_out/kernel", "copy", None),
+    ("h.{i}.attn.c_proj.bias", "h_{i}/attn_out/bias", "copy", None),
+    ("h.{i}.ln_2.weight", "h_{i}/ln_2/scale", "copy", None),
+    ("h.{i}.ln_2.bias", "h_{i}/ln_2/bias", "copy", None),
+    ("h.{i}.mlp.c_fc.weight", "h_{i}/fc1/kernel", "copy", None),
+    ("h.{i}.mlp.c_fc.bias", "h_{i}/fc1/bias", "copy", None),
+    ("h.{i}.mlp.c_proj.weight", "h_{i}/fc2/kernel", "copy", None),
+    ("h.{i}.mlp.c_proj.bias", "h_{i}/fc2/bias", "copy", None),
+    ("ln_f.weight", "ln_f/scale", "copy", None),
+    ("ln_f.bias", "ln_f/bias", "copy", None),
+]
+
+_BERT_RULES = [
+    ("embeddings.word_embeddings.weight", "encoder/word_embeddings/embedding", "copy", None),
+    ("embeddings.position_embeddings.weight",
+     "encoder/position_embeddings/embedding", "copy", None),
+    ("embeddings.token_type_embeddings.weight",
+     "encoder/token_type_embeddings/embedding", "copy", None),
+    ("embeddings.LayerNorm.weight", "encoder/embed_norm/scale", "copy", None),
+    ("embeddings.LayerNorm.bias", "encoder/embed_norm/bias", "copy", None),
+    ("encoder.layer.{i}.attention.self.{p}.weight",
+     "encoder/layer_{i}/attention/{p}/kernel", "t", ("query", "key", "value")),
+    ("encoder.layer.{i}.attention.self.{p}.bias",
+     "encoder/layer_{i}/attention/{p}/bias", "copy", ("query", "key", "value")),
+    ("encoder.layer.{i}.attention.output.dense.weight",
+     "encoder/layer_{i}/attention/attn_out/kernel", "t", None),
+    ("encoder.layer.{i}.attention.output.dense.bias",
+     "encoder/layer_{i}/attention/attn_out/bias", "copy", None),
+    ("encoder.layer.{i}.attention.output.LayerNorm.weight",
+     "encoder/layer_{i}/attn_norm/scale", "copy", None),
+    ("encoder.layer.{i}.attention.output.LayerNorm.bias",
+     "encoder/layer_{i}/attn_norm/bias", "copy", None),
+    ("encoder.layer.{i}.intermediate.dense.weight",
+     "encoder/layer_{i}/intermediate/kernel", "t", None),
+    ("encoder.layer.{i}.intermediate.dense.bias",
+     "encoder/layer_{i}/intermediate/bias", "copy", None),
+    ("encoder.layer.{i}.output.dense.weight",
+     "encoder/layer_{i}/mlp_out/kernel", "t", None),
+    ("encoder.layer.{i}.output.dense.bias",
+     "encoder/layer_{i}/mlp_out/bias", "copy", None),
+    ("encoder.layer.{i}.output.LayerNorm.weight",
+     "encoder/layer_{i}/mlp_norm/scale", "copy", None),
+    ("encoder.layer.{i}.output.LayerNorm.bias",
+     "encoder/layer_{i}/mlp_norm/bias", "copy", None),
+    ("pooler.dense.weight", "pooler/kernel", "t", None),
+    ("pooler.dense.bias", "pooler/bias", "copy", None),
+    ("classifier.weight", "classifier/kernel", "t", None),
+    ("classifier.bias", "classifier/bias", "copy", None),
+]
+
+_T5_RULES = [
+    ("shared.weight", "shared_embedding/embedding", "copy", None),
+    # Encoder.
+    ("encoder.block.{i}.layer.0.SelfAttention.q.weight",
+     "encoder_layer_{i}/attention/query/kernel", "t", None),
+    ("encoder.block.{i}.layer.0.SelfAttention.k.weight",
+     "encoder_layer_{i}/attention/key/kernel", "t", None),
+    ("encoder.block.{i}.layer.0.SelfAttention.v.weight",
+     "encoder_layer_{i}/attention/value/kernel", "t", None),
+    ("encoder.block.{i}.layer.0.SelfAttention.o.weight",
+     "encoder_layer_{i}/attention/attn_out/kernel", "t", None),
+    ("encoder.block.{i}.layer.0.SelfAttention.relative_attention_bias.weight",
+     "encoder_layer_{i}/attention/relative_attention_bias/embedding", "copy", None),
+    ("encoder.block.{i}.layer.0.layer_norm.weight",
+     "encoder_layer_{i}/attn_norm/scale", "copy", None),
+    ("encoder.block.{i}.layer.1.DenseReluDense.wi.weight",
+     "encoder_layer_{i}/mlp/intermediate/kernel", "t", None),
+    ("encoder.block.{i}.layer.1.DenseReluDense.wo.weight",
+     "encoder_layer_{i}/mlp/mlp_out/kernel", "t", None),
+    ("encoder.block.{i}.layer.1.layer_norm.weight",
+     "encoder_layer_{i}/mlp_norm/scale", "copy", None),
+    ("encoder.final_layer_norm.weight", "encoder_norm/scale", "copy", None),
+    # Decoder.
+    ("decoder.block.{i}.layer.0.SelfAttention.q.weight",
+     "decoder_layer_{i}/self_attention/query/kernel", "t", None),
+    ("decoder.block.{i}.layer.0.SelfAttention.k.weight",
+     "decoder_layer_{i}/self_attention/key/kernel", "t", None),
+    ("decoder.block.{i}.layer.0.SelfAttention.v.weight",
+     "decoder_layer_{i}/self_attention/value/kernel", "t", None),
+    ("decoder.block.{i}.layer.0.SelfAttention.o.weight",
+     "decoder_layer_{i}/self_attention/attn_out/kernel", "t", None),
+    ("decoder.block.{i}.layer.0.SelfAttention.relative_attention_bias.weight",
+     "decoder_layer_{i}/self_attention/relative_attention_bias/embedding", "copy", None),
+    ("decoder.block.{i}.layer.0.layer_norm.weight",
+     "decoder_layer_{i}/self_norm/scale", "copy", None),
+    ("decoder.block.{i}.layer.1.EncDecAttention.q.weight",
+     "decoder_layer_{i}/cross_attention/query/kernel", "t", None),
+    ("decoder.block.{i}.layer.1.EncDecAttention.k.weight",
+     "decoder_layer_{i}/cross_attention/key/kernel", "t", None),
+    ("decoder.block.{i}.layer.1.EncDecAttention.v.weight",
+     "decoder_layer_{i}/cross_attention/value/kernel", "t", None),
+    ("decoder.block.{i}.layer.1.EncDecAttention.o.weight",
+     "decoder_layer_{i}/cross_attention/attn_out/kernel", "t", None),
+    ("decoder.block.{i}.layer.1.layer_norm.weight",
+     "decoder_layer_{i}/cross_norm/scale", "copy", None),
+    ("decoder.block.{i}.layer.2.DenseReluDense.wi.weight",
+     "decoder_layer_{i}/mlp/intermediate/kernel", "t", None),
+    ("decoder.block.{i}.layer.2.DenseReluDense.wo.weight",
+     "decoder_layer_{i}/mlp/mlp_out/kernel", "t", None),
+    ("decoder.block.{i}.layer.2.layer_norm.weight",
+     "decoder_layer_{i}/mlp_norm/scale", "copy", None),
+    ("decoder.final_layer_norm.weight", "decoder_norm/scale", "copy", None),
+]
+
+_FAMILY_RULES = {
+    "llama": _LLAMA_RULES,
+    "mixtral": _MIXTRAL_RULES,
+    "gpt2": _GPT2_RULES,
+    "bert": _BERT_RULES,
+    "t5": _T5_RULES,
+}
+
+# Top-level prefixes HF wrapper classes add around the base model; stripped
+# before matching so both BertModel and BertForSequenceClassification load.
+_STRIP_PREFIXES = {
+    "gpt2": ("transformer.",),
+    "bert": ("bert.",),
+    "llama": (),
+    "mixtral": (),
+    "t5": (),
+}
+
+# HF keys that are legitimately rule-less: tied copies and index buffers.
+_SKIPPABLE = re.compile(
+    r"(^|\.)(lm_head\.weight|predictions\..*|position_ids"
+    r"|encoder\.embed_tokens\.weight|decoder\.embed_tokens\.weight"
+    r"|attn\.(bias|masked_bias))$"
+)
+
+
+def _compile_rules(rules):
+    compiled = []
+    for hf_t, ours_t, op, alts in rules:
+        alt = "|".join(alts) if alts else None
+        hf_re = re.escape(hf_t).replace(r"\{i\}", r"(?P<i>\d+)")
+        ours_re = re.escape(ours_t).replace(r"\{i\}", r"(?P<i>\d+)")
+        if alt:
+            hf_re = hf_re.replace(r"\{p\}", f"(?P<p>{alt})")
+            ours_re = ours_re.replace(r"\{p\}", f"(?P<p>{alt})")
+        compiled.append((re.compile(f"^{hf_re}$"), re.compile(f"^{ours_re}$"),
+                         hf_t, ours_t, op))
+    return compiled
+
+
+_COMPILED = {fam: _compile_rules(rules) for fam, rules in _FAMILY_RULES.items()}
+
+
+def _apply_op(value: np.ndarray, op: str) -> np.ndarray:
+    if op == "t":
+        if value.ndim != 2:
+            raise ValueError(f"op 't' expects a 2D weight, got shape {value.shape}")
+        return np.ascontiguousarray(value.T)
+    return value
+
+
+def _fill(template: str, match: re.Match) -> str:
+    out = template
+    for name, val in match.groupdict().items():
+        out = out.replace("{" + name + "}", val)
+    return out
+
+
+def _nest(flat: dict[str, np.ndarray]) -> dict:
+    tree: dict = {}
+    for key, value in flat.items():
+        parts = key.split("/")
+        node = tree
+        for part in parts[:-1]:
+            node = node.setdefault(part, {})
+        node[parts[-1]] = value
+    return tree
+
+
+def _flatten(tree: dict, prefix: str = "") -> dict[str, np.ndarray]:
+    flat = {}
+    for key, value in tree.items():
+        path = f"{prefix}/{key}" if prefix else key
+        if isinstance(value, dict):
+            flat.update(_flatten(value, path))
+        else:
+            flat[path] = np.asarray(value)
+    return flat
+
+
+def detect_family(hf_config: dict) -> str:
+    """Family name from an HF ``config.json`` dict (its ``model_type``)."""
+    model_type = str(hf_config.get("model_type", "")).lower()
+    for fam in _FAMILY_RULES:
+        if model_type == fam:
+            return fam
+    raise ValueError(
+        f"unsupported model_type {model_type!r}; supported: {sorted(_FAMILY_RULES)}")
+
+
+def config_from_hf(hf_config: dict, family: Optional[str] = None):
+    """Build the matching ``accelerate_tpu.models`` config dataclass from an
+    HF ``config.json`` dict."""
+    family = family or detect_family(hf_config)
+    get = hf_config.get
+    if family in ("llama", "mixtral"):
+        from ..models.llama import LlamaConfig
+        from ..models.mixtral import MixtralConfig
+
+        kwargs = dict(
+            vocab_size=get("vocab_size", 32000),
+            hidden_size=get("hidden_size", 4096),
+            intermediate_size=get("intermediate_size", 11008),
+            num_hidden_layers=get("num_hidden_layers", 32),
+            num_attention_heads=get("num_attention_heads", 32),
+            num_key_value_heads=get("num_key_value_heads",
+                                    get("num_attention_heads", 32)),
+            max_position_embeddings=get("max_position_embeddings", 4096),
+            rms_norm_eps=get("rms_norm_eps", 1e-5),
+            rope_theta=get("rope_theta", 10000.0),
+            tie_word_embeddings=get("tie_word_embeddings", False),
+        )
+        if family == "llama":
+            return LlamaConfig(**kwargs)
+        return MixtralConfig(**kwargs,
+                             num_experts=get("num_local_experts", 8),
+                             top_k=get("num_experts_per_tok", 2))
+    if family == "gpt2":
+        from ..models.gpt2 import GPT2Config
+
+        return GPT2Config(
+            vocab_size=get("vocab_size", 50257),
+            hidden_size=get("n_embd", 768),
+            num_hidden_layers=get("n_layer", 12),
+            num_attention_heads=get("n_head", 12),
+            max_position_embeddings=get("n_positions", 1024),
+            layer_norm_eps=get("layer_norm_epsilon", 1e-5),
+        )
+    if family == "bert":
+        from ..models.bert import BertConfig
+
+        return BertConfig(
+            vocab_size=get("vocab_size", 30522),
+            hidden_size=get("hidden_size", 768),
+            num_hidden_layers=get("num_hidden_layers", 12),
+            num_attention_heads=get("num_attention_heads", 12),
+            intermediate_size=get("intermediate_size", 3072),
+            max_position_embeddings=get("max_position_embeddings", 512),
+            type_vocab_size=get("type_vocab_size", 2),
+            layer_norm_eps=get("layer_norm_eps", 1e-12),
+            num_labels=len(get("id2label", {0: 0, 1: 1})),
+        )
+    if family == "t5":
+        from ..models.t5 import T5Config
+
+        return T5Config(
+            vocab_size=get("vocab_size", 32128),
+            hidden_size=get("d_model", 512),
+            intermediate_size=get("d_ff", 2048),
+            num_layers=get("num_layers", 6),
+            num_heads=get("num_heads", 8),
+            head_dim=get("d_kv", 64),
+            relative_attention_num_buckets=get("relative_attention_num_buckets", 32),
+            relative_attention_max_distance=get("relative_attention_max_distance", 128),
+            layer_norm_eps=get("layer_norm_epsilon", 1e-6),
+            dropout_rate=get("dropout_rate", 0.1),
+        )
+    raise ValueError(f"unsupported family {family!r}")
+
+
+def _strip_prefix(key: str, family: str) -> str:
+    for prefix in _STRIP_PREFIXES.get(family, ()):
+        if key.startswith(prefix):
+            return key[len(prefix):]
+    return key
+
+
+def convert_hf_state_dict(
+    state_dict: dict, family: str, *, strict: bool = False,
+    to_numpy: Optional[Callable] = None,
+) -> dict:
+    """HF state dict -> our nested param pytree (numpy leaves).
+
+    ``state_dict`` values may be numpy arrays or anything with ``.numpy()``
+    (torch CPU tensors). Unmatched HF keys are skipped (tied heads, buffers)
+    unless ``strict``.
+    """
+    if family not in _COMPILED:
+        raise ValueError(f"unsupported family {family!r}; supported: {sorted(_COMPILED)}")
+    rules = _COMPILED[family]
+    flat: dict[str, np.ndarray] = {}
+    expert_parts: dict[str, dict[int, np.ndarray]] = {}
+
+    def as_np(v):
+        if to_numpy is not None:
+            return to_numpy(v)
+        if hasattr(v, "detach"):  # torch tensor without importing torch
+            return v.detach().cpu().numpy()
+        return np.asarray(v)
+
+    if family == "t5":
+        # Our T5 always ties the output head to shared_embedding
+        # (models/t5.py:237) and the rule table has no lm_head rule. An
+        # *untied* head (t5-v1.1 / flan-t5 style) must not be silently
+        # dropped — the converted model would produce wrong logits.
+        head = state_dict.get("lm_head.weight")
+        shared = state_dict.get("shared.weight")
+        if head is not None and (
+            shared is None or not np.array_equal(as_np(head), as_np(shared))
+        ):
+            raise ValueError(
+                "this T5 checkpoint has an untied lm_head (tie_word_embeddings="
+                "False, t5-v1.1/flan style), which the tied-head flax T5 model "
+                "cannot represent")
+
+    for raw_key, raw_value in state_dict.items():
+        key = _strip_prefix(raw_key, family)
+        if family == "mixtral":
+            em = _MIXTRAL_EXPERT_RE.match(key)
+            if em:
+                layer, expert, w = em.group(1), int(em.group(2)), em.group(3)
+                ours = f"layers_{layer}/mlp/experts/{_MIXTRAL_W_TO_NAME[w]}"
+                # HF per-expert Linear is (out, in); batched einsum wants
+                # (in, out) per expert -> transpose, then stack on E below.
+                expert_parts.setdefault(ours, {})[expert] = as_np(raw_value).T
+                continue
+        for hf_re, _, _, ours_t, op in rules:
+            match = hf_re.match(key)
+            if match:
+                flat[_fill(ours_t, match)] = _apply_op(as_np(raw_value), op)
+                break
+        else:
+            if strict and not _SKIPPABLE.search(key):
+                raise KeyError(f"no conversion rule for HF key {raw_key!r} ({family})")
+    for ours, parts in expert_parts.items():
+        # The router's output width is the authoritative expert count — a
+        # truncated shard set missing the *tail* experts would otherwise
+        # stack a silently-too-small tensor.
+        router_key = ours.rsplit("/experts/", 1)[0] + "/router"
+        n_experts = flat[router_key].shape[1] if router_key in flat else max(parts) + 1
+        missing = set(range(n_experts)) - set(parts)
+        if missing:
+            raise KeyError(f"missing experts {sorted(missing)} for {ours}")
+        flat[ours] = np.stack([parts[e] for e in sorted(parts)])
+    return _nest(flat)
+
+
+def export_hf_state_dict(params: dict, family: str, *, prefix: str = "") -> dict:
+    """Our param pytree -> flat HF-named state dict (numpy, torch layouts).
+
+    Inverse of :func:`convert_hf_state_dict`; raises on any param with no
+    rule so checkpoints cannot silently lose weights. ``prefix`` lets callers
+    re-add a wrapper scope (e.g. ``"transformer."`` for GPT-2)."""
+    if family not in _COMPILED:
+        raise ValueError(f"unsupported family {family!r}; supported: {sorted(_COMPILED)}")
+    rules = _COMPILED[family]
+    out: dict[str, np.ndarray] = {}
+    for key, value in _flatten(params).items():
+        if family == "mixtral" and re.match(r"^layers_\d+/mlp/experts/", key):
+            layer = re.search(r"layers_(\d+)", key).group(1)
+            name = key.rsplit("/", 1)[1]
+            w = {v: k for k, v in _MIXTRAL_W_TO_NAME.items()}[name]
+            for e in range(value.shape[0]):
+                hf_key = f"model.layers.{layer}.block_sparse_moe.experts.{e}.w{w}.weight"
+                out[prefix + hf_key] = np.ascontiguousarray(value[e].T)
+            continue
+        for _, ours_re, hf_t, _, op in rules:
+            match = ours_re.match(key)
+            if match:
+                out[prefix + _fill(hf_t, match)] = _apply_op(value, op)
+                break
+        else:
+            raise KeyError(f"no export rule for param {key!r} ({family})")
+    return out
+
+
+def load_hf_checkpoint(
+    checkpoint_dir: str, family: Optional[str] = None, config=None, dtype=None,
+):
+    """Load an HF-format checkpoint directory into (config, params).
+
+    Reads ``config.json`` (family autodetection + config build) and the
+    safetensors weights (single file, or sharded via
+    ``model.safetensors.index.json``) — no torch involved.
+    """
+    from safetensors import safe_open
+
+    from ..big_modeling import _checkpoint_shards
+
+    config_path = os.path.join(checkpoint_dir, "config.json")
+    hf_config = {}
+    if os.path.exists(config_path):
+        with open(config_path) as f:
+            hf_config = json.load(f)
+    if family is None:
+        family = detect_family(hf_config)
+    if config is None:
+        config = config_from_hf(hf_config, family)
+    state_dict = {}
+    for shard_path, keys in _checkpoint_shards(checkpoint_dir):
+        with safe_open(shard_path, framework="numpy") as f:
+            for key in keys:
+                state_dict[key] = f.get_tensor(key)
+    params = convert_hf_state_dict(state_dict, family)
+    if dtype is not None:
+        params = _nest({k: v.astype(dtype) for k, v in _flatten(params).items()})
+    return config, params

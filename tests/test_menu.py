@@ -68,6 +68,35 @@ class TestStepState:
         assert s.done and s.cancelled
 
 
+class TestReadKey:
+    """_read_key must use os.read on the raw fd: buffered stdin reads would
+    strand escape-sequence tails in the TextIOWrapper where select() can't
+    see them (every arrow would decode as bare ESC = cancel)."""
+
+    def _via_pipe(self, data: bytes) -> str:
+        import os
+
+        from accelerate_tpu.commands.menu import _read_key
+
+        r, w = os.pipe()
+        try:
+            os.write(w, data)
+            return _read_key(r)
+        finally:
+            os.close(r)
+            os.close(w)
+
+    def test_arrow_sequence_read_whole(self):
+        assert decode_key(self._via_pipe(b"\x1b[A")) == KEY_UP
+        assert decode_key(self._via_pipe(b"\x1b[B")) == KEY_DOWN
+
+    def test_plain_key(self):
+        assert self._via_pipe(b"j") == "j"
+
+    def test_bare_escape_is_cancel(self):
+        assert decode_key(self._via_pipe(b"\x1b")) == KEY_CANCEL
+
+
 class TestFallbackSelect:
     """Non-TTY path: numbered prompt over stdin."""
 
