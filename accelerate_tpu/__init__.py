@@ -41,6 +41,7 @@ from .big_modeling import (
     dispatch_model,
     init_empty_weights,
     load_checkpoint_and_dispatch,
+    load_hf_checkpoint_and_dispatch,
     load_checkpoint_in_model,
 )
 from .data_loader import NumpyDataLoader, prepare_data_loader, skip_first_batches

@@ -38,6 +38,11 @@ class LlamaConfig:
     max_position_embeddings: int = 4096
     rms_norm_eps: float = 1e-5
     rope_theta: float = 10000.0
+    # HF-style rope scaling dict, e.g. {"rope_type": "llama3", "factor": 8.0,
+    # "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+    # "original_max_position_embeddings": 8192} or {"rope_type": "linear",
+    # "factor": 2.0}. None = vanilla RoPE.
+    rope_scaling: Optional[dict] = None
     tie_word_embeddings: bool = False
     remat: bool = False
     use_flash_attention: bool = True
@@ -111,9 +116,40 @@ class RMSNorm(nn.Module):
         return (norm * scale).astype(dtype)
 
 
-def rotary_embedding(positions: jnp.ndarray, head_dim: int, theta: float, dtype=jnp.float32):
+def scale_rope_frequencies(inv_freq: jnp.ndarray, rope_scaling: dict) -> jnp.ndarray:
+    """Apply HF-style RoPE scaling to the base inverse frequencies.
+
+    "linear" divides every frequency by ``factor`` (position interpolation);
+    "llama3" (Llama 3.1+) keeps high frequencies, scales low frequencies by
+    ``factor``, and smoothly interpolates the band in between — the published
+    long-context recipe, vectorized with jnp.where so it stays jittable.
+    """
+    rope_type = rope_scaling.get("rope_type", rope_scaling.get("type", "default"))
+    if rope_type in ("default", None):
+        return inv_freq
+    factor = float(rope_scaling.get("factor", 1.0))
+    if rope_type == "linear":
+        return inv_freq / factor
+    if rope_type == "llama3":
+        low = float(rope_scaling.get("low_freq_factor", 1.0))
+        high = float(rope_scaling.get("high_freq_factor", 4.0))
+        original = float(rope_scaling.get("original_max_position_embeddings", 8192))
+        wavelen = 2.0 * jnp.pi / inv_freq
+        low_wavelen = original / low
+        high_wavelen = original / high
+        smooth = (original / wavelen - low) / (high - low)
+        interpolated = (1.0 - smooth) * inv_freq / factor + smooth * inv_freq
+        scaled = jnp.where(wavelen > low_wavelen, inv_freq / factor, interpolated)
+        return jnp.where(wavelen < high_wavelen, inv_freq, scaled)
+    raise NotImplementedError(f"rope_scaling type {rope_type!r} (supported: linear, llama3)")
+
+
+def rotary_embedding(positions: jnp.ndarray, head_dim: int, theta: float,
+                     dtype=jnp.float32, rope_scaling: Optional[dict] = None):
     """RoPE tables: returns (cos, sin) of shape [..., seq, head_dim//2]."""
     inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    if rope_scaling:
+        inv_freq = scale_rope_frequencies(inv_freq, rope_scaling)
     angles = positions[..., None].astype(jnp.float32) * inv_freq
     return jnp.cos(angles).astype(dtype), jnp.sin(angles).astype(dtype)
 
@@ -229,7 +265,8 @@ class LlamaAttention(nn.Module):
         k = dense(n_kv * hd, "k_proj")(x).reshape(B, S, n_kv, hd)
         v = dense(n_kv * hd, "v_proj")(x).reshape(B, S, n_kv, hd)
 
-        cos, sin = rotary_embedding(positions, hd, cfg.rope_theta, dtype=x.dtype)
+        cos, sin = rotary_embedding(positions, hd, cfg.rope_theta, dtype=x.dtype,
+                                    rope_scaling=cfg.rope_scaling)
         q = apply_rotary(q, cos, sin)
         k = apply_rotary(k, cos, sin)
 

@@ -289,10 +289,23 @@ def config_from_hf(hf_config: dict, family: Optional[str] = None):
     family = family or detect_family(hf_config)
     get = hf_config.get
     if family in ("llama", "mixtral"):
-        from ..models.llama import LlamaConfig
+        from ..models.llama import LlamaConfig, scale_rope_frequencies
         from ..models.mixtral import MixtralConfig
 
+        act = get("hidden_act", "silu")
+        if act not in ("silu", "swish"):
+            raise NotImplementedError(
+                f"hidden_act {act!r}: the flax {family} MLP is SwiGLU (silu)")
+        rope_scaling = get("rope_scaling") or None
+        if rope_scaling:
+            import jax.numpy as jnp
+
+            # Validate the scaling type NOW (supported: default/linear/llama3)
+            # rather than at first forward — an unrepresentable checkpoint
+            # must not convert silently (same policy as the T5 untied head).
+            scale_rope_frequencies(jnp.ones((2,), jnp.float32), rope_scaling)
         kwargs = dict(
+            rope_scaling=rope_scaling,
             vocab_size=get("vocab_size", 32000),
             hidden_size=get("hidden_size", 4096),
             intermediate_size=get("intermediate_size", 11008),
@@ -351,6 +364,25 @@ def config_from_hf(hf_config: dict, family: Optional[str] = None):
             dropout_rate=get("dropout_rate", 0.1),
         )
     raise ValueError(f"unsupported family {family!r}")
+
+
+def map_hf_key(key: str, family: str) -> Optional[tuple[str, str]]:
+    """Translate one HF tensor name to ``(our_dotted_name, op)``.
+
+    Returns None for rule-less keys (tied heads, buffers). This is the
+    per-tensor streaming interface used by the big-model loader
+    (big_modeling.load_checkpoint_in_model) so HF shards can be mapped
+    lazily without materializing the whole state dict; op "t" means the
+    tensor must be transposed when it is finally read.
+    """
+    if family not in _COMPILED:
+        raise ValueError(f"unsupported family {family!r}; supported: {sorted(_COMPILED)}")
+    key = _strip_prefix(key, family)
+    for hf_re, _, _, ours_t, op in _COMPILED[family]:
+        match = hf_re.match(key)
+        if match:
+            return _fill(ours_t, match).replace("/", "."), op
+    return None
 
 
 def _strip_prefix(key: str, family: str) -> str:
@@ -485,8 +517,8 @@ def load_hf_checkpoint(
     for shard_path, keys in _checkpoint_shards(checkpoint_dir):
         with safe_open(shard_path, framework="numpy") as f:
             for key in keys:
-                state_dict[key] = f.get_tensor(key)
-    params = convert_hf_state_dict(state_dict, family)
-    if dtype is not None:
-        params = _nest({k: v.astype(dtype) for k, v in _flatten(params).items()})
-    return config, params
+                tensor = f.get_tensor(key)
+                # Cast at read time: casting after conversion would hold
+                # three full-size copies of the model in host RAM at peak.
+                state_dict[key] = tensor if dtype is None else tensor.astype(dtype)
+    return config, convert_hf_state_dict(state_dict, family)

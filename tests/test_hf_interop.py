@@ -75,6 +75,40 @@ class TestLlama:
         hf, _, params = self._pair()
         _roundtrip(params, "llama", hf.state_dict())
 
+    def test_llama3_rope_scaling_parity(self):
+        """Llama-3.1-style checkpoints carry rope_scaling; logits must match
+        HF's scaled-RoPE implementation, not silently use vanilla RoPE."""
+        rope_scaling = {"rope_type": "llama3", "factor": 8.0,
+                        "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+                        "original_max_position_embeddings": 32}
+        hf_cfg = transformers.LlamaConfig(
+            vocab_size=128, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=64, rope_theta=10000.0,
+            rope_scaling=rope_scaling, tie_word_embeddings=False)
+        with torch.no_grad():
+            hf = transformers.LlamaForCausalLM(hf_cfg).eval()
+        cfg = config_from_hf(hf_cfg.to_dict())
+        assert cfg.rope_scaling is not None
+        cfg.use_flash_attention = False
+        from accelerate_tpu.models.llama import LlamaForCausalLM
+
+        params = convert_hf_state_dict(hf.state_dict(), "llama", strict=True)
+        ids = np.arange(40, dtype=np.int64).reshape(2, 20) % 128
+        ours = LlamaForCausalLM(cfg).apply({"params": params}, jnp.asarray(ids, jnp.int32))
+        with torch.no_grad():
+            theirs = hf(torch.from_numpy(ids)).logits
+        _logits_close(ours, theirs)
+
+    def test_unsupported_rope_type_rejected(self):
+        with pytest.raises(NotImplementedError, match="rope_scaling"):
+            config_from_hf({"model_type": "llama",
+                            "rope_scaling": {"rope_type": "yarn", "factor": 4.0}})
+
+    def test_unsupported_hidden_act_rejected(self):
+        with pytest.raises(NotImplementedError, match="hidden_act"):
+            config_from_hf({"model_type": "llama", "hidden_act": "gelu"})
+
     def test_checkpoint_dir_load(self, tmp_path):
         import json
 
@@ -221,6 +255,51 @@ class TestMixtral:
     def test_roundtrip(self):
         hf, _, params = self._pair()
         _roundtrip(params, "mixtral", hf.state_dict())
+
+
+class TestStreamedDispatch:
+    """HF checkpoint dir -> per-tensor lazy translation -> block-streaming
+    executor, against the torch model's logits."""
+
+    def _hf_dir(self, tmp_path):
+        import json
+
+        from safetensors.numpy import save_file
+
+        hf_cfg = transformers.LlamaConfig(
+            vocab_size=128, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=64, tie_word_embeddings=False)
+        with torch.no_grad():
+            hf = transformers.LlamaForCausalLM(hf_cfg).eval()
+        save_file({k: v.numpy() for k, v in hf.state_dict().items()},
+                  str(tmp_path / "model.safetensors"))
+        (tmp_path / "config.json").write_text(json.dumps(hf_cfg.to_dict()))
+        return hf
+
+    @pytest.mark.parametrize("tier", ["device", "cpu", "disk"])
+    def test_llama_parity_per_tier(self, tmp_path, tier):
+        from accelerate_tpu.big_modeling import load_hf_checkpoint_and_dispatch
+
+        hf = self._hf_dir(tmp_path)
+        device_map = {"": {"device": 0, "cpu": "cpu", "disk": "disk"}[tier]}
+        streamed, module = load_hf_checkpoint_and_dispatch(
+            str(tmp_path), device_map=device_map)
+        module.config.use_flash_attention = False
+        ids = np.arange(16, dtype=np.int64).reshape(2, 8) % 128
+        ours = streamed(jnp.asarray(ids, jnp.int32))
+        with torch.no_grad():
+            theirs = hf(torch.from_numpy(ids)).logits
+        _logits_close(ours, theirs)
+
+    def test_rejects_unsupported_family(self, tmp_path):
+        import json
+
+        (tmp_path / "config.json").write_text(json.dumps({"model_type": "bert"}))
+        from accelerate_tpu.big_modeling import load_hf_checkpoint_and_dispatch
+
+        with pytest.raises(ValueError, match="streamed dispatch supports"):
+            load_hf_checkpoint_and_dispatch(str(tmp_path))
 
 
 class TestErrors:
