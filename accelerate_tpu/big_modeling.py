@@ -734,25 +734,18 @@ def load_hf_checkpoint_and_dispatch(
     """
     import json as _json
 
-    from .utils.hf_interop import config_from_hf, detect_family, map_hf_key
+    from .utils.hf_interop import config_from_hf, detect_family, map_hf_key, model_from_config
 
     with open(os.path.join(checkpoint_dir, "config.json")) as f:
         hf_config = _json.load(f)
     family = detect_family(hf_config)
     if config is None:
         config = config_from_hf(hf_config, family)
-    if family == "llama":
-        from .models.llama import LlamaForCausalLM
-
-        module = LlamaForCausalLM(config)
-    elif family == "gpt2":
-        from .models.gpt2 import GPT2LMHeadModel
-
-        module = GPT2LMHeadModel(config)
-    else:
+    if family not in ("llama", "gpt2"):
         raise ValueError(
             f"streamed dispatch supports llama/gpt2 (got {family!r}); use "
             "utils.load_hf_checkpoint + dispatch_model for other families")
+    module = model_from_config(config, family)
 
     streamed = load_checkpoint_and_dispatch(
         module, checkpoint_dir, device_map=device_map, max_memory=max_memory,

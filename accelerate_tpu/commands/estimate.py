@@ -72,28 +72,30 @@ def _abstract_from_path(path: str):
         path = os.path.join(path, "config.json")
     if os.path.isfile(path) and path.endswith(".json"):
         import json
-
-        from ..models.llama import LlamaConfig, LlamaForCausalLM
-
         from pathlib import Path
 
-        cfg_dict = json.loads(Path(path).read_text())
-        model_type = cfg_dict.get("model_type")
-        if model_type not in ("llama", "mistral"):
-            raise ValueError(
-                f"config.json has model_type={model_type!r}; only llama-family configs "
-                "(llama, mistral) can be estimated from a config — pass the checkpoint's "
-                ".safetensors directory instead."
-            )
-        fields = (
-            "vocab_size", "hidden_size", "intermediate_size", "num_hidden_layers",
-            "num_attention_heads", "num_key_value_heads", "max_position_embeddings",
-            "tie_word_embeddings",
-        )
-        kwargs = {k: cfg_dict[k] for k in fields if k in cfg_dict}
-        from ..big_modeling import init_empty_weights
+        import numpy as np
 
-        return init_empty_weights(LlamaForCausalLM(LlamaConfig(**kwargs)))
+        from ..big_modeling import init_empty_weights
+        from ..utils.hf_interop import config_from_hf, detect_family
+
+        cfg_dict = json.loads(Path(path).read_text())
+        # mistral configs are llama-shaped; the weight bridge's families
+        # cover the rest (llama/mixtral/gpt2/bert/t5).
+        if cfg_dict.get("model_type") == "mistral":
+            cfg_dict = {**cfg_dict, "model_type": "llama"}
+        # Fidelity-only fields (they change *values*, never shapes): a size
+        # estimate must not refuse a yarn-scaled or gelu llama variant.
+        cfg_dict.pop("rope_scaling", None)
+        cfg_dict.pop("hidden_act", None)
+        family = detect_family(cfg_dict)
+        config = config_from_hf(cfg_dict, family)
+        from ..utils.hf_interop import model_from_config
+
+        module = model_from_config(config, family)
+        ids = np.zeros((1, 8), np.int32)
+        extra = (ids,) if family == "t5" else ()  # decoder_input_ids
+        return init_empty_weights(module, ids, *extra)
     return None
 
 

@@ -366,6 +366,33 @@ def config_from_hf(hf_config: dict, family: Optional[str] = None):
     raise ValueError(f"unsupported family {family!r}")
 
 
+def model_from_config(config, family: str):
+    """Instantiate the flax module matching a converted config — the single
+    family→model-class switch shared by the streamed HF dispatch
+    (big_modeling) and the memory estimator (commands/estimate)."""
+    if family == "llama":
+        from ..models.llama import LlamaForCausalLM
+
+        return LlamaForCausalLM(config)
+    if family == "mixtral":
+        from ..models.mixtral import MixtralForCausalLM
+
+        return MixtralForCausalLM(config)
+    if family == "gpt2":
+        from ..models.gpt2 import GPT2LMHeadModel
+
+        return GPT2LMHeadModel(config)
+    if family == "bert":
+        from ..models.bert import BertForSequenceClassification
+
+        return BertForSequenceClassification(config)
+    if family == "t5":
+        from ..models.t5 import T5ForConditionalGeneration
+
+        return T5ForConditionalGeneration(config)
+    raise ValueError(f"unsupported family {family!r}; supported: {sorted(_FAMILY_RULES)}")
+
+
 def map_hf_key(key: str, family: str) -> Optional[tuple[str, str]]:
     """Translate one HF tensor name to ``(our_dotted_name, op)``.
 

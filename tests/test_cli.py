@@ -175,6 +175,21 @@ class TestCLISubprocess:
         assert updated["mixed_precision"] == "fp16"  # old value preserved
         assert "obsolete_key" not in updated
 
+    def test_estimate_from_hf_configs(self, tmp_path):
+        import json
+
+        for name, cfg in {
+            "t5": {"model_type": "t5", "vocab_size": 128, "d_model": 16,
+                   "d_ff": 32, "d_kv": 4, "num_layers": 1, "num_heads": 4},
+            "gpt2": {"model_type": "gpt2", "vocab_size": 128, "n_embd": 16,
+                     "n_layer": 1, "n_head": 4, "n_positions": 32},
+        }.items():
+            p = tmp_path / f"{name}.json"
+            p.write_text(json.dumps(cfg))
+            out = _run_cli("estimate-memory", str(p), "--dtypes", "bfloat16")
+            assert out.returncode == 0, out.stderr
+            assert "training (Adam)" in out.stdout
+
     def test_launch_simple_passes_env(self, tmp_path):
         probe = tmp_path / "probe.py"
         probe.write_text("import os\nprint(os.environ['" + env_var("MESH_TP") + "'])\n"
