@@ -728,9 +728,9 @@ def load_hf_checkpoint_and_dispatch(
     refs into the original HF shards (the transpose happens at block-fetch
     time). Returns ``(streamed_model, module)``.
 
-    Supported: decoder families with block specs (llama, gpt2). Mixtral's
-    per-expert shards need stacking, which has no lazy form — load it with
-    utils.load_hf_checkpoint + dispatch_model(params=...) instead.
+    Supported: decoder families with block specs (llama, mistral, gpt2).
+    Mixtral's per-expert shards need stacking, which has no lazy form — load
+    it with utils.load_hf_checkpoint + dispatch_model(params=...) instead.
     """
     import json as _json
 
@@ -741,10 +741,10 @@ def load_hf_checkpoint_and_dispatch(
     family = detect_family(hf_config)
     if config is None:
         config = config_from_hf(hf_config, family)
-    if family not in ("llama", "gpt2"):
+    if family not in ("llama", "mistral", "gpt2"):
         raise ValueError(
-            f"streamed dispatch supports llama/gpt2 (got {family!r}); use "
-            "utils.load_hf_checkpoint + dispatch_model for other families")
+            f"streamed dispatch supports llama/mistral/gpt2 (got {family!r}); "
+            "use utils.load_hf_checkpoint + dispatch_model for other families")
     module = model_from_config(config, family)
 
     streamed = load_checkpoint_and_dispatch(

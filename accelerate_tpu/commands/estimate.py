@@ -77,25 +77,20 @@ def _abstract_from_path(path: str):
         import numpy as np
 
         from ..big_modeling import init_empty_weights
-        from ..utils.hf_interop import config_from_hf, detect_family
+        from ..utils.hf_interop import config_from_hf, detect_family, model_from_config
 
         cfg_dict = json.loads(Path(path).read_text())
-        # mistral configs are llama-shaped; the weight bridge's families
-        # cover the rest (llama/mixtral/gpt2/bert/t5).
-        if cfg_dict.get("model_type") == "mistral":
-            cfg_dict = {**cfg_dict, "model_type": "llama"}
         # Fidelity-only fields (they change *values*, never shapes): a size
         # estimate must not refuse a yarn-scaled or gelu llama variant.
         cfg_dict.pop("rope_scaling", None)
         cfg_dict.pop("hidden_act", None)
         family = detect_family(cfg_dict)
         config = config_from_hf(cfg_dict, family)
-        from ..utils.hf_interop import model_from_config
-
         module = model_from_config(config, family)
+        # init_empty_weights defaults to one (1, 8) int32 input; T5 needs
+        # decoder_input_ids as a second.
         ids = np.zeros((1, 8), np.int32)
-        extra = (ids,) if family == "t5" else ()  # decoder_input_ids
-        return init_empty_weights(module, ids, *extra)
+        return init_empty_weights(module, *((ids, ids) if family == "t5" else ()))
     return None
 
 

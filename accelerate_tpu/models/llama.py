@@ -43,6 +43,8 @@ class LlamaConfig:
     # "original_max_position_embeddings": 8192} or {"rope_type": "linear",
     # "factor": 2.0}. None = vanilla RoPE.
     rope_scaling: Optional[dict] = None
+    # Mistral-style local attention: each token sees only the last N keys.
+    sliding_window: Optional[int] = None
     tie_word_embeddings: bool = False
     remat: bool = False
     use_flash_attention: bool = True
@@ -163,9 +165,14 @@ def apply_rotary(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray):
 
 
 def multi_head_attention(
-    q, k, v, causal: bool = True, use_flash: bool = True, segment_ids=None, backend: str = "auto"
+    q, k, v, causal: bool = True, use_flash: bool = True, segment_ids=None,
+    backend: str = "auto", sliding_window: Optional[int] = None,
 ):
     """Dispatch between the attention implementations in ops/.
+
+    ``sliding_window`` (Mistral) always routes through the einsum path: the
+    flash kernel and the CP strategies compute full causal attention, which
+    would *silently widen* the receptive field.
 
     backend semantics:
       * 'auto'    — context-parallel (ring/Ulysses) when the ambient mesh has
@@ -187,6 +194,16 @@ def multi_head_attention(
         raise ValueError(
             f"unknown attention_backend {backend!r}; expected auto/ring/ulysses/flash/einsum"
         )
+    if sliding_window is not None and sliding_window < q.shape[1]:
+        # Only a window narrower than the sequence masks anything; when
+        # window >= seq, full causal attention is exact and the flash/CP
+        # fast paths below stay available (Mistral-7B sets window=4096, so
+        # typical prefills never pay the einsum path).
+        if backend in ("ring", "ulysses"):
+            raise ValueError(
+                f"attention_backend={backend!r} does not support sliding_window")
+        return _einsum_attention(q, k, v, causal=causal, segment_ids=segment_ids,
+                                 sliding_window=sliding_window)
     if backend in ("auto", "ring", "ulysses"):
         from ..ops.ring_attention import _axis_size, _resolve_mesh, context_parallel_attention
 
@@ -215,7 +232,7 @@ def init_kv_cache(config: "LlamaConfig", batch_size: int, max_len: int, dtype=jn
     )
 
 
-def _cached_attention(q, k_all, v_all, cache_pos, n_rep: int):
+def _cached_attention(q, k_all, v_all, cache_pos, n_rep: int, sliding_window=None):
     """Attention of q [B, S, H, hd] against the full cache [B, L, n_kv, hd].
 
     Valid keys are those at global index <= cache_pos + (local query index):
@@ -232,14 +249,17 @@ def _cached_attention(q, k_all, v_all, cache_pos, n_rep: int):
     qg = (q * hd**-0.5).astype(jnp.float32).reshape(B, S, H // n_rep, n_rep, hd)
     logits = jnp.einsum("bqgrd,bkgd->bgrqk", qg, k_all.astype(jnp.float32))
     q_pos = cache_pos + jnp.arange(S, dtype=jnp.int32)
-    mask = jnp.arange(L, dtype=jnp.int32)[None, :] <= q_pos[:, None]
+    k_pos = jnp.arange(L, dtype=jnp.int32)[None, :]
+    mask = k_pos <= q_pos[:, None]
+    if sliding_window is not None:
+        mask &= k_pos > q_pos[:, None] - sliding_window
     logits = jnp.where(mask[None, None, None], logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("bgrqk,bkgd->bqgrd", probs, v_all.astype(jnp.float32))
     return out.reshape(B, S, H, hd).astype(q.dtype)
 
 
-def update_kv_cache_and_attend(cache, q, k, v, cache_pos, n_rep: int):
+def update_kv_cache_and_attend(cache, q, k, v, cache_pos, n_rep: int, sliding_window=None):
     """Write this call's K/V into the cache at ``cache_pos`` and attend q
     against the whole buffer. Shared by every cached attention (Llama, GPT-2).
     Returns (out [B,S,H,hd], new_cache)."""
@@ -248,7 +268,8 @@ def update_kv_cache_and_attend(cache, q, k, v, cache_pos, n_rep: int):
         "k": jax.lax.dynamic_update_slice(cache["k"], k.astype(cache["k"].dtype), start),
         "v": jax.lax.dynamic_update_slice(cache["v"], v.astype(cache["v"].dtype), start),
     }
-    out = _cached_attention(q, new_cache["k"], new_cache["v"], cache_pos, n_rep)
+    out = _cached_attention(q, new_cache["k"], new_cache["v"], cache_pos, n_rep,
+                            sliding_window=sliding_window)
     return out, new_cache
 
 
@@ -272,7 +293,9 @@ class LlamaAttention(nn.Module):
 
         if cache is not None:
             # KV-cached path (generate).
-            out, new_cache = update_kv_cache_and_attend(cache, q, k, v, cache_pos, n_q // n_kv)
+            out, new_cache = update_kv_cache_and_attend(
+                cache, q, k, v, cache_pos, n_q // n_kv,
+                sliding_window=cfg.sliding_window)
             out = out.reshape(B, S, n_q * hd)
             return dense(cfg.hidden_size, "o_proj")(out), new_cache
 
@@ -282,7 +305,8 @@ class LlamaAttention(nn.Module):
             v = jnp.repeat(v, rep, axis=2)
 
         out = multi_head_attention(
-            q, k, v, causal=causal, use_flash=cfg.use_flash_attention, backend=cfg.attention_backend
+            q, k, v, causal=causal, use_flash=cfg.use_flash_attention,
+            backend=cfg.attention_backend, sliding_window=cfg.sliding_window,
         )
         out = out.reshape(B, S, n_q * hd)
         return dense(cfg.hidden_size, "o_proj")(out)

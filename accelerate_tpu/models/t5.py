@@ -34,6 +34,12 @@ class T5Config:
     relative_attention_max_distance: int = 128
     layer_norm_eps: float = 1e-6
     dropout_rate: float = 0.1
+    # "relu" (t5 v1.0: wi/wo) or "gated-gelu"/"gated-silu" (v1.1/flan:
+    # act(wi_0) * wi_1 then wo — one extra d_model x d_ff matrix per layer).
+    feed_forward_proj: str = "relu"
+    # v1.0 ties the head to the shared embedding (with a 1/sqrt(d) rescale);
+    # v1.1/flan use a separate lm_head and no rescale.
+    tie_word_embeddings: bool = True
     use_flash_attention: bool = False  # bias-ful attention: einsum path
 
     @classmethod
@@ -149,12 +155,19 @@ class T5MLP(nn.Module):
     @nn.compact
     def __call__(self, x):
         cfg = self.config
-        h = nn.Dense(cfg.intermediate_size, use_bias=False, name="intermediate",
-                     dtype=x.dtype, param_dtype=jnp.float32)(x)
-        h = jax.nn.relu(h)
+        dense = lambda feats, name: nn.Dense(
+            feats, use_bias=False, name=name, dtype=x.dtype, param_dtype=jnp.float32)
+        proj = cfg.feed_forward_proj
+        if proj.startswith("gated-"):
+            act = {"gated-gelu": jax.nn.gelu, "gated-silu": jax.nn.silu}[proj]
+            h = act(dense(cfg.intermediate_size, "intermediate")(x)) * dense(
+                cfg.intermediate_size, "intermediate_gate")(x)
+        elif proj == "relu":
+            h = jax.nn.relu(dense(cfg.intermediate_size, "intermediate")(x))
+        else:
+            raise NotImplementedError(f"feed_forward_proj {proj!r}")
         h = nn.Dropout(cfg.dropout_rate, deterministic=self.deterministic)(h)
-        return nn.Dense(cfg.hidden_size, use_bias=False, name="mlp_out",
-                        dtype=x.dtype, param_dtype=jnp.float32)(h)
+        return dense(cfg.hidden_size, "mlp_out")(h)
 
 
 class T5EncoderBlock(nn.Module):
@@ -233,9 +246,13 @@ class T5ForConditionalGeneration(nn.Module):
                 y, enc, decoder_attention_mask, attention_mask, dbias)
         y = drop(T5LayerNorm(cfg.layer_norm_eps, name="decoder_norm")(y))
 
-        # Tied head with T5's 1/sqrt(d) rescale.
-        kernel = self.variables["params"]["shared_embedding"]["embedding"]
-        return (y * (cfg.hidden_size ** -0.5)) @ kernel.T.astype(y.dtype)
+        if cfg.tie_word_embeddings:
+            # Tied head with T5's 1/sqrt(d) rescale (the rescale exists ONLY
+            # in the tied variant — v1.1/flan heads are plain projections).
+            kernel = self.variables["params"]["shared_embedding"]["embedding"]
+            return (y * (cfg.hidden_size ** -0.5)) @ kernel.T.astype(y.dtype)
+        return nn.Dense(cfg.vocab_size, use_bias=False, name="lm_head",
+                        dtype=y.dtype, param_dtype=jnp.float32)(y)
 
     def init_params(self, rng, batch_size=1, src_len=8, tgt_len=8):
         src = jnp.zeros((batch_size, src_len), jnp.int32)

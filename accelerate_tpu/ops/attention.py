@@ -32,14 +32,26 @@ def flash_attention_available(q=None) -> bool:
     return True
 
 
-def _einsum_attention(q, k, v, causal: bool, segment_ids=None):
-    """XLA-fused reference path: [B, S, H, D] -> [B, S, H, D]."""
+def _einsum_attention(q, k, v, causal: bool, segment_ids=None, sliding_window=None):
+    """XLA-fused reference path: [B, S, H, D] -> [B, S, H, D].
+
+    ``sliding_window=w`` (Mistral-style) restricts each query to the last
+    ``w`` keys: k_pos in (q_pos - w, q_pos]."""
     scale = q.shape[-1] ** -0.5
     logits = jnp.einsum("bqhd,bkhd->bhqk", q * scale, k)
     big_neg = jnp.finfo(logits.dtype).min
-    if causal:
+    if causal or sliding_window is not None:
         q_len, k_len = q.shape[1], k.shape[1]
-        mask = jnp.tril(jnp.ones((q_len, k_len), dtype=bool))
+        q_pos = jnp.arange(q_len)[:, None]
+        k_pos = jnp.arange(k_len)[None, :]
+        mask = jnp.ones((q_len, k_len), bool)
+        if causal:
+            mask &= k_pos <= q_pos
+        if sliding_window is not None:
+            # The documented window is k_pos in (q_pos - w, q_pos] — both
+            # bounds apply regardless of `causal`, so a non-causal caller
+            # still gets a window, never unmasked future keys.
+            mask &= (k_pos > q_pos - sliding_window) & (k_pos <= q_pos)
         logits = jnp.where(mask[None, None], logits, big_neg)
     if segment_ids is not None:
         seg_mask = segment_ids[:, :, None] == segment_ids[:, None, :]

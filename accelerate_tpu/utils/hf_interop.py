@@ -156,6 +156,12 @@ _T5_RULES = [
      "encoder_layer_{i}/attn_norm/scale", "copy", None),
     ("encoder.block.{i}.layer.1.DenseReluDense.wi.weight",
      "encoder_layer_{i}/mlp/intermediate/kernel", "t", None),
+    # Gated variants (t5-v1.1/flan): wi_0 is the activated projection, wi_1
+    # the linear gate (HF T5DenseGatedActDense).
+    ("encoder.block.{i}.layer.1.DenseReluDense.wi_0.weight",
+     "encoder_layer_{i}/mlp/intermediate/kernel", "t", None),
+    ("encoder.block.{i}.layer.1.DenseReluDense.wi_1.weight",
+     "encoder_layer_{i}/mlp/intermediate_gate/kernel", "t", None),
     ("encoder.block.{i}.layer.1.DenseReluDense.wo.weight",
      "encoder_layer_{i}/mlp/mlp_out/kernel", "t", None),
     ("encoder.block.{i}.layer.1.layer_norm.weight",
@@ -186,15 +192,25 @@ _T5_RULES = [
      "decoder_layer_{i}/cross_norm/scale", "copy", None),
     ("decoder.block.{i}.layer.2.DenseReluDense.wi.weight",
      "decoder_layer_{i}/mlp/intermediate/kernel", "t", None),
+    ("decoder.block.{i}.layer.2.DenseReluDense.wi_0.weight",
+     "decoder_layer_{i}/mlp/intermediate/kernel", "t", None),
+    ("decoder.block.{i}.layer.2.DenseReluDense.wi_1.weight",
+     "decoder_layer_{i}/mlp/intermediate_gate/kernel", "t", None),
     ("decoder.block.{i}.layer.2.DenseReluDense.wo.weight",
      "decoder_layer_{i}/mlp/mlp_out/kernel", "t", None),
     ("decoder.block.{i}.layer.2.layer_norm.weight",
      "decoder_layer_{i}/mlp_norm/scale", "copy", None),
     ("decoder.final_layer_norm.weight", "decoder_norm/scale", "copy", None),
+    # Untied head (v1.1/flan). For tied checkpoints the duplicate
+    # lm_head.weight is dropped by convert_hf_state_dict's T5 pre-pass.
+    ("lm_head.weight", "lm_head/kernel", "t", None),
 ]
 
 _FAMILY_RULES = {
     "llama": _LLAMA_RULES,
+    # Mistral checkpoints are llama-named tensor-for-tensor; the config adds
+    # sliding_window (handled in config_from_hf).
+    "mistral": _LLAMA_RULES,
     "mixtral": _MIXTRAL_RULES,
     "gpt2": _GPT2_RULES,
     "bert": _BERT_RULES,
@@ -288,7 +304,7 @@ def config_from_hf(hf_config: dict, family: Optional[str] = None):
     HF ``config.json`` dict."""
     family = family or detect_family(hf_config)
     get = hf_config.get
-    if family in ("llama", "mixtral"):
+    if family in ("llama", "mistral", "mixtral"):
         from ..models.llama import LlamaConfig, scale_rope_frequencies
         from ..models.mixtral import MixtralConfig
 
@@ -318,9 +334,12 @@ def config_from_hf(hf_config: dict, family: Optional[str] = None):
             rope_theta=get("rope_theta", 10000.0),
             tie_word_embeddings=get("tie_word_embeddings", False),
         )
+        if family == "mistral":
+            return LlamaConfig(**kwargs, sliding_window=get("sliding_window"))
         if family == "llama":
             return LlamaConfig(**kwargs)
         return MixtralConfig(**kwargs,
+                             sliding_window=get("sliding_window"),
                              num_experts=get("num_local_experts", 8),
                              top_k=get("num_experts_per_tok", 2))
     if family == "gpt2":
@@ -362,6 +381,8 @@ def config_from_hf(hf_config: dict, family: Optional[str] = None):
             relative_attention_max_distance=get("relative_attention_max_distance", 128),
             layer_norm_eps=get("layer_norm_epsilon", 1e-6),
             dropout_rate=get("dropout_rate", 0.1),
+            feed_forward_proj=get("feed_forward_proj", "relu"),
+            tie_word_embeddings=get("tie_word_embeddings", True),
         )
     raise ValueError(f"unsupported family {family!r}")
 
@@ -370,7 +391,7 @@ def model_from_config(config, family: str):
     """Instantiate the flax module matching a converted config — the single
     family→model-class switch shared by the streamed HF dispatch
     (big_modeling) and the memory estimator (commands/estimate)."""
-    if family == "llama":
+    if family in ("llama", "mistral"):
         from ..models.llama import LlamaForCausalLM
 
         return LlamaForCausalLM(config)
@@ -434,6 +455,7 @@ def convert_hf_state_dict(
     rules = _COMPILED[family]
     flat: dict[str, np.ndarray] = {}
     expert_parts: dict[str, dict[int, np.ndarray]] = {}
+    drop_keys: set[str] = set()
 
     def as_np(v):
         if to_numpy is not None:
@@ -443,21 +465,20 @@ def convert_hf_state_dict(
         return np.asarray(v)
 
     if family == "t5":
-        # Our T5 always ties the output head to shared_embedding
-        # (models/t5.py:237) and the rule table has no lm_head rule. An
-        # *untied* head (t5-v1.1 / flan-t5 style) must not be silently
-        # dropped — the converted model would produce wrong logits.
+        # Tied checkpoints carry lm_head.weight as a duplicate of
+        # shared.weight; the tied flax model has no lm_head param, so drop
+        # it. A genuinely *untied* head (t5-v1.1/flan) converts via the
+        # lm_head rule and requires config.tie_word_embeddings=False.
         head = state_dict.get("lm_head.weight")
         shared = state_dict.get("shared.weight")
-        if head is not None and (
-            shared is None or not np.array_equal(as_np(head), as_np(shared))
+        if head is not None and shared is not None and np.array_equal(
+            as_np(head), as_np(shared)
         ):
-            raise ValueError(
-                "this T5 checkpoint has an untied lm_head (tie_word_embeddings="
-                "False, t5-v1.1/flan style), which the tied-head flax T5 model "
-                "cannot represent")
+            drop_keys.add("lm_head.weight")
 
     for raw_key, raw_value in state_dict.items():
+        if raw_key in drop_keys:
+            continue
         key = _strip_prefix(raw_key, family)
         if family == "mixtral":
             em = _MIXTRAL_EXPERT_RE.match(key)
@@ -499,7 +520,11 @@ def export_hf_state_dict(params: dict, family: str, *, prefix: str = "") -> dict
         raise ValueError(f"unsupported family {family!r}; supported: {sorted(_COMPILED)}")
     rules = _COMPILED[family]
     out: dict[str, np.ndarray] = {}
-    for key, value in _flatten(params).items():
+    flat_params = _flatten(params)
+    # Gated T5 trees (intermediate_gate present) must export the activated
+    # projection as wi_0, not v1.0's wi — the first-match rule can't know.
+    t5_gated = family == "t5" and any("intermediate_gate" in k for k in flat_params)
+    for key, value in flat_params.items():
         if family == "mixtral" and re.match(r"^layers_\d+/mlp/experts/", key):
             layer = re.search(r"layers_(\d+)", key).group(1)
             name = key.rsplit("/", 1)[1]
@@ -511,7 +536,10 @@ def export_hf_state_dict(params: dict, family: str, *, prefix: str = "") -> dict
         for _, ours_re, hf_t, _, op in rules:
             match = ours_re.match(key)
             if match:
-                out[prefix + _fill(hf_t, match)] = _apply_op(value, op)
+                hf_key = _fill(hf_t, match)
+                if t5_gated and hf_key.endswith(".DenseReluDense.wi.weight"):
+                    hf_key = hf_key.replace(".wi.weight", ".wi_0.weight")
+                out[prefix + hf_key] = _apply_op(value, op)
                 break
         else:
             raise KeyError(f"no export rule for param {key!r} ({family})")

@@ -219,6 +219,32 @@ class TestT5:
         hf, _, params = self._pair()
         _roundtrip(params, "t5", hf.state_dict())
 
+    def test_flan_style_gated_untied_parity(self):
+        """t5-v1.1/flan: gated-gelu MLP + untied lm_head, no 1/sqrt(d)
+        head rescale."""
+        hf_cfg = transformers.T5Config(
+            vocab_size=100, d_model=32, d_ff=64, d_kv=8, num_layers=2,
+            num_heads=4, relative_attention_num_buckets=8,
+            relative_attention_max_distance=20, dropout_rate=0.0,
+            feed_forward_proj="gated-gelu", tie_word_embeddings=False)
+        with torch.no_grad():
+            hf = transformers.T5ForConditionalGeneration(hf_cfg).eval()
+        cfg = config_from_hf(hf_cfg.to_dict())
+        assert cfg.feed_forward_proj == "gated-gelu" and not cfg.tie_word_embeddings
+        cfg.dropout_rate = 0.0
+        from accelerate_tpu.models.t5 import T5ForConditionalGeneration
+
+        params = convert_hf_state_dict(hf.state_dict(), "t5", strict=True)
+        src = (np.arange(16, dtype=np.int64).reshape(2, 8) * 7) % 100
+        tgt = (np.arange(12, dtype=np.int64).reshape(2, 6) * 3) % 100
+        ours = T5ForConditionalGeneration(cfg).apply(
+            {"params": params}, jnp.asarray(src, jnp.int32), jnp.asarray(tgt, jnp.int32))
+        with torch.no_grad():
+            theirs = hf(input_ids=torch.from_numpy(src),
+                        decoder_input_ids=torch.from_numpy(tgt)).logits
+        _logits_close(ours, theirs)
+        _roundtrip(params, "t5", hf.state_dict())
+
 
 class TestMixtral:
     def _pair(self):
@@ -257,6 +283,59 @@ class TestMixtral:
         _roundtrip(params, "mixtral", hf.state_dict())
 
 
+class TestMistral:
+    """Mistral = llama naming + sliding-window attention. The window (4) is
+    narrower than the test sequence, so any implementation that silently
+    computes full causal attention fails the comparison."""
+
+    def _pair(self, window=4):
+        hf_cfg = transformers.MistralConfig(
+            vocab_size=128, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=64, sliding_window=window,
+            attention_dropout=0.0, tie_word_embeddings=False)
+        with torch.no_grad():
+            hf = transformers.MistralForCausalLM(hf_cfg).eval()
+        cfg = config_from_hf(hf_cfg.to_dict())
+        assert detect_family(hf_cfg.to_dict()) == "mistral"
+        assert cfg.sliding_window == window
+        from accelerate_tpu.models.llama import LlamaForCausalLM
+
+        params = convert_hf_state_dict(hf.state_dict(), "mistral", strict=True)
+        return hf, LlamaForCausalLM(cfg), params
+
+    def test_forward_parity_window_narrower_than_seq(self):
+        hf, model, params = self._pair(window=4)
+        ids = (np.arange(24, dtype=np.int64).reshape(2, 12) * 3) % 128
+        ours = model.apply({"params": params}, jnp.asarray(ids, jnp.int32))
+        with torch.no_grad():
+            theirs = hf(torch.from_numpy(ids)).logits
+        _logits_close(ours, theirs)
+
+    def test_window_changes_logits(self):
+        """Sanity: the window actually masks something on this input."""
+        hf, model, params = self._pair(window=4)
+        import dataclasses
+
+        wide = dataclasses.replace(model.config, sliding_window=None)
+        ids = jnp.asarray((np.arange(24).reshape(2, 12) * 3) % 128, jnp.int32)
+        narrow_out = model.apply({"params": params}, ids)
+        wide_out = type(model)(wide).apply({"params": params}, ids)
+        assert not np.allclose(np.asarray(narrow_out), np.asarray(wide_out), atol=1e-5)
+
+    def test_cached_generate_parity(self):
+        """KV-cached decode must apply the same window as prefill."""
+        from accelerate_tpu.generation import generate
+
+        hf, model, params = self._pair(window=4)
+        ids = np.arange(10, dtype=np.int64)[None] % 128
+        ours = generate(model, params, jnp.asarray(ids, jnp.int32), max_new_tokens=6)
+        with torch.no_grad():
+            theirs = hf.generate(torch.from_numpy(ids).long(), max_new_tokens=6,
+                                 do_sample=False)
+        assert np.asarray(ours)[0, 10:].tolist() == theirs[0, 10:].tolist()
+
+
 class TestStreamedDispatch:
     """HF checkpoint dir -> per-tensor lazy translation -> block-streaming
     executor, against the torch model's logits."""
@@ -292,6 +371,35 @@ class TestStreamedDispatch:
             theirs = hf(torch.from_numpy(ids)).logits
         _logits_close(ours, theirs)
 
+    def test_mistral_sliding_window_through_block_executor(self, tmp_path):
+        """The streamed executor must thread sliding_window into the cached
+        block passes — full causal attention here would silently widen the
+        receptive field (window 4 < prompt 10)."""
+        import json
+
+        from safetensors.numpy import save_file
+
+        from accelerate_tpu.big_modeling import load_hf_checkpoint_and_dispatch
+
+        hf_cfg = transformers.MistralConfig(
+            vocab_size=128, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=64, sliding_window=4,
+            attention_dropout=0.0, tie_word_embeddings=False)
+        with torch.no_grad():
+            hf = transformers.MistralForCausalLM(hf_cfg).eval()
+        save_file({k: v.numpy() for k, v in hf.state_dict().items()},
+                  str(tmp_path / "model.safetensors"))
+        (tmp_path / "config.json").write_text(json.dumps(hf_cfg.to_dict()))
+        streamed, module = load_hf_checkpoint_and_dispatch(
+            str(tmp_path), device_map={"": "cpu"})
+        ids = np.arange(10, dtype=np.int64)[None] % 128
+        ours = streamed.generate(jnp.asarray(ids, jnp.int32), max_new_tokens=6)
+        with torch.no_grad():
+            theirs = hf.generate(torch.from_numpy(ids).long(), max_new_tokens=6,
+                                 do_sample=False)
+        assert np.asarray(ours)[0, 10:].tolist() == theirs[0, 10:].tolist()
+
     def test_rejects_unsupported_family(self, tmp_path):
         import json
 
@@ -323,17 +431,17 @@ class TestErrors:
         with pytest.raises(KeyError, match="no export rule"):
             export_hf_state_dict({"mystery": {"kernel": np.ones((2, 2))}}, "llama")
 
-    def test_untied_t5_head_rejected(self):
+    def test_untied_t5_head_converts_to_lm_head(self):
         sd = {"shared.weight": np.ones((8, 4), np.float32),
               "lm_head.weight": np.full((8, 4), 2.0, np.float32)}
-        with pytest.raises(ValueError, match="untied lm_head"):
-            convert_hf_state_dict(sd, "t5")
+        params = convert_hf_state_dict(sd, "t5")
+        assert params["lm_head"]["kernel"].shape == (4, 8)
 
-    def test_tied_t5_head_accepted(self):
+    def test_tied_t5_head_dropped(self):
         shared = np.ones((8, 4), np.float32)
         params = convert_hf_state_dict(
             {"shared.weight": shared, "lm_head.weight": shared.copy()}, "t5")
-        assert "shared_embedding" in params
+        assert "shared_embedding" in params and "lm_head" not in params
 
     def test_missing_tail_expert_detected(self):
         # Router says 4 experts; only experts 0-2 present (truncated shards).
