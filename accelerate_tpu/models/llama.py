@@ -170,9 +170,11 @@ def multi_head_attention(
 ):
     """Dispatch between the attention implementations in ops/.
 
-    ``sliding_window`` (Mistral) always routes through the einsum path: the
-    flash kernel and the CP strategies compute full causal attention, which
-    would *silently widen* the receptive field.
+    ``sliding_window`` (Mistral) narrower than the sequence routes to the
+    *windowed* flash kernel (banded grid — O(S*w) compute and HBM traffic)
+    or the windowed einsum mask; the CP strategies compute full causal
+    attention and are rejected, since they would silently widen the
+    receptive field.
 
     backend semantics:
       * 'auto'    — context-parallel (ring/Ulysses) when the ambient mesh has
@@ -196,12 +198,16 @@ def multi_head_attention(
         )
     if sliding_window is not None and sliding_window < q.shape[1]:
         # Only a window narrower than the sequence masks anything; when
-        # window >= seq, full causal attention is exact and the flash/CP
-        # fast paths below stay available (Mistral-7B sets window=4096, so
-        # typical prefills never pay the einsum path).
+        # window >= seq, full causal attention is exact and every fast path
+        # below stays available (Mistral-7B sets window=4096, so typical
+        # prefills never branch here). A narrower window uses the windowed
+        # flash kernel — O(S * w) with whole K blocks skipped — or the
+        # windowed einsum mask as fallback.
         if backend in ("ring", "ulysses"):
             raise ValueError(
                 f"attention_backend={backend!r} does not support sliding_window")
+        if backend != "einsum" and use_flash and segment_ids is None and causal:
+            return flash_attention(q, k, v, causal=True, sliding_window=sliding_window)
         return _einsum_attention(q, k, v, causal=causal, segment_ids=segment_ids,
                                  sliding_window=sliding_window)
     if backend in ("auto", "ring", "ulysses"):

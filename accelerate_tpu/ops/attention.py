@@ -60,14 +60,20 @@ def _einsum_attention(q, k, v, causal: bool, segment_ids=None, sliding_window=No
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
-def flash_attention(q, k, v, causal: bool = True, block_q: int = 128, block_k: int = 128):
+def flash_attention(q, k, v, causal: bool = True, block_q: int = 128, block_k: int = 128,
+                    sliding_window=None):
     """Flash attention entry point.
 
     Args are [batch, seq, heads, head_dim]. Dispatches to the Pallas kernel
     on TPU; einsum fallback elsewhere.
     """
+    if sliding_window is not None and not causal:
+        # Validated here (not just in the kernel) so CPU-fallback runs fail
+        # identically to TPU runs instead of silently clamping causally.
+        raise ValueError("sliding_window requires causal=True")
     if not flash_attention_available(q):
-        return _einsum_attention(q, k, v, causal)
+        return _einsum_attention(q, k, v, causal, sliding_window=sliding_window)
     from .flash_pallas import pallas_flash_attention
 
-    return pallas_flash_attention(q, k, v, causal=causal, block_q=block_q, block_k=block_k)
+    return pallas_flash_attention(q, k, v, causal=causal, block_q=block_q, block_k=block_k,
+                                  sliding_window=sliding_window)

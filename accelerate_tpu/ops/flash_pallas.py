@@ -37,21 +37,75 @@ def _interpret() -> bool:
 # Forward
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
-                sm_scale: float, causal: bool, block_q: int, block_k: int, num_k_blocks: int):
-    qi = pl.program_id(2)
-    ki = pl.program_id(3)
+def _block_visible(qi, ki, block_q: int, block_k: int, causal: bool, window):
+    """Whether any (q_pos, k_pos) pair in block (qi, ki) is unmasked.
 
-    @pl.when(ki == 0)
+    Causal upper bound: the block's smallest k must not exceed its largest q.
+    Window lower bound (k_pos > q_pos - w): the block's largest k must
+    exceed its smallest q minus w."""
+    visible = True
+    if causal:
+        visible = ki * block_k <= (qi + 1) * block_q - 1
+    if window is not None:
+        visible &= ki * block_k + block_k - 1 > qi * block_q - window
+    return visible
+
+
+# Banded grids: with a window only ~(block + w) of the key axis is visible
+# per opposite-axis block, so the grid's inner dimension is shrunk to that
+# band and the BlockSpec index_map offsets it to the band's start. Skipped
+# blocks are then never DMA'd HBM->VMEM at all (a pl.when alone would still
+# fetch them) — true O(S * w) compute AND memory traffic. The band start is
+# clamped into range; clamp duplicates are rejected by the in-kernel
+# `*_band_valid` check before any compute.
+
+def _k_band(window, block_q: int, block_k: int, num_k: int):
+    """(band_size, k_start(qi)) for q-major kernels (fwd, dq)."""
+    if window is None:
+        return num_k, lambda qi: 0
+    band = min(num_k, (block_q + window - 1 + block_k - 1) // block_k + 1)
+    # First k block that can contain k_pos > qi*block_q - window.
+    return band, lambda qi: jnp.maximum(0, (qi * block_q - window + 1) // block_k)
+
+
+def _q_band(window, block_q: int, block_k: int, num_q: int):
+    """(band_size, q_start(ki)) for the k-major dk/dv kernel. With a causal
+    window, visible q for k block ki are q in [ki*bk, ki*bk + bk - 1 + w)."""
+    if window is None:
+        return num_q, lambda ki: 0
+    band = min(num_q, (block_k + window - 1 + block_q - 1) // block_q + 1)
+    return band, lambda ki: (ki * block_k) // block_q
+
+
+def _pair_mask(qi, ki, block_q: int, block_k: int, causal: bool, window):
+    """In-block [block_q, block_k] boolean mask (True = keep)."""
+    q_ids = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    k_ids = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    mask = jnp.ones((block_q, block_k), jnp.bool_)
+    if causal:
+        mask &= q_ids >= k_ids
+    if window is not None:
+        mask &= k_ids > q_ids - window
+    return mask
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
+                sm_scale: float, causal: bool, window, block_q: int, block_k: int,
+                num_k_blocks: int, band: int):
+    qi = pl.program_id(2)
+    kj = pl.program_id(3)
+    _, k_start = _k_band(window, block_q, block_k, num_k_blocks)
+    ki = k_start(qi) + kj
+    band_valid = ki < num_k_blocks
+    ki = jnp.minimum(ki, num_k_blocks - 1)
+
+    @pl.when(kj == 0)
     def _init():
         m_scr[:] = jnp.full_like(m_scr, NEG_INF)
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    # Causal: whole block is masked out when every k index > every q index.
-    should_compute = True
-    if causal:
-        should_compute = ki * block_k <= (qi + 1) * block_q - 1
+    should_compute = band_valid & _block_visible(qi, ki, block_q, block_k, causal, window)
 
     @pl.when(should_compute)
     def _compute():
@@ -62,10 +116,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * sm_scale  # [block_q, block_k]
 
-        if causal:
-            q_ids = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-            k_ids = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_ids >= k_ids, s, NEG_INF)
+        if causal or window is not None:
+            s = jnp.where(_pair_mask(qi, ki, block_q, block_k, causal, window), s, NEG_INF)
 
         m_prev = m_scr[:, :1]                       # [block_q, 1]
         l_prev = l_scr[:, :1]
@@ -81,7 +133,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
         )
         acc_scr[:] = acc_scr[:] * alpha + pv
 
-    @pl.when(ki == num_k_blocks - 1)
+    @pl.when(kj == band - 1)
     def _finalize():
         l = l_scr[:, :1]
         l_safe = jnp.where(l == 0.0, 1.0, l)
@@ -91,28 +143,32 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
         lse_ref[0, 0] = jnp.broadcast_to(lse, lse_ref.shape[2:])
 
 
-def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k):
+def _flash_fwd(q, k, v, sm_scale, causal, window, block_q, block_k):
     B, H, S_q, D = q.shape
     S_k = k.shape[2]
     num_q = S_q // block_q
     num_k = S_k // block_k
-    grid = (B, H, num_q, num_k)
+    band, k_start = _k_band(window, block_q, block_k, num_k)
+    grid = (B, H, num_q, band)
+
+    def k_index(b, h, qi, kj):
+        return (b, h, jnp.minimum(k_start(qi) + kj, num_k - 1), 0)
 
     kernel = functools.partial(
-        _fwd_kernel, sm_scale=sm_scale, causal=causal,
-        block_q=block_q, block_k=block_k, num_k_blocks=num_k,
+        _fwd_kernel, sm_scale=sm_scale, causal=causal, window=window,
+        block_q=block_q, block_k=block_k, num_k_blocks=num_k, band=band,
     )
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, 0)),
-            pl.BlockSpec((1, 1, block_k, D), lambda b, h, qi, ki: (b, h, ki, 0)),
-            pl.BlockSpec((1, 1, block_k, D), lambda b, h, qi, ki: (b, h, ki, 0)),
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, kj: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, D), k_index),
+            pl.BlockSpec((1, 1, block_k, D), k_index),
         ],
         out_specs=[
-            pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, 0)),
-            pl.BlockSpec((1, 1, block_q, LANES), lambda b, h, qi, ki: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, kj: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, LANES), lambda b, h, qi, kj: (b, h, qi, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B, H, S_q, D), q.dtype),
@@ -141,18 +197,21 @@ def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k):
 # ---------------------------------------------------------------------------
 
 def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-                     dk_scr, dv_scr, *, sm_scale, causal, block_q, block_k, num_q_blocks):
+                     dk_scr, dv_scr, *, sm_scale, causal, window, block_q, block_k,
+                     num_q_blocks, band: int):
     ki = pl.program_id(2)
-    qi = pl.program_id(3)
+    qj = pl.program_id(3)
+    _, q_start = _q_band(window, block_q, block_k, num_q_blocks)
+    qi = q_start(ki) + qj
+    band_valid = qi < num_q_blocks
+    qi = jnp.minimum(qi, num_q_blocks - 1)
 
-    @pl.when(qi == 0)
+    @pl.when(qj == 0)
     def _init():
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    should_compute = True
-    if causal:
-        should_compute = (qi + 1) * block_q - 1 >= ki * block_k
+    should_compute = band_valid & _block_visible(qi, ki, block_q, block_k, causal, window)
 
     @pl.when(should_compute)
     def _compute():
@@ -166,10 +225,8 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * sm_scale             # [bq, bk]
-        if causal:
-            q_ids = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-            k_ids = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_ids >= k_ids, s, NEG_INF)
+        if causal or window is not None:
+            s = jnp.where(_pair_mask(qi, ki, block_q, block_k, causal, window), s, NEG_INF)
         p = jnp.exp(s - lse)     # [bq, bk] fp32
 
         # dV += P^T dO
@@ -186,24 +243,26 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
 
-    @pl.when(qi == num_q_blocks - 1)
+    @pl.when(qj == band - 1)
     def _finalize():
         dk_ref[0, 0] = dk_scr[:].astype(dk_ref.dtype)
         dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr, *,
-                   sm_scale, causal, block_q, block_k, num_k_blocks):
+                   sm_scale, causal, window, block_q, block_k, num_k_blocks, band: int):
     qi = pl.program_id(2)
-    ki = pl.program_id(3)
+    kj = pl.program_id(3)
+    _, k_start = _k_band(window, block_q, block_k, num_k_blocks)
+    ki = k_start(qi) + kj
+    band_valid = ki < num_k_blocks
+    ki = jnp.minimum(ki, num_k_blocks - 1)
 
-    @pl.when(ki == 0)
+    @pl.when(kj == 0)
     def _init():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
-    should_compute = True
-    if causal:
-        should_compute = ki * block_k <= (qi + 1) * block_q - 1
+    should_compute = band_valid & _block_visible(qi, ki, block_q, block_k, causal, window)
 
     @pl.when(should_compute)
     def _compute():
@@ -217,10 +276,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_s
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * sm_scale
-        if causal:
-            q_ids = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-            k_ids = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_ids >= k_ids, s, NEG_INF)
+        if causal or window is not None:
+            s = jnp.where(_pair_mask(qi, ki, block_q, block_k, causal, window), s, NEG_INF)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -230,12 +287,12 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_s
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
 
-    @pl.when(ki == num_k_blocks - 1)
+    @pl.when(kj == band - 1)
     def _finalize():
         dq_ref[0, 0] = dq_scr[:].astype(dq_ref.dtype)
 
 
-def _flash_bwd(sm_scale, causal, block_q, block_k, residuals, d_out):
+def _flash_bwd(sm_scale, causal, window, block_q, block_k, residuals, d_out):
     q, k, v, out, lse = residuals
     do = d_out
     B, H, S_q, D = q.shape
@@ -247,23 +304,28 @@ def _flash_bwd(sm_scale, causal, block_q, block_k, residuals, d_out):
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1, keepdims=True)
     delta = jnp.broadcast_to(delta, (B, H, S_q, LANES))
 
+    band_q, q_start = _q_band(window, block_q, block_k, num_q)
+
+    def q_index(b, h, ki, qj):
+        return (b, h, jnp.minimum(q_start(ki) + qj, num_q - 1), 0)
+
     dkdv = pl.pallas_call(
         functools.partial(
-            _bwd_dkdv_kernel, sm_scale=sm_scale, causal=causal,
-            block_q=block_q, block_k=block_k, num_q_blocks=num_q,
+            _bwd_dkdv_kernel, sm_scale=sm_scale, causal=causal, window=window,
+            block_q=block_q, block_k=block_k, num_q_blocks=num_q, band=band_q,
         ),
-        grid=(B, H, num_k, num_q),
+        grid=(B, H, num_k, band_q),
         in_specs=[
-            pl.BlockSpec((1, 1, block_q, D), lambda b, h, ki, qi: (b, h, qi, 0)),
-            pl.BlockSpec((1, 1, block_k, D), lambda b, h, ki, qi: (b, h, ki, 0)),
-            pl.BlockSpec((1, 1, block_k, D), lambda b, h, ki, qi: (b, h, ki, 0)),
-            pl.BlockSpec((1, 1, block_q, D), lambda b, h, ki, qi: (b, h, qi, 0)),
-            pl.BlockSpec((1, 1, block_q, LANES), lambda b, h, ki, qi: (b, h, qi, 0)),
-            pl.BlockSpec((1, 1, block_q, LANES), lambda b, h, ki, qi: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, D), q_index),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, ki, qj: (b, h, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, ki, qj: (b, h, ki, 0)),
+            pl.BlockSpec((1, 1, block_q, D), q_index),
+            pl.BlockSpec((1, 1, block_q, LANES), q_index),
+            pl.BlockSpec((1, 1, block_q, LANES), q_index),
         ],
         out_specs=[
-            pl.BlockSpec((1, 1, block_k, D), lambda b, h, ki, qi: (b, h, ki, 0)),
-            pl.BlockSpec((1, 1, block_k, D), lambda b, h, ki, qi: (b, h, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, ki, qj: (b, h, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, ki, qj: (b, h, ki, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B, H, S_k, D), k.dtype),
@@ -280,21 +342,26 @@ def _flash_bwd(sm_scale, causal, block_q, block_k, residuals, d_out):
     )(q, k, v, do, lse, delta)
     dk, dv = dkdv
 
+    band_k, k_start = _k_band(window, block_q, block_k, num_k)
+
+    def k_index(b, h, qi, kj):
+        return (b, h, jnp.minimum(k_start(qi) + kj, num_k - 1), 0)
+
     dq = pl.pallas_call(
         functools.partial(
-            _bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
-            block_q=block_q, block_k=block_k, num_k_blocks=num_k,
+            _bwd_dq_kernel, sm_scale=sm_scale, causal=causal, window=window,
+            block_q=block_q, block_k=block_k, num_k_blocks=num_k, band=band_k,
         ),
-        grid=(B, H, num_q, num_k),
+        grid=(B, H, num_q, band_k),
         in_specs=[
-            pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, 0)),
-            pl.BlockSpec((1, 1, block_k, D), lambda b, h, qi, ki: (b, h, ki, 0)),
-            pl.BlockSpec((1, 1, block_k, D), lambda b, h, qi, ki: (b, h, ki, 0)),
-            pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, 0)),
-            pl.BlockSpec((1, 1, block_q, LANES), lambda b, h, qi, ki: (b, h, qi, 0)),
-            pl.BlockSpec((1, 1, block_q, LANES), lambda b, h, qi, ki: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, kj: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, D), k_index),
+            pl.BlockSpec((1, 1, block_k, D), k_index),
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, kj: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, LANES), lambda b, h, qi, kj: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, LANES), lambda b, h, qi, kj: (b, h, qi, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, 0)),
+        out_specs=pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, kj: (b, h, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((B, H, S_q, D), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
@@ -310,14 +377,14 @@ def _flash_bwd(sm_scale, causal, block_q, block_k, residuals, d_out):
 # custom_vjp wrapper
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_bhsd(q, k, v, sm_scale, causal, block_q, block_k):
-    out, _ = _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_bhsd(q, k, v, sm_scale, causal, window, block_q, block_k):
+    out, _ = _flash_fwd(q, k, v, sm_scale, causal, window, block_q, block_k)
     return out
 
 
-def _fwd_rule(q, k, v, sm_scale, causal, block_q, block_k):
-    out, lse = _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k)
+def _fwd_rule(q, k, v, sm_scale, causal, window, block_q, block_k):
+    out, lse = _flash_fwd(q, k, v, sm_scale, causal, window, block_q, block_k)
     return out, (q, k, v, out, lse)
 
 
@@ -325,14 +392,20 @@ _flash_bhsd.defvjp(_fwd_rule, _flash_bwd)
 
 
 def pallas_flash_attention(q, k, v, causal: bool = True, block_q: int = 128, block_k: int = 128,
-                           sm_scale: float | None = None):
-    """Public entry. q/k/v: [batch, seq, heads, head_dim] (models layout)."""
+                           sm_scale: float | None = None, sliding_window: int | None = None):
+    """Public entry. q/k/v: [batch, seq, heads, head_dim] (models layout).
+
+    ``sliding_window=w`` masks k_pos outside (q_pos - w, q_pos] and *skips*
+    fully-masked K blocks, so long-sequence local attention (Mistral) costs
+    O(S * w) instead of O(S^2)."""
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
+    if sliding_window is not None and not causal:
+        raise ValueError("sliding_window requires causal=True")
     S = q.shape[1]
     block_q = min(block_q, S)
     block_k = min(block_k, k.shape[1])
     # [B, S, H, D] -> [B, H, S, D]
     qt, kt, vt = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
-    out = _flash_bhsd(qt, kt, vt, sm_scale, causal, block_q, block_k)
+    out = _flash_bhsd(qt, kt, vt, sm_scale, causal, sliding_window, block_q, block_k)
     return jnp.swapaxes(out, 1, 2)
