@@ -39,11 +39,13 @@ import jax.numpy as jnp
 
 
 def supports_kv_cache(module) -> bool:
-    """True if this model family threads a KV cache (cache=/cache_pos=).
-    Single registry: big_modeling.cache_factory_for."""
+    """True if this model threads a KV cache: decoder-only families are
+    registered in big_modeling.cache_factory_for (the streamed executor's
+    registry); encoder-decoder families expose ``init_decode_cache`` +
+    ``mode="decode"`` (consumed by :func:`seq2seq_generate`)."""
     from .big_modeling import cache_factory_for
 
-    return cache_factory_for(module) is not None
+    return cache_factory_for(module) is not None or hasattr(module, "init_decode_cache")
 
 
 _generate_cache: dict = {}
@@ -79,33 +81,68 @@ def _make_selector(sampling):
     return select
 
 
+def _cache_key(module, *parts):
+    """Executable-cache key over the config's *field values* (the apply
+    computation depends only on them), not the module object: model configs
+    are plain mutable dataclasses and not hashable. None = uncacheable."""
+    import dataclasses
+
+    cfg = getattr(module, "config", None)
+    if cfg is None or not dataclasses.is_dataclass(cfg):
+        return None
+    return (type(module).__name__, dataclasses.astuple(cfg), *parts)
+
+
+def _cache_put(key, value):
+    if key is not None:
+        if len(_generate_cache) >= 64:  # bound growth; configs rarely churn
+            _generate_cache.pop(next(iter(_generate_cache)))
+        _generate_cache[key] = value
+    return value
+
+
+def _decode_scan(step_fn, select, first_tok, carry_extra, start_pos, done0_override,
+                 eos_token_id, num_steps: int, rng):
+    """Shared decode loop: scan ``num_steps`` single-token forwards.
+
+    ``step_fn(tok, extra, pos) -> (logits, extra)`` hides the family
+    difference (decoder-only cache vs seq2seq cache+cross_kv). EOS
+    semantics: sequences that emitted eos keep emitting it (ragged stop
+    inside a static-shape scan). Emits the *computed* token each step — the
+    scan runs num_steps times and first_tok supplies the head, so no
+    forward's output is ever discarded.
+    """
+    def body(carry, _):
+        tok, extra, pos, done, rng = carry
+        logits, extra = step_fn(tok, extra, pos)
+        rng, sub = jax.random.split(rng)
+        nxt = select(logits[:, -1], sub).astype(tok.dtype)
+        if eos_token_id is not None:
+            nxt = jnp.where(done, jnp.asarray(eos_token_id, tok.dtype), nxt)
+            done = done | (nxt == eos_token_id)
+        return (nxt, extra, pos + 1, done, rng), nxt
+
+    done0 = jnp.zeros((first_tok.shape[0],), bool)
+    if eos_token_id is not None:
+        done0 = first_tok == eos_token_id
+    if done0_override is not None:
+        done0 = done0_override
+    _, toks = jax.lax.scan(
+        body, (first_tok, carry_extra, start_pos, done0, rng), None, length=num_steps)
+    return jnp.concatenate([first_tok[:, None], toks.T], axis=1)
+
+
 def _compiled_generate(module, max_new_tokens: int, eos_token_id, cache_dtype,
                        sampling=None):
     """(prefill, decode) jitted pair for this (model config, length, eos,
     dtype) — cached so repeat generate calls reuse the same jitted function
     objects (and therefore jax.jit's executable cache) instead of retracing
-    fresh closures every call.
-
-    Keyed on the config's *field values* (the apply computation depends only
-    on them), not the module object: model configs are plain mutable
-    dataclasses and not hashable.
-    """
-    import dataclasses
-
-    cfg = getattr(module, "config", None)
-    key = None
-    if cfg is not None and dataclasses.is_dataclass(cfg):
-        key = (
-            type(module).__name__,
-            dataclasses.astuple(cfg),
-            max_new_tokens,
-            eos_token_id,
-            jnp.dtype(cache_dtype).name,
-            sampling,
-        )
-        hit = _generate_cache.get(key)
-        if hit is not None:
-            return hit
+    fresh closures every call."""
+    key = _cache_key(module, max_new_tokens, eos_token_id,
+                     jnp.dtype(cache_dtype).name, sampling)
+    hit = _generate_cache.get(key) if key is not None else None
+    if hit is not None:
+        return hit
 
     select = _make_selector(sampling)
 
@@ -119,35 +156,13 @@ def _compiled_generate(module, max_new_tokens: int, eos_token_id, cache_dtype,
         # (No donation: the final cache is discarded, not an output, so the
         # input buffers cannot alias anything — XLA reuses the scan carry
         # buffers in place regardless.)
-        def body(carry, _):
-            tok, cache, pos, done, rng = carry
-            logits, cache = module.apply(
-                {"params": params}, tok[:, None], cache=cache, cache_pos=pos
-            )
-            rng, sub = jax.random.split(rng)
-            nxt = select(logits[:, -1], sub).astype(tok.dtype)
-            if eos_token_id is not None:
-                nxt = jnp.where(done, jnp.asarray(eos_token_id, tok.dtype), nxt)
-                done = done | (nxt == eos_token_id)
-            # Emit the *computed* token: the scan runs max_new_tokens - 1
-            # steps and first_tok supplies the head, so no forward's output
-            # is ever discarded.
-            return (nxt, cache, pos + 1, done, rng), nxt
+        def step(tok, cache, pos):
+            return module.apply({"params": params}, tok[:, None], cache=cache, cache_pos=pos)
 
-        done0 = jnp.zeros((first_tok.shape[0],), bool)
-        if eos_token_id is not None:
-            done0 = first_tok == eos_token_id
-        (_, _, _, _, _), toks = jax.lax.scan(
-            body, (first_tok, cache, start_pos, done0, rng), None,
-            length=max_new_tokens - 1,
-        )
-        return jnp.concatenate([first_tok[:, None], toks.T], axis=1)
+        return _decode_scan(step, select, first_tok, cache, start_pos, None,
+                            eos_token_id, max_new_tokens - 1, rng)
 
-    if key is not None:
-        if len(_generate_cache) >= 64:  # bound growth; configs rarely churn
-            _generate_cache.pop(next(iter(_generate_cache)))
-        _generate_cache[key] = (prefill, decode)
-    return prefill, decode
+    return _cache_put(key, (prefill, decode))
 
 
 def _check_position_bound(module, total_len: int):
@@ -226,3 +241,87 @@ def greedy_generate(module, params, input_ids, max_new_tokens: int = 20,
     """Greedy alias of :func:`generate` (kept as the benchmark-stable name)."""
     return generate(module, params, input_ids, max_new_tokens=max_new_tokens,
                     eos_token_id=eos_token_id, cache_dtype=cache_dtype)
+
+
+def seq2seq_generate(
+    module,
+    params,
+    input_ids,
+    max_new_tokens: int = 20,
+    decoder_start_token_id: int = 0,
+    eos_token_id: Optional[int] = None,
+    attention_mask=None,
+    cache_dtype=None,
+    do_sample: bool = False,
+    temperature: float = 1.0,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
+    rng=None,
+):
+    """KV-cached encoder-decoder decoding (T5-style modules exposing
+    mode="encode"/"decode" and ``init_decode_cache``).
+
+    Structure: one jitted encoder pass, one jitted prefill (start token;
+    also computes each layer's encoder K/V projections exactly once), and
+    ONE ``lax.scan`` over the remaining steps reusing those projections —
+    per-token cost is O(1) in both the target length (self-attention cache)
+    and the source length (cross K/V never recomputed).
+
+    Returns [B, 1 + max_new_tokens] decoder ids (leading start token).
+    """
+    ids = jnp.asarray(input_ids)
+    B = ids.shape[0]
+    if max_new_tokens <= 0:
+        return jnp.full((B, 1), decoder_start_token_id, ids.dtype)
+    dtype = cache_dtype or jnp.bfloat16
+    sampling = (float(temperature), top_k, top_p) if do_sample else None
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+
+    encode, prefill, decode = _compiled_seq2seq(module, max_new_tokens, eos_token_id,
+                                                dtype, sampling)
+    enc = encode(params, ids, attention_mask)
+    # Capacity max_new_tokens: the last generated token is returned, never
+    # fed back, so the highest cache_pos written is max_new_tokens - 1.
+    cache = module.init_decode_cache(B, max_new_tokens, dtype)
+    start = jnp.full((B, 1), decoder_start_token_id, ids.dtype)
+    rng, pre_rng = jax.random.split(rng)
+    first_tok, cache, cross_kv = prefill(params, enc, attention_mask, start, cache, pre_rng)
+    new_toks = decode(params, enc, attention_mask, first_tok, cache, cross_kv, rng)
+    return jnp.concatenate([start, new_toks], axis=1)
+
+
+def _compiled_seq2seq(module, max_new_tokens: int, eos_token_id, cache_dtype, sampling):
+    """(encode, prefill, decode) jitted triple, cached like
+    :func:`_compiled_generate` so repeat calls never retrace."""
+    key = _cache_key(module, "seq2seq", max_new_tokens, eos_token_id,
+                     jnp.dtype(cache_dtype).name, sampling)
+    hit = _generate_cache.get(key) if key is not None else None
+    if hit is not None:
+        return hit
+
+    select = _make_selector(sampling)
+
+    @jax.jit
+    def encode(params, ids, mask):
+        return module.apply({"params": params}, ids, attention_mask=mask, mode="encode")
+
+    @jax.jit
+    def prefill(params, enc, mask, start_tok, cache, rng):
+        logits, cache, cross_kv = module.apply(
+            {"params": params}, decoder_input_ids=start_tok, attention_mask=mask,
+            mode="decode", encoder_out=enc, cache=cache, cache_pos=0)
+        return select(logits[:, -1], rng).astype(start_tok.dtype), cache, cross_kv
+
+    @jax.jit
+    def decode(params, enc, mask, first_tok, cache, cross_kv, rng):
+        def step(tok, cache, pos):
+            logits, cache, _ = module.apply(
+                {"params": params}, decoder_input_ids=tok[:, None], attention_mask=mask,
+                mode="decode", encoder_out=enc, cache=cache, cache_pos=pos,
+                cross_kv=cross_kv)
+            return logits, cache
+
+        return _decode_scan(step, select, first_tok, cache, jnp.asarray(1, jnp.int32),
+                            None, eos_token_id, max_new_tokens - 1, rng)
+
+    return _cache_put(key, (encode, prefill, decode))

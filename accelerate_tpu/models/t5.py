@@ -97,47 +97,89 @@ class T5Attention(nn.Module):
     has_relative_bias: bool = False
     deterministic: bool = True
 
-    @nn.compact
-    def __call__(self, x, kv=None, mask=None, position_bias=None):
-        """Self-attention when ``kv`` is None, cross-attention otherwise.
+    def _relative_bias(self, q_positions, k_len: int):
+        """[1, H, S_q, S_k] bias from the layer's bucket table for arbitrary
+        query positions (prefill uses 0..S-1, cached decode cache_pos..)."""
+        cfg = self.config
+        rel = jnp.arange(k_len)[None, :] - q_positions[:, None]
+        buckets = relative_position_bucket(
+            rel, bidirectional=not self.causal,
+            num_buckets=cfg.relative_attention_num_buckets,
+            max_distance=cfg.relative_attention_max_distance,
+        )
+        bias_table = nn.Embed(
+            cfg.relative_attention_num_buckets, cfg.num_heads,
+            name="relative_attention_bias", param_dtype=jnp.float32,
+        )
+        return bias_table(buckets).transpose(2, 0, 1)[None]
 
-        Returns (out, position_bias) — the bias is computed only by the
-        first layer of a stack (``has_relative_bias``) and shared onward,
-        exactly T5's layout.
+    @nn.compact
+    def __call__(self, x, kv=None, mask=None, position_bias=None,
+                 cache=None, cache_pos=None, cross_kv=None, return_cross_kv=False):
+        """Self-attention when ``kv``/``cross_kv`` are None, cross-attention
+        otherwise.
+
+        Returns (out, position_bias[, extra]) — the bias is computed only by
+        the first layer of a stack (``has_relative_bias``) and shared
+        onward, exactly T5's layout. KV-cached decode:
+
+        * self-attention: pass ``cache={"k","v"}`` buffers + ``cache_pos``;
+          ``extra`` is the updated cache. Causality is enforced against
+          absolute cache positions, and the relative bias is looked up for
+          the true query positions.
+        * cross-attention: pass ``cross_kv=(k, v)`` precomputed from the
+          encoder (or ``kv=enc, return_cross_kv=True`` once to obtain it as
+          ``extra``) — decode steps then skip the K/V projections entirely.
         """
         cfg = self.config
         B, S_q, _ = x.shape
-        source = x if kv is None else kv
-        S_k = source.shape[1]
         H, D = cfg.num_heads, cfg.head_dim
         dense = lambda feats, name: nn.Dense(
             feats, use_bias=False, name=name, dtype=x.dtype, param_dtype=jnp.float32
         )
         q = dense(H * D, "query")(x).reshape(B, S_q, H, D)
-        k = dense(H * D, "key")(source).reshape(B, S_k, H, D)
-        v = dense(H * D, "value")(source).reshape(B, S_k, H, D)
+
+        extra = None
+        big_neg = jnp.finfo(jnp.float32).min
+        if cross_kv is not None:
+            k, v = cross_kv
+        else:
+            source = x if kv is None else kv
+            S_k = source.shape[1]
+            k = dense(H * D, "key")(source).reshape(B, S_k, H, D)
+            v = dense(H * D, "value")(source).reshape(B, S_k, H, D)
+            if return_cross_kv:
+                extra = (k, v)
+
+        causal_mask = None
+        if cache is not None:
+            # Write the step's K/V at cache_pos and attend over the buffer.
+            start = (0, cache_pos, 0, 0)
+            cache = {
+                "k": jax.lax.dynamic_update_slice(cache["k"], k.astype(cache["k"].dtype), start),
+                "v": jax.lax.dynamic_update_slice(cache["v"], v.astype(cache["v"].dtype), start),
+            }
+            k, v = cache["k"].astype(x.dtype), cache["v"].astype(x.dtype)
+            extra = cache
+            q_positions = cache_pos + jnp.arange(S_q)
+            # Future cache slots (zeros) and future tokens are masked by
+            # absolute position, not the S_q x S_k triangle.
+            causal_mask = jnp.arange(k.shape[1])[None, :] <= q_positions[:, None]
+        elif self.causal:
+            q_positions = jnp.arange(S_q)
+            causal_mask = q_positions[:, None] >= jnp.arange(k.shape[1])[None, :]
+        else:
+            q_positions = jnp.arange(S_q)
 
         # T5 does NOT scale q by 1/sqrt(d) (folded into init).
         logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
 
         if position_bias is None and self.has_relative_bias:
-            rel = jnp.arange(S_k)[None, :] - jnp.arange(S_q)[:, None]
-            buckets = relative_position_bucket(
-                rel, bidirectional=not self.causal,
-                num_buckets=cfg.relative_attention_num_buckets,
-                max_distance=cfg.relative_attention_max_distance,
-            )
-            bias_table = nn.Embed(
-                cfg.relative_attention_num_buckets, H,
-                name="relative_attention_bias", param_dtype=jnp.float32,
-            )
-            position_bias = bias_table(buckets).transpose(2, 0, 1)[None]  # [1, H, S_q, S_k]
+            position_bias = self._relative_bias(q_positions, k.shape[1])
         if position_bias is not None:
             logits = logits + position_bias
 
-        big_neg = jnp.finfo(jnp.float32).min
-        if self.causal:
-            causal_mask = jnp.arange(S_q)[:, None] >= jnp.arange(S_k)[None, :]
+        if causal_mask is not None:
             logits = jnp.where(causal_mask[None, None], logits, big_neg)
         if mask is not None:
             logits = jnp.where(mask[:, None, None, :].astype(bool), logits, big_neg)
@@ -145,7 +187,10 @@ class T5Attention(nn.Module):
         probs = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
         probs = nn.Dropout(cfg.dropout_rate, deterministic=self.deterministic)(probs)
         out = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(B, S_q, H * D)
-        return dense(cfg.hidden_size, "attn_out")(out), position_bias
+        out = dense(cfg.hidden_size, "attn_out")(out)
+        if cache is not None or cross_kv is not None or return_cross_kv:
+            return out, position_bias, extra
+        return out, position_bias
 
 
 class T5MLP(nn.Module):
@@ -197,22 +242,43 @@ class T5DecoderBlock(nn.Module):
     deterministic: bool = True
 
     @nn.compact
-    def __call__(self, x, enc, self_mask=None, cross_mask=None, position_bias=None):
+    def __call__(self, x, enc, self_mask=None, cross_mask=None, position_bias=None,
+                 cache=None, cache_pos=None, cross_kv=None):
+        """Train path returns (x, position_bias). With ``cache`` it returns
+        (x, position_bias, new_cache, cross_kv) — cross_kv computed from
+        ``enc`` on the first (prefill) call and passed back verbatim after."""
         cfg = self.config
         det = self.deterministic
         drop = nn.Dropout(cfg.dropout_rate, deterministic=det)
-        attn, position_bias = T5Attention(
+        self_attn = T5Attention(
             cfg, causal=True, has_relative_bias=self.has_relative_bias,
-            deterministic=det, name="self_attention"
-        )(T5LayerNorm(cfg.layer_norm_eps, name="self_norm")(x), mask=self_mask,
-          position_bias=position_bias)
+            deterministic=det, name="self_attention")
+        normed = T5LayerNorm(cfg.layer_norm_eps, name="self_norm")(x)
+        if cache is not None:
+            attn, position_bias, new_cache = self_attn(
+                normed, mask=self_mask, position_bias=position_bias,
+                cache=cache, cache_pos=cache_pos)
+        else:
+            attn, position_bias = self_attn(normed, mask=self_mask,
+                                            position_bias=position_bias)
+            new_cache = None
         x = x + drop(attn)
-        cross, _ = T5Attention(cfg, causal=False, deterministic=det, name="cross_attention")(
-            T5LayerNorm(cfg.layer_norm_eps, name="cross_norm")(x), kv=enc, mask=cross_mask
-        )
+
+        cross_attn = T5Attention(cfg, causal=False, deterministic=det, name="cross_attention")
+        cross_in = T5LayerNorm(cfg.layer_norm_eps, name="cross_norm")(x)
+        if cache is not None:
+            if cross_kv is None:
+                cross, _, cross_kv = cross_attn(cross_in, kv=enc, mask=cross_mask,
+                                                return_cross_kv=True)
+            else:
+                cross, _, _ = cross_attn(cross_in, mask=cross_mask, cross_kv=cross_kv)
+        else:
+            cross, _ = cross_attn(cross_in, kv=enc, mask=cross_mask)
         x = x + drop(cross)
         x = x + drop(T5MLP(cfg, deterministic=det, name="mlp")(
             T5LayerNorm(cfg.layer_norm_eps, name="mlp_norm")(x)))
+        if cache is not None:
+            return x, position_bias, new_cache, cross_kv
         return x, position_bias
 
 
@@ -220,39 +286,74 @@ class T5ForConditionalGeneration(nn.Module):
     config: T5Config
 
     @nn.compact
-    def __call__(self, input_ids, decoder_input_ids, attention_mask=None,
-                 decoder_attention_mask=None, deterministic=True):
+    def __call__(self, input_ids=None, decoder_input_ids=None, attention_mask=None,
+                 decoder_attention_mask=None, deterministic=True, mode="train",
+                 encoder_out=None, cache=None, cache_pos=None, cross_kv=None):
+        """mode="train" (default): full teacher-forced forward -> logits.
+        mode="encode": encoder only -> [B, S_enc, D] hidden states.
+        mode="decode": KV-cached decoder step over ``encoder_out`` ->
+        (logits, new_cache, cross_kv). The first decode call (prefill,
+        cross_kv=None) computes each layer's encoder K/V projections once;
+        later steps reuse them and touch only the new tokens.
+        """
         cfg = self.config
         drop = nn.Dropout(cfg.dropout_rate, deterministic=deterministic)
         embed = nn.Embed(cfg.vocab_size, cfg.hidden_size, name="shared_embedding",
                          param_dtype=jnp.float32)
 
-        # Encoder stack: relative bias from layer 0, shared onward.
-        x = drop(embed(input_ids))
-        bias = None
-        for i in range(cfg.num_layers):
-            x, bias = T5EncoderBlock(cfg, has_relative_bias=(i == 0),
-                                     deterministic=deterministic,
-                                     name=f"encoder_layer_{i}")(x, attention_mask, bias)
-        enc = drop(T5LayerNorm(cfg.layer_norm_eps, name="encoder_norm")(x))
+        enc = encoder_out
+        if mode in ("train", "encode"):
+            # Encoder stack: relative bias from layer 0, shared onward.
+            x = drop(embed(input_ids))
+            bias = None
+            for i in range(cfg.num_layers):
+                x, bias = T5EncoderBlock(cfg, has_relative_bias=(i == 0),
+                                         deterministic=deterministic,
+                                         name=f"encoder_layer_{i}")(x, attention_mask, bias)
+            enc = drop(T5LayerNorm(cfg.layer_norm_eps, name="encoder_norm")(x))
+            if mode == "encode":
+                return enc
 
         # Decoder stack.
+        decoding = mode == "decode"
         y = drop(embed(decoder_input_ids))
         dbias = None
+        new_caches, new_cross = [], []
         for i in range(cfg.num_layers):
-            y, dbias = T5DecoderBlock(cfg, has_relative_bias=(i == 0),
-                                      deterministic=deterministic,
-                                      name=f"decoder_layer_{i}")(
-                y, enc, decoder_attention_mask, attention_mask, dbias)
+            block = T5DecoderBlock(cfg, has_relative_bias=(i == 0),
+                                   deterministic=deterministic,
+                                   name=f"decoder_layer_{i}")
+            if decoding:
+                y, dbias, layer_cache, layer_ckv = block(
+                    y, enc, decoder_attention_mask, attention_mask, dbias,
+                    cache=cache[i], cache_pos=cache_pos,
+                    cross_kv=None if cross_kv is None else cross_kv[i])
+                new_caches.append(layer_cache)
+                new_cross.append(layer_ckv)
+            else:
+                y, dbias = block(y, enc, decoder_attention_mask, attention_mask, dbias)
         y = drop(T5LayerNorm(cfg.layer_norm_eps, name="decoder_norm")(y))
 
         if cfg.tie_word_embeddings:
             # Tied head with T5's 1/sqrt(d) rescale (the rescale exists ONLY
             # in the tied variant — v1.1/flan heads are plain projections).
             kernel = self.variables["params"]["shared_embedding"]["embedding"]
-            return (y * (cfg.hidden_size ** -0.5)) @ kernel.T.astype(y.dtype)
-        return nn.Dense(cfg.vocab_size, use_bias=False, name="lm_head",
-                        dtype=y.dtype, param_dtype=jnp.float32)(y)
+            logits = (y * (cfg.hidden_size ** -0.5)) @ kernel.T.astype(y.dtype)
+        else:
+            logits = nn.Dense(cfg.vocab_size, use_bias=False, name="lm_head",
+                              dtype=y.dtype, param_dtype=jnp.float32)(y)
+        if decoding:
+            return logits, tuple(new_caches), tuple(new_cross)
+        return logits
+
+    def init_decode_cache(self, batch_size: int, max_len: int, dtype=jnp.bfloat16):
+        """Per-decoder-layer self-attention KV buffers [B, max_len, H, D]."""
+        cfg = self.config
+        shape = (batch_size, max_len, cfg.num_heads, cfg.head_dim)
+        return tuple(
+            {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+            for _ in range(cfg.num_layers)
+        )
 
     def init_params(self, rng, batch_size=1, src_len=8, tgt_len=8):
         src = jnp.zeros((batch_size, src_len), jnp.int32)

@@ -53,6 +53,7 @@ class TestLlama:
             vocab_size=128, hidden_size=32, intermediate_size=64,
             num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
             max_position_embeddings=64, rms_norm_eps=1e-5, tie_word_embeddings=False)
+        torch.manual_seed(0)
         with torch.no_grad():
             hf = transformers.LlamaForCausalLM(hf_cfg).eval()
         cfg = config_from_hf(hf_cfg.to_dict())
@@ -86,6 +87,7 @@ class TestLlama:
             num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
             max_position_embeddings=64, rope_theta=10000.0,
             rope_scaling=rope_scaling, tie_word_embeddings=False)
+        torch.manual_seed(0)
         with torch.no_grad():
             hf = transformers.LlamaForCausalLM(hf_cfg).eval()
         cfg = config_from_hf(hf_cfg.to_dict())
@@ -131,6 +133,7 @@ class TestGPT2:
         hf_cfg = transformers.GPT2Config(
             vocab_size=96, n_embd=32, n_layer=2, n_head=4, n_positions=64,
             resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0)
+        torch.manual_seed(0)
         with torch.no_grad():
             hf = transformers.GPT2LMHeadModel(hf_cfg).eval()
         cfg = config_from_hf(hf_cfg.to_dict())
@@ -161,6 +164,7 @@ class TestBert:
             max_position_embeddings=64, type_vocab_size=2,
             hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
             num_labels=3)
+        torch.manual_seed(0)
         with torch.no_grad():
             hf = transformers.BertForSequenceClassification(hf_cfg).eval()
         cfg = config_from_hf(hf_cfg.to_dict())
@@ -195,6 +199,7 @@ class TestT5:
             num_heads=4, relative_attention_num_buckets=8,
             relative_attention_max_distance=20, dropout_rate=0.0,
             feed_forward_proj="relu", tie_word_embeddings=True)
+        torch.manual_seed(0)
         with torch.no_grad():
             hf = transformers.T5ForConditionalGeneration(hf_cfg).eval()
         cfg = config_from_hf(hf_cfg.to_dict())
@@ -227,6 +232,7 @@ class TestT5:
             num_heads=4, relative_attention_num_buckets=8,
             relative_attention_max_distance=20, dropout_rate=0.0,
             feed_forward_proj="gated-gelu", tie_word_embeddings=False)
+        torch.manual_seed(0)
         with torch.no_grad():
             hf = transformers.T5ForConditionalGeneration(hf_cfg).eval()
         cfg = config_from_hf(hf_cfg.to_dict())
@@ -255,6 +261,7 @@ class TestMixtral:
             max_position_embeddings=64, rms_norm_eps=1e-5,
             router_jitter_noise=0.0, attention_dropout=0.0,
             tie_word_embeddings=False)
+        torch.manual_seed(0)
         with torch.no_grad():
             hf = transformers.MixtralForCausalLM(hf_cfg).eval()
         cfg = config_from_hf(hf_cfg.to_dict())
@@ -283,6 +290,76 @@ class TestMixtral:
         _roundtrip(params, "mixtral", hf.state_dict())
 
 
+class TestT5Generate:
+    """Cached encoder-decoder decode vs HF greedy generate — validates the
+    decoder self-attention cache, the absolute-position relative bias, and
+    the precomputed cross K/V in one shot."""
+
+    def _make(self, **cfg_over):
+        base = dict(
+            vocab_size=100, d_model=32, d_ff=64, d_kv=8, num_layers=2,
+            num_heads=4, relative_attention_num_buckets=8,
+            relative_attention_max_distance=20, dropout_rate=0.0,
+            feed_forward_proj="relu", tie_word_embeddings=True,
+            decoder_start_token_id=0, eos_token_id=1, pad_token_id=0)
+        base.update(cfg_over)
+        hf_cfg = transformers.T5Config(**base)
+        torch.manual_seed(0)
+        with torch.no_grad():
+            hf = transformers.T5ForConditionalGeneration(hf_cfg).eval()
+        cfg = config_from_hf(hf_cfg.to_dict())
+        cfg.dropout_rate = 0.0
+        from accelerate_tpu.models.t5 import T5ForConditionalGeneration
+
+        params = convert_hf_state_dict(hf.state_dict(), "t5", strict=True)
+        return hf, T5ForConditionalGeneration(cfg), params
+
+    @pytest.mark.parametrize("variant", ["tied-relu", "flan"])
+    def test_cached_generate_matches_hf(self, variant):
+        from accelerate_tpu.generation import seq2seq_generate
+
+        over = {} if variant == "tied-relu" else dict(
+            feed_forward_proj="gated-gelu", tie_word_embeddings=False)
+        hf, model, params = self._make(**over)
+        src = (np.arange(16, dtype=np.int64).reshape(2, 8) * 7) % 100
+        ours = np.asarray(seq2seq_generate(
+            model, params, jnp.asarray(src, jnp.int32), max_new_tokens=7,
+            decoder_start_token_id=0, eos_token_id=1, cache_dtype=jnp.float32))
+        with torch.no_grad():
+            # Explicit all-ones mask: src contains token 0, which HF's
+            # generate would otherwise treat as padding (pad_token_id=0).
+            theirs = hf.generate(torch.from_numpy(src),
+                                 attention_mask=torch.ones_like(torch.from_numpy(src)),
+                                 max_new_tokens=7, do_sample=False).numpy()
+        # Compare up to and including the first EOS: past it HF pads with
+        # pad_token while ours repeats EOS (both are "stopped").
+        for row_ours, row_hf in zip(ours, theirs):
+            hf_eos = np.where(row_hf == 1)[0]
+            stop = (hf_eos[0] + 1) if hf_eos.size else len(row_hf)
+            np.testing.assert_array_equal(row_ours[:stop], row_hf[:stop])
+
+    def test_cached_matches_full_forward(self):
+        """Per-step cached logits == teacher-forced full forward logits."""
+        hf, model, params = self._make()
+        src = jnp.asarray((np.arange(8)[None] * 5) % 100, jnp.int32)
+        dec = jnp.asarray([[0, 42, 17, 63]], jnp.int32)
+        full = model.apply({"params": params}, src, dec)
+        enc = model.apply({"params": params}, src, mode="encode")
+        cache = model.init_decode_cache(1, 4, jnp.float32)
+        logits0, cache, ckv = model.apply(
+            {"params": params}, decoder_input_ids=dec[:, :1], mode="decode",
+            encoder_out=enc, cache=cache, cache_pos=0)
+        steps = [logits0]
+        for t in range(1, 4):
+            lt, cache, _ = model.apply(
+                {"params": params}, decoder_input_ids=dec[:, t:t + 1], mode="decode",
+                encoder_out=enc, cache=cache, cache_pos=t, cross_kv=ckv)
+            steps.append(lt)
+        stepwise = jnp.concatenate(steps, axis=1)
+        np.testing.assert_allclose(np.asarray(stepwise), np.asarray(full),
+                                   atol=2e-4, rtol=2e-3)
+
+
 class TestMistral:
     """Mistral = llama naming + sliding-window attention. The window (4) is
     narrower than the test sequence, so any implementation that silently
@@ -294,6 +371,7 @@ class TestMistral:
             num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
             max_position_embeddings=64, sliding_window=window,
             attention_dropout=0.0, tie_word_embeddings=False)
+        torch.manual_seed(0)
         with torch.no_grad():
             hf = transformers.MistralForCausalLM(hf_cfg).eval()
         cfg = config_from_hf(hf_cfg.to_dict())
@@ -329,7 +407,10 @@ class TestMistral:
 
         hf, model, params = self._pair(window=4)
         ids = np.arange(10, dtype=np.int64)[None] % 128
-        ours = generate(model, params, jnp.asarray(ids, jnp.int32), max_new_tokens=6)
+        # fp32 cache: HF decodes in fp32, and bf16 KV rounding can flip
+        # greedy ties on a random tiny model.
+        ours = generate(model, params, jnp.asarray(ids, jnp.int32), max_new_tokens=6,
+                        cache_dtype=jnp.float32)
         with torch.no_grad():
             theirs = hf.generate(torch.from_numpy(ids).long(), max_new_tokens=6,
                                  do_sample=False)
@@ -349,6 +430,7 @@ class TestStreamedDispatch:
             vocab_size=128, hidden_size=32, intermediate_size=64,
             num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
             max_position_embeddings=64, tie_word_embeddings=False)
+        torch.manual_seed(0)
         with torch.no_grad():
             hf = transformers.LlamaForCausalLM(hf_cfg).eval()
         save_file({k: v.numpy() for k, v in hf.state_dict().items()},
@@ -386,6 +468,7 @@ class TestStreamedDispatch:
             num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
             max_position_embeddings=64, sliding_window=4,
             attention_dropout=0.0, tie_word_embeddings=False)
+        torch.manual_seed(0)
         with torch.no_grad():
             hf = transformers.MistralForCausalLM(hf_cfg).eval()
         save_file({k: v.numpy() for k, v in hf.state_dict().items()},
