@@ -81,6 +81,16 @@ def _make_selector(sampling):
     return select
 
 
+def _freeze(obj):
+    """Recursively convert dict/list config fields (e.g. rope_scaling) to
+    hashable tuples so they can live in a cache key."""
+    if isinstance(obj, dict):
+        return tuple(sorted((k, _freeze(v)) for k, v in obj.items()))
+    if isinstance(obj, (list, tuple)):
+        return tuple(_freeze(v) for v in obj)
+    return obj
+
+
 def _cache_key(module, *parts):
     """Executable-cache key over the config's *field values* (the apply
     computation depends only on them), not the module object: model configs
@@ -90,7 +100,7 @@ def _cache_key(module, *parts):
     cfg = getattr(module, "config", None)
     if cfg is None or not dataclasses.is_dataclass(cfg):
         return None
-    return (type(module).__name__, dataclasses.astuple(cfg), *parts)
+    return (type(module).__name__, _freeze(dataclasses.astuple(cfg)), *parts)
 
 
 def _cache_put(key, value):
@@ -101,7 +111,7 @@ def _cache_put(key, value):
     return value
 
 
-def _decode_scan(step_fn, select, first_tok, carry_extra, start_pos, done0_override,
+def _decode_scan(step_fn, select, first_tok, carry_extra, start_pos,
                  eos_token_id, num_steps: int, rng):
     """Shared decode loop: scan ``num_steps`` single-token forwards.
 
@@ -125,8 +135,6 @@ def _decode_scan(step_fn, select, first_tok, carry_extra, start_pos, done0_overr
     done0 = jnp.zeros((first_tok.shape[0],), bool)
     if eos_token_id is not None:
         done0 = first_tok == eos_token_id
-    if done0_override is not None:
-        done0 = done0_override
     _, toks = jax.lax.scan(
         body, (first_tok, carry_extra, start_pos, done0, rng), None, length=num_steps)
     return jnp.concatenate([first_tok[:, None], toks.T], axis=1)
@@ -159,7 +167,7 @@ def _compiled_generate(module, max_new_tokens: int, eos_token_id, cache_dtype,
         def step(tok, cache, pos):
             return module.apply({"params": params}, tok[:, None], cache=cache, cache_pos=pos)
 
-        return _decode_scan(step, select, first_tok, cache, start_pos, None,
+        return _decode_scan(step, select, first_tok, cache, start_pos,
                             eos_token_id, max_new_tokens - 1, rng)
 
     return _cache_put(key, (prefill, decode))
@@ -213,6 +221,14 @@ def generate(
 
     factory = cache_factory_for(module)
     if factory is None:
+        if hasattr(module, "init_decode_cache"):
+            # Encoder-decoder family: same public entry point, seq2seq
+            # mechanics (so supports_kv_cache => generate works).
+            return seq2seq_generate(
+                module, params, input_ids, max_new_tokens=max_new_tokens,
+                eos_token_id=eos_token_id, cache_dtype=cache_dtype,
+                do_sample=do_sample, temperature=temperature, top_k=top_k,
+                top_p=top_p, rng=rng)
         raise TypeError(
             f"{type(module).__name__} does not thread a KV cache; use the model's "
             "full-forward generate or add cache support to the family "
@@ -322,6 +338,6 @@ def _compiled_seq2seq(module, max_new_tokens: int, eos_token_id, cache_dtype, sa
             return logits, cache
 
         return _decode_scan(step, select, first_tok, cache, jnp.asarray(1, jnp.int32),
-                            None, eos_token_id, max_new_tokens - 1, rng)
+                            eos_token_id, max_new_tokens - 1, rng)
 
     return _cache_put(key, (encode, prefill, decode))

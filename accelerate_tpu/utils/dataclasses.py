@@ -450,6 +450,89 @@ class DeepSpeedPlugin(KwargsHandler):
         if self.offload_param_device is None:
             self.offload_param_device = zero.get("offload_param", {}).get("device", "none")
 
+    def _schedule_fn(self):
+        """step -> lr callable from the ``"scheduler"`` section, or None.
+        Supports DeepSpeed's WarmupLR (linear warmup then constant) and
+        WarmupDecayLR (warmup then linear decay to zero)."""
+        cfg = (self.hf_ds_config or {}).get("scheduler")
+        if not cfg:
+            return None
+        p = {k: v for k, v in cfg.get("params", {}).items() if v != "auto"}
+        lo = float(p.get("warmup_min_lr", 0.0))
+        hi = float(p.get("warmup_max_lr", 1e-3))
+        warmup = int(p.get("warmup_num_steps", 0))
+        typ = str(cfg.get("type", "WarmupLR")).lower()
+        # Branchless (jnp.where) because the schedule doubles as the optax
+        # learning rate inside the jitted update, where ``step`` is traced.
+        import jax.numpy as jnp
+
+        if typ == "warmuplr":
+            def schedule(step):
+                ramp = lo + (hi - lo) * step / max(warmup, 1)
+                return jnp.where(step >= warmup, hi, ramp)
+        elif typ == "warmupdecaylr":
+            total = int(p.get("total_num_steps", max(warmup, 1)))
+
+            def schedule(step):
+                ramp = lo + (hi - lo) * step / max(warmup, 1)
+                frac = (total - step) / max(total - warmup, 1)
+                decayed = hi * jnp.clip(frac, 0.0, 1.0)
+                return jnp.where(step < warmup, ramp,
+                                 hi if total <= warmup else decayed)
+        else:
+            raise ValueError(f"unsupported DeepSpeed scheduler type {cfg.get('type')!r}")
+        return schedule
+
+    def build_optimizer(self):
+        """optax transform from the config's ``"optimizer"`` section, or None.
+
+        The reference's DummyOptim workflow (utils/deepspeed.py:225-270):
+        the user passes a placeholder and the engine builds the real
+        optimizer from the json. Here the json builds the optax chain
+        directly — pass the result to ``Accelerator.prepare``. When the
+        config also carries a ``"scheduler"`` section, its schedule becomes
+        the optax learning rate (jax-idiomatic: LR follows the update count
+        inside the executable), so the warmup/decay actually applies.
+        "auto" values fall back to DeepSpeed's defaults.
+        """
+        import optax
+
+        cfg = (self.hf_ds_config or {}).get("optimizer")
+        if not cfg:
+            return None
+        p = {k: v for k, v in cfg.get("params", {}).items() if v != "auto"}
+        lr = self._schedule_fn() or float(p.get("lr", 1e-3))
+        betas = p.get("betas", (0.9, 0.999))
+        eps = float(p.get("eps", 1e-8))
+        wd = float(p.get("weight_decay", 0.0))
+        typ = str(cfg.get("type", "AdamW")).lower()
+        if typ in ("adam", "adamw"):
+            # DeepSpeed's FusedAdam defaults to adam_w_mode=True, so "Adam"
+            # with weight_decay is decoupled AdamW there too; plain adam only
+            # when no decay is requested.
+            if typ == "adam" and wd == 0.0:
+                return optax.adam(lr, b1=float(betas[0]), b2=float(betas[1]), eps=eps)
+            return optax.adamw(lr, b1=float(betas[0]), b2=float(betas[1]), eps=eps,
+                               weight_decay=wd)
+        if typ == "sgd":
+            return optax.sgd(lr, momentum=float(p.get("momentum", 0.0)))
+        if typ == "lion":
+            return optax.lion(lr, b1=float(betas[0]), b2=float(betas[1]),
+                              weight_decay=wd)
+        raise ValueError(f"unsupported DeepSpeed optimizer type {cfg.get('type')!r}")
+
+    def build_scheduler(self):
+        """LRScheduler over the config's schedule, or None (the
+        DummyScheduler workflow). Reporting surface only
+        (``get_last_lr``): when built via :meth:`build_optimizer`, the same
+        schedule is already the optimizer's learning rate."""
+        schedule = self._schedule_fn()
+        if schedule is None:
+            return None
+        from ..scheduler import LRScheduler
+
+        return LRScheduler(schedule)
+
     def to_fsdp_plugin(self) -> FullyShardedDataParallelPlugin:
         """Translate the ZeRO stage onto an FSDP sharding policy."""
         if self.zero_stage >= 3:

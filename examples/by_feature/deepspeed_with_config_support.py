@@ -30,6 +30,12 @@ DEFAULT_DS_CONFIG = {
         "stage": 2,
         "offload_optimizer": {"device": "cpu"},
     },
+    # Optimizer/scheduler from the config — the reference's DummyOptim /
+    # DummyScheduler workflow (build_optimizer()/build_scheduler()).
+    "optimizer": {"type": "AdamW", "params": {"lr": 1e-3, "weight_decay": 0.01}},
+    "scheduler": {"type": "WarmupLR",
+                  "params": {"warmup_min_lr": 0.0, "warmup_max_lr": 1e-3,
+                             "warmup_num_steps": 5}},
     "bf16": {"enabled": True},
 }
 
@@ -42,15 +48,26 @@ def training_function(args):
         json.dump(DEFAULT_DS_CONFIG, tmp)
         tmp.close()
         config_file = tmp.name
+    ds_plugin = DeepSpeedPlugin(config_file=config_file)
     accelerator = Accelerator(
         mixed_precision=args.mixed_precision,
-        deepspeed_plugin=DeepSpeedPlugin(config_file=config_file),
+        deepspeed_plugin=ds_plugin,
     )
     model_def, params = build_model(args.seed)
     train_dl, eval_dl = get_dataloaders(args.batch_size)
-    model, optimizer, train_dl, eval_dl = accelerator.prepare(
-        Model(model_def, params), optax.adamw(args.lr), train_dl, eval_dl
-    )
+    # Config-supplied optimizer if the json has one (DummyOptim workflow —
+    # the scheduler section's schedule is baked in as the optax LR); the
+    # user's own optax chain otherwise.
+    tx = ds_plugin.build_optimizer() or optax.adamw(args.lr)
+    scheduler = ds_plugin.build_scheduler()  # reporting surface (get_last_lr)
+    if scheduler is not None:
+        model, optimizer, train_dl, eval_dl, scheduler = accelerator.prepare(
+            Model(model_def, params), tx, train_dl, eval_dl, scheduler
+        )
+    else:
+        model, optimizer, train_dl, eval_dl = accelerator.prepare(
+            Model(model_def, params), tx, train_dl, eval_dl
+        )
     step = accelerator.compile_train_step(classification_loss(model_def.apply))
 
     accelerator.print(
@@ -61,9 +78,13 @@ def training_function(args):
         losses = []
         for batch in train_dl:
             metrics = step(make_global_batch(batch, accelerator.mesh))
+            if scheduler is not None:
+                scheduler.step()
             losses.append(float(metrics["loss"]))
         acc = evaluate(accelerator, model, eval_dl)
-        accelerator.print(f"epoch {epoch}: loss {np.mean(losses):.4f} acc {acc:.3f}")
+        lr_note = (f" lr {scheduler.get_last_lr()[0]:.2e}"
+                   if scheduler is not None else "")
+        accelerator.print(f"epoch {epoch}: loss {np.mean(losses):.4f} acc {acc:.3f}{lr_note}")
 
 
 def main():

@@ -102,6 +102,19 @@ class TestLlama:
             theirs = hf(torch.from_numpy(ids)).logits
         _logits_close(ours, theirs)
 
+    def test_generate_with_rope_scaling_config(self):
+        """Dict-valued config fields (rope_scaling) must not break the
+        generate executable cache (hashability)."""
+        from accelerate_tpu.generation import generate
+        from accelerate_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+        cfg = LlamaConfig.tiny(use_flash_attention=False,
+                               rope_scaling={"rope_type": "linear", "factor": 2.0})
+        model = LlamaForCausalLM(cfg)
+        params = model.init_params(jax.random.PRNGKey(0))
+        out = generate(model, params, jnp.zeros((1, 4), jnp.int32), max_new_tokens=3)
+        assert out.shape == (1, 7)
+
     def test_unsupported_rope_type_rejected(self):
         with pytest.raises(NotImplementedError, match="rope_scaling"):
             config_from_hf({"model_type": "llama",
@@ -337,6 +350,17 @@ class TestT5Generate:
             hf_eos = np.where(row_hf == 1)[0]
             stop = (hf_eos[0] + 1) if hf_eos.size else len(row_hf)
             np.testing.assert_array_equal(row_ours[:stop], row_hf[:stop])
+
+    def test_generate_routes_seq2seq(self):
+        """supports_kv_cache(t5) is True, so generate() must work on it —
+        it delegates to the seq2seq mechanics."""
+        from accelerate_tpu.generation import generate, supports_kv_cache
+
+        hf, model, params = self._make()
+        assert supports_kv_cache(model)
+        src = jnp.asarray((np.arange(8)[None] * 5) % 100, jnp.int32)
+        out = generate(model, params, src, max_new_tokens=4)
+        assert out.shape == (1, 5)  # start token + 4 generated
 
     def test_cached_matches_full_forward(self):
         """Per-step cached logits == teacher-forced full forward logits."""

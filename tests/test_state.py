@@ -107,6 +107,75 @@ def test_deepspeed_plugin_translation():
     assert state.mesh.shape["fsdp"] == 8
 
 
+def test_deepspeed_config_builds_optimizer_and_scheduler():
+    """The DummyOptim/DummyScheduler workflow (reference:
+    utils/deepspeed.py:225-270): optimizer + scheduler come from the json."""
+    import numpy as np
+    import optax
+
+    from accelerate_tpu.utils import DeepSpeedPlugin
+
+    ds = DeepSpeedPlugin(hf_ds_config={
+        "zero_optimization": {"stage": 2},
+        "optimizer": {"type": "AdamW",
+                      "params": {"lr": 2e-3, "betas": [0.9, 0.95],
+                                 "eps": 1e-8, "weight_decay": 0.01}},
+        "scheduler": {"type": "WarmupDecayLR",
+                      "params": {"warmup_min_lr": 0.0, "warmup_max_lr": 2e-3,
+                                 "warmup_num_steps": 10, "total_num_steps": 100}},
+    })
+    tx = ds.build_optimizer()
+    assert tx is not None
+    params = {"w": np.ones((4,), np.float32)}
+    state = tx.init(params)  # a real optax transform
+    assert state is not None
+
+    sched = ds.build_scheduler()
+    assert sched.get_last_lr() == [0.0]
+    for _ in range(10):
+        sched.step()
+    assert abs(sched.get_last_lr()[0] - 2e-3) < 1e-9
+    for _ in range(90):
+        sched.step()
+    assert sched.get_last_lr()[0] == 0.0
+
+    assert DeepSpeedPlugin(hf_ds_config={"zero_optimization": {}}).build_optimizer() is None
+    # "auto" values fall back to defaults instead of crashing.
+    auto = DeepSpeedPlugin(hf_ds_config={
+        "optimizer": {"type": "Adam", "params": {"lr": "auto"}}})
+    assert auto.build_optimizer() is not None
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="unsupported DeepSpeed optimizer"):
+        DeepSpeedPlugin(hf_ds_config={"optimizer": {"type": "Lamb"}}).build_optimizer()
+
+    # The scheduler section's schedule IS the optax learning rate: at update
+    # 0 the warmup LR is 0, so the first update must be a no-op.
+    import jax.numpy as jnp
+
+    grads = {"w": np.full((4,), 0.5, np.float32)}
+    updates, _ = tx.update(grads, tx.init(params), params)
+    assert float(jnp.abs(updates["w"]).max()) == 0.0
+
+
+def test_deepspeed_adam_with_weight_decay_is_decoupled():
+    """DeepSpeed's FusedAdam defaults to adam_w_mode=True — "Adam" with
+    weight_decay must decay, not silently drop it."""
+    import numpy as np
+
+    from accelerate_tpu.utils import DeepSpeedPlugin
+
+    ds = DeepSpeedPlugin(hf_ds_config={
+        "optimizer": {"type": "Adam",
+                      "params": {"lr": 0.1, "weight_decay": 1.0}}})
+    tx = ds.build_optimizer()
+    params = {"w": np.ones((2,), np.float32)}
+    zero_grads = {"w": np.zeros((2,), np.float32)}
+    updates, _ = tx.update(zero_grads, tx.init(params), params)
+    # With decoupled decay, zero grads still shrink params.
+    assert float(np.asarray(updates["w"]).max()) < 0.0
+
+
 def test_megatron_plugin_translation():
     from accelerate_tpu.utils import MegatronLMPlugin
 
