@@ -581,6 +581,8 @@ def load_checkpoint_in_model(
     """
     from safetensors import safe_open
 
+    from .utils.hf_interop import _apply_op
+
     device_map = device_map or {"": 0}
     store = WeightStore()
     expected = set(named_parameters(abstract_params).keys()) if abstract_params is not None else None
@@ -605,9 +607,7 @@ def load_checkpoint_in_model(
                 if place == "disk" and not offload_to_memmap:
                     store.put(key, LazyWeight(shard_path, ckpt_key, dtype, transform=op), place)
                     continue
-                arr = f.get_tensor(ckpt_key)
-                if op == "t":
-                    arr = np.ascontiguousarray(arr.T)
+                arr = _apply_op(f.get_tensor(ckpt_key), op or "copy")
                 if dtype is not None:
                     arr = arr.astype(dtype)
                 if place == "disk":
@@ -732,20 +732,13 @@ def load_hf_checkpoint_and_dispatch(
     Mixtral's per-expert shards need stacking, which has no lazy form — load
     it with utils.load_hf_checkpoint + dispatch_model(params=...) instead.
     """
-    import json as _json
+    from .utils.hf_interop import map_hf_key, open_hf_checkpoint
 
-    from .utils.hf_interop import config_from_hf, detect_family, map_hf_key, model_from_config
-
-    with open(os.path.join(checkpoint_dir, "config.json")) as f:
-        hf_config = _json.load(f)
-    family = detect_family(hf_config)
-    if config is None:
-        config = config_from_hf(hf_config, family)
+    family, config, module = open_hf_checkpoint(checkpoint_dir, config)
     if family not in ("llama", "mistral", "gpt2"):
         raise ValueError(
             f"streamed dispatch supports llama/mistral/gpt2 (got {family!r}); "
             "use utils.load_hf_checkpoint + dispatch_model for other families")
-    module = model_from_config(config, family)
 
     streamed = load_checkpoint_and_dispatch(
         module, checkpoint_dir, device_map=device_map, max_memory=max_memory,

@@ -215,7 +215,10 @@ def generate(
         compiles once).
       rng: jax PRNG key for sampling (default PRNGKey(0)).
 
-    Returns [B, S + max_new_tokens] ids.
+    Returns [B, S + max_new_tokens] ids (prompt + completion). For
+    encoder-decoder modules the call delegates to :func:`seq2seq_generate`
+    and returns **decoder** ids, [B, 1 + max_new_tokens] — the prompt is
+    the encoder's input, not a decode prefix.
     """
     from .big_modeling import cache_factory_for
 

@@ -464,20 +464,32 @@ class DeepSpeedPlugin(KwargsHandler):
         typ = str(cfg.get("type", "WarmupLR")).lower()
         # Branchless (jnp.where) because the schedule doubles as the optax
         # learning rate inside the jitted update, where ``step`` is traced.
+        import math
+
         import jax.numpy as jnp
+
+        # DeepSpeed's WarmupLR defaults to *log* warmup; "linear" is opt-in.
+        warmup_type = str(p.get("warmup_type", "log")).lower()
+        if warmup_type not in ("log", "linear"):
+            raise ValueError(f"unsupported DeepSpeed warmup_type {warmup_type!r}")
+
+        def ramp(step):
+            if warmup_type == "linear":
+                frac = step / max(warmup, 1)
+            else:
+                frac = jnp.log(1.0 + step) / math.log(1.0 + max(warmup, 1))
+            return lo + (hi - lo) * frac
 
         if typ == "warmuplr":
             def schedule(step):
-                ramp = lo + (hi - lo) * step / max(warmup, 1)
-                return jnp.where(step >= warmup, hi, ramp)
+                return jnp.where(step >= warmup, hi, ramp(step))
         elif typ == "warmupdecaylr":
             total = int(p.get("total_num_steps", max(warmup, 1)))
 
             def schedule(step):
-                ramp = lo + (hi - lo) * step / max(warmup, 1)
                 frac = (total - step) / max(total - warmup, 1)
                 decayed = hi * jnp.clip(frac, 0.0, 1.0)
-                return jnp.where(step < warmup, ramp,
+                return jnp.where(step < warmup, ramp(step),
                                  hi if total <= warmup else decayed)
         else:
             raise ValueError(f"unsupported DeepSpeed scheduler type {cfg.get('type')!r}")

@@ -387,6 +387,23 @@ def config_from_hf(hf_config: dict, family: Optional[str] = None):
     raise ValueError(f"unsupported family {family!r}")
 
 
+def open_hf_checkpoint(checkpoint_dir: str, config=None):
+    """Shared HF-dir preamble: read ``config.json``, detect the family,
+    build (or accept) the config, and instantiate the flax module.
+    Returns ``(family, config, module)`` — used by the streamed dispatch
+    (big_modeling), the quantized loader, and anything else that consumes a
+    checkpoint directory."""
+    config_path = os.path.join(checkpoint_dir, "config.json")
+    hf_config = {}
+    if os.path.exists(config_path):
+        with open(config_path) as f:
+            hf_config = json.load(f)
+    family = detect_family(hf_config)
+    if config is None:
+        config = config_from_hf(hf_config, family)
+    return family, config, model_from_config(config, family)
+
+
 def model_from_config(config, family: str):
     """Instantiate the flax module matching a converted config — the single
     family→model-class switch shared by the streamed HF dispatch

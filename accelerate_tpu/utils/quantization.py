@@ -204,12 +204,19 @@ def load_and_quantize_model(
     params=None,
     quantization_config: Optional[QuantizationConfig] = None,
     dtype=None,
+    key_map=None,
+    expected_params=None,
 ):
     """bnb-parity one-call entry (reference: load_and_quantize_model,
     utils/bnb.py:44): load weights, quantize eligible leaves shard-by-shard
     (host RSS stays ~one full-precision shard), return
     ``(quantized_params, apply_fn)`` where ``apply_fn(params, *args)``
     dequantizes lazily inside jit.
+
+    ``key_map(ckpt_key) -> (our_name, op) | None`` translates foreign
+    checkpoint names per tensor mid-stream (HF Transformers layouts — see
+    big_modeling.load_checkpoint_in_model), so Hub checkpoints quantize
+    without a full-precision intermediate state dict.
     """
     if quantization_config is None:
         raise ValueError("quantization_config is required")
@@ -217,15 +224,35 @@ def load_and_quantize_model(
         raise ValueError("pass exactly one of checkpoint / params")
 
     if checkpoint is not None:
-        from ..big_modeling import _checkpoint_shards, _nest
         from safetensors import safe_open
 
+        from ..big_modeling import _checkpoint_shards, _nest, named_parameters
+        from .hf_interop import _apply_op
+
+        # Enforce the same completeness invariant as
+        # big_modeling.load_checkpoint_in_model: a truncated checkpoint must
+        # fail with a clear error, never a cryptic flax scope error at first
+        # apply; extraneous tensors (e.g. a tied head duplicate) are dropped.
+        expected = (set(named_parameters(expected_params).keys())
+                    if expected_params is not None else None)
+        seen: set = set()
         skip = [re.compile(p) for p in quantization_config.skip_modules or []]
         flat: dict = {}
         for shard_path, keys in _checkpoint_shards(checkpoint):
             with safe_open(shard_path, framework="numpy") as f:
-                for key in keys:
-                    arr = f.get_tensor(key)
+                for ckpt_key in keys:
+                    op = None
+                    if key_map is not None:
+                        mapped = key_map(ckpt_key)
+                        if mapped is None:
+                            continue
+                        key, op = mapped
+                    else:
+                        key = ckpt_key
+                    if expected is not None and key not in expected:
+                        continue
+                    seen.add(key)
+                    arr = _apply_op(f.get_tensor(ckpt_key), op or "copy")
                     if dtype is not None:
                         arr = arr.astype(dtype)
                     # Quantize eligible tensors AS THEY STREAM so only the
@@ -243,6 +270,11 @@ def load_and_quantize_model(
                         )
                     else:
                         flat[key] = arr
+        if expected is not None:
+            missing = expected - seen
+            if missing:
+                raise ValueError(
+                    f"Checkpoint {checkpoint} is missing keys: {sorted(missing)[:5]}...")
         qparams = _nest(flat)
     else:
         if dtype is not None:
@@ -261,3 +293,37 @@ def load_and_quantize_model(
     else:
         raise TypeError(f"cannot derive an apply fn from {type(module)}")
     return qparams, quantizing_apply(base_apply, quantization_config.compute_dtype)
+
+
+def load_and_quantize_hf_checkpoint(
+    checkpoint_dir: str,
+    quantization_config: QuantizationConfig,
+    dtype=None,
+    config=None,
+):
+    """Quantize a HuggingFace checkpoint directory in one call.
+
+    Detects the family from ``config.json``, builds the flax module, and
+    stream-quantizes with per-tensor HF name/layout translation (no
+    full-precision intermediate state dict). Mixtral needs expert stacking,
+    which has no streaming form — it falls back to load-then-quantize.
+    Returns ``(config, module, qparams, apply_fn)``.
+    """
+    import numpy as _np
+
+    from ..big_modeling import init_empty_weights
+    from .hf_interop import load_hf_checkpoint, map_hf_key, open_hf_checkpoint
+
+    family, config, module = open_hf_checkpoint(checkpoint_dir, config)
+    if family == "mixtral":
+        _, params = load_hf_checkpoint(checkpoint_dir, family, config, dtype=dtype)
+        qparams, apply_fn = load_and_quantize_model(
+            module, params=params, quantization_config=quantization_config)
+        return config, module, qparams, apply_fn
+    ids = _np.zeros((1, 8), _np.int32)
+    abstract = init_empty_weights(module, *((ids, ids) if family == "t5" else (ids,)))
+    qparams, apply_fn = load_and_quantize_model(
+        module, checkpoint=checkpoint_dir, quantization_config=quantization_config,
+        dtype=dtype, key_map=lambda key: map_hf_key(key, family),
+        expected_params=abstract)
+    return config, module, qparams, apply_fn

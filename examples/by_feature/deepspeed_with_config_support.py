@@ -59,15 +59,15 @@ def training_function(args):
     # the scheduler section's schedule is baked in as the optax LR); the
     # user's own optax chain otherwise.
     tx = ds_plugin.build_optimizer() or optax.adamw(args.lr)
-    scheduler = ds_plugin.build_scheduler()  # reporting surface (get_last_lr)
-    if scheduler is not None:
-        model, optimizer, train_dl, eval_dl, scheduler = accelerator.prepare(
-            Model(model_def, params), tx, train_dl, eval_dl, scheduler
-        )
-    else:
-        model, optimizer, train_dl, eval_dl = accelerator.prepare(
-            Model(model_def, params), tx, train_dl, eval_dl
-        )
+    # Reporting surface only: the same schedule is already baked into the
+    # optax chain as its LR (keyed to the update count), so the scheduler is
+    # stepped RAW once per update — not through prepare(), whose
+    # num_processes multiplier targets user schedules written for
+    # per-process progress.
+    scheduler = ds_plugin.build_scheduler()
+    model, optimizer, train_dl, eval_dl = accelerator.prepare(
+        Model(model_def, params), tx, train_dl, eval_dl
+    )
     step = accelerator.compile_train_step(classification_loss(model_def.apply))
 
     accelerator.print(

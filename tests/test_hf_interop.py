@@ -507,6 +507,46 @@ class TestStreamedDispatch:
                                  do_sample=False)
         assert np.asarray(ours)[0, 10:].tolist() == theirs[0, 10:].tolist()
 
+    def test_quantized_hf_load(self, tmp_path):
+        """HF dir -> stream-quantized int8 params: close logits, smaller
+        footprint, head kept full precision."""
+        from accelerate_tpu.utils import (
+            QuantizationConfig,
+            QuantizedTensor,
+            load_and_quantize_hf_checkpoint,
+            load_hf_checkpoint,
+        )
+
+        self._hf_dir(tmp_path)
+        qcfg = QuantizationConfig(load_in_8bit=True, min_weight_size=64)
+        cfg, module, qparams, apply_fn = load_and_quantize_hf_checkpoint(
+            str(tmp_path), qcfg)
+        cfg.use_flash_attention = False
+        _, full_params = load_hf_checkpoint(str(tmp_path))
+        ids = jnp.asarray(np.arange(8)[None] % 128, jnp.int32)
+        q_out = apply_fn(qparams, ids)
+        full_out = module.apply({"params": full_params}, ids)
+        np.testing.assert_allclose(np.asarray(q_out, np.float32),
+                                   np.asarray(full_out, np.float32),
+                                   atol=0.35, rtol=0.35)
+        # Projections quantized, head skipped.
+        assert isinstance(
+            qparams["model"]["layers_0"]["self_attn"]["q_proj"]["kernel"], QuantizedTensor)
+        assert not isinstance(qparams["lm_head"]["kernel"], QuantizedTensor)
+
+    def test_quantized_hf_load_rejects_truncated_checkpoint(self, tmp_path):
+        from safetensors.numpy import load_file, save_file
+
+        from accelerate_tpu.utils import QuantizationConfig, load_and_quantize_hf_checkpoint
+
+        self._hf_dir(tmp_path)
+        sd = load_file(str(tmp_path / "model.safetensors"))
+        sd.pop("model.layers.1.mlp.down_proj.weight")
+        save_file(sd, str(tmp_path / "model.safetensors"))
+        with pytest.raises(ValueError, match="missing keys"):
+            load_and_quantize_hf_checkpoint(
+                str(tmp_path), QuantizationConfig(load_in_8bit=True, min_weight_size=64))
+
     def test_rejects_unsupported_family(self, tmp_path):
         import json
 
