@@ -262,6 +262,136 @@ def greedy_generate(module, params, input_ids, max_new_tokens: int = 20,
                     eos_token_id=eos_token_id, cache_dtype=cache_dtype)
 
 
+def beam_search_generate(
+    module,
+    params,
+    input_ids,
+    max_new_tokens: int = 20,
+    num_beams: int = 4,
+    eos_token_id: Optional[int] = None,
+    length_penalty: float = 1.0,
+    cache_dtype=None,
+):
+    """Fully-compiled beam search for decoder-only cache-threading models.
+
+    Beams ride the batch axis (B*K rows share one cache layout), so the
+    whole search is ONE jitted prefill + ONE ``lax.scan``: each step scores
+    K*V continuations per sequence, keeps the top K by running logprob, and
+    gathers the KV cache rows to follow their beams. Finished beams (eos)
+    are frozen: they contribute exactly one continuation (eos, score
+    unchanged) so live beams can still overtake them, and selection uses
+    length-normalized scores (``length_penalty``) like transformers.
+
+    Returns [B, S + max_new_tokens] ids of the best beam per batch row.
+    """
+    from .big_modeling import cache_factory_for
+
+    factory = cache_factory_for(module)
+    if factory is None:
+        raise TypeError(f"{type(module).__name__} does not thread a KV cache")
+    ids = jnp.asarray(input_ids)
+    B, S = ids.shape
+    if max_new_tokens <= 0:
+        return ids
+    _check_position_bound(module, S + max_new_tokens)
+    K = num_beams
+    dtype = cache_dtype or jnp.bfloat16
+    # Prefill runs on [B] rows (all K beams of a row are identical until the
+    # first selection); the compiled fn repeats the cache to [B*K] after.
+    cache = factory(B, S + max_new_tokens, dtype)
+
+    jitted = _compiled_beam(module, max_new_tokens, K, eos_token_id,
+                            length_penalty, dtype)
+    return jitted(params, ids, cache)
+
+
+def _compiled_beam(module, max_new_tokens, K, eos_token_id, length_penalty,
+                   cache_dtype):
+    key = _cache_key(module, "beam", max_new_tokens, K, eos_token_id,
+                     length_penalty, jnp.dtype(cache_dtype).name)
+    hit = _generate_cache.get(key) if key is not None else None
+    if hit is not None:
+        return hit
+
+    NEG = jnp.float32(-1e9)
+
+    @jax.jit
+    def run(params, ids, cache):
+        B, S = ids.shape
+
+        # Prefill once per batch row; all K beams share it, so the cache is
+        # repeated to [B*K] rows only afterwards ((K-1)/K of the prefill
+        # FLOPs and activation memory saved).
+        logits, cache = module.apply({"params": params}, ids, cache=cache,
+                                     cache_pos=0)
+        cache = jax.tree_util.tree_map(lambda buf: jnp.repeat(buf, K, axis=0), cache)
+        logp = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32), axis=-1)
+        V = logp.shape[-1]
+        # The first top-k picks K *distinct* tokens of the single prefill
+        # distribution (equivalent to the usual seed-beams-1..K-1-with--inf
+        # trick on identical replicas).
+        top_scores, first_tok32 = jax.lax.top_k(logp, K)          # [B, K]
+        first_tok = first_tok32.astype(ids.dtype)
+        beam_scores = top_scores                                  # [B, K]
+        done = jnp.zeros((B, K), bool)
+        if eos_token_id is not None:
+            done = first_tok == eos_token_id
+
+        toks0 = jnp.zeros((B, K, max_new_tokens), ids.dtype)
+        toks0 = toks0.at[:, :, 0].set(first_tok)
+
+        def body(carry, step):
+            tok_hist, beam_scores, cache, done, pos = carry
+            cur = jax.lax.dynamic_index_in_dim(tok_hist, step, axis=2,
+                                               keepdims=False)   # [B, K]
+            logits, new_cache = module.apply(
+                {"params": params}, cur.reshape(B * K, 1), cache=cache,
+                cache_pos=pos)
+            logp = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32), axis=-1)
+            logp = logp.reshape(B, K, V)
+            if eos_token_id is not None:
+                # Frozen beams: only the eos continuation, at unchanged score.
+                eos_only = jnp.full((V,), NEG).at[eos_token_id].set(0.0)
+                logp = jnp.where(done[:, :, None], eos_only[None, None], logp)
+            cand = beam_scores[:, :, None] + logp                 # [B, K, V]
+            top_scores, top_idx = jax.lax.top_k(cand.reshape(B, K * V), K)
+            src_beam = top_idx // V                               # [B, K]
+            new_tok = (top_idx % V).astype(tok_hist.dtype)
+
+            # Follow the beams: gather history and KV rows.
+            batch_ix = jnp.arange(B)[:, None]
+            tok_hist = tok_hist[batch_ix, src_beam]               # [B, K, L]
+            flat_src = (batch_ix * K + src_beam).reshape(-1)      # [B*K]
+            new_cache = jax.tree_util.tree_map(
+                lambda buf: buf[flat_src], new_cache)
+            done = done[batch_ix, src_beam]
+            tok_hist = tok_hist.at[:, :, step + 1].set(new_tok)
+            if eos_token_id is not None:
+                done = done | (new_tok == eos_token_id)
+            return (tok_hist, top_scores, new_cache, done, pos + 1), None
+
+        (tok_hist, beam_scores, _, done, _), _ = jax.lax.scan(
+            body, (toks0, beam_scores, cache, done, jnp.asarray(S, jnp.int32)),
+            jnp.arange(max_new_tokens - 1))
+
+        # Length-normalized selection (finished beams use their eos-frozen
+        # running score). transformers normalizes by the FULL hypothesis
+        # length — prompt + generated tokens up to and including eos.
+        if eos_token_id is not None:
+            is_eos = tok_hist == eos_token_id
+            first_eos = jnp.argmax(is_eos, axis=-1)
+            gen_len = jnp.where(is_eos.any(axis=-1), first_eos + 1, max_new_tokens)
+        else:
+            gen_len = jnp.full((B, K), max_new_tokens)
+        lengths = S + gen_len
+        norm = beam_scores / (lengths.astype(jnp.float32) ** length_penalty)
+        best = jnp.argmax(norm, axis=-1)                          # [B]
+        best_toks = tok_hist[jnp.arange(B), best]                 # [B, L]
+        return jnp.concatenate([ids, best_toks], axis=1)
+
+    return _cache_put(key, run)
+
+
 def seq2seq_generate(
     module,
     params,

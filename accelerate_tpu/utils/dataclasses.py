@@ -452,8 +452,8 @@ class DeepSpeedPlugin(KwargsHandler):
 
     def _schedule_fn(self):
         """step -> lr callable from the ``"scheduler"`` section, or None.
-        Supports DeepSpeed's WarmupLR (linear warmup then constant) and
-        WarmupDecayLR (warmup then linear decay to zero)."""
+        Supports DeepSpeed's WarmupLR (log or linear warmup, then constant)
+        and WarmupDecayLR (warmup then linear decay to zero)."""
         cfg = (self.hf_ds_config or {}).get("scheduler")
         if not cfg:
             return None
@@ -469,6 +469,8 @@ class DeepSpeedPlugin(KwargsHandler):
         import jax.numpy as jnp
 
         # DeepSpeed's WarmupLR defaults to *log* warmup; "linear" is opt-in.
+        # Exact DeepSpeed gammas: log -> log(1+step)/log(max(2, warmup))
+        # (reaches 1.0 at step warmup-1), linear -> step/warmup.
         warmup_type = str(p.get("warmup_type", "log")).lower()
         if warmup_type not in ("log", "linear"):
             raise ValueError(f"unsupported DeepSpeed warmup_type {warmup_type!r}")
@@ -477,8 +479,8 @@ class DeepSpeedPlugin(KwargsHandler):
             if warmup_type == "linear":
                 frac = step / max(warmup, 1)
             else:
-                frac = jnp.log(1.0 + step) / math.log(1.0 + max(warmup, 1))
-            return lo + (hi - lo) * frac
+                frac = jnp.log(1.0 + step) / math.log(max(2, warmup))
+            return lo + (hi - lo) * jnp.minimum(frac, 1.0)
 
         if typ == "warmuplr":
             def schedule(step):

@@ -394,10 +394,14 @@ def open_hf_checkpoint(checkpoint_dir: str, config=None):
     (big_modeling), the quantized loader, and anything else that consumes a
     checkpoint directory."""
     config_path = os.path.join(checkpoint_dir, "config.json")
-    hf_config = {}
-    if os.path.exists(config_path):
-        with open(config_path) as f:
-            hf_config = json.load(f)
+    if not os.path.exists(config_path):
+        # No family escape hatch here (unlike load_hf_checkpoint's family=
+        # argument), so a weights-only dir must fail with the real reason,
+        # not a misleading "unsupported model_type ''".
+        raise FileNotFoundError(
+            f"{checkpoint_dir} has no config.json; family detection needs it")
+    with open(config_path) as f:
+        hf_config = json.load(f)
     family = detect_family(hf_config)
     if config is None:
         config = config_from_hf(hf_config, family)

@@ -303,6 +303,69 @@ class TestMixtral:
         _roundtrip(params, "mixtral", hf.state_dict())
 
 
+class TestBeamSearch:
+    def _pair(self):
+        hf_cfg = transformers.LlamaConfig(
+            vocab_size=128, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=64, tie_word_embeddings=False)
+        torch.manual_seed(3)
+        with torch.no_grad():
+            hf = transformers.LlamaForCausalLM(hf_cfg).eval()
+        cfg = config_from_hf(hf_cfg.to_dict())
+        cfg.use_flash_attention = False
+        from accelerate_tpu.models.llama import LlamaForCausalLM
+
+        params = convert_hf_state_dict(hf.state_dict(), "llama", strict=True)
+        return hf, LlamaForCausalLM(cfg), params
+
+    def test_matches_hf_beam_search(self):
+        from accelerate_tpu.generation import beam_search_generate
+
+        hf, model, params = self._pair()
+        ids = (np.arange(12, dtype=np.int64).reshape(2, 6) * 11) % 128
+        ours = beam_search_generate(model, params, jnp.asarray(ids, jnp.int32),
+                                    max_new_tokens=6, num_beams=4,
+                                    cache_dtype=jnp.float32)
+        with torch.no_grad():
+            theirs = hf.generate(torch.from_numpy(ids), max_new_tokens=6,
+                                 num_beams=4, do_sample=False,
+                                 min_new_tokens=6, length_penalty=1.0)
+        np.testing.assert_array_equal(np.asarray(ours), theirs.numpy())
+
+    def test_single_beam_equals_greedy(self):
+        from accelerate_tpu.generation import beam_search_generate, generate
+
+        hf, model, params = self._pair()
+        ids = jnp.asarray((np.arange(8)[None] * 7) % 128, jnp.int32)
+        beam = beam_search_generate(model, params, ids, max_new_tokens=5,
+                                    num_beams=1, cache_dtype=jnp.float32)
+        greedy = generate(model, params, ids, max_new_tokens=5,
+                          cache_dtype=jnp.float32)
+        np.testing.assert_array_equal(np.asarray(beam), np.asarray(greedy))
+
+    def test_eos_freezes_beams(self):
+        """With eos = the argmax first token, the best beam stops and pads
+        with eos; shape stays static."""
+        from accelerate_tpu.generation import beam_search_generate, generate
+
+        hf, model, params = self._pair()
+        ids = jnp.asarray((np.arange(8)[None] * 7) % 128, jnp.int32)
+        greedy = np.asarray(generate(model, params, ids, max_new_tokens=5,
+                                     cache_dtype=jnp.float32))
+        eos = int(greedy[0, 8])  # force the greedy continuation to be eos
+        out = np.asarray(beam_search_generate(
+            model, params, ids, max_new_tokens=5, num_beams=3,
+            eos_token_id=eos, cache_dtype=jnp.float32))
+        assert out.shape == (1, 13)
+        row = out[0, 8:]
+        eos_positions = np.where(row == eos)[0]
+        assert eos_positions.size > 0  # some beam finished
+        first = eos_positions[0]
+        # frozen: everything after the first eos is eos
+        assert (row[first:] == eos).all()
+
+
 class TestT5Generate:
     """Cached encoder-decoder decode vs HF greedy generate — validates the
     decoder self-attention cache, the absolute-position relative bias, and
