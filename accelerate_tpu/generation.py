@@ -375,15 +375,15 @@ def _compiled_beam(module, max_new_tokens, K, eos_token_id, length_penalty,
             jnp.arange(max_new_tokens - 1))
 
         # Length-normalized selection (finished beams use their eos-frozen
-        # running score). transformers normalizes by the FULL hypothesis
-        # length — prompt + generated tokens up to and including eos.
+        # running score). transformers normalizes by the *generated* length
+        # only — cur_len + 1 - decoder_prompt_len in its beam finalization —
+        # counting tokens up to and including eos; the prompt is excluded.
         if eos_token_id is not None:
             is_eos = tok_hist == eos_token_id
             first_eos = jnp.argmax(is_eos, axis=-1)
-            gen_len = jnp.where(is_eos.any(axis=-1), first_eos + 1, max_new_tokens)
+            lengths = jnp.where(is_eos.any(axis=-1), first_eos + 1, max_new_tokens)
         else:
-            gen_len = jnp.full((B, K), max_new_tokens)
-        lengths = S + gen_len
+            lengths = jnp.full((B, K), max_new_tokens)
         norm = beam_scores / (lengths.astype(jnp.float32) ** length_penalty)
         best = jnp.argmax(norm, axis=-1)                          # [B]
         best_toks = tok_hist[jnp.arange(B), best]                 # [B, L]

@@ -38,6 +38,7 @@ SCRIPTS = {
     "megatron_lm_gpt_pretraining.py": ["--tp", "2", "--pp", "2", "--steps", "4"],
     "moe_context_parallel.py": ["--steps", "4"],
     "native_data_pipeline.py": ["--seq_len", "64"],
+    "hf_checkpoint_finetune.py": [],
 }
 
 
