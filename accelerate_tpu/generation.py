@@ -222,16 +222,16 @@ def generate(
     """
     from .big_modeling import cache_factory_for
 
+    if hasattr(module, "init_decode_cache"):
+        # Encoder-decoder family: same public entry point, seq2seq
+        # mechanics (so supports_kv_cache => generate works).
+        return seq2seq_generate(
+            module, params, input_ids, max_new_tokens=max_new_tokens,
+            eos_token_id=eos_token_id, cache_dtype=cache_dtype,
+            do_sample=do_sample, temperature=temperature, top_k=top_k,
+            top_p=top_p, rng=rng)
     factory = cache_factory_for(module)
     if factory is None:
-        if hasattr(module, "init_decode_cache"):
-            # Encoder-decoder family: same public entry point, seq2seq
-            # mechanics (so supports_kv_cache => generate works).
-            return seq2seq_generate(
-                module, params, input_ids, max_new_tokens=max_new_tokens,
-                eos_token_id=eos_token_id, cache_dtype=cache_dtype,
-                do_sample=do_sample, temperature=temperature, top_k=top_k,
-                top_p=top_p, rng=rng)
         raise TypeError(
             f"{type(module).__name__} does not thread a KV cache; use the model's "
             "full-forward generate or add cache support to the family "
