@@ -2,8 +2,9 @@
 benchmarks/big_model_inference/measures_util.py + README.md:26-45 — model
 load time, per-token generation latency, memory placement).
 
-Builds a Llama, exports it to sharded safetensors, then for each placement
-tier (all-HBM / host-offload / disk-offload) measures:
+Builds a Llama (or, with ``--family t5``, an encoder-decoder — the
+reference table's T0pp-11B shape), exports it to sharded safetensors, then
+for each placement tier (all-HBM / host-offload / disk-offload) measures:
 
 * load time  — checkpoint -> WeightStore via load_checkpoint_and_dispatch
 * first call — generate end-to-end including XLA compiles
@@ -38,23 +39,34 @@ SIZES = {
 }
 
 
-def build_and_save(size: str, ckpt_dir: str):
+def build_and_save(size: str, ckpt_dir: str, family: str = "llama"):
     import types
 
     import jax
 
     from accelerate_tpu.checkpointing import save_model
-    from accelerate_tpu.models.llama import LlamaConfig, LlamaForCausalLM
 
     h, inter, layers, heads, kv, vocab = SIZES[size]
-    cfg = LlamaConfig(
-        vocab_size=vocab, hidden_size=h, intermediate_size=inter,
-        num_hidden_layers=layers, num_attention_heads=heads,
-        num_key_value_heads=kv, max_position_embeddings=2048,
-        use_flash_attention=False,
-    )
-    module = LlamaForCausalLM(cfg)
-    params = module.init_params(jax.random.PRNGKey(0), batch_size=1, seq_len=8)
+    if family == "t5":
+        # Encoder-decoder tier rows (reference table's T0pp-11B shape).
+        from accelerate_tpu.models.t5 import T5Config, T5ForConditionalGeneration
+
+        cfg = T5Config(vocab_size=vocab, hidden_size=h, intermediate_size=inter,
+                       num_layers=layers, num_heads=heads,
+                       head_dim=max(h // heads, 8), dropout_rate=0.0)
+        module = T5ForConditionalGeneration(cfg)
+        params = module.init_params(jax.random.PRNGKey(0))
+    else:
+        from accelerate_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+        cfg = LlamaConfig(
+            vocab_size=vocab, hidden_size=h, intermediate_size=inter,
+            num_hidden_layers=layers, num_attention_heads=heads,
+            num_key_value_heads=kv, max_position_embeddings=2048,
+            use_flash_attention=False,
+        )
+        module = LlamaForCausalLM(cfg)
+        params = module.init_params(jax.random.PRNGKey(0), batch_size=1, seq_len=8)
     single = types.SimpleNamespace(is_main_process=True, wait_for_everyone=lambda: None)
     save_model(single, params, ckpt_dir, max_shard_size="512MB")
     n_params = sum(int(p.size) for p in jax.tree_util.tree_leaves(params))
@@ -71,10 +83,12 @@ def bench_tier(module, ckpt_dir: str, tier: str, prompt_len: int, tokens: int,
     from accelerate_tpu.big_modeling import load_checkpoint_and_dispatch
 
     device_map = {"": {"device": 0, "cpu": "cpu", "disk": "disk"}[tier]}
+    ex = jnp.zeros((1, 8), jnp.int32)
+    is_t5 = type(module).__name__ == "T5ForConditionalGeneration"
     t0 = time.perf_counter()
     streamed = load_checkpoint_and_dispatch(
         module, ckpt_dir, device_map=device_map, offload_folder=offload_folder,
-        example_args=(jnp.zeros((1, 8), jnp.int32),),
+        example_args=(ex, ex) if is_t5 else (ex,),
     )
     load_s = time.perf_counter() - t0
 
@@ -83,22 +97,28 @@ def bench_tier(module, ckpt_dir: str, tier: str, prompt_len: int, tokens: int,
         rng.integers(0, module.config.vocab_size, size=(1, prompt_len)), jnp.int32
     )
 
+    def gen(n=None, **kw):
+        n = tokens if n is None else n
+        if is_t5:
+            return streamed.seq2seq_generate(ids, max_new_tokens=n, **kw)
+        return streamed.generate(ids, max_new_tokens=n, **kw)
+
     # First call compiles one executable per block kind for THIS cache
     # length (cache shape is part of the jit key, so the warm-up must use
     # the same max_new_tokens as the timed run).
     t0 = time.perf_counter()
-    out = streamed.generate(ids, max_new_tokens=tokens)
+    out = gen()
     first_token_s = time.perf_counter() - t0  # includes compile
 
     t0 = time.perf_counter()
-    out = streamed.generate(ids, max_new_tokens=tokens)
+    out = gen()
     kv_per_token = (time.perf_counter() - t0) / tokens  # prefill amortized in
 
     nocache_per_token = None
     if tokens >= 2:
-        streamed.generate(ids, max_new_tokens=2, use_cache=False)  # compile warm-up
+        gen(n=2, use_cache=False)  # compile warm-up
         t0 = time.perf_counter()
-        streamed.generate(ids, max_new_tokens=2, use_cache=False)
+        gen(n=2, use_cache=False)
         nocache_per_token = (time.perf_counter() - t0) / 2
 
     result = {
@@ -108,7 +128,7 @@ def bench_tier(module, ckpt_dir: str, tier: str, prompt_len: int, tokens: int,
         "kv_s_per_token": round(kv_per_token, 4),
         "nocache_s_per_token": round(nocache_per_token, 4) if nocache_per_token else None,
         "hbm_resident_bytes": streamed.hbm_resident_bytes,
-        "n_new_tokens": int(out.shape[1] - prompt_len),
+        "n_new_tokens": int(out.shape[1] - (1 if is_t5 else prompt_len)),
     }
     streamed.close()
     return result
@@ -117,6 +137,7 @@ def bench_tier(module, ckpt_dir: str, tier: str, prompt_len: int, tokens: int,
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--size", default="tiny", choices=sorted(SIZES))
+    ap.add_argument("--family", default="llama", choices=["llama", "t5"])
     ap.add_argument("--tiers", default="device,cpu")
     ap.add_argument("--tokens", type=int, default=16)
     ap.add_argument("--prompt-len", type=int, default=64)
@@ -130,7 +151,7 @@ def main() -> int:
     rows = []
     with tempfile.TemporaryDirectory() as tmp:
         ckpt = f"{tmp}/ckpt"
-        module, n_params = build_and_save(args.size, ckpt)
+        module, n_params = build_and_save(args.size, ckpt, family=args.family)
         for tier in args.tiers.split(","):
             offload = f"{tmp}/offload_{tier}" if tier == "disk" else None
             rows.append(
@@ -138,7 +159,7 @@ def main() -> int:
                            offload_folder=offload)
             )
 
-    print(f"\nLlama-{args.size} ({n_params/1e6:.0f}M params), "
+    print(f"\n{args.family}-{args.size} ({n_params/1e6:.0f}M params), "
           f"prompt={args.prompt_len}, platform={platform}\n")
     print("| Placement | Load time | First call (compile) | KV decode /token | No-cache /token | HBM resident |")
     print("|:---------:|:---------:|:-----------:|:----------------:|:---------------:|:------------:|")
@@ -149,7 +170,8 @@ def main() -> int:
               f"| {r['hbm_resident_bytes']/2**30:.2f}GiB |")
     print()
     print(json.dumps({"metric": "big_model_kv_decode_s_per_token",
-                      "size": args.size, "platform": platform, "tiers": rows}))
+                      "size": args.size, "family": args.family,
+                      "platform": platform, "tiers": rows}))
     return 0
 
 
