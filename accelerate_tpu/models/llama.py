@@ -51,6 +51,10 @@ class LlamaConfig:
     # 'auto' uses ring/Ulysses context parallelism when the ambient mesh has
     # cp > 1 (ops/ring_attention.py), flash/einsum otherwise.
     attention_backend: str = "auto"
+    # Pallas flash tile sizes — the single biggest MFU knob on real TPUs;
+    # tune per generation/sequence length without touching kernel code.
+    flash_block_q: int = 128
+    flash_block_k: int = 128
     # fp8 projections (ops/quant.py Fp8Dense, delayed scaling): the TE-swap
     # equivalent (reference: utils/transformer_engine.py:40-49). Pair with
     # Accelerator(mixed_precision="fp8") — the fp8 statistics params are
@@ -167,6 +171,7 @@ def apply_rotary(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray):
 def multi_head_attention(
     q, k, v, causal: bool = True, use_flash: bool = True, segment_ids=None,
     backend: str = "auto", sliding_window: Optional[int] = None,
+    block_q: int = 128, block_k: int = 128,
 ):
     """Dispatch between the attention implementations in ops/.
 
@@ -207,7 +212,8 @@ def multi_head_attention(
             raise ValueError(
                 f"attention_backend={backend!r} does not support sliding_window")
         if backend != "einsum" and use_flash and segment_ids is None and causal:
-            return flash_attention(q, k, v, causal=True, sliding_window=sliding_window)
+            return flash_attention(q, k, v, causal=True, sliding_window=sliding_window,
+                                   block_q=block_q, block_k=block_k)
         return _einsum_attention(q, k, v, causal=causal, segment_ids=segment_ids,
                                  sliding_window=sliding_window)
     if backend in ("auto", "ring", "ulysses"):
@@ -223,7 +229,7 @@ def multi_head_attention(
                     q, k, v, mesh=mesh, causal=causal, strategy=backend, use_flash=use_flash
                 )
     if backend != "einsum" and use_flash and segment_ids is None and flash_attention_available(q):
-        return flash_attention(q, k, v, causal=causal)
+        return flash_attention(q, k, v, causal=causal, block_q=block_q, block_k=block_k)
     return _einsum_attention(q, k, v, causal=causal, segment_ids=segment_ids)
 
 
@@ -313,6 +319,7 @@ class LlamaAttention(nn.Module):
         out = multi_head_attention(
             q, k, v, causal=causal, use_flash=cfg.use_flash_attention,
             backend=cfg.attention_backend, sliding_window=cfg.sliding_window,
+            block_q=cfg.flash_block_q, block_k=cfg.flash_block_k,
         )
         out = out.reshape(B, S, n_q * hd)
         return dense(cfg.hidden_size, "o_proj")(out)
