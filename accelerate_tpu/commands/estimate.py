@@ -87,8 +87,12 @@ def _abstract_from_path(path: str):
         family = detect_family(cfg_dict)
         config = config_from_hf(cfg_dict, family)
         module = model_from_config(config, family)
-        # init_empty_weights defaults to one (1, 8) int32 input; T5 needs
-        # decoder_input_ids as a second.
+        # Per-family example inputs: tokens by default, decoder_input_ids as
+        # a second arg for T5, NHWC images for ViT.
+        if family == "vit":
+            image = np.zeros((1, config.image_size, config.image_size,
+                              config.num_channels), np.float32)
+            return init_empty_weights(module, image)
         ids = np.zeros((1, 8), np.int32)
         return init_empty_weights(module, *((ids, ids) if family == "t5" else ()))
     return None

@@ -5,3 +5,4 @@ from .mixtral import MixtralConfig, MixtralForCausalLM, mixtral_lm_loss
 from .resnet import ResNet, ResNetConfig
 from .simple import MLP, RegressionModel
 from .t5 import T5Config, T5ForConditionalGeneration, seq2seq_lm_loss
+from .vit import ViTConfig, ViTForImageClassification

@@ -1,0 +1,139 @@
+"""Vision Transformer (ViT) for image classification, TPU-first.
+
+NHWC images (TPU-native, like models/resnet.py); the patch projection is a
+single dense matmul over flattened patches — on the MXU that IS the conv,
+without the conv lowering. Parameter naming follows the TP sharding rules
+(query/key/value/attn_out, intermediate/mlp_out), and the HF weight bridge
+(utils/hf_interop.py, family "vit") maps google/vit-style checkpoints onto
+it, reconciling torch's NCHW conv kernel with the NHWC patch order.
+
+Reference-capability note: the reference framework runs torchvision/timm
+models through torch wrappers (reference: examples/cv_example.py); this is
+the shipped-native equivalent at transformer parity with HF ViT.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    num_channels: int = 3
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    layer_norm_eps: float = 1e-12
+    hidden_dropout_prob: float = 0.0
+    attention_probs_dropout_prob: float = 0.0
+    num_labels: int = 1000
+
+    @classmethod
+    def base(cls, **overrides):
+        return dataclasses.replace(cls(), **overrides)
+
+    @classmethod
+    def tiny(cls, **overrides):
+        cfg = cls(image_size=32, patch_size=8, hidden_size=64,
+                  num_hidden_layers=2, num_attention_heads=4,
+                  intermediate_size=128, num_labels=10)
+        return dataclasses.replace(cfg, **overrides)
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+    @property
+    def num_patches(self):
+        return (self.image_size // self.patch_size) ** 2
+
+
+class ViTSelfAttention(nn.Module):
+    config: ViTConfig
+
+    @nn.compact
+    def __call__(self, x, deterministic=True):
+        cfg = self.config
+        B, S, _ = x.shape
+        H, D = cfg.num_attention_heads, cfg.head_dim
+        dense = lambda feats, name: nn.Dense(feats, name=name, dtype=x.dtype,
+                                             param_dtype=jnp.float32)
+        q = dense(H * D, "query")(x).reshape(B, S, H, D)
+        k = dense(H * D, "key")(x).reshape(B, S, H, D)
+        v = dense(H * D, "value")(x).reshape(B, S, H, D)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q * (D ** -0.5), k)
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(x.dtype)
+        probs = nn.Dropout(cfg.attention_probs_dropout_prob,
+                           deterministic=deterministic)(probs)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(B, S, H * D)
+        return dense(cfg.hidden_size, "attn_out")(out)
+
+
+class ViTBlock(nn.Module):
+    config: ViTConfig
+
+    @nn.compact
+    def __call__(self, x, deterministic=True):
+        cfg = self.config
+        ln = lambda name: nn.LayerNorm(epsilon=cfg.layer_norm_eps, name=name,
+                                       param_dtype=jnp.float32)
+        # HF placement: dropout AFTER each output dense (ViTSelfOutput /
+        # ViTOutput), none on the intermediate activations.
+        drop = nn.Dropout(cfg.hidden_dropout_prob, deterministic=deterministic)
+        attn = ViTSelfAttention(cfg, name="attention")(
+            ln("norm_before")(x), deterministic=deterministic)
+        x = x + drop(attn)
+        h = nn.Dense(cfg.intermediate_size, name="intermediate", dtype=x.dtype,
+                     param_dtype=jnp.float32)(ln("norm_after")(x))
+        h = jax.nn.gelu(h, approximate=False)  # HF ViT uses exact gelu
+        h = nn.Dense(cfg.hidden_size, name="mlp_out", dtype=x.dtype,
+                     param_dtype=jnp.float32)(h)
+        return x + drop(h)
+
+
+def patchify(images: jnp.ndarray, patch: int) -> jnp.ndarray:
+    """[B, H, W, C] NHWC -> [B, (H/p)*(W/p), C*p*p] with per-patch features
+    ordered (c, ph, pw) — exactly torch's Conv2d weight layout flattened, so
+    HF conv kernels convert by a single reshape+transpose."""
+    B, H, W, C = images.shape
+    x = images.reshape(B, H // patch, patch, W // patch, patch, C)
+    # -> [B, hp, wp, C, patch_h, patch_w]
+    x = x.transpose(0, 1, 3, 5, 2, 4)
+    return x.reshape(B, (H // patch) * (W // patch), C * patch * patch)
+
+
+class ViTForImageClassification(nn.Module):
+    config: ViTConfig
+
+    @nn.compact
+    def __call__(self, pixel_values, deterministic=True):
+        cfg = self.config
+        B = pixel_values.shape[0]
+        patches = patchify(pixel_values, cfg.patch_size)
+        x = nn.Dense(cfg.hidden_size, name="patch_projection",
+                     param_dtype=jnp.float32)(patches)
+        cls = self.param("cls_token", nn.initializers.zeros,
+                         (1, 1, cfg.hidden_size), jnp.float32)
+        x = jnp.concatenate([jnp.broadcast_to(cls, (B, 1, cfg.hidden_size)).astype(x.dtype), x],
+                            axis=1)
+        pos = self.param("position_embeddings", nn.initializers.normal(0.02),
+                         (1, cfg.num_patches + 1, cfg.hidden_size), jnp.float32)
+        x = x + pos.astype(x.dtype)
+        for i in range(cfg.num_hidden_layers):
+            x = ViTBlock(cfg, name=f"layer_{i}")(x, deterministic=deterministic)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="norm",
+                         param_dtype=jnp.float32)(x)
+        return nn.Dense(cfg.num_labels, name="classifier", param_dtype=jnp.float32)(x[:, 0])
+
+    def init_params(self, rng, batch_size=1):
+        cfg = self.config
+        dummy = jnp.zeros((batch_size, cfg.image_size, cfg.image_size,
+                           cfg.num_channels), jnp.float32)
+        return self.init(rng, dummy)["params"]

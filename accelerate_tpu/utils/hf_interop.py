@@ -206,8 +206,40 @@ _T5_RULES = [
     ("lm_head.weight", "lm_head/kernel", "t", None),
 ]
 
+_VIT_RULES = [
+    ("embeddings.cls_token", "cls_token", "copy", None),
+    ("embeddings.position_embeddings", "position_embeddings", "copy", None),
+    # torch Conv2d kernel [D, C, p, p] <-> dense over (c, ph, pw)-flattened
+    # patches [C*p*p, D] (models/vit.py patchify order matches exactly).
+    ("embeddings.patch_embeddings.projection.weight",
+     "patch_projection/kernel", "cf", None),
+    ("embeddings.patch_embeddings.projection.bias",
+     "patch_projection/bias", "copy", None),
+    ("encoder.layer.{i}.layernorm_before.weight", "layer_{i}/norm_before/scale", "copy", None),
+    ("encoder.layer.{i}.layernorm_before.bias", "layer_{i}/norm_before/bias", "copy", None),
+    ("encoder.layer.{i}.attention.attention.{p}.weight",
+     "layer_{i}/attention/{p}/kernel", "t", ("query", "key", "value")),
+    ("encoder.layer.{i}.attention.attention.{p}.bias",
+     "layer_{i}/attention/{p}/bias", "copy", ("query", "key", "value")),
+    ("encoder.layer.{i}.attention.output.dense.weight",
+     "layer_{i}/attention/attn_out/kernel", "t", None),
+    ("encoder.layer.{i}.attention.output.dense.bias",
+     "layer_{i}/attention/attn_out/bias", "copy", None),
+    ("encoder.layer.{i}.layernorm_after.weight", "layer_{i}/norm_after/scale", "copy", None),
+    ("encoder.layer.{i}.layernorm_after.bias", "layer_{i}/norm_after/bias", "copy", None),
+    ("encoder.layer.{i}.intermediate.dense.weight", "layer_{i}/intermediate/kernel", "t", None),
+    ("encoder.layer.{i}.intermediate.dense.bias", "layer_{i}/intermediate/bias", "copy", None),
+    ("encoder.layer.{i}.output.dense.weight", "layer_{i}/mlp_out/kernel", "t", None),
+    ("encoder.layer.{i}.output.dense.bias", "layer_{i}/mlp_out/bias", "copy", None),
+    ("layernorm.weight", "norm/scale", "copy", None),
+    ("layernorm.bias", "norm/bias", "copy", None),
+    ("classifier.weight", "classifier/kernel", "t", None),
+    ("classifier.bias", "classifier/bias", "copy", None),
+]
+
 _FAMILY_RULES = {
     "llama": _LLAMA_RULES,
+    "vit": _VIT_RULES,
     # Mistral checkpoints are llama-named tensor-for-tensor; the config adds
     # sliding_window (handled in config_from_hf).
     "mistral": _LLAMA_RULES,
@@ -222,6 +254,7 @@ _FAMILY_RULES = {
 _STRIP_PREFIXES = {
     "gpt2": ("transformer.",),
     "bert": ("bert.",),
+    "vit": ("vit.",),
     "llama": (),
     "mixtral": (),
     "t5": (),
@@ -257,6 +290,12 @@ def _apply_op(value: np.ndarray, op: str) -> np.ndarray:
         if value.ndim != 2:
             raise ValueError(f"op 't' expects a 2D weight, got shape {value.shape}")
         return np.ascontiguousarray(value.T)
+    if op == "cf":
+        # torch Conv2d kernel [out, in, kh, kw] -> dense kernel over
+        # (c, kh, kw)-flattened patches: [in*kh*kw, out].
+        if value.ndim != 4:
+            raise ValueError(f"op 'cf' expects a 4D conv kernel, got {value.shape}")
+        return np.ascontiguousarray(value.reshape(value.shape[0], -1).T)
     return value
 
 
@@ -353,6 +392,30 @@ def config_from_hf(hf_config: dict, family: Optional[str] = None):
             max_position_embeddings=get("n_positions", 1024),
             layer_norm_eps=get("layer_norm_epsilon", 1e-5),
         )
+    if family == "vit":
+        from ..models.vit import ViTConfig
+
+        act = get("hidden_act", "gelu")
+        if act != "gelu":
+            raise NotImplementedError(
+                f"hidden_act {act!r}: the flax ViT MLP is exact gelu")
+        if not get("qkv_bias", True):
+            raise NotImplementedError(
+                "qkv_bias=False ViT variants are not representable (the flax "
+                "attention projections carry biases)")
+        return ViTConfig(
+            image_size=get("image_size", 224),
+            patch_size=get("patch_size", 16),
+            num_channels=get("num_channels", 3),
+            hidden_size=get("hidden_size", 768),
+            num_hidden_layers=get("num_hidden_layers", 12),
+            num_attention_heads=get("num_attention_heads", 12),
+            intermediate_size=get("intermediate_size", 3072),
+            layer_norm_eps=get("layer_norm_eps", 1e-12),
+            hidden_dropout_prob=get("hidden_dropout_prob", 0.0),
+            attention_probs_dropout_prob=get("attention_probs_dropout_prob", 0.0),
+            num_labels=len(get("id2label", {i: i for i in range(1000)})),
+        )
     if family == "bert":
         from ..models.bert import BertConfig
 
@@ -428,6 +491,10 @@ def model_from_config(config, family: str):
         from ..models.bert import BertForSequenceClassification
 
         return BertForSequenceClassification(config)
+    if family == "vit":
+        from ..models.vit import ViTForImageClassification
+
+        return ViTForImageClassification(config)
     if family == "t5":
         from ..models.t5 import T5ForConditionalGeneration
 
@@ -531,12 +598,15 @@ def convert_hf_state_dict(
     return _nest(flat)
 
 
-def export_hf_state_dict(params: dict, family: str, *, prefix: str = "") -> dict:
+def export_hf_state_dict(params: dict, family: str, *, prefix: str = "",
+                         config=None) -> dict:
     """Our param pytree -> flat HF-named state dict (numpy, torch layouts).
 
     Inverse of :func:`convert_hf_state_dict`; raises on any param with no
     rule so checkpoints cannot silently lose weights. ``prefix`` lets callers
-    re-add a wrapper scope (e.g. ``"transformer."`` for GPT-2)."""
+    re-add a wrapper scope (e.g. ``"transformer."`` for GPT-2). ``config``
+    is required for families whose export is shape-ambiguous (vit: the conv
+    kernel's (channels, patch, patch) factorization)."""
     if family not in _COMPILED:
         raise ValueError(f"unsupported family {family!r}; supported: {sorted(_COMPILED)}")
     rules = _COMPILED[family]
@@ -560,7 +630,18 @@ def export_hf_state_dict(params: dict, family: str, *, prefix: str = "") -> dict
                 hf_key = _fill(hf_t, match)
                 if t5_gated and hf_key.endswith(".DenseReluDense.wi.weight"):
                     hf_key = hf_key.replace(".wi.weight", ".wi_0.weight")
-                out[prefix + hf_key] = _apply_op(value, op)
+                if op == "cf":
+                    # [in*p*p, out] -> [out, in, p, p]: the factorization
+                    # needs the config (shape alone is ambiguous).
+                    if config is None:
+                        raise ValueError(
+                            f"exporting {key!r} needs config= (conv kernel "
+                            "channel/patch factorization)")
+                    c, p = config.num_channels, config.patch_size
+                    out[prefix + hf_key] = np.ascontiguousarray(
+                        value.T.reshape(value.shape[1], c, p, p))
+                else:
+                    out[prefix + hf_key] = _apply_op(value, op)
                 break
         else:
             raise KeyError(f"no export rule for param {key!r} ({family})")

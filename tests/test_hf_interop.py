@@ -303,6 +303,50 @@ class TestMixtral:
         _roundtrip(params, "mixtral", hf.state_dict())
 
 
+class TestViT:
+    def _pair(self):
+        hf_cfg = transformers.ViTConfig(
+            image_size=32, patch_size=8, num_channels=3, hidden_size=32,
+            num_hidden_layers=2, num_attention_heads=4, intermediate_size=64,
+            hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)
+        hf_cfg.id2label = {0: "a", 1: "b", 2: "c"}
+        torch.manual_seed(0)
+        with torch.no_grad():
+            hf = transformers.ViTForImageClassification(hf_cfg).eval()
+        cfg = config_from_hf({**hf_cfg.to_dict(), "model_type": "vit"})
+        assert cfg.num_labels == 3 and cfg.patch_size == 8
+        from accelerate_tpu.models.vit import ViTForImageClassification
+
+        params = convert_hf_state_dict(hf.state_dict(), "vit", strict=True)
+        return hf, ViTForImageClassification(cfg), params, cfg
+
+    def test_forward_parity(self):
+        hf, model, params, _ = self._pair()
+        images = np.random.RandomState(0).randn(2, 3, 32, 32).astype(np.float32)
+        # ours: NHWC, HF: NCHW
+        ours = model.apply({"params": params},
+                           jnp.asarray(images.transpose(0, 2, 3, 1)))
+        with torch.no_grad():
+            theirs = hf(torch.from_numpy(images)).logits
+        _logits_close(ours, theirs)
+
+    def test_roundtrip(self):
+        hf, _, params, cfg = self._pair()
+        exported = export_hf_state_dict(params, "vit", prefix="", config=cfg)
+        back = convert_hf_state_dict(exported, "vit")
+        from accelerate_tpu.utils.hf_interop import _flatten
+
+        flat, flat_back = _flatten(params), _flatten(back)
+        assert set(flat) == set(flat_back)
+        for key in flat:
+            np.testing.assert_array_equal(flat[key], flat_back[key], err_msg=key)
+
+    def test_export_without_config_rejected(self):
+        _, _, params, _ = self._pair()
+        with pytest.raises(ValueError, match="needs config"):
+            export_hf_state_dict(params, "vit")
+
+
 class TestBeamSearch:
     def _pair(self):
         hf_cfg = transformers.LlamaConfig(
