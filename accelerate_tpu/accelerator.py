@@ -989,6 +989,47 @@ class Accelerator:
         return False
 
     # ------------------------------------------------------------------
+    # Preemption (graceful save-and-restart; completes the elastic story
+    # with `accelerate-tpu launch --max_restarts` + auto-resume)
+    # ------------------------------------------------------------------
+
+    #: exit code signalling "preempted after saving" — launchers and pod
+    #: schedulers treat nonzero as restart-eligible; 75 is EX_TEMPFAIL.
+    PREEMPTED_EXIT_CODE = 75
+
+    def install_preemption_handler(self, signals=None):
+        """Catch SIGTERM (the preemption notice on TPU pods and most
+        schedulers) and latch :attr:`preemption_requested`. The training
+        loop checks it at step boundaries and winds down::
+
+            accelerator.install_preemption_handler()
+            for batch in loader:
+                if accelerator.preemption_requested:
+                    accelerator.save_state()
+                    sys.exit(accelerator.PREEMPTED_EXIT_CODE)
+                step(batch)
+
+        ``launch --max_restarts`` (or the pod scheduler) then relaunches,
+        and ``load_state()`` resumes from the just-saved checkpoint. The
+        reference delegates this to torch elastic's restart-the-world
+        (reference: commands/launch.py:775-799); the handler only sets a
+        flag, so a signal mid-XLA-dispatch is safe."""
+        import signal as _signal
+
+        self._preemption_requested = False
+        for sig in signals or (_signal.SIGTERM,):
+            _signal.signal(sig, self._on_preemption_signal)
+
+    def _on_preemption_signal(self, signum, frame):
+        self._preemption_requested = True
+
+    @property
+    def preemption_requested(self) -> bool:
+        """True once a preemption signal arrived (see
+        :meth:`install_preemption_handler`)."""
+        return getattr(self, "_preemption_requested", False)
+
+    # ------------------------------------------------------------------
     # Autocast / profile (reference: accelerator.py:3383, 3423)
     # ------------------------------------------------------------------
 

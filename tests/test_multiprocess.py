@@ -109,7 +109,75 @@ print(f"finished at step {counter.step}", flush=True)
 """
 
 
+PREEMPT_TRAINER = """
+import os, signal, sys
+import numpy as np
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+import optax
+
+from accelerate_tpu import Accelerator, Model, ProjectConfiguration
+from accelerate_tpu.test_utils.training import RegressionData, init_mlp, mlp_apply, mse_loss
+
+project_dir, marker = sys.argv[1], sys.argv[2]
+acc = Accelerator(project_config=ProjectConfiguration(
+    project_dir=project_dir, automatic_checkpoint_naming=True))
+acc.install_preemption_handler()
+
+class StepCounter:
+    step = 0
+    def state_dict(self): return {"step": self.step}
+    def load_state_dict(self, sd): self.step = sd["step"]
+
+counter = StepCounter()
+model = Model(mlp_apply, init_mlp())
+model, opt = acc.prepare(model, optax.sgd(0.05))
+acc.register_for_checkpointing(counter)
+try:
+    acc.load_state()
+    print(f"resumed at step {counter.step}", flush=True)
+except FileNotFoundError:
+    print("fresh start", flush=True)
+
+data = RegressionData(32)
+batch = {k: np.stack([s[k] for s in data[:16]]) for k in data[0]}
+while counter.step < 8:
+    if acc.preemption_requested:
+        acc.save_state()
+        print(f"preempted: saved at step {counter.step}", flush=True)
+        sys.exit(acc.PREEMPTED_EXIT_CODE)
+    acc.backward(mse_loss, batch)
+    opt.step()
+    opt.zero_grad()
+    counter.step += 1
+    if counter.step == 4 and not os.path.exists(marker):
+        open(marker, "w").write("preempting")
+        # The pod scheduler's preemption notice: SIGTERM to this process.
+        os.kill(os.getpid(), signal.SIGTERM)
+print(f"finished at step {counter.step}", flush=True)
+"""
+
+
 class TestElasticLaunch:
+    def test_sigterm_saves_and_resumes(self, tmp_path):
+        """Graceful preemption: SIGTERM -> flag -> save_state -> exit(75);
+        --max_restarts relaunches and load_state resumes exactly where the
+        signal landed."""
+        script = tmp_path / "preempt_trainer.py"
+        script.write_text(PREEMPT_TRAINER)
+        project = tmp_path / "project"
+        marker = tmp_path / "marker"
+        res = _launch([
+            "--max_restarts", "1", "--restart_backoff", "0.1",
+            "--use_cpu_emulation",
+            str(script), str(project), str(marker),
+        ], timeout=600)
+        assert res.returncode == 0, res.stdout[-3000:] + res.stderr[-3000:]
+        assert "preempted: saved at step 4" in res.stdout
+        assert "resumed at step 4" in res.stdout
+        assert "finished at step 8" in res.stdout
+
     def test_max_restarts_recovers(self, tmp_path):
         script = tmp_path / "crash_once.py"
         script.write_text(CRASH_ONCE)
