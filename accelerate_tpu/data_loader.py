@@ -852,3 +852,72 @@ def skip_first_batches(dataloader, num_batches: int = 0):
         new.skip_batches = num_batches
         return new
     return SkipDataLoader(dataloader, skip_batches=num_batches)
+
+
+def pack_sequences(sequences, seq_len: int, pad_token_id: int = 0):
+    """Pack variable-length token sequences into fixed [N, seq_len] rows.
+
+    The training-throughput alternative to padding each document: documents
+    are greedily first-fit packed into rows; the returned batch carries
+    everything the models need to keep them independent:
+
+    * ``input_ids``   [N, L] — concatenated documents + trailing pad
+    * ``segment_ids`` [N, L] — 1, 2, ... per document, 0 on padding; the
+      attention mask (ops/attention.py segment semantics) blocks
+      cross-document attention
+    * ``positions``   [N, L] — restart at 0 for each document, so RoPE sees
+      every document at its own offsets
+    * ``labels``      [N, L] — next token *within* the document; -100 (the
+      ignored-index convention) at document boundaries and padding
+
+    Documents longer than ``seq_len`` are split into ``seq_len`` chunks
+    first (each chunk becomes its own segment). Use with
+    ``causal_lm_loss``/``fused_causal_lm_loss`` over a Llama-family model —
+    they forward positions/segment_ids automatically (other families'
+    apply signatures don't take these kwargs). Segment masking rides the
+    einsum attention path; backend "auto" falls back to it when
+    segment_ids are present.
+    """
+    chunks = []
+    for seq in sequences:
+        arr = np.asarray(seq, dtype=np.int32).reshape(-1)
+        for start in range(0, len(arr), seq_len):
+            piece = arr[start:start + seq_len]
+            if len(piece) > 0:
+                chunks.append(piece)
+    # Best-fit-decreasing via a bisect-sorted free list: O(n log n) in
+    # document count (a linear first-fit scan is quadratic — hours of
+    # Python for a 1M-doc corpus).
+    import bisect
+
+    rows: list[list[np.ndarray]] = []
+    free_sorted: list[tuple[int, int]] = []  # (free_space, row_index), sorted
+    for piece in sorted(chunks, key=len, reverse=True):
+        j = bisect.bisect_left(free_sorted, (len(piece), -1))
+        if j < len(free_sorted):
+            free, r = free_sorted.pop(j)
+            rows[r].append(piece)
+            if free - len(piece) > 0:
+                bisect.insort(free_sorted, (free - len(piece), r))
+        else:
+            rows.append([piece])
+            if seq_len - len(piece) > 0:
+                bisect.insort(free_sorted, (seq_len - len(piece), len(rows) - 1))
+
+    N = len(rows)
+    input_ids = np.full((N, seq_len), pad_token_id, np.int32)
+    segment_ids = np.zeros((N, seq_len), np.int32)
+    positions = np.zeros((N, seq_len), np.int32)
+    labels = np.full((N, seq_len), -100, np.int32)
+    for r, pieces in enumerate(rows):
+        offset = 0
+        for s, piece in enumerate(pieces, start=1):
+            n = len(piece)
+            input_ids[r, offset:offset + n] = piece
+            segment_ids[r, offset:offset + n] = s
+            positions[r, offset:offset + n] = np.arange(n)
+            # next-token labels stay inside the document
+            labels[r, offset:offset + n - 1] = piece[1:]
+            offset += n
+    return {"input_ids": input_ids, "segment_ids": segment_ids,
+            "positions": positions, "labels": labels}

@@ -289,7 +289,8 @@ class LlamaAttention(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, positions, causal=True, cache=None, cache_pos=None):
+    def __call__(self, x, positions, causal=True, cache=None, cache_pos=None,
+                 segment_ids=None):
         cfg = self.config
         B, S, _ = x.shape
         n_q, n_kv, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
@@ -318,6 +319,7 @@ class LlamaAttention(nn.Module):
 
         out = multi_head_attention(
             q, k, v, causal=causal, use_flash=cfg.use_flash_attention,
+            segment_ids=segment_ids,
             backend=cfg.attention_backend, sliding_window=cfg.sliding_window,
             block_q=cfg.flash_block_q, block_k=cfg.flash_block_k,
         )
@@ -341,10 +343,12 @@ class LlamaBlock(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, positions, cache=None, cache_pos=None):
+    def __call__(self, x, positions, cache=None, cache_pos=None, segment_ids=None):
         cfg = self.config
         attn_in = RMSNorm(cfg.rms_norm_eps, name="input_norm")(x)
-        attn = LlamaAttention(cfg, name="self_attn")(attn_in, positions, cache=cache, cache_pos=cache_pos)
+        attn = LlamaAttention(cfg, name="self_attn")(attn_in, positions, cache=cache,
+                                                      cache_pos=cache_pos,
+                                                      segment_ids=segment_ids)
         new_cache = None
         if cache is not None:
             attn, new_cache = attn
@@ -359,12 +363,17 @@ class LlamaModel(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, input_ids, positions=None, cache=None, cache_pos=None):
+    def __call__(self, input_ids, positions=None, cache=None, cache_pos=None,
+                 segment_ids=None):
         cfg = self.config
         if positions is None:
             start = 0 if cache_pos is None else cache_pos
             positions = start + jnp.arange(input_ids.shape[1], dtype=jnp.int32)[None, :]
             positions = jnp.broadcast_to(positions, input_ids.shape)
+        if segment_ids is not None and cache is not None:
+            raise ValueError(
+                "segment_ids (packed sequences) is a training feature; the "
+                "KV-cache decode path does not apply segment masking")
         embed = nn.Embed(cfg.vocab_size, cfg.hidden_size, name="embed_tokens", param_dtype=jnp.float32)
         x = embed(input_ids)
         block_cls = LlamaBlock
@@ -373,7 +382,7 @@ class LlamaModel(nn.Module):
         new_caches = []
         for i in range(cfg.num_hidden_layers):
             if cache is None:
-                x = block_cls(cfg, name=f"layers_{i}")(x, positions)
+                x = block_cls(cfg, name=f"layers_{i}")(x, positions, segment_ids=segment_ids)
             else:
                 x, layer_cache = block_cls(cfg, name=f"layers_{i}")(
                     x, positions, cache=cache[i], cache_pos=cache_pos
@@ -388,9 +397,10 @@ class LlamaForCausalLM(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, positions=None, cache=None, cache_pos=None,
-                 return_hidden=False):
+                 return_hidden=False, segment_ids=None):
         cfg = self.config
-        x = LlamaModel(cfg, name="model")(input_ids, positions, cache=cache, cache_pos=cache_pos)
+        x = LlamaModel(cfg, name="model")(input_ids, positions, cache=cache,
+                                          cache_pos=cache_pos, segment_ids=segment_ids)
         new_cache = None
         if cache is not None:
             x, new_cache = x
@@ -542,7 +552,14 @@ def causal_lm_loss(apply_fn):
     compile_train_step: next-token cross-entropy with optional loss mask."""
 
     def loss_fn(params, batch, rng=None):
-        logits = apply_fn({"params": params}, batch["input_ids"])
+        kwargs = {}
+        # Packed-sequence batches (data_loader.pack_sequences) carry
+        # per-token positions + segment ids; plain batches don't.
+        if "positions" in batch:
+            kwargs["positions"] = batch["positions"]
+        if "segment_ids" in batch:
+            kwargs["segment_ids"] = batch["segment_ids"]
+        logits = apply_fn({"params": params}, batch["input_ids"], **kwargs)
         return masked_next_token_ce(logits, batch)
 
     return loss_fn
@@ -560,7 +577,16 @@ def fused_causal_lm_loss(module: "LlamaForCausalLM", num_chunks: int = 8):
 
     def loss_fn(params, batch, rng=None):
         p = params["params"] if isinstance(params, dict) and "params" in params else params
-        h = module.apply({"params": p}, batch["input_ids"], return_hidden=True)  # [B,S,H]
+        kwargs = {}
+        # Packed batches (data_loader.pack_sequences) — same forwarding as
+        # causal_lm_loss, or documents would silently attend across each
+        # other under the memory-efficient head.
+        if "positions" in batch:
+            kwargs["positions"] = batch["positions"]
+        if "segment_ids" in batch:
+            kwargs["segment_ids"] = batch["segment_ids"]
+        h = module.apply({"params": p}, batch["input_ids"], return_hidden=True,
+                         **kwargs)  # [B,S,H]
         if cfg.tie_word_embeddings:
             kernel = p["model"]["embed_tokens"]["embedding"].T
         else:
