@@ -1,0 +1,73 @@
+"""Sequence packing: train on variable-length documents without padding waste.
+
+``pack_sequences`` bins documents into fixed-length rows (best-fit
+decreasing); ``segment_ids`` block cross-document attention and
+``positions`` restart RoPE per document, so the packed forward is exactly
+the sum of the standalone forwards — at a fraction of the padded token
+count. The fused train step consumes the packed batch unchanged
+(``causal_lm_loss`` forwards the packed keys).
+
+No reference counterpart: the reference framework leaves packing to user
+code; here it is a first-class, correctness-tested data utility.
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np
+import optax
+
+from accelerate_tpu import Accelerator, Model
+from accelerate_tpu.data_loader import make_global_batch, pack_sequences
+from accelerate_tpu.models.llama import LlamaConfig, LlamaForCausalLM, causal_lm_loss
+from accelerate_tpu.utils import set_seed
+from example_lib import common_parser
+
+
+def training_function(args):
+    set_seed(args.seed)
+    accelerator = Accelerator(mixed_precision=args.mixed_precision)
+    cfg = LlamaConfig.tiny(use_flash_attention=False)
+    model_def = LlamaForCausalLM(cfg)
+    import jax
+
+    params = model_def.init_params(jax.random.PRNGKey(args.seed))
+    model, optimizer = accelerator.prepare(Model(model_def, params), optax.adamw(args.lr))
+    step = accelerator.compile_train_step(causal_lm_loss(model_def.apply),
+                                          max_grad_norm=1.0)
+
+    rng = np.random.default_rng(args.seed)
+    # A synthetic "corpus" of ragged documents.
+    docs = [rng.integers(0, cfg.vocab_size, size=int(n)).astype(np.int32)
+            for n in rng.integers(4, args.seq_len, size=256)]
+    packed = pack_sequences(docs, seq_len=args.seq_len)
+    total_tokens = sum(len(d) for d in docs)
+    rows = packed["input_ids"].shape[0]
+    fill = total_tokens / (rows * args.seq_len)
+    accelerator.print(
+        f"packed {len(docs)} docs ({total_tokens} tokens) into {rows} rows "
+        f"of {args.seq_len} — {fill:.0%} fill vs "
+        f"{total_tokens / (len(docs) * args.seq_len):.0%} if padded per-doc")
+
+    pad_rows = -(-rows // 8) * 8 - rows  # device-divisible row count
+    batch = {
+        k: np.concatenate(
+            [v, np.full((pad_rows, v.shape[1]), -100 if k == "labels" else 0, v.dtype)])
+        for k, v in packed.items()
+    }
+    for epoch in range(args.epochs):
+        m = step(make_global_batch(batch, accelerator.mesh))
+        accelerator.print(f"epoch {epoch}: loss {float(m['loss']):.4f}")
+
+
+def main():
+    parser = common_parser(__doc__)
+    parser.add_argument("--seq_len", type=int, default=64)
+    args = parser.parse_args()
+    training_function(args)
+
+
+if __name__ == "__main__":
+    main()

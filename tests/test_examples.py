@@ -39,6 +39,7 @@ SCRIPTS = {
     "moe_context_parallel.py": ["--steps", "4"],
     "native_data_pipeline.py": ["--seq_len", "64"],
     "hf_checkpoint_finetune.py": [],
+    "sequence_packing.py": ["--seq_len", "32"],
 }
 
 
