@@ -132,6 +132,35 @@ class LazyWeight:
         return arr
 
 
+@dataclasses.dataclass
+class LazyStack:
+    """A stacked tensor whose members are still on disk (mixtral experts:
+    E per-expert matrices -> one (E, in, out) param). Loaded and stacked
+    only when the owning block is fetched."""
+
+    members: list  # [(shard_path, ckpt_key, member_op)] in stack order
+    dtype: Optional[Any] = None
+
+    def load(self) -> np.ndarray:
+        from safetensors import safe_open
+
+        from .utils.hf_interop import _apply_op
+
+        # One safe_open per distinct shard (members usually share one file;
+        # re-parsing its header per member would recur on every block fetch).
+        parts: list = [None] * len(self.members)
+        by_path: dict[str, list[int]] = {}
+        for i, (path, _, _) in enumerate(self.members):
+            by_path.setdefault(path, []).append(i)
+        for path, idxs in by_path.items():
+            with safe_open(path, framework="numpy") as f:
+                for i in idxs:
+                    _, key, op = self.members[i]
+                    parts[i] = _apply_op(f.get_tensor(key), op or "copy")
+        arr = np.stack(parts)
+        return arr if self.dtype is None else arr.astype(self.dtype)
+
+
 class WeightStore:
     """Flat ``{param_name: entry}`` with per-name placement. Entries are
     jax.Arrays (resident in HBM), numpy arrays (host DRAM), or LazyWeight
@@ -156,7 +185,7 @@ class WeightStore:
         for name in self.names_under(prefix):
             rel = name[len(prefix) + 1:] if name != prefix else name.rsplit(".", 1)[-1]
             val = self.entries[name]
-            if isinstance(val, LazyWeight):
+            if isinstance(val, (LazyWeight, LazyStack)):
                 val = val.load()
             if device is not None and not _on_device(val, device):
                 val = jax.device_put(val, device)
@@ -167,9 +196,10 @@ class WeightStore:
         total = 0
         for name, val in self.entries.items():
             place = self.placement.get(name)
-            k = "disk" if isinstance(val, LazyWeight) else ("cpu" if place == "cpu" else "device")
+            lazy = isinstance(val, (LazyWeight, LazyStack))
+            k = "disk" if lazy else ("cpu" if place == "cpu" else "device")
             if kind is None or k == kind:
-                if isinstance(val, LazyWeight):
+                if lazy:
                     total += 0
                 else:
                     total += int(np.prod(val.shape)) * val.dtype.itemsize if hasattr(val, "shape") else 0
@@ -220,8 +250,11 @@ def block_specs_for(module) -> Optional[list[BlockSpec]]:
     for unknown architectures (caller must pass specs explicitly)."""
     from .models.gpt2 import GPT2LMHeadModel
     from .models.llama import LlamaForCausalLM
+    from .models.mixtral import MixtralForCausalLM
     from .models.t5 import T5ForConditionalGeneration
 
+    if isinstance(module, MixtralForCausalLM):  # before its Llama parent check
+        return _mixtral_block_specs(module.config)
     if isinstance(module, LlamaForCausalLM):
         return _llama_block_specs(module.config)
     if isinstance(module, GPT2LMHeadModel):
@@ -231,9 +264,12 @@ def block_specs_for(module) -> Optional[list[BlockSpec]]:
     return None
 
 
-def _llama_block_specs(cfg) -> list[BlockSpec]:
+def _decoder_block_specs(cfg, block_cls, scope: str, has_aux: bool) -> list[BlockSpec]:
+    """Shared decoder-only spec builder: llama (params under "model.",
+    blocks return x) and mixtral (flat params, blocks return (x, aux) —
+    router losses, dropped at inference)."""
     import flax.linen as nn
-    from .models.llama import LlamaBlock, RMSNorm
+    from .models.llama import RMSNorm
 
     def embed_apply(ptrees, input_ids):
         embed = nn.Embed(cfg.vocab_size, cfg.hidden_size, param_dtype=jnp.float32)
@@ -242,10 +278,13 @@ def _llama_block_specs(cfg) -> list[BlockSpec]:
             jnp.arange(input_ids.shape[1], dtype=jnp.int32)[None, :], input_ids.shape)
         return x, positions
 
-    block = LlamaBlock(cfg)
+    block = block_cls(cfg)
 
     def layer_apply(ptrees, x, positions):
-        return block.apply({"params": ptrees[0]}, x, positions), positions
+        out = block.apply({"params": ptrees[0]}, x, positions)
+        if has_aux:
+            out, _aux = out
+        return out, positions
 
     def head_apply(ptrees, x, positions):
         h = RMSNorm(cfg.rms_norm_eps).apply({"params": ptrees[0]}, x)
@@ -267,9 +306,11 @@ def _llama_block_specs(cfg) -> list[BlockSpec]:
 
     def layer_cached(ptrees, args, cache, pos):
         x, positions = args
-        x, new_cache = block.apply(
-            {"params": ptrees[0]}, x, positions, cache=cache, cache_pos=pos
-        )
+        out = block.apply({"params": ptrees[0]}, x, positions, cache=cache, cache_pos=pos)
+        if has_aux:
+            x, _aux, new_cache = out
+        else:
+            x, new_cache = out
         return (x, positions), new_cache
 
     def head_cached(ptrees, args, cache, pos):
@@ -277,17 +318,24 @@ def _llama_block_specs(cfg) -> list[BlockSpec]:
         return (head_apply(ptrees, x, positions),), None
 
     specs = [
-        BlockSpec("embed", ("model.embed_tokens",), embed_apply, kind="embed",
+        BlockSpec("embed", (f"{scope}embed_tokens",), embed_apply, kind="embed",
                   cached_apply=embed_cached)
     ]
     for i in range(cfg.num_hidden_layers):
-        specs.append(BlockSpec(f"layers_{i}", (f"model.layers_{i}",), layer_apply,
+        specs.append(BlockSpec(f"layers_{i}", (f"{scope}layers_{i}",), layer_apply,
                                kind="layer", cache_slot=True,
                                cached_apply=layer_cached))
-    head_prefixes = ("model.norm", "model.embed_tokens") if cfg.tie_word_embeddings else ("model.norm", "lm_head")
+    head_prefixes = ((f"{scope}norm", f"{scope}embed_tokens") if cfg.tie_word_embeddings
+                     else (f"{scope}norm", "lm_head"))
     specs.append(BlockSpec("head", head_prefixes, head_apply, kind="head",
                            cached_apply=head_cached))
     return specs
+
+
+def _llama_block_specs(cfg) -> list[BlockSpec]:
+    from .models.llama import LlamaBlock
+
+    return _decoder_block_specs(cfg, LlamaBlock, "model.", has_aux=False)
 
 
 def cache_factory_for(module) -> Optional[Callable]:
@@ -372,6 +420,15 @@ def _gpt2_block_specs(cfg) -> list[BlockSpec]:
     specs.append(BlockSpec("head", ("ln_f", "wte"), head_apply, kind="head",
                            cached_apply=head_cached))
     return specs
+
+
+def _mixtral_block_specs(cfg) -> list[BlockSpec]:
+    """Sparse-MoE decoder streaming: shared decoder builder with flat param
+    names (models/mixtral.py:130) and aux-carrying blocks. Stacked expert
+    tensors arrive via LazyStack for HF per-expert shards."""
+    from .models.mixtral import MixtralBlock
+
+    return _decoder_block_specs(cfg, MixtralBlock, "", has_aux=True)
 
 
 def _t5_block_specs(cfg) -> list[BlockSpec]:
@@ -824,6 +881,9 @@ def load_checkpoint_in_model(
     expected = set(named_parameters(abstract_params).keys()) if abstract_params is not None else None
     seen = set()
     memmap_index: dict = {}
+    # key -> {member_index: (shard_path, ckpt_key, member_op)} for params
+    # aggregated from several checkpoint tensors (op "stack:<e>[:t]").
+    stack_parts: dict[str, dict[int, tuple]] = {}
 
     for shard_path, keys in _checkpoint_shards(checkpoint):
         with safe_open(shard_path, framework="numpy") as f:
@@ -837,6 +897,11 @@ def load_checkpoint_in_model(
                 else:
                     key = ckpt_key
                 if expected is not None and key not in expected:
+                    continue
+                if op is not None and op.startswith("stack:"):
+                    _, idx, *rest = op.split(":")
+                    stack_parts.setdefault(key, {})[int(idx)] = (
+                        shard_path, ckpt_key, rest[0] if rest else None)
                     continue
                 seen.add(key)
                 place = _placement_for(key, device_map)
@@ -856,6 +921,35 @@ def load_checkpoint_in_model(
                     store.put(key, arr, place)
                 else:
                     store.put(key, jax.device_put(arr, _resolve_device(place)), place)
+    abstract_flat = named_parameters(abstract_params) if abstract_params is not None else {}
+    for key, parts in stack_parts.items():
+        # The abstract shape's leading dim is the authoritative member count
+        # (a truncated shard set missing *tail* experts must not pass).
+        n_members = (abstract_flat[key].shape[0] if key in abstract_flat
+                     else max(parts) + 1)
+        missing_members = set(range(n_members)) - set(parts)
+        if missing_members:
+            raise ValueError(
+                f"{key}: missing stacked members {sorted(missing_members)}")
+        seen.add(key)
+        members = [parts[i] for i in sorted(parts)]
+        place = _placement_for(key, device_map)
+        lazy = LazyStack(members, dtype)
+        if place == "disk" and not offload_to_memmap:
+            store.put(key, lazy, place)
+        elif place == "disk":
+            # Honor offload_to_memmap like single tensors: the offload
+            # folder must stand alone (original shards may be deleted).
+            from .utils.offload import offload_weight
+
+            arr = lazy.load()
+            memmap_index = offload_weight(arr, key, offload_folder, memmap_index)
+            store.put(key, LazyWeight(os.path.join(offload_folder, f"{key}.dat"), key,
+                                      None, memmap_info=memmap_index[key]), place)
+        elif place == "cpu":
+            store.put(key, lazy.load(), place)
+        else:
+            store.put(key, jax.device_put(lazy.load(), _resolve_device(place)), place)
     if memmap_index and offload_folder:
         from .utils.offload import save_offload_index
 
@@ -964,17 +1058,19 @@ def load_hf_checkpoint_and_dispatch(
     refs into the original HF shards (the transpose happens at block-fetch
     time). Returns ``(streamed_model, module)``.
 
-    Supported: decoder families with block specs (llama, mistral, gpt2).
-    Mixtral's per-expert shards need stacking, which has no lazy form — load
-    it with utils.load_hf_checkpoint + dispatch_model(params=...) instead.
+    Supported: llama, mistral, gpt2, mixtral (per-expert HF shards aggregate
+    lazily into stacked (E, in, out) tensors — LazyStack — so even the
+    disk tier never holds more than a block of experts), and t5
+    (encoder-decoder; generate via ``streamed.seq2seq_generate``).
     """
     from .utils.hf_interop import map_hf_key, open_hf_checkpoint
 
     family, config, module = open_hf_checkpoint(checkpoint_dir, config)
-    if family not in ("llama", "mistral", "gpt2", "t5"):
+    if family not in ("llama", "mistral", "gpt2", "t5", "mixtral"):
         raise ValueError(
-            f"streamed dispatch supports llama/mistral/gpt2/t5 (got {family!r}); "
-            "use utils.load_hf_checkpoint + dispatch_model for other families")
+            f"streamed dispatch supports llama/mistral/gpt2/t5/mixtral (got "
+            f"{family!r}); use utils.load_hf_checkpoint + dispatch_model for "
+            "other families")
 
     ids = np.zeros((1, 8), np.int32)
     streamed = load_checkpoint_and_dispatch(

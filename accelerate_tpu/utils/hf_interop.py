@@ -508,12 +508,20 @@ def map_hf_key(key: str, family: str) -> Optional[tuple[str, str]]:
     Returns None for rule-less keys (tied heads, buffers). This is the
     per-tensor streaming interface used by the big-model loader
     (big_modeling.load_checkpoint_in_model) so HF shards can be mapped
-    lazily without materializing the whole state dict; op "t" means the
-    tensor must be transposed when it is finally read.
+    lazily without materializing the whole state dict. Ops: "t" transposes
+    on read; "stack:<e>:t" (mixtral experts) marks the tensor as member
+    ``e`` of a stacked (E, in, out) param, transposed — the loader
+    aggregates all members before placing the name.
     """
     if family not in _COMPILED:
         raise ValueError(f"unsupported family {family!r}; supported: {sorted(_COMPILED)}")
     key = _strip_prefix(key, family)
+    if family == "mixtral":
+        em = _MIXTRAL_EXPERT_RE.match(key)
+        if em:
+            layer, expert, w = em.group(1), int(em.group(2)), em.group(3)
+            ours = f"layers_{layer}.mlp.experts.{_MIXTRAL_W_TO_NAME[w]}"
+            return ours, f"stack:{expert}:t"
     for hf_re, _, _, ours_t, op in _COMPILED[family]:
         match = hf_re.match(key)
         if match:

@@ -51,7 +51,8 @@ def training_function(args):
         f"of {args.seq_len} — {fill:.0%} fill vs "
         f"{total_tokens / (len(docs) * args.seq_len):.0%} if padded per-doc")
 
-    pad_rows = -(-rows // 8) * 8 - rows  # device-divisible row count
+    n_dev = len(jax.devices())
+    pad_rows = -(-rows // n_dev) * n_dev - rows  # device-divisible row count
     batch = {
         k: np.concatenate(
             [v, np.full((pad_rows, v.shape[1]), -100 if k == "labels" else 0, v.dtype)])
