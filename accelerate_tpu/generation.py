@@ -51,16 +51,33 @@ def supports_kv_cache(module) -> bool:
 _generate_cache: dict = {}
 
 
-def _make_selector(sampling):
-    """Token-selection fn (logits [B, V], rng) -> [B] ids. ``sampling`` is
-    None for greedy, else a (temperature, top_k, top_p) triple (static —
-    baked into the executable)."""
+def _make_selector(sampling, repetition_penalty: float = 1.0):
+    """Token-selection fn (logits [B, V], rng, seen [B, V] bool) -> [B] ids.
+    ``sampling`` is None for greedy, else a (temperature, top_k, top_p)
+    triple (static — baked into the executable). ``repetition_penalty``
+    applies the CTRL rule to already-seen tokens BEFORE the warpers, like
+    transformers' processor ordering: negative scores multiply by the
+    penalty, positive divide."""
+
+    if repetition_penalty <= 0:
+        raise ValueError(
+            f"repetition_penalty must be > 0, got {repetition_penalty} "
+            "(transformers semantics: >1 suppresses repeats, <1 boosts)")
+
+    def apply_penalty(logits, seen):
+        if repetition_penalty == 1.0:
+            return logits
+        logits = logits.astype(jnp.float32)
+        penalized = jnp.where(logits < 0, logits * repetition_penalty,
+                              logits / repetition_penalty)
+        return jnp.where(seen, penalized, logits)
+
     if sampling is None:
-        return lambda logits, rng: jnp.argmax(logits, axis=-1)
+        return lambda logits, rng, seen: jnp.argmax(apply_penalty(logits, seen), axis=-1)
     temperature, top_k, top_p = sampling
 
-    def select(logits, rng):
-        logits = logits.astype(jnp.float32) / max(temperature, 1e-6)
+    def select(logits, rng, seen):
+        logits = apply_penalty(logits, seen).astype(jnp.float32) / max(temperature, 1e-6)
         if top_k is not None and top_k > 0:
             k = min(top_k, logits.shape[-1])
             kth = jax.lax.top_k(logits, k)[0][:, -1:]
@@ -111,8 +128,15 @@ def _cache_put(key, value):
     return value
 
 
+def _mark_seen(seen, token_ids):
+    """seen [B, V] bool |= one-hot union of token_ids [B] or [B, S]."""
+    ids = token_ids if token_ids.ndim == 2 else token_ids[:, None]
+    B = seen.shape[0]
+    return seen.at[jnp.arange(B)[:, None], ids].set(True)
+
+
 def _decode_scan(step_fn, select, first_tok, carry_extra, start_pos,
-                 eos_token_id, num_steps: int, rng):
+                 eos_token_id, num_steps: int, rng, seen0, track_seen=True):
     """Shared decode loop: scan ``num_steps`` single-token forwards.
 
     ``step_fn(tok, extra, pos) -> (logits, extra)`` hides the family
@@ -120,47 +144,61 @@ def _decode_scan(step_fn, select, first_tok, carry_extra, start_pos,
     semantics: sequences that emitted eos keep emitting it (ragged stop
     inside a static-shape scan). Emits the *computed* token each step — the
     scan runs num_steps times and first_tok supplies the head, so no
-    forward's output is ever discarded.
+    forward's output is ever discarded. ``seen0`` [B, V] is the
+    repetition-penalty occurrence set (already including first_tok).
     """
     def body(carry, _):
-        tok, extra, pos, done, rng = carry
+        tok, extra, pos, done, rng, seen = carry
         logits, extra = step_fn(tok, extra, pos)
         rng, sub = jax.random.split(rng)
-        nxt = select(logits[:, -1], sub).astype(tok.dtype)
+        nxt = select(logits[:, -1], sub, seen).astype(tok.dtype)
         if eos_token_id is not None:
             nxt = jnp.where(done, jnp.asarray(eos_token_id, tok.dtype), nxt)
             done = done | (nxt == eos_token_id)
-        return (nxt, extra, pos + 1, done, rng), nxt
+        if track_seen:
+            seen = _mark_seen(seen, nxt)
+        return (nxt, extra, pos + 1, done, rng, seen), nxt
 
     done0 = jnp.zeros((first_tok.shape[0],), bool)
     if eos_token_id is not None:
         done0 = first_tok == eos_token_id
     _, toks = jax.lax.scan(
-        body, (first_tok, carry_extra, start_pos, done0, rng), None, length=num_steps)
+        body, (first_tok, carry_extra, start_pos, done0, rng, seen0), None,
+        length=num_steps)
     return jnp.concatenate([first_tok[:, None], toks.T], axis=1)
 
 
 def _compiled_generate(module, max_new_tokens: int, eos_token_id, cache_dtype,
-                       sampling=None):
+                       sampling=None, repetition_penalty: float = 1.0):
     """(prefill, decode) jitted pair for this (model config, length, eos,
     dtype) — cached so repeat generate calls reuse the same jitted function
     objects (and therefore jax.jit's executable cache) instead of retracing
     fresh closures every call."""
     key = _cache_key(module, max_new_tokens, eos_token_id,
-                     jnp.dtype(cache_dtype).name, sampling)
+                     jnp.dtype(cache_dtype).name, sampling, repetition_penalty)
     hit = _generate_cache.get(key) if key is not None else None
     if hit is not None:
         return hit
 
-    select = _make_selector(sampling)
+    select = _make_selector(sampling, repetition_penalty)
+
+    track_seen = repetition_penalty != 1.0
 
     @jax.jit
     def prefill(params, ids, cache, rng):
         logits, cache = module.apply({"params": params}, ids, cache=cache, cache_pos=0)
-        return select(logits[:, -1], rng).astype(ids.dtype), cache
+        if track_seen:
+            # Repetition penalty counts the prompt too (transformers
+            # semantics); off the penalty path the tracking (a [B, V] bool
+            # per call) is skipped entirely — a (B, 1) dummy rides the carry.
+            seen = _mark_seen(jnp.zeros((ids.shape[0], logits.shape[-1]), bool), ids)
+        else:
+            seen = jnp.zeros((ids.shape[0], 1), bool)
+        tok = select(logits[:, -1], rng, seen).astype(ids.dtype)
+        return tok, cache, (_mark_seen(seen, tok) if track_seen else seen)
 
     @jax.jit
-    def decode(params, first_tok, cache, start_pos, rng):
+    def decode(params, first_tok, cache, start_pos, rng, seen):
         # (No donation: the final cache is discarded, not an output, so the
         # input buffers cannot alias anything — XLA reuses the scan carry
         # buffers in place regardless.)
@@ -168,7 +206,8 @@ def _compiled_generate(module, max_new_tokens: int, eos_token_id, cache_dtype,
             return module.apply({"params": params}, tok[:, None], cache=cache, cache_pos=pos)
 
         return _decode_scan(step, select, first_tok, cache, start_pos,
-                            eos_token_id, max_new_tokens - 1, rng)
+                            eos_token_id, max_new_tokens - 1, rng, seen,
+                            track_seen=track_seen)
 
     return _cache_put(key, (prefill, decode))
 
@@ -195,12 +234,14 @@ def generate(
     temperature: float = 1.0,
     top_k: Optional[int] = None,
     top_p: Optional[float] = None,
+    repetition_penalty: float = 1.0,
     rng=None,
 ):
     """KV-cached decoding, fully compiled (prefill + scan): greedy by
     default, ancestral sampling with temperature / top-k / top-p when
-    ``do_sample=True`` (the transformers-generate surface the reference's
-    users rely on).
+    ``do_sample=True``, CTRL-style ``repetition_penalty`` over
+    prompt+generated tokens (the transformers-generate surface the
+    reference's users rely on).
 
     Args:
       module: a cache-threading model (see :func:`supports_kv_cache`).
@@ -229,7 +270,7 @@ def generate(
             module, params, input_ids, max_new_tokens=max_new_tokens,
             eos_token_id=eos_token_id, cache_dtype=cache_dtype,
             do_sample=do_sample, temperature=temperature, top_k=top_k,
-            top_p=top_p, rng=rng)
+            top_p=top_p, repetition_penalty=repetition_penalty, rng=rng)
     factory = cache_factory_for(module)
     if factory is None:
         raise TypeError(
@@ -248,10 +289,11 @@ def generate(
     sampling = (float(temperature), top_k, top_p) if do_sample else None
     rng = rng if rng is not None else jax.random.PRNGKey(0)
     prefill, decode = _compiled_generate(module, max_new_tokens, eos_token_id, dtype,
-                                         sampling=sampling)
+                                         sampling=sampling,
+                                         repetition_penalty=float(repetition_penalty))
     rng, pre_rng = jax.random.split(rng)
-    first_tok, cache = prefill(params, ids, cache, pre_rng)
-    new_toks = decode(params, first_tok, cache, jnp.asarray(S, jnp.int32), rng)
+    first_tok, cache, seen = prefill(params, ids, cache, pre_rng)
+    new_toks = decode(params, first_tok, cache, jnp.asarray(S, jnp.int32), rng, seen)
     return jnp.concatenate([ids, new_toks], axis=1)
 
 
@@ -405,6 +447,7 @@ def seq2seq_generate(
     temperature: float = 1.0,
     top_k: Optional[int] = None,
     top_p: Optional[float] = None,
+    repetition_penalty: float = 1.0,
     rng=None,
 ):
     """KV-cached encoder-decoder decoding (T5-style modules exposing
@@ -427,28 +470,32 @@ def seq2seq_generate(
     rng = rng if rng is not None else jax.random.PRNGKey(0)
 
     encode, prefill, decode = _compiled_seq2seq(module, max_new_tokens, eos_token_id,
-                                                dtype, sampling)
+                                                dtype, sampling,
+                                                float(repetition_penalty))
     enc = encode(params, ids, attention_mask)
     # Capacity max_new_tokens: the last generated token is returned, never
     # fed back, so the highest cache_pos written is max_new_tokens - 1.
     cache = module.init_decode_cache(B, max_new_tokens, dtype)
     start = jnp.full((B, 1), decoder_start_token_id, ids.dtype)
     rng, pre_rng = jax.random.split(rng)
-    first_tok, cache, cross_kv = prefill(params, enc, attention_mask, start, cache, pre_rng)
-    new_toks = decode(params, enc, attention_mask, first_tok, cache, cross_kv, rng)
+    first_tok, cache, cross_kv, seen = prefill(params, enc, attention_mask, start,
+                                               cache, pre_rng)
+    new_toks = decode(params, enc, attention_mask, first_tok, cache, cross_kv, rng, seen)
     return jnp.concatenate([start, new_toks], axis=1)
 
 
-def _compiled_seq2seq(module, max_new_tokens: int, eos_token_id, cache_dtype, sampling):
+def _compiled_seq2seq(module, max_new_tokens: int, eos_token_id, cache_dtype, sampling,
+                      repetition_penalty: float = 1.0):
     """(encode, prefill, decode) jitted triple, cached like
     :func:`_compiled_generate` so repeat calls never retrace."""
     key = _cache_key(module, "seq2seq", max_new_tokens, eos_token_id,
-                     jnp.dtype(cache_dtype).name, sampling)
+                     jnp.dtype(cache_dtype).name, sampling, repetition_penalty)
     hit = _generate_cache.get(key) if key is not None else None
     if hit is not None:
         return hit
 
-    select = _make_selector(sampling)
+    select = _make_selector(sampling, repetition_penalty)
+    track_seen = repetition_penalty != 1.0
 
     @jax.jit
     def encode(params, ids, mask):
@@ -459,10 +506,17 @@ def _compiled_seq2seq(module, max_new_tokens: int, eos_token_id, cache_dtype, sa
         logits, cache, cross_kv = module.apply(
             {"params": params}, decoder_input_ids=start_tok, attention_mask=mask,
             mode="decode", encoder_out=enc, cache=cache, cache_pos=0)
-        return select(logits[:, -1], rng).astype(start_tok.dtype), cache, cross_kv
+        if track_seen:
+            # HF penalizes over the decoder sequence (start token included).
+            seen = _mark_seen(jnp.zeros((start_tok.shape[0], logits.shape[-1]), bool),
+                              start_tok)
+        else:
+            seen = jnp.zeros((start_tok.shape[0], 1), bool)
+        tok = select(logits[:, -1], rng, seen).astype(start_tok.dtype)
+        return tok, cache, cross_kv, (_mark_seen(seen, tok) if track_seen else seen)
 
     @jax.jit
-    def decode(params, enc, mask, first_tok, cache, cross_kv, rng):
+    def decode(params, enc, mask, first_tok, cache, cross_kv, rng, seen):
         def step(tok, cache, pos):
             logits, cache, _ = module.apply(
                 {"params": params}, decoder_input_ids=tok[:, None], attention_mask=mask,
@@ -471,6 +525,7 @@ def _compiled_seq2seq(module, max_new_tokens: int, eos_token_id, cache_dtype, sa
             return logits, cache
 
         return _decode_scan(step, select, first_tok, cache, jnp.asarray(1, jnp.int32),
-                            eos_token_id, max_new_tokens - 1, rng)
+                            eos_token_id, max_new_tokens - 1, rng, seen,
+                            track_seen=track_seen)
 
     return _cache_put(key, (encode, prefill, decode))

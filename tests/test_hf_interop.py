@@ -76,6 +76,26 @@ class TestLlama:
         hf, _, params = self._pair()
         _roundtrip(params, "llama", hf.state_dict())
 
+    def test_repetition_penalty_matches_hf(self):
+        """CTRL-rule penalty over prompt+generated tokens, greedy — must
+        change the output AND match transformers exactly."""
+        from accelerate_tpu.generation import generate
+
+        hf, model, params = self._pair()
+        ids = (np.arange(10, dtype=np.int64)[None] * 3) % 128
+        plain = np.asarray(generate(model, params, jnp.asarray(ids, jnp.int32),
+                                    max_new_tokens=8, cache_dtype=jnp.float32))
+        for penalty in (1.8, 0.05):  # suppress repeats / strongly boost them
+            ours = np.asarray(generate(model, params, jnp.asarray(ids, jnp.int32),
+                                       max_new_tokens=8, repetition_penalty=penalty,
+                                       cache_dtype=jnp.float32))
+            with torch.no_grad():
+                theirs = hf.generate(torch.from_numpy(ids).long(), max_new_tokens=8,
+                                     do_sample=False, repetition_penalty=penalty)
+            np.testing.assert_array_equal(ours, theirs.numpy(), err_msg=str(penalty))
+        # The boosting penalty must force repeated tokens != plain greedy.
+        assert not np.array_equal(ours, plain)
+
     def test_llama3_rope_scaling_parity(self):
         """Llama-3.1-style checkpoints carry rope_scaling; logits must match
         HF's scaled-RoPE implementation, not silently use vanilla RoPE."""
@@ -468,6 +488,25 @@ class TestT5Generate:
         src = jnp.asarray((np.arange(8)[None] * 5) % 100, jnp.int32)
         out = generate(model, params, src, max_new_tokens=4)
         assert out.shape == (1, 5)  # start token + 4 generated
+
+    def test_repetition_penalty_seq2seq_matches_hf(self):
+        from accelerate_tpu.generation import seq2seq_generate
+
+        hf, model, params = self._make()
+        src = (np.arange(16, dtype=np.int64).reshape(2, 8) * 7) % 100
+        ours = np.asarray(seq2seq_generate(
+            model, params, jnp.asarray(src, jnp.int32), max_new_tokens=7,
+            decoder_start_token_id=0, eos_token_id=1, repetition_penalty=1.7,
+            cache_dtype=jnp.float32))
+        with torch.no_grad():
+            theirs = hf.generate(torch.from_numpy(src),
+                                 attention_mask=torch.ones_like(torch.from_numpy(src)),
+                                 max_new_tokens=7, do_sample=False,
+                                 repetition_penalty=1.7).numpy()
+        for row_ours, row_hf in zip(ours, theirs):
+            hf_eos = np.where(row_hf == 1)[0]
+            stop = (hf_eos[0] + 1) if hf_eos.size else len(row_hf)
+            np.testing.assert_array_equal(row_ours[:stop], row_hf[:stop])
 
     def test_cached_matches_full_forward(self):
         """Per-step cached logits == teacher-forced full forward logits."""
