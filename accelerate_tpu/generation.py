@@ -128,6 +128,18 @@ def _cache_put(key, value):
     return value
 
 
+def _suppress_eos(last, gen_index, eos_token_id, min_new_tokens: int):
+    """Mask the EOS column of ``last`` [B, V] while the token being selected
+    (generation index ``gen_index``, 1-based; may be traced) is still within
+    ``min_new_tokens`` — HF MinNewTokensLength semantics: EOS is first
+    allowed at new token min+1."""
+    if eos_token_id is None or min_new_tokens < 1:
+        return last
+    allow = jnp.asarray(gen_index) > min_new_tokens
+    eos_col = last[:, eos_token_id]
+    return last.at[:, eos_token_id].set(jnp.where(allow, eos_col, -jnp.inf))
+
+
 def _mark_seen(seen, token_ids):
     """seen [B, V] bool |= one-hot union of token_ids [B] or [B, S]."""
     ids = token_ids if token_ids.ndim == 2 else token_ids[:, None]
@@ -136,7 +148,8 @@ def _mark_seen(seen, token_ids):
 
 
 def _decode_scan(step_fn, select, first_tok, carry_extra, start_pos,
-                 eos_token_id, num_steps: int, rng, seen0, track_seen=True):
+                 eos_token_id, num_steps: int, rng, seen0, track_seen=True,
+                 min_new_tokens: int = 0):
     """Shared decode loop: scan ``num_steps`` single-token forwards.
 
     ``step_fn(tok, extra, pos) -> (logits, extra)`` hides the family
@@ -147,11 +160,13 @@ def _decode_scan(step_fn, select, first_tok, carry_extra, start_pos,
     forward's output is ever discarded. ``seen0`` [B, V] is the
     repetition-penalty occurrence set (already including first_tok).
     """
-    def body(carry, _):
+    def body(carry, i):
         tok, extra, pos, done, rng, seen = carry
         logits, extra = step_fn(tok, extra, pos)
+        # This body emits generation index i+2 (first_tok is index 1).
+        last = _suppress_eos(logits[:, -1], i + 2, eos_token_id, min_new_tokens)
         rng, sub = jax.random.split(rng)
-        nxt = select(logits[:, -1], sub, seen).astype(tok.dtype)
+        nxt = select(last, sub, seen).astype(tok.dtype)
         if eos_token_id is not None:
             nxt = jnp.where(done, jnp.asarray(eos_token_id, tok.dtype), nxt)
             done = done | (nxt == eos_token_id)
@@ -163,19 +178,21 @@ def _decode_scan(step_fn, select, first_tok, carry_extra, start_pos,
     if eos_token_id is not None:
         done0 = first_tok == eos_token_id
     _, toks = jax.lax.scan(
-        body, (first_tok, carry_extra, start_pos, done0, rng, seen0), None,
-        length=num_steps)
+        body, (first_tok, carry_extra, start_pos, done0, rng, seen0),
+        jnp.arange(num_steps))
     return jnp.concatenate([first_tok[:, None], toks.T], axis=1)
 
 
 def _compiled_generate(module, max_new_tokens: int, eos_token_id, cache_dtype,
-                       sampling=None, repetition_penalty: float = 1.0):
+                       sampling=None, repetition_penalty: float = 1.0,
+                       min_new_tokens: int = 0):
     """(prefill, decode) jitted pair for this (model config, length, eos,
     dtype) — cached so repeat generate calls reuse the same jitted function
     objects (and therefore jax.jit's executable cache) instead of retracing
     fresh closures every call."""
     key = _cache_key(module, max_new_tokens, eos_token_id,
-                     jnp.dtype(cache_dtype).name, sampling, repetition_penalty)
+                     jnp.dtype(cache_dtype).name, sampling, repetition_penalty,
+                     min_new_tokens)
     hit = _generate_cache.get(key) if key is not None else None
     if hit is not None:
         return hit
@@ -194,7 +211,8 @@ def _compiled_generate(module, max_new_tokens: int, eos_token_id, cache_dtype,
             seen = _mark_seen(jnp.zeros((ids.shape[0], logits.shape[-1]), bool), ids)
         else:
             seen = jnp.zeros((ids.shape[0], 1), bool)
-        tok = select(logits[:, -1], rng, seen).astype(ids.dtype)
+        last = _suppress_eos(logits[:, -1], 1, eos_token_id, min_new_tokens)
+        tok = select(last, rng, seen).astype(ids.dtype)
         return tok, cache, (_mark_seen(seen, tok) if track_seen else seen)
 
     @jax.jit
@@ -207,7 +225,7 @@ def _compiled_generate(module, max_new_tokens: int, eos_token_id, cache_dtype,
 
         return _decode_scan(step, select, first_tok, cache, start_pos,
                             eos_token_id, max_new_tokens - 1, rng, seen,
-                            track_seen=track_seen)
+                            track_seen=track_seen, min_new_tokens=min_new_tokens)
 
     return _cache_put(key, (prefill, decode))
 
@@ -235,6 +253,7 @@ def generate(
     top_k: Optional[int] = None,
     top_p: Optional[float] = None,
     repetition_penalty: float = 1.0,
+    min_new_tokens: int = 0,
     rng=None,
 ):
     """KV-cached decoding, fully compiled (prefill + scan): greedy by
@@ -254,6 +273,10 @@ def generate(
       do_sample: sample instead of argmax.
       temperature / top_k / top_p: sampling knobs (static — each combination
         compiles once).
+      repetition_penalty: CTRL rule over prompt+generated tokens (>1
+        suppresses repeats, <1 boosts; applied before the warpers).
+      min_new_tokens: EOS is masked until this many tokens are generated
+        (EOS first allowed at new token min+1, HF semantics).
       rng: jax PRNG key for sampling (default PRNGKey(0)).
 
     Returns [B, S + max_new_tokens] ids (prompt + completion). For
@@ -270,7 +293,8 @@ def generate(
             module, params, input_ids, max_new_tokens=max_new_tokens,
             eos_token_id=eos_token_id, cache_dtype=cache_dtype,
             do_sample=do_sample, temperature=temperature, top_k=top_k,
-            top_p=top_p, repetition_penalty=repetition_penalty, rng=rng)
+            top_p=top_p, repetition_penalty=repetition_penalty,
+            min_new_tokens=min_new_tokens, rng=rng)
     factory = cache_factory_for(module)
     if factory is None:
         raise TypeError(
@@ -290,7 +314,8 @@ def generate(
     rng = rng if rng is not None else jax.random.PRNGKey(0)
     prefill, decode = _compiled_generate(module, max_new_tokens, eos_token_id, dtype,
                                          sampling=sampling,
-                                         repetition_penalty=float(repetition_penalty))
+                                         repetition_penalty=float(repetition_penalty),
+                                         min_new_tokens=int(min_new_tokens))
     rng, pre_rng = jax.random.split(rng)
     first_tok, cache, seen = prefill(params, ids, cache, pre_rng)
     new_toks = decode(params, first_tok, cache, jnp.asarray(S, jnp.int32), rng, seen)
@@ -448,6 +473,7 @@ def seq2seq_generate(
     top_k: Optional[int] = None,
     top_p: Optional[float] = None,
     repetition_penalty: float = 1.0,
+    min_new_tokens: int = 0,
     rng=None,
 ):
     """KV-cached encoder-decoder decoding (T5-style modules exposing
@@ -471,7 +497,8 @@ def seq2seq_generate(
 
     encode, prefill, decode = _compiled_seq2seq(module, max_new_tokens, eos_token_id,
                                                 dtype, sampling,
-                                                float(repetition_penalty))
+                                                float(repetition_penalty),
+                                                int(min_new_tokens))
     enc = encode(params, ids, attention_mask)
     # Capacity max_new_tokens: the last generated token is returned, never
     # fed back, so the highest cache_pos written is max_new_tokens - 1.
@@ -485,11 +512,12 @@ def seq2seq_generate(
 
 
 def _compiled_seq2seq(module, max_new_tokens: int, eos_token_id, cache_dtype, sampling,
-                      repetition_penalty: float = 1.0):
+                      repetition_penalty: float = 1.0, min_new_tokens: int = 0):
     """(encode, prefill, decode) jitted triple, cached like
     :func:`_compiled_generate` so repeat calls never retrace."""
     key = _cache_key(module, "seq2seq", max_new_tokens, eos_token_id,
-                     jnp.dtype(cache_dtype).name, sampling, repetition_penalty)
+                     jnp.dtype(cache_dtype).name, sampling, repetition_penalty,
+                     min_new_tokens)
     hit = _generate_cache.get(key) if key is not None else None
     if hit is not None:
         return hit
@@ -512,7 +540,8 @@ def _compiled_seq2seq(module, max_new_tokens: int, eos_token_id, cache_dtype, sa
                               start_tok)
         else:
             seen = jnp.zeros((start_tok.shape[0], 1), bool)
-        tok = select(logits[:, -1], rng, seen).astype(start_tok.dtype)
+        last = _suppress_eos(logits[:, -1], 1, eos_token_id, min_new_tokens)
+        tok = select(last, rng, seen).astype(start_tok.dtype)
         return tok, cache, cross_kv, (_mark_seen(seen, tok) if track_seen else seen)
 
     @jax.jit
@@ -526,6 +555,6 @@ def _compiled_seq2seq(module, max_new_tokens: int, eos_token_id, cache_dtype, sa
 
         return _decode_scan(step, select, first_tok, cache, jnp.asarray(1, jnp.int32),
                             eos_token_id, max_new_tokens - 1, rng, seen,
-                            track_seen=track_seen)
+                            track_seen=track_seen, min_new_tokens=min_new_tokens)
 
     return _cache_put(key, (encode, prefill, decode))

@@ -462,21 +462,78 @@ class TestT5Generate:
             feed_forward_proj="gated-gelu", tie_word_embeddings=False)
         hf, model, params = self._make(**over)
         src = (np.arange(16, dtype=np.int64).reshape(2, 8) * 7) % 100
+        # min_new_tokens on BOTH sides -> no early EOS anywhere, so the
+        # whole [B, 1+T] arrays must be exactly equal (same-length rows).
         ours = np.asarray(seq2seq_generate(
             model, params, jnp.asarray(src, jnp.int32), max_new_tokens=7,
-            decoder_start_token_id=0, eos_token_id=1, cache_dtype=jnp.float32))
+            decoder_start_token_id=0, eos_token_id=1, min_new_tokens=7,
+            cache_dtype=jnp.float32))
         with torch.no_grad():
             # Explicit all-ones mask: src contains token 0, which HF's
             # generate would otherwise treat as padding (pad_token_id=0).
             theirs = hf.generate(torch.from_numpy(src),
                                  attention_mask=torch.ones_like(torch.from_numpy(src)),
+                                 max_new_tokens=7, min_new_tokens=7,
+                                 do_sample=False).numpy()
+        np.testing.assert_array_equal(ours, theirs)
+
+    def test_early_eos_parity(self):
+        """No min_new_tokens: the EOS stop path itself — rows compare up to
+        and including HF's first EOS (past it HF pads, ours repeats EOS)."""
+        from accelerate_tpu.generation import seq2seq_generate
+
+        hf, model, params = self._make()
+        src = (np.arange(16, dtype=np.int64).reshape(2, 8) * 7) % 100
+        ours = np.asarray(seq2seq_generate(
+            model, params, jnp.asarray(src, jnp.int32), max_new_tokens=7,
+            decoder_start_token_id=0, eos_token_id=1, cache_dtype=jnp.float32))
+        with torch.no_grad():
+            theirs = hf.generate(torch.from_numpy(src),
+                                 attention_mask=torch.ones_like(torch.from_numpy(src)),
                                  max_new_tokens=7, do_sample=False).numpy()
-        # Compare up to and including the first EOS: past it HF pads with
-        # pad_token while ours repeats EOS (both are "stopped").
         for row_ours, row_hf in zip(ours, theirs):
             hf_eos = np.where(row_hf == 1)[0]
             stop = (hf_eos[0] + 1) if hf_eos.size else len(row_hf)
             np.testing.assert_array_equal(row_ours[:stop], row_hf[:stop])
+        # Stopped rows keep emitting EOS (static shape contract).
+        for row_ours, row_hf in zip(ours, theirs):
+            hf_eos = np.where(row_hf == 1)[0]
+            if hf_eos.size:
+                assert (row_ours[hf_eos[0]:] == 1).all()
+
+    def test_min_new_tokens_boundary_decoder_only(self):
+        """min_new < max on the decoder-only path: EOS must be allowed from
+        exactly new token min+1 — an off-by-one diverges from HF."""
+        from accelerate_tpu.generation import generate
+        from accelerate_tpu.models.llama import LlamaForCausalLM
+
+        torch.manual_seed(0)
+        hf_cfg = transformers.LlamaConfig(
+            vocab_size=64, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=64, tie_word_embeddings=False,
+            eos_token_id=1, pad_token_id=0)
+        with torch.no_grad():
+            hf = transformers.LlamaForCausalLM(hf_cfg).eval()
+        cfg = config_from_hf(hf_cfg.to_dict())
+        cfg.use_flash_attention = False
+        params = convert_hf_state_dict(hf.state_dict(), "llama", strict=True)
+        ids = (np.arange(6, dtype=np.int64)[None] * 5) % 64
+        for min_new in (1, 3, 5):
+            ours = np.asarray(generate(
+                LlamaForCausalLM(cfg), params, jnp.asarray(ids, jnp.int32),
+                max_new_tokens=8, eos_token_id=1, min_new_tokens=min_new,
+                cache_dtype=jnp.float32))
+            with torch.no_grad():
+                theirs = hf.generate(torch.from_numpy(ids).long(),
+                                     attention_mask=torch.ones(1, 6).long(),
+                                     max_new_tokens=8, min_new_tokens=min_new,
+                                     do_sample=False).numpy()
+            for row_ours, row_hf in zip(ours, theirs):
+                hf_eos = np.where(row_hf == 1)[0]
+                stop = (hf_eos[0] + 1) if hf_eos.size else len(row_hf)
+                np.testing.assert_array_equal(row_ours[:stop], row_hf[:stop],
+                                              err_msg=f"min_new={min_new}")
 
     def test_generate_routes_seq2seq(self):
         """supports_kv_cache(t5) is True, so generate() must work on it —
