@@ -97,6 +97,69 @@ class TestReadKey:
         assert decode_key(self._via_pipe(b"\x1b")) == KEY_CANCEL
 
 
+class TestInteractiveSelect:
+    """The real cursor path on a pty, in a subprocess with a hard timeout so
+    a regression can fail but never wedge the suite."""
+
+    def _run_on_pty(self, keys: bytes) -> str:
+        import subprocess
+        import sys as _sys
+
+        # The keys must be written only AFTER the menu has rendered (i.e.
+        # the child has switched the pty to cbreak): earlier bytes sit in
+        # the line discipline's canonical buffer — a bare ESC would be held
+        # there forever. Reads use select timeouts so a regression fails
+        # the subprocess timeout instead of wedging.
+        code = (
+            "import os, pty, select as sel, sys, time\n"
+            "pid, fd = pty.fork()\n"
+            "if pid == 0:\n"
+            "    sys.path.insert(0, %r)\n"
+            "    from accelerate_tpu.commands.menu import select\n"
+            "    choice = select('pick', ['alpha', 'beta', 'gamma'], default='alpha')\n"
+            "    print('CHOICE=' + choice)\n"
+            "    os._exit(0)\n"
+            "out = b''\n"
+            "def drain(until, stop=None):\n"
+            "    global out\n"
+            "    end = time.time() + until\n"
+            "    while time.time() < end:\n"
+            "        r, _, _ = sel.select([fd], [], [], 0.2)\n"
+            "        if not r:\n"
+            "            continue\n"
+            "        try:\n"
+            "            chunk = os.read(fd, 4096)\n"
+            "        except OSError:\n"
+            "            return False\n"
+            "        if not chunk:\n"
+            "            return False\n"
+            "        out += chunk\n"
+            "        if stop and stop in out:\n"
+            "            return True\n"
+            "    return True\n"
+            "drain(30, b'Enter selects')\n"
+            "os.write(fd, %r)\n"
+            "drain(20, b'CHOICE=')\n"
+            "os.waitpid(pid, 0)\n"
+            "sys.stdout.buffer.write(out)\n"
+        ) % (str(__import__('pathlib').Path(__file__).resolve().parent.parent), keys)
+        res = subprocess.run([_sys.executable, "-c", code], capture_output=True,
+                             timeout=90)
+        return res.stdout.decode(errors="replace")
+
+    def test_arrow_down_then_enter_picks_second(self):
+        out = self._run_on_pty(b"\x1b[B\r")
+        assert "CHOICE=beta" in out
+
+    def test_digit_jump_then_enter(self):
+        out = self._run_on_pty(b"3\r")
+        assert "CHOICE=gamma" in out
+
+    def test_escape_cancels_to_default(self):
+        out = self._run_on_pty(b"\x1b[B\x1b")
+        assert "CHOICE=alpha" in out
+
+
 class TestFallbackSelect:
     """Non-TTY path: numbered prompt over stdin."""
 
