@@ -72,16 +72,17 @@ def force_cpu_platform(num_virtual_devices: int | None = None) -> None:
     jax.config.update("jax_platforms", "cpu")
 
 
-def probe_backend_info(timeout: float = 60.0) -> dict | None:
+def probe_backend_info(timeout: float = 60.0, fresh: bool = False) -> dict | None:
     """Full default-backend report from a throwaway subprocess, or None.
 
     Initializing the default backend can hang irrecoverably in-process when
     the platform plugin's transport is down; only a process boundary lets us
     enforce a timeout. Returns ``{"platform", "device_count", "devices",
     "process_count"}`` on success, ``None`` on crash or timeout. Cached per
-    timeout value for the life of this process.
+    timeout value for the life of this process; ``fresh=True`` bypasses the
+    cache (long-lived watchers re-probe a tunnel that comes and goes).
     """
-    if timeout in _probe_cache:
+    if not fresh and timeout in _probe_cache:
         return _probe_cache[timeout]
     env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
     code = (

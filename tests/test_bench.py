@@ -1,0 +1,194 @@
+"""The driver bench contract: bench.py must always emit one JSON line, and
+the bench_watch watcher's persisted-best artifact must flow into it when the
+live TPU attempt fails (VERDICT r2 item 1: the round artifact should carry
+the best real number even if the tunnel is down at capture time)."""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench  # noqa: E402
+import bench_watch  # noqa: E402
+
+
+@pytest.fixture
+def artifacts(tmp_path, monkeypatch):
+    """Point every bench_watch artifact path into a temp dir."""
+    d = tmp_path / "bench_artifacts"
+    monkeypatch.setattr(bench_watch, "ARTIFACT_DIR", str(d))
+    monkeypatch.setattr(bench_watch, "HISTORY", str(d / "history.jsonl"))
+    monkeypatch.setattr(bench_watch, "BEST", str(d / "best.json"))
+    monkeypatch.setattr(bench_watch, "KERNELS", str(d / "kernels.json"))
+    monkeypatch.setattr(bench_watch, "SWEEP", str(d / "sweep.json"))
+    monkeypatch.setattr(bench_watch, "LOG", str(d / "watch.log"))
+    return d
+
+
+FAKE_BEST = {
+    "metric": "llama_train_tokens_per_sec_per_chip",
+    "value": 12345.6,
+    "unit": "tokens/s/chip",
+    "vs_baseline": 1.1,
+    "extra": {"mfu": 0.495, "step_ms": 66.0},
+    "captured_at": "2026-07-30T12:00:00",
+}
+
+
+def _emitted(capsys):
+    lines = [l for l in capsys.readouterr().out.splitlines() if l.strip().startswith("{")]
+    assert lines, "bench must emit a JSON line"
+    return json.loads(lines[-1])
+
+
+def test_persisted_best_reemitted_when_tunnel_down(artifacts, monkeypatch, capsys):
+    bench_watch._save_json(bench_watch.BEST, dict(FAKE_BEST))
+    from accelerate_tpu.utils import platforms
+
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    monkeypatch.delenv("ACCELERATE_TPU_PLATFORM", raising=False)
+    monkeypatch.setattr(platforms, "probe_default_backend", lambda timeout: None)
+    out = None
+    monkeypatch.setattr(bench, "run_bench", lambda on_tpu: pytest.fail("must not run live"))
+    bench.main()
+    out = _emitted(capsys)
+    assert out["value"] == FAKE_BEST["value"]
+    assert out["extra"]["mfu"] == 0.495
+    assert "persisted best" in out["extra"]["source"]
+    assert "probe" in out["error"]
+
+
+def test_tpu_child_failure_falls_back_to_persisted(artifacts, monkeypatch, capsys):
+    bench_watch._save_json(bench_watch.BEST, dict(FAKE_BEST))
+    from accelerate_tpu.utils import platforms
+
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    monkeypatch.delenv("ACCELERATE_TPU_PLATFORM", raising=False)
+    monkeypatch.setattr(platforms, "probe_default_backend", lambda timeout: "tpu")
+    monkeypatch.setattr(
+        bench, "_tpu_subprocess",
+        lambda timeout=480.0: (None, "child killed at 480s budget, during backend init"),
+    )
+    bench.main()
+    out = _emitted(capsys)
+    assert out["value"] == FAKE_BEST["value"]
+    assert "tpu attempt" in out["error"]
+    assert "child killed" in out["error"]
+
+
+def test_cpu_pin_never_uses_persisted(artifacts, monkeypatch, capsys):
+    """JAX_PLATFORMS=cpu bench.py = an explicit CPU run, not an archive read."""
+    bench_watch._save_json(bench_watch.BEST, dict(FAKE_BEST))
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    smoke = {"metric": bench.METRIC, "value": 1.0, "unit": "tokens/s/chip",
+             "vs_baseline": 0.0, "extra": {}}
+    monkeypatch.setattr(bench, "run_bench", lambda on_tpu: dict(smoke))
+    from accelerate_tpu.utils import platforms
+
+    monkeypatch.setattr(platforms, "force_cpu_platform", lambda *a, **k: None)
+    bench.main()
+    out = _emitted(capsys)
+    assert out["value"] == 1.0
+    assert out["extra"]["cpu_smoke"] is True
+
+
+def test_live_success_updates_best(artifacts, monkeypatch, capsys):
+    """A live TPU result better than the stored best replaces it and picks up
+    kernel/sweep evidence."""
+    bench_watch._save_json(bench_watch.BEST, dict(FAKE_BEST))
+    bench_watch._save_json(bench_watch.KERNELS, {"ok": True, "checks": {"flash_fwd": {"ok": True}},
+                                                 "timings_ms": {"flash_fwd": 1.0}, "ts": "t"})
+    bench_watch._save_json(bench_watch.SWEEP, {"best": {"block_q": 256, "block_k": 256},
+                                               "rows": [], "ts": "t"})
+    live = {"metric": bench.METRIC, "value": 20000.0, "unit": "tokens/s/chip",
+            "vs_baseline": 1.2, "extra": {"mfu": 0.54, "step_ms": 50.0}}
+    from accelerate_tpu.utils import platforms
+
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    monkeypatch.delenv("ACCELERATE_TPU_PLATFORM", raising=False)
+    monkeypatch.setattr(platforms, "probe_default_backend", lambda timeout: "tpu")
+    monkeypatch.setattr(bench, "_tpu_subprocess", lambda timeout=480.0: (dict(live), None))
+    bench.main()
+    out = _emitted(capsys)
+    assert out["value"] == 20000.0
+    assert "error" not in out
+    assert out["extra"]["compiled_kernels"]["ok"] is True
+    assert out["extra"]["flash_block_sweep"]["best"]["block_q"] == 256
+    stored = bench_watch._load_json(bench_watch.BEST)
+    assert stored["value"] == 20000.0
+    assert stored["extra"]["mfu"] == 0.54
+
+
+def test_worse_live_result_does_not_clobber_best(artifacts, monkeypatch, capsys):
+    bench_watch._save_json(bench_watch.BEST, dict(FAKE_BEST))
+    live = {"metric": bench.METRIC, "value": 100.0, "unit": "tokens/s/chip",
+            "vs_baseline": 0.1, "extra": {"mfu": 0.05, "step_ms": 500.0}}
+    from accelerate_tpu.utils import platforms
+
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    monkeypatch.delenv("ACCELERATE_TPU_PLATFORM", raising=False)
+    monkeypatch.setattr(platforms, "probe_default_backend", lambda timeout: "tpu")
+    monkeypatch.setattr(bench, "_tpu_subprocess", lambda timeout=480.0: (dict(live), None))
+    bench.main()
+    out = _emitted(capsys)
+    assert out["value"] == 100.0  # live run is still what the driver sees
+    stored = bench_watch._load_json(bench_watch.BEST)
+    assert stored["value"] == FAKE_BEST["value"]  # best survives
+
+
+class TestWatcherCycle:
+    def _patch_probe(self, monkeypatch, info):
+        from accelerate_tpu.utils import platforms
+
+        monkeypatch.setattr(platforms, "probe_backend_info",
+                            lambda timeout, fresh=False: info)
+
+    def test_down_tunnel_records_probe_event(self, artifacts, monkeypatch):
+        self._patch_probe(monkeypatch, None)
+        sleep = bench_watch.run_cycle()
+        assert sleep == bench_watch.DOWN_SLEEP
+        events = [json.loads(l) for l in open(bench_watch.HISTORY)]
+        assert events[-1]["event"] == "probe" and events[-1]["up"] is False
+
+    def test_full_cycle_persists_best_and_evidence(self, artifacts, monkeypatch):
+        self._patch_probe(monkeypatch, {"platform": "tpu", "device_count": 1,
+                                        "devices": ["TPU:0"], "process_count": 1})
+        results = {
+            "--liveness-run": {"ok": True, "backend": "tpu", "device_count": 1,
+                               "device_kind": "TPU v5e", "first_matmul_s": 1.0},
+            "--kernels-run": {"ok": True, "checks": {}, "timings_ms": {"k": 1.0},
+                              "backend": "tpu", "interpret_mode": False},
+            "--tpu-run": {"metric": bench.METRIC, "value": 9000.0, "unit": "tokens/s/chip",
+                          "vs_baseline": 1.0, "extra": {"mfu": 0.45, "step_ms": 90.0}},
+            "--sweep-run": {"ok": True, "rows": [], "best": {"block_q": 512, "block_k": 256},
+                            "backend": "tpu"},
+        }
+        monkeypatch.setattr(bench_watch, "_run_child",
+                            lambda mode, budget: (dict(results[mode]), None))
+        sleep = bench_watch.run_cycle()
+        assert sleep == bench_watch.SUCCESS_SLEEP
+        best = bench_watch._load_json(bench_watch.BEST)
+        assert best["value"] == 9000.0
+        assert best["extra"]["compiled_kernels"]["ok"] is True
+        assert best["extra"]["flash_block_sweep"]["best"]["block_q"] == 512
+        events = [json.loads(l) for l in open(bench_watch.HISTORY)]
+        kinds = [e["event"] for e in events]
+        assert kinds == ["probe", "liveness", "kernels", "tier1", "sweep"]
+
+    def test_tier_failure_retries_sooner(self, artifacts, monkeypatch):
+        self._patch_probe(monkeypatch, {"platform": "tpu", "device_count": 1,
+                                        "devices": ["TPU:0"], "process_count": 1})
+
+        def child(mode, budget):
+            if mode == "--liveness-run":
+                return {"ok": True, "backend": "tpu", "device_count": 1,
+                        "device_kind": "TPU v5e", "first_matmul_s": 1.0}, None
+            return None, f"child killed at {budget:.0f}s budget"
+
+        monkeypatch.setattr(bench_watch, "_run_child", child)
+        sleep = bench_watch.run_cycle()
+        assert sleep == bench_watch.PARTIAL_SLEEP
+        assert bench_watch._load_json(bench_watch.BEST) is None
