@@ -1,0 +1,148 @@
+"""Generate docs/package_reference/*.md from the package's docstrings.
+
+Usage:  python docs/gen_api_reference.py
+
+Pure introspection — imports the package on a pinned CPU platform, walks a
+curated module list (mirroring the reference's package_reference/ layout),
+and emits one markdown file per group: every public class with its public
+methods, every public function, each with its signature and the first
+paragraph of its docstring. Items without docstrings are listed bare, so
+gaps are visible rather than hidden.
+"""
+
+from __future__ import annotations
+
+import importlib
+import inspect
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from accelerate_tpu.utils.platforms import force_cpu_platform  # noqa: E402
+
+force_cpu_platform()
+
+OUT_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "package_reference")
+
+#: (output file stem, page title, [module paths], optional intro line)
+GROUPS = [
+    ("accelerator", "Accelerator", ["accelerate_tpu.accelerator"],
+     "The main orchestrator: `prepare`, the fused train step, collectives, checkpoint hooks."),
+    ("state", "State singletons", ["accelerate_tpu.state"],
+     "Process topology, mesh, precision, and accumulation state shared framework-wide."),
+    ("big_modeling", "Big-model inference", ["accelerate_tpu.big_modeling"],
+     "Meta-init, device maps, weight streaming, the block-streaming executor."),
+    ("generation", "Generation", ["accelerate_tpu.generation"],
+     "Fused KV-cached decoding: greedy/sampling, beam search, encoder-decoder."),
+    ("inference", "Pipelined inference", ["accelerate_tpu.inference"],
+     "PiPPy-parity staged inference over the pp axis."),
+    ("data_loader", "Data loading", ["accelerate_tpu.data_loader"],
+     "Sharded/dispatched loaders, global-batch assembly, skip/resume, packing."),
+    ("optimizer_scheduler", "Optimizer & scheduler",
+     ["accelerate_tpu.optimizer", "accelerate_tpu.scheduler"], None),
+    ("checkpointing", "Checkpointing", ["accelerate_tpu.checkpointing"], None),
+    ("tracking_logging", "Tracking & logging",
+     ["accelerate_tpu.tracking", "accelerate_tpu.logging"], None),
+    ("launchers", "Launchers & LocalSGD",
+     ["accelerate_tpu.launchers", "accelerate_tpu.local_sgd"], None),
+    ("parallel", "Parallelism",
+     ["accelerate_tpu.parallel.mesh", "accelerate_tpu.parallel.sharding",
+      "accelerate_tpu.parallel.pipeline", "accelerate_tpu.parallel.host_offload"],
+     "The mesh, sharding rules, the pipeline scan, and host offload."),
+    ("ops", "Ops & kernels",
+     ["accelerate_tpu.ops.attention", "accelerate_tpu.ops.flash_pallas",
+      "accelerate_tpu.ops.ring_attention", "accelerate_tpu.ops.moe",
+      "accelerate_tpu.ops.quant", "accelerate_tpu.ops.fused_loss"],
+     "Pallas flash attention, ring/Ulysses attention, MoE dispatch, fp8 matmul."),
+    ("kwargs", "Plugins & kwargs handlers", ["accelerate_tpu.utils.dataclasses"],
+     "Every plugin/config dataclass `Accelerator` accepts."),
+    ("precision", "Precision policies", ["accelerate_tpu.precision"], None),
+    ("utilities", "Utilities",
+     ["accelerate_tpu.utils.operations", "accelerate_tpu.utils.modeling",
+      "accelerate_tpu.utils.memory", "accelerate_tpu.utils.random",
+      "accelerate_tpu.utils.quantization", "accelerate_tpu.utils.environment",
+      "accelerate_tpu.utils.platforms", "accelerate_tpu.utils.hf_interop"], None),
+    ("native", "Native IO", ["accelerate_tpu.native.io"],
+     "The C++ parallel safetensors reader and token-bin prefetch ring."),
+]
+
+
+def first_paragraph(obj) -> str:
+    doc = inspect.getdoc(obj)
+    if not doc:
+        return "*(no docstring)*"
+    return doc.split("\n\n")[0].replace("\n", " ").strip()
+
+
+def signature_of(obj) -> str:
+    try:
+        return str(inspect.signature(obj))
+    except (ValueError, TypeError):
+        return "(...)"
+
+
+def public_members(mod):
+    """Classes and functions defined in (not imported into) the module."""
+    classes, functions = [], []
+    for name, obj in vars(mod).items():
+        if name.startswith("_") or getattr(obj, "__module__", None) != mod.__name__:
+            continue
+        if inspect.isclass(obj):
+            classes.append((name, obj))
+        elif inspect.isfunction(obj):
+            functions.append((name, obj))
+    return classes, functions
+
+
+def render_class(name: str, cls) -> list[str]:
+    lines = [f"### `{name}{signature_of(cls)}`", "", first_paragraph(cls), ""]
+    for mname, meth in sorted(vars(cls).items()):
+        if mname.startswith("_") or not (inspect.isfunction(meth) or isinstance(meth, property)):
+            continue
+        if isinstance(meth, property):
+            lines.append(f"- **`.{mname}`** (property) — {first_paragraph(meth.fget)}")
+        else:
+            lines.append(f"- **`.{mname}{signature_of(meth)}`** — {first_paragraph(meth)}")
+    lines.append("")
+    return lines
+
+
+def render_module(path: str) -> list[str]:
+    mod = importlib.import_module(path)
+    classes, functions = public_members(mod)
+    if not classes and not functions:
+        return []
+    lines = [f"## `{path}`", "", first_paragraph(mod), ""]
+    for name, cls in classes:
+        lines += render_class(name, cls)
+    for name, fn in functions:
+        lines += [f"### `{name}{signature_of(fn)}`", "", first_paragraph(fn), ""]
+    return lines
+
+
+def main() -> int:
+    os.makedirs(OUT_DIR, exist_ok=True)
+    index = ["# API reference", "",
+             "Generated from docstrings by `python docs/gen_api_reference.py` — do not edit by hand.", ""]
+    for stem, title, modules, intro in GROUPS:
+        lines = [f"# {title}", ""]
+        if intro:
+            lines += [intro, ""]
+        for path in modules:
+            lines += render_module(path)
+        with open(os.path.join(OUT_DIR, f"{stem}.md"), "w") as f:
+            f.write("\n".join(lines).rstrip() + "\n")
+        index.append(f"- [{title}]({stem}.md)")
+        print(f"wrote package_reference/{stem}.md")
+    index += ["", "CLI commands are documented in "
+              "[Launching scripts](../basic_tutorials/launch.md); run "
+              "`accelerate-tpu <command> --help` for flag-level detail."]
+    with open(os.path.join(OUT_DIR, "index.md"), "w") as f:
+        f.write("\n".join(index) + "\n")
+    print("wrote package_reference/index.md")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
