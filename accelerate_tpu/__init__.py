@@ -63,6 +63,7 @@ from .utils.dataclasses import (
     DistributedInitKwargs,
     DistributedType,
     ExpertParallelPlugin,
+    FP8RecipeKwargs,
     FullyShardedDataParallelPlugin,
     GradientAccumulationPlugin,
     GradScalerKwargs,

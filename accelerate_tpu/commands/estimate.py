@@ -107,6 +107,14 @@ def _fmt(nbytes: float) -> str:
 
 
 def estimate_command(args) -> int:
+    # Estimation is abstract math (eval_shape + byte counting) — but the
+    # PRNG key / tiny concrete arrays involved would initialize the default
+    # backend, which can hang indefinitely on a dead accelerator transport.
+    # Pin CPU: this command never needs a chip.
+    from ..utils.platforms import force_cpu_platform
+
+    force_cpu_platform()
+
     import jax.numpy as jnp
 
     from ..big_modeling import init_empty_weights

@@ -15,6 +15,13 @@ from pathlib import Path
 
 
 def merge_command(args) -> int:
+    # Loading checkpoint trees materializes arrays through the default
+    # backend, which can hang on a dead accelerator transport. Merging is
+    # a host-side byte shuffle — pin CPU unconditionally.
+    from ..utils.platforms import force_cpu_platform
+
+    force_cpu_platform()
+
     import numpy as np
 
     from ..checkpointing import flatten_params, load_array_tree, load_safetensors_model

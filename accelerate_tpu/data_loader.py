@@ -92,8 +92,8 @@ class BatchSamplerShard:
             bs = getattr(batch_sampler, "batch_size", None)
             if bs is not None and bs % num_processes != 0:
                 raise ValueError(
-                    f"To use `BatchSamplerShard` in `split_batches` mode, the batch size ({bs}) "
-                    f"needs to be a round multiple of the number of processes ({num_processes})."
+                    f"split_batches=True requires the batch size to divide evenly across "
+                    f"processes, but {bs} is not divisible by {num_processes}."
                 )
         self.batch_sampler = batch_sampler
         self.num_processes = num_processes

@@ -72,6 +72,72 @@ def force_cpu_platform(num_virtual_devices: int | None = None) -> None:
     jax.config.update("jax_platforms", "cpu")
 
 
+#: Cross-process probe results stay valid this long (seconds). A down
+#: tunnel probed by one CLI invocation shouldn't cost every subsequent
+#: invocation its own full probe timeout.
+PROBE_FILE_CACHE_TTL = 120.0
+
+
+def _probe_cache_path() -> str:
+    import tempfile
+
+    override = os.environ.get("ACCELERATE_TPU_PROBE_CACHE")
+    if override:
+        return override
+    uid = os.getuid() if hasattr(os, "getuid") else 0
+    return os.path.join(tempfile.gettempdir(), f"accelerate_tpu_probe_{uid}.json")
+
+
+def _read_probe_file(timeout: float):
+    """A recent cross-process "backend down" record, or a miss sentinel.
+
+    Only ``None`` (down) results are ever cached across processes: a stale
+    "up" record could send an unpinned process into in-process init of a
+    backend that died since — the exact hang this module exists to prevent.
+    A down record costs at worst a CPU fallback. Records under a *shorter*
+    probe timeout than requested are not trusted (the longer probe might
+    have succeeded), nor are files owned by another user or stamped in the
+    future.
+    """
+    import json
+    import time
+
+    path = _probe_cache_path()
+    try:
+        if hasattr(os, "getuid") and os.stat(path).st_uid != os.getuid():
+            return False
+        with open(path) as f:
+            rec = json.load(f)
+        elapsed = time.time() - rec["ts"]
+        if not 0 <= elapsed <= PROBE_FILE_CACHE_TTL:
+            return False
+        if rec["result"] is not None or rec["timeout"] < timeout:
+            return False
+        return None
+    except (OSError, ValueError, KeyError, TypeError):
+        return False
+
+
+def _write_probe_file(timeout: float, result) -> None:
+    """Record a "backend down" probe for other processes (see reader)."""
+    import json
+    import time
+
+    if result is not None:
+        return
+    path = _probe_cache_path()
+    tmp = f"{path}.{os.getpid()}.tmp"
+    try:
+        with open(tmp, "w") as f:
+            json.dump({"ts": time.time(), "timeout": timeout, "result": None}, f)
+        os.replace(tmp, path)
+    except OSError:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+
+
 def probe_backend_info(timeout: float = 60.0, fresh: bool = False) -> dict | None:
     """Full default-backend report from a throwaway subprocess, or None.
 
@@ -79,11 +145,28 @@ def probe_backend_info(timeout: float = 60.0, fresh: bool = False) -> dict | Non
     the platform plugin's transport is down; only a process boundary lets us
     enforce a timeout. Returns ``{"platform", "device_count", "devices",
     "process_count"}`` on success, ``None`` on crash or timeout. Cached per
-    timeout value for the life of this process; ``fresh=True`` bypasses the
-    cache (long-lived watchers re-probe a tunnel that comes and goes).
+    timeout value for the life of this process; "down" results are also
+    cached :data:`PROBE_FILE_CACHE_TTL` seconds across processes (a down
+    tunnel probed once shouldn't cost every CLI invocation its own full
+    timeout — "up" results are never file-cached, a stale one could hang
+    an unpinned process on a backend that died since). ``fresh=True``
+    bypasses both caches (long-lived watchers re-probe a tunnel that comes
+    and goes) but still refreshes the down-file for others.
+    ``ACCELERATE_TPU_PROBE_TIMEOUT`` overrides ``timeout`` globally.
     """
-    if not fresh and timeout in _probe_cache:
-        return _probe_cache[timeout]
+    env_timeout = os.environ.get("ACCELERATE_TPU_PROBE_TIMEOUT")
+    if env_timeout:
+        try:
+            timeout = float(env_timeout)
+        except ValueError:
+            pass  # malformed override: keep the caller's timeout
+    if not fresh:
+        if timeout in _probe_cache:
+            return _probe_cache[timeout]
+        cached = _read_probe_file(timeout)
+        if cached is not False:
+            _probe_cache[timeout] = cached
+            return cached
     env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
     code = (
         "import jax, json, sys\n"
@@ -107,6 +190,7 @@ def probe_backend_info(timeout: float = 60.0, fresh: bool = False) -> dict | Non
             except ValueError:
                 result = None
     _probe_cache[timeout] = result
+    _write_probe_file(timeout, result)
     return result
 
 

@@ -24,6 +24,14 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 # pool var the sitecustomize skips its TPU-relay dial at startup, which can
 # otherwise hang a fresh interpreter for minutes when the tunnel is flaky.
 os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+# Isolate the cross-process probe-result cache (utils/platforms.py) from
+# whatever a concurrently running watcher/CLI wrote on this machine — and
+# from the developer's own shell override, hence assignment, not setdefault.
+import tempfile as _tempfile
+
+os.environ["ACCELERATE_TPU_PROBE_CACHE"] = os.path.join(
+    _tempfile.mkdtemp(prefix="atpu_test_probe_"), "probe.json"
+)
 
 import jax  # noqa: E402
 
