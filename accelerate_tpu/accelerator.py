@@ -338,6 +338,15 @@ class Accelerator:
         self.gradient_state.plugin_kwargs.update({"num_steps": num_steps})
 
     @property
+    def even_batches(self):
+        """Default tail-padding behavior for prepared loaders (reference: :571)."""
+        return self.dataloader_config.even_batches
+
+    @even_batches.setter
+    def even_batches(self, value: bool):
+        self.dataloader_config.even_batches = value
+
+    @property
     def project_dir(self):
         return self.project_configuration.project_dir
 
@@ -542,17 +551,49 @@ class Accelerator:
 
     @contextlib.contextmanager
     def join_uneven_inputs(self, joinables, even_batches=None):
-        """Parity context (reference: :1091). With even_batches=True data
-        loading (our default) inputs are never uneven; this is a no-op
-        wrapper kept for API compatibility."""
+        """Train/evaluate on uneven inputs (reference: :1091).
+
+        Overrides ``even_batches`` on every prepared map-style dataloader's
+        batch sampler for the context's duration (reference behavior:
+        :1136-1157), plus the config default for loaders prepared inside
+        the context. ``joinables`` is accepted for API parity; there is no
+        torch Join to wrap — gradient synchronization here happens inside
+        compiled steps over global arrays, which REQUIRE every process to
+        dispatch the same programs. The supported uneven pattern is
+        therefore: iterate locally (per-process batch counts may differ —
+        run no per-batch collectives), then aggregate once after the loop
+        with ``gather_for_metrics(..., use_gather_object=True)`` /
+        ``pad_across_processes``. Exercised by
+        ``test_utils/scripts/test_script.py::check_uneven_tail`` in the
+        real multi-process lane.
+        """
+        restore: list[tuple] = []
         if even_batches is not None:
-            prev = self.dataloader_config.even_batches
+            restore.append((self.dataloader_config, self.dataloader_config.even_batches))
             self.dataloader_config.even_batches = even_batches
+            untoggleable = 0
+            for dl in self._dataloaders:
+                sampler = getattr(dl.base_dataloader, "batch_sampler", None)
+                if hasattr(sampler, "even_batches"):
+                    restore.append((sampler, sampler.even_batches))
+                    sampler.even_batches = even_batches
+                elif self.num_processes > 1:
+                    # Dispatcher or generic-iterable loader: nothing to
+                    # toggle (reference warns for iterable datasets too,
+                    # :1150-1155). Single-process loaders never pad, so the
+                    # override is vacuously in effect for them.
+                    untoggleable += 1
+            if untoggleable:
+                warnings.warn(
+                    f"Overriding even_batches only affects map-style dataloaders; "
+                    f"{untoggleable} prepared dispatcher/iterable loader(s) keep "
+                    f"their behavior."
+                )
         try:
             yield
         finally:
-            if even_batches is not None:
-                self.dataloader_config.even_batches = prev
+            for obj, prev in restore:
+                obj.even_batches = prev
 
     # ------------------------------------------------------------------
     # backward (reference: accelerator.py:2164)

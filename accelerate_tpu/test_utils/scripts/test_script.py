@@ -90,6 +90,48 @@ def check_gather_for_metrics(acc):
     print("  gather_for_metrics ok (exact epoch reconstruction)")
 
 
+def check_uneven_tail(acc):
+    """even_batches=False end to end: loader -> local eval loop -> one
+    ragged-safe aggregation (the join_uneven_inputs contract; reference
+    drives uneven tails through Join in test_script.py's DDP sections).
+
+    The supported pattern on global-array backends: iterate with
+    device_placement=False (per-process batch counts differ at the tail, so
+    no per-batch multi-host dispatch is allowed), compute locally, then
+    aggregate ONCE after the loop with gather_object — every process
+    executes exactly one collective regardless of its local batch count.
+    """
+    from accelerate_tpu import NumpyDataLoader
+
+    n = 37
+    data = [{"x": np.array([i], dtype=np.float32)} for i in range(n)]
+    loader = acc.prepare_data_loader(
+        NumpyDataLoader(data, batch_size=8), device_placement=False
+    )
+
+    sizes, local = [], []
+    with acc.join_uneven_inputs([], even_batches=False):
+        for batch in loader:
+            x = np.asarray(batch["x"]).reshape(-1)
+            sizes.append(len(x))
+            local.extend(float(v) for v in x * 2.0)  # stand-in local "model"
+    collected = acc.gather_for_metrics(local, use_gather_object=True)
+    expected = [float(2 * i) for i in range(n)]
+    assert sorted(collected) == expected, (
+        f"uneven tail lost/duplicated samples: got {len(collected)} of {n}"
+    )
+    # The tail really was uneven: the last local batch is short on exactly
+    # one process (37 = 2 full rounds of 16 + one 5-sample batch).
+    short = acc.gather_for_metrics([s for s in sizes if s < 8], use_gather_object=True)
+    assert short == [5], f"expected one 5-sample tail batch somewhere, got {short}"
+    # Context restored: the same loader pads again afterwards.
+    seen = sum(len(np.asarray(b["x"]).reshape(-1)) for b in loader)
+    total = acc.gather_for_metrics([seen], use_gather_object=True)
+    if acc.num_processes > 1:
+        assert all(s == total[0] for s in total), f"even_batches not restored: {total}"
+    print(f"  uneven tail ok (ragged sizes {sizes}, exact aggregation)")
+
+
 def check_training_convergence_multiprocess():
     """Multi-process stand-in for the parity check: a single-device baseline
     world cannot be constructed when this process only addresses a subset of
@@ -258,6 +300,7 @@ def main():
     check_split_between_processes(acc)
     check_dataloader_sharding(acc)
     check_gather_for_metrics(acc)
+    check_uneven_tail(acc)
     multi_process = state.num_processes > 1
     from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
 

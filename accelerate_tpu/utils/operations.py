@@ -237,20 +237,33 @@ def gather(tensor):
 
 
 def gather_object(object: Any):
-    """Gather arbitrary picklable objects from each process into a list
-    (reference: :449 — notably *unsupported* on TPU there; supported here)."""
+    """Gather arbitrary picklable objects from each process
+    (reference: :449 — notably *unsupported* on TPU there; supported here).
+
+    Matches the reference's concatenation semantics for the common case
+    (each process contributes a list/tuple; results flatten into one list —
+    reference :442-446 — which is what ``gather_for_metrics(...,
+    use_gather_object=True)`` relies on for ragged uneven-tail metrics).
+    Non-sequence payloads come back as one list entry per process in rank
+    order — where the reference would crash trying to flatten them.
+    """
     state = PartialState()
     if state.num_processes == 1:
-        return [object]
-    from jax.experimental import multihost_utils
-
-    payload = pickle.dumps(object)
-    n = np.array([len(payload)], dtype=np.int64)
-    lens = _process_allgather(n, tiled=False).reshape(-1)
-    max_len = int(lens.max())
-    buf = np.frombuffer(payload.ljust(max_len, b"\0"), dtype=np.uint8)
-    gathered = _process_allgather(buf, tiled=False)
-    return [pickle.loads(bytes(gathered[i][: int(lens[i])].tobytes())) for i in range(state.num_processes)]
+        objs = [object]
+    else:
+        payload = pickle.dumps(object)
+        n = np.array([len(payload)], dtype=np.int64)
+        lens = _process_allgather(n, tiled=False).reshape(-1)
+        max_len = int(lens.max())
+        buf = np.frombuffer(payload.ljust(max_len, b"\0"), dtype=np.uint8)
+        gathered = _process_allgather(buf, tiled=False)
+        objs = [
+            pickle.loads(bytes(gathered[i][: int(lens[i])].tobytes()))
+            for i in range(state.num_processes)
+        ]
+    if all(isinstance(o, (list, tuple)) for o in objs):
+        return [x for y in objs for x in y]
+    return objs
 
 
 @verify_operation

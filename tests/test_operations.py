@@ -80,6 +80,14 @@ def test_gather_object_single():
     assert gather_object({"a": 1}) == [{"a": 1}]
 
 
+def test_gather_object_flattens_sequences():
+    """Reference parity (operations.py:442-446): list payloads concatenate —
+    the contract gather_for_metrics(use_gather_object=True) relies on for
+    ragged uneven-tail aggregation."""
+    assert gather_object([1, 2, 3]) == [1, 2, 3]
+    assert gather_object((4, 5)) == [4, 5]
+
+
 def test_broadcast_single():
     x = {"t": jnp.ones(3)}
     out = broadcast(x)
