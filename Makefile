@@ -1,0 +1,33 @@
+# Budgeted test lanes (reference: Makefile:26-58). Lane membership lives in
+# tests/lanes.py — the single source of truth, guarded by tests/test_lanes.py.
+#
+#   make test-fast          unit core             (~5 min on a 1-core box)
+#   make test-models        model zoo + HF parity (~8 min)
+#   make test-subproc       CLI + example scripts (~9 min)
+#   make test-multiprocess  real jax.distributed  (~8 min)
+#   make test-all           full suite, no -x (one flake can't hide the rest)
+#
+# Dev loop: run test-fast after every change; the others before a commit
+# that touches their area; test-all before shipping.
+
+PYTHON ?= python
+
+.PHONY: test-fast test-models test-subproc test-multiprocess test-all quality
+
+test-fast:
+	$(PYTHON) -m pytest -q $$($(PYTHON) tests/lanes.py fast)
+
+test-models:
+	$(PYTHON) -m pytest -q $$($(PYTHON) tests/lanes.py models)
+
+test-subproc:
+	$(PYTHON) -m pytest -q $$($(PYTHON) tests/lanes.py subproc)
+
+test-multiprocess:
+	$(PYTHON) -m pytest -q $$($(PYTHON) tests/lanes.py multiprocess)
+
+test-all:
+	$(PYTHON) -m pytest -q tests/
+
+quality:
+	$(PYTHON) -m compileall -q accelerate_tpu bench.py bench_watch.py __graft_entry__.py

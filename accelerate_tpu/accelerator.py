@@ -553,10 +553,12 @@ class Accelerator:
     def join_uneven_inputs(self, joinables, even_batches=None):
         """Train/evaluate on uneven inputs (reference: :1091).
 
-        Overrides ``even_batches`` on every prepared map-style dataloader's
-        batch sampler for the context's duration (reference behavior:
-        :1136-1157), plus the config default for loaders prepared inside
-        the context. ``joinables`` is accepted for API parity; there is no
+        Overrides ``even_batches`` on every prepared HOST-side map-style
+        dataloader's batch sampler for the context's duration (reference
+        behavior: :1136-1157), plus the config default for loaders prepared
+        inside the context. Device-staged loaders are deliberately skipped
+        (with a warning): their per-batch multi-host dispatch would deadlock
+        on an uneven tail. ``joinables`` is accepted for API parity; there is no
         torch Join to wrap — gradient synchronization here happens inside
         compiled steps over global arrays, which REQUIRE every process to
         dispatch the same programs. The supported uneven pattern is
@@ -568,32 +570,48 @@ class Accelerator:
         real multi-process lane.
         """
         restore: list[tuple] = []
+        prev_default = self.dataloader_config.even_batches
+        n_loaders_at_entry = len(self._dataloaders)
         if even_batches is not None:
-            restore.append((self.dataloader_config, self.dataloader_config.even_batches))
+            restore.append((self.dataloader_config, prev_default))
             self.dataloader_config.even_batches = even_batches
             untoggleable = 0
             for dl in self._dataloaders:
                 sampler = getattr(dl.base_dataloader, "batch_sampler", None)
-                if hasattr(sampler, "even_batches"):
+                if hasattr(sampler, "even_batches") and not getattr(dl, "stage_to_device", False):
                     restore.append((sampler, sampler.even_batches))
                     sampler.even_batches = even_batches
                 elif self.num_processes > 1:
-                    # Dispatcher or generic-iterable loader: nothing to
-                    # toggle (reference warns for iterable datasets too,
+                    # Device-staged loaders are NOT toggled: uneven tails mean
+                    # per-process batch counts differ, and every device batch
+                    # implies a multi-host dispatch all processes must join —
+                    # toggling would trade padding for a distributed deadlock.
+                    # Prepare the eval loader with device_placement=False to
+                    # opt in (see the contract above). Dispatcher/iterable
+                    # loaders have nothing to toggle (reference warns too,
                     # :1150-1155). Single-process loaders never pad, so the
                     # override is vacuously in effect for them.
                     untoggleable += 1
             if untoggleable:
                 warnings.warn(
-                    f"Overriding even_batches only affects map-style dataloaders; "
-                    f"{untoggleable} prepared dispatcher/iterable loader(s) keep "
-                    f"their behavior."
+                    f"even_batches override skipped {untoggleable} prepared "
+                    f"loader(s): device-staged loaders would deadlock on uneven "
+                    f"tails (prepare with device_placement=False to opt in); "
+                    f"dispatcher/iterable loaders have nothing to toggle."
                 )
         try:
             yield
         finally:
             for obj, prev in restore:
                 obj.even_batches = prev
+            if even_batches is not None:
+                # Loaders prepared INSIDE the context baked the override into
+                # their samplers; restore them to the pre-context default so
+                # the toggle really is scoped to the context's duration.
+                for dl in self._dataloaders[n_loaders_at_entry:]:
+                    sampler = getattr(dl.base_dataloader, "batch_sampler", None)
+                    if hasattr(sampler, "even_batches"):
+                        sampler.even_batches = prev_default
 
     # ------------------------------------------------------------------
     # backward (reference: accelerator.py:2164)

@@ -77,15 +77,69 @@ def save_array_tree(tree, path: str | Path):
         (path / "tree.msgpack").write_bytes(serialization.to_bytes(host_tree))
 
 
-def load_array_tree(path: str | Path, target=None, shardings=None):
+def load_array_tree(path: str | Path, target=None, shardings=None, via_host: bool = False):
     """Restore a pytree; with ``shardings`` the arrays are restored directly
-    into the requested (possibly different) mesh layout — elastic resume."""
+    into the requested (possibly different) mesh layout — elastic resume.
+
+    ``via_host=True`` restores through host memory: every process reads the
+    full tree as numpy and rebuilds the global arrays shard-by-shard with
+    ``make_array_from_callback``. Slower, but the only path that is safe
+    when the restoring world differs from the saving one — orbax's direct
+    sharded restore can fail *asymmetrically* across processes there (some
+    ranks raise, others wait in its internal barrier), so it must not even
+    be attempted.
+    """
     path = Path(path).absolute()
     if _is_orbax_available() and not (path / "tree.msgpack").exists():
         import jax
         import orbax.checkpoint as ocp
 
         with ocp.PyTreeCheckpointer() as ckptr:
+            if target is not None and via_host:
+                # Force numpy restoration: a bare restore would rebuild the
+                # SAVING world's shardings from the checkpoint's sharding
+                # file, which don't exist in this world. restore_args must
+                # mirror the checkpoint's OWN structure (orbax serializes
+                # custom nodes like optax NamedTuples as lists), so build it
+                # from the checkpoint metadata, then zip leaves back onto
+                # the target's structure in flatten order.
+                meta = ckptr.metadata(path)
+                # StepMetadata wraps the saved tree (newer orbax); older
+                # versions return the tree directly.
+                inner = getattr(meta, "item_metadata", meta)
+                saved_tree = getattr(inner, "tree", inner)
+                restore_args = jax.tree_util.tree_map(
+                    lambda _: ocp.RestoreArgs(restore_type=np.ndarray), saved_tree
+                )
+                host = ckptr.restore(path, restore_args=restore_args)
+
+                t_leaves, treedef = jax.tree_util.tree_flatten(target)
+                s_leaves = (
+                    jax.tree_util.tree_leaves(shardings)
+                    if shardings is not None
+                    else [getattr(t, "sharding", None) for t in t_leaves]
+                )
+                h_leaves = jax.tree_util.tree_leaves(host)
+                if not len(t_leaves) == len(s_leaves) == len(h_leaves):
+                    raise ValueError(
+                        f"checkpoint at {path} has {len(h_leaves)} leaves but the "
+                        f"target tree has {len(t_leaves)} — structure changed?"
+                    )
+
+                def _place(sharding, val):
+                    val = np.asarray(val)
+                    # Single-device/None shardings (e.g. optimizer scalars):
+                    # hand back the host value uncommitted — a committed
+                    # single-device array would conflict with the mesh-wide
+                    # arguments at the next jitted step.
+                    if sharding is None or len(getattr(sharding, "device_set", ())) <= 1:
+                        return val
+                    return jax.make_array_from_callback(
+                        val.shape, sharding, lambda idx: val[idx]
+                    )
+
+                placed = [_place(s, v) for s, v in zip(s_leaves, h_leaves)]
+                return jax.tree_util.tree_unflatten(treedef, placed)
             if target is not None:
                 def _abstract(t, s=None):
                     sharding = s if s is not None else getattr(t, "sharding", None)
@@ -180,6 +234,15 @@ def save_accelerator_state(accelerator, output_dir: Optional[str] = None, safe_s
     if state.is_main_process:
         out.mkdir(parents=True, exist_ok=True)
     state.wait_for_everyone()
+    if state.is_main_process:
+        import jax
+
+        # The saving world's shape: load_accelerator_state uses it to pick
+        # the topology-change-safe restore path (elastic resume).
+        (out / "world.json").write_text(json.dumps({
+            "process_count": state.num_processes,
+            "device_count": jax.device_count(),
+        }))
 
     # Models (sharded arrays via orbax — all hosts participate).
     for i, model in enumerate(accelerator._models):
@@ -242,14 +305,34 @@ def load_accelerator_state(accelerator, input_dir: Optional[str] = None, load_kw
         raise FileNotFoundError(f"Checkpoint directory {src} does not exist")
     state = PartialState()
 
+    import jax
+
+    world_path = src / "world.json"
+    via_host = False
+    if world_path.exists():
+        saved_world = json.loads(world_path.read_text())
+        via_host = (
+            saved_world.get("process_count") != state.num_processes
+            or saved_world.get("device_count") != jax.device_count()
+        )
+        if via_host:
+            logger.info(
+                "Checkpoint written by %s processes / %s devices; restoring into "
+                "%s / %s via host memory (elastic reshard)",
+                saved_world.get("process_count"), saved_world.get("device_count"),
+                state.num_processes, jax.device_count(),
+            )
+
     for i, model in enumerate(accelerator._models):
         path = src / (f"{MODEL_NAME}_{i}" if i > 0 else MODEL_NAME)
-        model.params = load_array_tree(path, target=model.params, shardings=model.param_shardings)
+        model.params = load_array_tree(
+            path, target=model.params, shardings=model.param_shardings, via_host=via_host
+        )
 
     for i, opt in enumerate(accelerator._optimizers):
         path = src / (f"{OPTIMIZER_NAME}_{i}" if i > 0 else OPTIMIZER_NAME)
         if path.exists() and opt.opt_state is not None:
-            opt.opt_state = load_array_tree(path, target=opt.opt_state)
+            opt.opt_state = load_array_tree(path, target=opt.opt_state, via_host=via_host)
         meta_path = src / f"optimizer_meta_{i}.json"
         if meta_path.exists():
             meta = json.loads(meta_path.read_text())

@@ -295,12 +295,31 @@ def make_global_batch(local_batch, mesh, sharding=None):
 
     def _make(x):
         x = np.asarray(x)
-        # Leaves whose batch dim doesn't divide the batch axes (e.g. scalars,
-        # odd tails) are replicated instead of sharded.
-        sh = sharding if (x.ndim > 0 and n_shards > 1 and x.shape[0] % n_shards == 0) else replicated
-        if jax.process_count() > 1:
-            return jax.make_array_from_process_local_data(sh, x)
-        return jax.device_put(x, sh)
+        if jax.process_count() == 1:
+            # x IS the global batch. Leaves whose batch dim doesn't divide
+            # the batch axes (scalars, odd tails) replicate instead.
+            sh = sharding if (x.ndim > 0 and n_shards > 1 and x.shape[0] % n_shards == 0) else replicated
+            return jax.device_put(x, sh)
+        # Multi-process: x is only this process's contribution; the global
+        # batch is the rank-order concatenation, so divisibility must be
+        # judged on the GLOBAL row count.
+        global_rows = x.shape[0] * jax.process_count() if x.ndim > 0 else 0
+        if x.ndim > 0 and n_shards > 1 and global_rows % n_shards == 0:
+            try:
+                return jax.make_array_from_process_local_data(sharding, x)
+            except ValueError:
+                pass  # local rows don't tile this process's shards: replicate
+        if x.ndim == 0:
+            # Scalar leaves are host-synchronized by contract (same value fed
+            # on every process); replicate directly.
+            return jax.make_array_from_process_local_data(replicated, x)
+        # Replicated fallback: build the TRUE global value first. Feeding the
+        # local shard under a replicated sharding would silently give every
+        # process a different "global" array — per-process training, no error.
+        from jax.experimental import multihost_utils
+
+        full = np.asarray(multihost_utils.process_allgather(x, tiled=True))
+        return jax.make_array_from_process_local_data(replicated, full)
 
     return recursively_apply(_make, local_batch)
 

@@ -27,6 +27,7 @@ from .parallel.mesh import MeshConfig
 from .utils.dataclasses import (
     DistributedInitKwargs,
     DistributedType,
+    FullyShardedDataParallelPlugin,
     GradientAccumulationPlugin,
     PrecisionType,
 )
@@ -421,6 +422,13 @@ class AcceleratorState:
             # FSDP default: shard over ALL devices on the fsdp axis.
             mesh_config.fsdp = -1
             mesh_config.dp = 1
+        if fsdp_plugin is None and mesh_config.fsdp not in (0, 1):
+            # The converse: a requested fsdp mesh axis (launch --fsdp N /
+            # ACCELERATE_TPU_MESH_FSDP) implies the sharding policy — without
+            # a plugin the axis would silently act as extra data parallelism
+            # with fully replicated params.
+            fsdp_plugin = FullyShardedDataParallelPlugin()
+            self.fsdp_plugin = fsdp_plugin
         if tp_plugin is not None and tp_plugin.tp_size > 1:
             mesh_config.tp = tp_plugin.tp_size
         if cp_plugin is not None and cp_plugin.cp_size > 1:

@@ -1,0 +1,111 @@
+"""Checkpoint resharding across process counts: save under one world,
+restore under a different one (reference capability: elastic resume;
+torch.distributed.checkpoint re-sharding — here orbax restores into the
+new mesh's shardings, checkpointing.load_array_tree).
+
+Launched twice by tests/test_multiprocess.py against one shared directory:
+
+    ... launch --num_processes 2 --emulated_device_count 2 --dp 1 --fsdp 4 \
+        --module ...test_reshard_checkpoint <dir> save
+    ... launch --num_processes 4 --emulated_device_count 2 --dp 2 --fsdp 4 \
+        --module ...test_reshard_checkpoint <dir> restore
+
+The save phase trains a few steps and records per-leaf checksums of params
+AND optimizer state; the restore phase — different process count, different
+mesh — must reproduce them exactly after load_state, then take one more
+step to prove the restored state is trainable.
+"""
+
+import json
+import sys
+
+import numpy as np
+
+
+def _checksums(acc, model, opt):
+    """Topology-independent content hashes: global sums over each leaf.
+
+    Computed as jitted reductions over the (possibly multi-host) global
+    arrays; the result is fully replicated so every process can read it.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    out = {}
+
+    def add(prefix, tree):
+        flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+        for path, leaf in flat:
+            key = prefix + jax.tree_util.keystr(path)
+            if hasattr(leaf, "shape"):
+                out[key] = float(jax.jit(lambda x: jnp.sum(jnp.abs(x.astype(jnp.float32))))(leaf))
+
+    add("params", model.params)
+    add("opt", opt.opt_state)
+    return out
+
+
+def main():
+    import os
+
+    if os.environ.get("ACCELERATE_TPU_TEST_CPU") == "1":
+        from accelerate_tpu.test_utils import use_emulated_devices
+
+        use_emulated_devices(int(os.environ.get("ACCELERATE_TPU_TEST_DEVICES", "8")))
+    from accelerate_tpu import PartialState
+
+    state = PartialState()
+    import optax
+
+    from accelerate_tpu import Accelerator, Model, ProjectConfiguration
+    from accelerate_tpu.test_utils.training import RegressionData, init_mlp, mlp_apply, mse_loss
+
+    workdir, phase = sys.argv[1], sys.argv[2]
+    acc = Accelerator(project_config=ProjectConfiguration(
+        project_dir=workdir, automatic_checkpoint_naming=True))
+    model = Model(mlp_apply, init_mlp(dh=64))
+    model, opt = acc.prepare(model, optax.adamw(0.05))
+    step = acc.compile_train_step(mse_loss)
+
+    data = RegressionData(32, seed=0)
+    batch = {k: np.stack([s[k] for s in data[:16]]) for k in data[0]}
+    from accelerate_tpu.data_loader import make_global_batch
+
+    gbatch = make_global_batch(batch, acc.mesh)
+
+    expected_path = os.path.join(workdir, "expected_checksums.json")
+    if phase == "save":
+        for _ in range(4):
+            metrics = step(gbatch)
+        acc.save_state()
+        sums = _checksums(acc, model, opt)
+        if acc.is_main_process:
+            with open(expected_path, "w") as f:
+                json.dump({"checksums": sums, "loss": float(metrics["loss"]),
+                           "world": state.num_processes}, f)
+        acc.wait_for_everyone()
+        print(f"saved under {state.num_processes} processes "
+              f"(loss {float(metrics['loss']):.6f})", flush=True)
+    elif phase == "restore":
+        acc.load_state()
+        with open(expected_path) as f:
+            expected = json.load(f)
+        assert expected["world"] != state.num_processes, (
+            "reshard test must restore under a different process count")
+        sums = _checksums(acc, model, opt)
+        assert sums.keys() == expected["checksums"].keys(), (
+            sorted(sums), sorted(expected["checksums"]))
+        for key, want in expected["checksums"].items():
+            got = sums[key]
+            assert abs(got - want) <= 1e-4 * max(1.0, abs(want)), (key, got, want)
+        print(f"restored under {state.num_processes} processes: "
+              f"{len(sums)} leaf checksums match", flush=True)
+        metrics = step(gbatch)  # restored state must be trainable on the new mesh
+        print(f"post-restore step ok (loss {float(metrics['loss']):.6f})", flush=True)
+    else:
+        raise SystemExit(f"unknown phase {phase!r}")
+    print("reshard-checkpoint phase complete.", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -337,6 +337,10 @@ class FullyShardedDataParallelPlugin(KwargsHandler):
             self.cpu_offload = parse_flag_from_env("FSDP_OFFLOAD_PARAMS")
         if "FSDP_ACTIVATION_CHECKPOINTING" in env:
             self.activation_checkpointing = parse_flag_from_env("FSDP_ACTIVATION_CHECKPOINTING")
+        if "FSDP_MIN_NUM_PARAMS" in env:
+            # Reference parity (utils/dataclasses.py size_based_auto_wrap):
+            # the smallest tensor worth sharding, as a param count.
+            self.min_weight_size_to_shard = int(env["FSDP_MIN_NUM_PARAMS"])
         if self.sharding_strategy == "NO_SHARD":
             self.min_weight_size_to_shard = 1 << 62  # nothing shards
         if self.sharding_strategy == "SHARD_GRAD_OP":

@@ -143,6 +143,38 @@ class TestJoinUnevenInputsToggle:
         assert sampler.even_batches is True
         assert acc.dataloader_config.even_batches == prev_cfg
 
+    def test_device_staged_loader_is_skipped_with_warning(self):
+        """Toggling a device-staged loader would deadlock multi-host uneven
+        tails; the context must skip it (and say so when multi-process)."""
+        from unittest import mock
+
+        from accelerate_tpu import Accelerator
+
+        acc = Accelerator()
+        inner = make_batch_sampler(37, 8)
+        sampler = BatchSamplerShard(inner, num_processes=3, process_index=0)
+        data = [{"x": np.array([i], np.float32)} for i in range(37)]
+        base = NumpyDataLoader(data, batch_size=8, batch_sampler=sampler)
+        dl = DataLoaderShard(base, mesh=acc.mesh, stage_to_device=True)
+        acc._dataloaders.append(dl)
+        with mock.patch.object(Accelerator, "num_processes", property(lambda self: 3)):
+            with pytest.warns(UserWarning, match="device-staged"):
+                with acc.join_uneven_inputs([], even_batches=False):
+                    assert sampler.even_batches is True  # untouched
+
+    def test_loader_prepared_inside_context_reverts_on_exit(self):
+        from accelerate_tpu import Accelerator, NumpyDataLoader
+
+        acc = Accelerator()
+        data = [{"x": np.array([i], np.float32)} for i in range(37)]
+        with acc.join_uneven_inputs([], even_batches=False):
+            dl = acc.prepare_data_loader(NumpyDataLoader(data, batch_size=8),
+                                         device_placement=False)
+        sampler = getattr(dl.base_dataloader, "batch_sampler", None)
+        if hasattr(sampler, "even_batches"):  # multi-process worlds only
+            assert sampler.even_batches is True
+        assert acc.even_batches is True
+
     def test_restores_on_exception(self):
         from accelerate_tpu import Accelerator
 

@@ -1,0 +1,22 @@
+"""Guard: every test file belongs to exactly one lane (tests/lanes.py)."""
+
+import glob
+import os
+
+import lanes
+
+
+def test_every_test_file_is_assigned_to_exactly_one_lane():
+    here = os.path.dirname(os.path.abspath(__file__))
+    present = {os.path.basename(p) for p in glob.glob(os.path.join(here, "test_*.py"))}
+    assigned = lanes.all_assigned()
+    missing = present - assigned
+    assert not missing, f"assign these files to a lane in tests/lanes.py: {sorted(missing)}"
+    stale = assigned - present
+    assert not stale, f"remove deleted files from tests/lanes.py: {sorted(stale)}"
+    counts = {}
+    for _, files in lanes.LANES.values():
+        for f in files:
+            counts[f] = counts.get(f, 0) + 1
+    dupes = [f for f, n in counts.items() if n > 1]
+    assert not dupes, f"files in more than one lane: {dupes}"

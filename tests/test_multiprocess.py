@@ -50,6 +50,46 @@ class TestMultiProcessLaunch:
                       "checkpoint round-trip ok"):
             assert check in res.stdout, f"missing: {check}"
 
+    def test_composed_mesh_four_processes(self):
+        """4 processes x 2 devices, dp=2 x fsdp=4 — every axis crosses
+        process boundaries (reference: test_multigpu.py scales worlds with
+        the device count)."""
+        res = _launch([
+            "--num_processes", "4", "--emulated_device_count", "2",
+            "--dp", "2", "--fsdp", "4",
+            "--module", "accelerate_tpu.test_utils.scripts.test_composed_mesh",
+        ], timeout=600, env_extra={"FSDP_MIN_NUM_PARAMS": "64"})
+        assert res.returncode == 0, res.stdout[-3000:] + res.stderr[-3000:]
+        assert "composed-mesh checks passed" in res.stdout
+        assert "fsdp sharding ok" in res.stdout
+        assert "gather_for_metrics over composed mesh ok" in res.stdout
+
+
+class TestReshardCheckpoint:
+    def test_save_2_processes_restore_4(self, tmp_path):
+        """Elastic resume: checkpoint written by a 2-process fsdp=4 world
+        restores bit-compatibly into a 4-process dp=2 x fsdp=4 world."""
+        workdir = tmp_path / "reshard"
+        workdir.mkdir()
+        module = "accelerate_tpu.test_utils.scripts.test_reshard_checkpoint"
+        save = _launch([
+            "--num_processes", "2", "--emulated_device_count", "2",
+            "--dp", "1", "--fsdp", "4",
+            "--module", module, str(workdir), "save",
+        ], timeout=600)
+        assert save.returncode == 0, save.stdout[-3000:] + save.stderr[-3000:]
+        assert "saved under 2 processes" in save.stdout
+
+        restore = _launch([
+            "--num_processes", "4", "--emulated_device_count", "2",
+            "--dp", "2", "--fsdp", "4",
+            "--module", module, str(workdir), "restore",
+        ], timeout=600)
+        assert restore.returncode == 0, restore.stdout[-3000:] + restore.stderr[-3000:]
+        assert "restored under 4 processes" in restore.stdout
+        assert "checksums match" in restore.stdout
+        assert "post-restore step ok" in restore.stdout
+
 
 CRASH_ONCE = """
 import os, sys
