@@ -134,10 +134,12 @@ class AcceleratedModel:
         self.training = True
 
     def eval(self):
+        """Switch to inference mode (dropout off via deterministic apply)."""
         self.training = False
         return self
 
     def train(self, mode: bool = True):
+        """Switch training mode (reference nn.Module.train parity)."""
         self.training = mode
         return self
 
@@ -175,9 +177,11 @@ class AcceleratedModel:
         return self._fwd_jit[static_key](self.params, args, traced_kwargs)
 
     def state_dict(self):
+        """The current parameter pytree (reference state_dict parity)."""
         return self.params
 
     def load_state_dict(self, params):
+        """Replace params, re-placing them into this model's shardings."""
         self.params = shard_params(params, self.param_shardings) if self.param_shardings is not None else params
 
 
@@ -283,54 +287,67 @@ class Accelerator:
 
     @property
     def mesh(self):
+        """The live jax.sharding.Mesh every prepared object is laid out over."""
         return self.state.mesh
 
     @property
     def distributed_type(self):
+        """The governing strategy (reference DistributedType parity)."""
         return self.state.distributed_type
 
     @property
     def num_processes(self):
+        """Process (host) count in the world."""
         return self.state.num_processes
 
     @property
     def process_index(self):
+        """This process's global rank."""
         return self.state.process_index
 
     @property
     def local_process_index(self):
+        """This process's rank on its machine."""
         return self.state.local_process_index
 
     @property
     def device(self):
+        """This process's first addressable device."""
         return self.state.device
 
     @property
     def is_main_process(self):
+        """True on global rank 0."""
         return self.state.is_main_process
 
     @property
     def is_local_main_process(self):
+        """True on each machine's rank-0 process."""
         return self.state.is_local_main_process
 
     @property
     def is_last_process(self):
+        """True on the highest-ranked process."""
         return self.state.is_last_process
 
     @property
     def mixed_precision(self):
+        """The active precision policy name ("no"/"bf16"/"fp16"/"fp8")."""
         return self.state.mixed_precision
 
     @property
     def use_distributed(self):
+        """True in any multi-process world."""
         return self.state.use_distributed
 
     @property
     def sync_gradients(self):
+        """True when the current accumulation window ends at this step."""
         return self.gradient_state.sync_gradients
 
     @property
     def gradient_accumulation_steps(self):
+        """Microbatches per optimizer update."""
         return self.gradient_state.num_steps
 
     @gradient_accumulation_steps.setter
@@ -348,24 +365,31 @@ class Accelerator:
 
     @property
     def project_dir(self):
+        """Root directory for checkpoints/logs (ProjectConfiguration)."""
         return self.project_configuration.project_dir
 
     def on_main_process(self, function):
+        """Decorator: run ``function`` on global rank 0 only (reference: :2665)."""
         return PartialState().on_main_process(function)
 
     def on_local_main_process(self, function):
+        """Decorator: run ``function`` on each machine's rank 0 only."""
         return PartialState().on_local_main_process(function)
 
     def on_process(self, function=None, process_index=None):
+        """Decorator: run ``function`` on one specific rank only."""
         return PartialState().on_process(function, process_index=process_index)
 
     def wait_for_everyone(self):
+        """Cross-process barrier (reference: :2810)."""
         PartialState().wait_for_everyone()
 
     def print(self, *args, **kwargs):
+        """print() on the main process only."""
         PartialState().print(*args, **kwargs)
 
     def split_between_processes(self, inputs, apply_padding: bool = False):
+        """Context yielding this process's slice of ``inputs`` (reference: :740)."""
         return PartialState().split_between_processes(inputs, apply_padding=apply_padding)
 
     # ------------------------------------------------------------------
@@ -618,6 +642,7 @@ class Accelerator:
     # ------------------------------------------------------------------
 
     def next_rng_key(self):
+        """Split and return a fresh PRNG key from the accelerator's stream."""
         self._rng_key, sub = jax.random.split(self._rng_key)
         return sub
 
@@ -970,6 +995,7 @@ class Accelerator:
     # ------------------------------------------------------------------
 
     def gather(self, tensor):
+        """Gather a pytree across processes, concatenated on dim 0 (reference: :2378)."""
         return gather(tensor)
 
     def gather_for_metrics(self, input_data, use_gather_object: bool = False):
@@ -1006,9 +1032,11 @@ class Accelerator:
         return data
 
     def reduce(self, tensor, reduction="sum", scale=1.0):
+        """Reduce a pytree across processes (sum/mean, reference: :2517)."""
         return reduce(tensor, reduction, scale)
 
     def pad_across_processes(self, tensor, dim=0, pad_index=0, pad_first=False):
+        """Pad each process's tensor to the max length before gathering ragged data (reference: :2467)."""
         return pad_across_processes(tensor, dim=dim, pad_index=pad_index, pad_first=pad_first)
 
     def unwrap_model(self, model, keep_fp32_wrapper: bool = True):
@@ -1099,6 +1127,7 @@ class Accelerator:
         yield self.policy
 
     def profile(self, profile_handler: Optional[ProfileKwargs] = None):
+        """Context manager capturing a jax.profiler trace (reference: :3423)."""
         handler = profile_handler or self.profile_handler or ProfileKwargs()
         log_dir = self.project_configuration.logging_dir or "./jax_trace"
         return handler.build(log_dir=log_dir)
@@ -1108,6 +1137,7 @@ class Accelerator:
     # ------------------------------------------------------------------
 
     def free_memory(self, *objects):
+        """Drop every prepared-object reference and free device buffers (reference: :3219)."""
         from .utils.memory import release_memory
 
         self._models.clear()
@@ -1120,6 +1150,7 @@ class Accelerator:
         return release_memory(*objects)
 
     def clear(self, *objects):
+        """Alias of free_memory (reference: :3270)."""
         return self.free_memory(*objects)
 
     def register_for_checkpointing(self, *objects):
@@ -1133,27 +1164,32 @@ class Accelerator:
         self._custom_objects.extend(objects)
 
     def skip_first_batches(self, dataloader, num_batches: int = 0):
+        """Fast-forward a prepared loader for mid-epoch resume (reference: :3440)."""
         return skip_first_batches(dataloader, num_batches)
 
     # save_state/load_state live in checkpointing.py and are bound here to
     # keep this module focused.
     def save_state(self, output_dir: Optional[str] = None, **save_model_kwargs):
+        """Checkpoint params/optimizer/RNG/loaders/custom objects (reference: :2915)."""
         from .checkpointing import save_accelerator_state
 
         return save_accelerator_state(self, output_dir, **save_model_kwargs)
 
     def load_state(self, input_dir: Optional[str] = None, **load_model_kwargs):
+        """Restore a save_state checkpoint, resharding on topology changes (reference: :3081)."""
         from .checkpointing import load_accelerator_state
 
         return load_accelerator_state(self, input_dir, **load_model_kwargs)
 
     def save_model(self, model, save_directory: str, max_shard_size: str = "10GB", safe_serialization: bool = True):
+        """Export params as (sharded) safetensors for serving (reference: :2848)."""
         from .checkpointing import save_model as _save_model
 
         return _save_model(self, model, save_directory, max_shard_size, safe_serialization)
 
     # Tracking API (tracking.py) ----------------------------------------
     def init_trackers(self, project_name: str, config: Optional[dict] = None, init_kwargs: Optional[dict] = None):
+        """Start every configured experiment tracker (reference: :2568)."""
         from .tracking import filter_trackers, resolve_trackers
 
         self.trackers = resolve_trackers(
@@ -1162,16 +1198,19 @@ class Accelerator:
         )
 
     def log(self, values: dict, step: Optional[int] = None, log_kwargs: Optional[dict] = None):
+        """Log scalars to every active tracker, main process only (reference: :2625)."""
         for tracker in self.trackers:
             tracker.log(values, step=step, **((log_kwargs or {}).get(tracker.name, {})))
 
     def get_tracker(self, name: str, unwrap: bool = False):
+        """Fetch one active tracker by name; ``unwrap`` returns the raw client run."""
         for tracker in self.trackers:
             if tracker.name == name:
                 return tracker.tracker if unwrap else tracker
         raise ValueError(f"{name} is not an available tracker: {[t.name for t in self.trackers]}")
 
     def end_training(self):
+        """Flush/close all trackers and barrier (reference: :2645)."""
         for tracker in self.trackers:
             tracker.finish()
         self.wait_for_everyone()

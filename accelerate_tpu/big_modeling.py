@@ -116,6 +116,7 @@ class LazyWeight:
     transform: Optional[str] = None  # "t" = transpose on load (HF torch layout)
 
     def load(self) -> np.ndarray:
+        """Read the tensor from its backing store into host memory."""
         if self.memmap_info is not None:
             from .utils.offload import load_offloaded_weight
 
@@ -142,6 +143,7 @@ class LazyStack:
     dtype: Optional[Any] = None
 
     def load(self) -> np.ndarray:
+        """Read the tensor from its backing store into host memory."""
         from safetensors import safe_open
 
         from .utils.hf_interop import _apply_op
@@ -171,10 +173,12 @@ class WeightStore:
         self.placement: dict[str, DeviceId] = {}
 
     def put(self, name: str, value, device: DeviceId):
+        """Store a tensor under ``name`` on the given placement tier."""
         self.placement[name] = device
         self.entries[name] = value
 
     def names_under(self, prefix: str) -> list[str]:
+        """All stored parameter names with this prefix."""
         return [n for n in self.entries if n == prefix or n.startswith(prefix + ".")]
 
     def fetch_subtree(self, prefix: str, device=None):
@@ -193,6 +197,7 @@ class WeightStore:
         return _nest(flat)
 
     def total_bytes(self, kind: Optional[str] = None) -> int:
+        """Total stored bytes, optionally for one placement kind."""
         total = 0
         for name, val in self.entries.items():
             place = self.placement.get(name)
@@ -605,6 +610,7 @@ class StreamedModel:
         return self._pool.submit(fn, *args)
 
     def close(self):
+        """Release device buffers and close backing files."""
         if self._pool is not None:
             self._pool.shutdown(wait=False)
             self._pool = None
@@ -800,6 +806,7 @@ class StreamedModel:
 
     @property
     def hbm_resident_bytes(self) -> int:
+        """Bytes of weights permanently resident on device."""
         return self.store.total_bytes("device")
 
 

@@ -57,6 +57,7 @@ class SeedableRandomSampler:
         return self.data_source_len
 
     def set_epoch(self, epoch: int):
+        """Reseed samplers/generators for a new epoch (reference: set_epoch parity)."""
         self.epoch = epoch
 
     def __iter__(self) -> Iterator[int]:
@@ -105,6 +106,7 @@ class BatchSamplerShard:
 
     @property
     def total_length(self):
+        """Number of batches in the underlying (unsharded) sampler."""
         return len(self.batch_sampler)
 
     def __len__(self):
@@ -209,6 +211,7 @@ class IterableDatasetShard:
         self.epoch = 0
 
     def set_epoch(self, epoch: int):
+        """Reseed samplers/generators for a new epoch (reference: set_epoch parity)."""
         self.epoch = epoch
         if hasattr(self.dataset, "set_epoch"):
             self.dataset.set_epoch(epoch)
@@ -337,10 +340,12 @@ class DataLoaderStateMixin:
         cls.remainder = -1
 
     def reset(self):
+        """Clear end-of-epoch bookkeeping."""
         self.end_of_dataloader = False
         self.remainder = -1
 
     def begin(self):
+        """Register with GradientState and compute the tail remainder at epoch start."""
         self.reset()
         with suppress_exceptions():
             length = getattr(self.base_dataloader, "total_dataset_length", len(self.dataset))
@@ -348,6 +353,7 @@ class DataLoaderStateMixin:
         self.gradient_state._add_dataloader(self)
 
     def end(self):
+        """Deregister from GradientState at epoch end."""
         self.gradient_state._remove_dataloader(self)
 
 
@@ -402,6 +408,7 @@ class DataLoaderShard(DataLoaderStateMixin):
 
     @property
     def dataset(self):
+        """The underlying dataset (or a length-only stand-in)."""
         inner = getattr(self.base_dataloader, "dataset", None)
         if inner is not None:
             return inner
@@ -431,12 +438,14 @@ class DataLoaderShard(DataLoaderStateMixin):
 
     @property
     def total_dataset_length(self):
+        """len(dataset), or None for unsized iterables."""
         try:
             return len(self.dataset)
         except (TypeError, AttributeError):
             return None
 
     def set_epoch(self, epoch: int):
+        """Reseed samplers/generators for a new epoch (reference: set_epoch parity)."""
         self.iteration = epoch
         if self.synchronized_generator is not None and hasattr(self.synchronized_generator, "set_epoch"):
             self.synchronized_generator.set_epoch(epoch)
@@ -507,12 +516,14 @@ class DataLoaderShard(DataLoaderStateMixin):
 
     # -- resume support (reference: DataLoaderAdapter.state_dict :448) -------
     def state_dict(self) -> dict:
+        """Resume position: epoch counter + batches consumed."""
         return {
             "epoch": self.iteration,
             "batches_consumed": self.batches_consumed,
         }
 
     def load_state_dict(self, sd: dict):
+        """Restore a resume position recorded by state_dict."""
         self.iteration = sd.get("epoch", 0)
         self.skip_batches = sd.get("batches_consumed", 0)
 
@@ -640,6 +651,7 @@ class NumpyDataLoader:
         self.batch_sampler = batch_sampler
 
     def set_epoch(self, epoch: int):
+        """Reseed samplers/generators for a new epoch (reference: set_epoch parity)."""
         if hasattr(self.sampler, "set_epoch"):
             self.sampler.set_epoch(epoch)
 
@@ -676,6 +688,7 @@ class BatchSamplerFromSampler:
         self.drop_last = drop_last
 
     def set_epoch(self, epoch: int):
+        """Reseed samplers/generators for a new epoch (reference: set_epoch parity)."""
         if hasattr(self.sampler, "set_epoch"):
             self.sampler.set_epoch(epoch)
 
@@ -837,6 +850,7 @@ class SkipBatchSampler:
 
     @property
     def total_length(self):
+        """Number of batches in the underlying (unsharded) sampler."""
         return len(self.batch_sampler)
 
     def __len__(self):

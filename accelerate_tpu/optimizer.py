@@ -224,12 +224,14 @@ class AcceleratedOptimizer:
 
     # -- checkpoint surface ---------------------------------------------
     def state_dict(self):
+        """Host-side snapshot of optimizer state (reference parity)."""
         sd = {"opt_state": self.opt_state, "steps_applied": self._steps_applied}
         if self.loss_scale is not None:
             sd["loss_scale"] = self.loss_scale
         return sd
 
     def load_state_dict(self, sd):
+        """Restore a state_dict snapshot."""
         self.opt_state = sd["opt_state"]
         if self.offload_to_host:
             from .parallel.host_offload import to_host

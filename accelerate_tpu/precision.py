@@ -32,12 +32,15 @@ class Policy:
     output_dtype: Any = jnp.float32
 
     def cast_to_compute(self, tree):
+        """Cast a pytree to the compute dtype (bf16/fp16 policy)."""
         return _cast_floating(tree, self.compute_dtype)
 
     def cast_to_param(self, tree):
+        """Cast a pytree to the (master) parameter dtype."""
         return _cast_floating(tree, self.param_dtype)
 
     def cast_to_output(self, tree):
+        """Cast model outputs to the output dtype (fp32 by default)."""
         return _cast_floating(tree, self.output_dtype)
 
 

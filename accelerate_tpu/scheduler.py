@@ -29,15 +29,19 @@ class LRScheduler:
         self.count = 0
 
     def step(self):
+        """Advance the schedule (gated to sync boundaries when prepared)."""
         self.count += 1
 
     def get_last_lr(self):
+        """Last computed learning rate(s), as a list (torch parity)."""
         return [float(self.schedule_fn(self.count))]
 
     def state_dict(self):
+        """Host-side snapshot of the schedule position."""
         return {"count": self.count}
 
     def load_state_dict(self, sd):
+        """Restore a state_dict snapshot."""
         self.count = sd.get("count", 0)
 
 
@@ -58,6 +62,7 @@ class AcceleratedScheduler:
         self.gradient_state = GradientState()
 
     def step(self, *args, **kwargs):
+        """Advance the schedule (gated to sync boundaries when prepared)."""
         if not self.step_with_optimizer:
             self.scheduler.step(*args, **kwargs)
             self._sync_lr_into_opt_states()
@@ -99,15 +104,19 @@ class AcceleratedScheduler:
                 hp["learning_rate"] = jnp.asarray(lr, jnp.float32)
 
     def get_last_lr(self):
+        """Last computed learning rate(s), as a list (torch parity)."""
         return self.scheduler.get_last_lr()
 
     def state_dict(self):
+        """Host-side snapshot of the schedule position."""
         return self.scheduler.state_dict()
 
     def load_state_dict(self, sd):
+        """Restore a state_dict snapshot."""
         self.scheduler.load_state_dict(sd)
 
     def get_lr(self):
+        """Current learning rate(s) from the schedule function."""
         return self.scheduler.get_lr() if hasattr(self.scheduler, "get_lr") else self.get_last_lr()
 
     def __getattr__(self, name):

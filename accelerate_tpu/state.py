@@ -188,6 +188,7 @@ class PartialState:
 
     @property
     def initialized(self) -> bool:
+        """True once the singleton has been constructed in this process."""
         return self._shared_state != {}
 
     @property
@@ -197,14 +198,17 @@ class PartialState:
 
     @property
     def is_main_process(self) -> bool:
+        """True on global rank 0."""
         return self.process_index == 0
 
     @property
     def is_local_main_process(self) -> bool:
+        """True on each machine's rank-0 process."""
         return self.local_process_index == 0
 
     @property
     def is_last_process(self) -> bool:
+        """True on the highest-ranked process."""
         return self.process_index == self.num_processes - 1
 
     # ------------------------------------------------------------------
@@ -234,6 +238,7 @@ class PartialState:
 
     @contextmanager
     def local_main_process_first(self):
+        """Each machine's main process runs the block before its peers."""
         yield from self._goes_first(self.is_local_main_process, "local_main_first")
 
     def on_main_process(self, function: Callable = None):
@@ -250,6 +255,7 @@ class PartialState:
         return execute_on_main_process
 
     def on_local_main_process(self, function: Callable = None):
+        """Decorator: run only on each machine's main process."""
         if function is None:
             return partial(self.on_local_main_process)
 
@@ -262,6 +268,7 @@ class PartialState:
         return execute_on_local_main_process
 
     def on_process(self, function: Callable = None, process_index: int = None):
+        """Decorator: run only on one specific rank."""
         if function is None:
             return partial(self.on_process, process_index=process_index)
         if process_index is None:
@@ -276,6 +283,7 @@ class PartialState:
         return execute_on_process
 
     def on_last_process(self, function: Callable):
+        """Decorator: run only on the last process."""
         return self.on_process(function, process_index=self.num_processes - 1)
 
     @contextmanager
@@ -339,6 +347,7 @@ class PartialState:
     # Parity helper: the reference's `set_device` pins CUDA devices; JAX
     # processes own all local chips, so this is a documented no-op.
     def set_device(self):
+        """Parity no-op: JAX addresses all local devices; nothing to pin."""
         return None
 
 
@@ -513,18 +522,22 @@ class GradientState:
 
     @property
     def num_steps(self) -> int:
+        """Microbatches per optimizer update (accumulation window)."""
         return self.plugin_kwargs.get("num_steps", 1)
 
     @property
     def adjust_scheduler(self) -> bool:
+        """Whether prepared schedulers should step only on sync boundaries."""
         return self.plugin_kwargs.get("adjust_scheduler", True)
 
     @property
     def sync_with_dataloader(self) -> bool:
+        """Whether epoch ends force a sync regardless of window position."""
         return self.plugin_kwargs.get("sync_with_dataloader", True)
 
     @property
     def sync_each_batch(self) -> bool:
+        """Force gradient sync on every microbatch (memory-saving mode)."""
         return self.plugin_kwargs.get("sync_each_batch", False)
 
     @property
@@ -533,18 +546,21 @@ class GradientState:
 
     @property
     def end_of_dataloader(self) -> bool:
+        """True while the active loader is on its final batch."""
         if not self.in_dataloader:
             return False
         return self.active_dataloader.end_of_dataloader
 
     @property
     def remainder(self) -> int:
+        """Tail samples beyond the last full global batch (-1 = unknown length)."""
         if not self.in_dataloader:
             return -1
         return self.active_dataloader.remainder
 
     @property
     def in_dataloader(self) -> bool:
+        """True while any prepared loader is being iterated."""
         return self.active_dataloader is not None
 
     def __repr__(self):

@@ -67,13 +67,13 @@ class GeneralTracker:
                 )
 
     def store_init_configuration(self, values: dict):
-        pass
+        """Record the run's hyperparameters/config at init_trackers time."""
 
     def log(self, values: dict, step: Optional[int] = None, **kwargs):
-        pass
+        """Log a dict of scalar metrics at ``step`` to the backing service."""
 
     def finish(self):
-        pass
+        """Flush and close the run (called by ``Accelerator.end_training``)."""
 
 
 class JSONLTracker(GeneralTracker):
@@ -93,6 +93,7 @@ class JSONLTracker(GeneralTracker):
 
     @property
     def tracker(self):
+        """The underlying client run object (raw handle for tracker-specific calls)."""
         return self._fh
 
     @on_main_process
@@ -138,6 +139,7 @@ class TensorBoardTracker(GeneralTracker):
 
     @property
     def tracker(self):
+        """The underlying client run object (raw handle for tracker-specific calls)."""
         return self.writer
 
     @on_main_process
@@ -180,6 +182,7 @@ class WandBTracker(GeneralTracker):
 
     @property
     def tracker(self):
+        """The underlying client run object (raw handle for tracker-specific calls)."""
         return self.run
 
     @on_main_process
@@ -217,6 +220,7 @@ class MLflowTracker(GeneralTracker):
 
     @property
     def tracker(self):
+        """The underlying client run object (raw handle for tracker-specific calls)."""
         return self.active_run
 
     @on_main_process
@@ -256,6 +260,7 @@ class CometMLTracker(GeneralTracker):
 
     @property
     def tracker(self):
+        """The underlying client run object (raw handle for tracker-specific calls)."""
         return self.writer
 
     @on_main_process
@@ -289,6 +294,7 @@ class AimTracker(GeneralTracker):
 
     @property
     def tracker(self):
+        """The underlying client run object (raw handle for tracker-specific calls)."""
         return self.writer
 
     @on_main_process
@@ -320,6 +326,7 @@ class ClearMLTracker(GeneralTracker):
 
     @property
     def tracker(self):
+        """The underlying client run object (raw handle for tracker-specific calls)."""
         return self.task
 
     @on_main_process
@@ -354,6 +361,7 @@ class DVCLiveTracker(GeneralTracker):
 
     @property
     def tracker(self):
+        """The underlying client run object (raw handle for tracker-specific calls)."""
         return self.live
 
     @on_main_process

@@ -132,6 +132,7 @@ class KwargsHandler:
     """Base for objects that tweak a subsystem's kwargs (reference: utils/dataclasses.py:45)."""
 
     def to_dict(self):
+        """Plain-dict view of the handler's fields."""
         return copy.deepcopy(self.__dict__)
 
     def to_kwargs(self):
@@ -269,6 +270,7 @@ class ProjectConfiguration(KwargsHandler):
     save_on_each_node: bool = False
 
     def set_directories(self, project_dir: str | None = None):
+        """Derive checkpoint/logging dirs from ``project_dir``."""
         self.project_dir = project_dir
         if self.logging_dir is None:
             self.logging_dir = project_dir
@@ -294,6 +296,7 @@ class JitConfig(KwargsHandler):
             self.persistent_cache_dir = os.environ.get(env_var("COMPILE_CACHE"), None)
 
     def apply(self):
+        """Apply this handler's settings to the ambient jax config."""
         if self.persistent_cache_dir:
             import jax
 
@@ -583,6 +586,7 @@ class MegatronLMPlugin(KwargsHandler):
     recompute_activations: bool = False
 
     def to_plugins(self):
+        """Translate Megatron degrees into (tp, pp, fsdp) plugins."""
         tp = TensorParallelPlugin(tp_size=self.tp_degree, sequence_parallelism=self.sequence_parallelism)
         pp = PipelineParallelPlugin(pp_size=self.pp_degree, num_microbatches=self.num_micro_batches)
         fsdp = None

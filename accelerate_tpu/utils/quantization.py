@@ -56,6 +56,7 @@ class QuantizationConfig:
 
     @property
     def bits(self) -> int:
+        """8 or 4, from load_in_8bit/load_in_4bit."""
         return 8 if self.load_in_8bit else 4
 
 
@@ -76,6 +77,7 @@ class QuantizedTensor:
 
     @property
     def shape(self):
+        """Shape of the logical tensor."""
         return tuple(self.q.shape)
 
     @property
@@ -84,9 +86,11 @@ class QuantizedTensor:
 
     @property
     def ndim(self):
+        """Rank of the logical tensor."""
         return self.q.ndim
 
     def dequantize(self, dtype=jnp.bfloat16):
+        """Materialize the full-precision tensor (scale * int values)."""
         if self.bits == 8:
             return (self.q.astype(jnp.float32) * self.scale).astype(dtype)
         shape = self.q.shape
@@ -95,10 +99,12 @@ class QuantizedTensor:
         return deq.reshape(shape).astype(dtype)
 
     def nbytes(self) -> int:
+        """Storage bytes at rest (ints + scales)."""
         qb = int(np.prod(self.q.shape)) * (1 if self.bits == 8 else 0.5)
         return int(qb + self.scale.size * self.scale.dtype.itemsize)
 
     def tree_flatten(self):
+        """jax pytree protocol: children = (q, scale)."""
         return (self.q, self.scale), (self.bits, self.block_size)
 
     @classmethod

@@ -95,15 +95,31 @@ def public_members(mod):
     return classes, functions
 
 
+def _doc_with_mro(cls, mname: str, obj) -> str:
+    """Docstring of a member, falling back to base classes (an override
+    without its own docstring inherits the interface's contract)."""
+    target = obj.fget if isinstance(obj, property) else obj
+    if inspect.getdoc(target):
+        return first_paragraph(target)
+    for base in cls.__mro__[1:]:
+        parent = base.__dict__.get(mname)
+        if parent is not None:
+            ptarget = parent.fget if isinstance(parent, property) else parent
+            if inspect.getdoc(ptarget):
+                return first_paragraph(ptarget)
+    return "*(no docstring)*"
+
+
 def render_class(name: str, cls) -> list[str]:
     lines = [f"### `{name}{signature_of(cls)}`", "", first_paragraph(cls), ""]
     for mname, meth in sorted(vars(cls).items()):
         if mname.startswith("_") or not (inspect.isfunction(meth) or isinstance(meth, property)):
             continue
+        doc = _doc_with_mro(cls, mname, meth)
         if isinstance(meth, property):
-            lines.append(f"- **`.{mname}`** (property) — {first_paragraph(meth.fget)}")
+            lines.append(f"- **`.{mname}`** (property) — {doc}")
         else:
-            lines.append(f"- **`.{mname}{signature_of(meth)}`** — {first_paragraph(meth)}")
+            lines.append(f"- **`.{mname}{signature_of(meth)}`** — {doc}")
     lines.append("")
     return lines
 
