@@ -48,7 +48,8 @@ def _qkv_spec(mesh, axis_name: str):
     return P(batch_axes or None, axis_name, head_ax, None)
 
 
-def _ring_attention_shard(q, k, v, *, axis_name: str, axis_size: int, causal: bool):
+def _ring_attention_shard(q, k, v, *, axis_name: str, axis_size: int, causal: bool,
+                          inner_chunk: int):
     """Per-shard body (runs inside shard_map).
 
     q, k, v: [B, S_local, H, D] — this device's contiguous sequence chunk.
@@ -62,6 +63,16 @@ def _ring_attention_shard(q, k, v, *, axis_name: str, axis_size: int, causal: bo
     q_pos = my_idx * q_len + jnp.arange(q_len, dtype=jnp.int32)
     qf = (q * scale).astype(jnp.float32)
 
+    # The arriving KV block is itself processed in sub-chunks so the logits
+    # tile is [B, H, q_len, sub] instead of [B, H, q_len, k_len] — at the
+    # sequence lengths ring attention exists for, the full tile would be
+    # gigabytes (e.g. cp=4, S=32k: 8k x 8k f32 per head). Falls back to one
+    # sub-chunk when k_len doesn't divide.
+    sub = min(inner_chunk, k_len)
+    if k_len % sub:
+        sub = k_len
+    n_sub = k_len // sub
+
     # Accumulators in f32: running max m, denominator l, unnormalized out o.
     m0 = jnp.full((B, H, q_len), _BIG_NEG, jnp.float32)
     l0 = jnp.zeros((B, H, q_len), jnp.float32)
@@ -69,11 +80,10 @@ def _ring_attention_shard(q, k, v, *, axis_name: str, axis_size: int, causal: bo
 
     perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
 
-    @jax.checkpoint
-    def block_update(acc, k_c, v_c, chunk):
+    def _tile_update(acc, k_t, v_t, k_pos):
+        """Online-softmax merge of one [*, sub, H, D] KV tile."""
         m, l, o = acc
-        k_pos = chunk * k_len + jnp.arange(k_len, dtype=jnp.int32)
-        logits = jnp.einsum("bqhd,bkhd->bhqk", qf, k_c.astype(jnp.float32))
+        logits = jnp.einsum("bqhd,bkhd->bhqk", qf, k_t.astype(jnp.float32))
         if causal:
             mask = q_pos[:, None] >= k_pos[None, :]
             logits = jnp.where(mask[None, None], logits, _BIG_NEG)
@@ -86,9 +96,26 @@ def _ring_attention_shard(q, k, v, *, axis_name: str, axis_size: int, causal: bo
         corr = jnp.exp(m - m_new)
         l_new = l * corr + p.sum(axis=-1)
         o_new = o * corr[..., None] + jnp.einsum(
-            "bhqk,bkhd->bhqd", p, v_c.astype(jnp.float32)
+            "bhqk,bkhd->bhqd", p, v_t.astype(jnp.float32)
         )
         return m_new, l_new, o_new
+
+    @jax.checkpoint
+    def block_update(acc, k_c, v_c, chunk):
+        base = chunk * k_len
+        if n_sub == 1:
+            return _tile_update(acc, k_c, v_c, base + jnp.arange(k_len, dtype=jnp.int32))
+        # [B, k_len, H, D] -> [n_sub, B, sub, H, D] for the inner scan.
+        k_tiles = jnp.moveaxis(k_c.reshape(B, n_sub, sub, H, D), 1, 0)
+        v_tiles = jnp.moveaxis(v_c.reshape(B, n_sub, sub, H, D), 1, 0)
+        offsets = base + jnp.arange(n_sub, dtype=jnp.int32) * sub
+
+        def sub_step(acc, tile):
+            k_t, v_t, off = tile
+            return _tile_update(acc, k_t, v_t, off + jnp.arange(sub, dtype=jnp.int32)), None
+
+        acc, _ = jax.lax.scan(sub_step, acc, (k_tiles, v_tiles, offsets))
+        return acc
 
     def step(carry, i):
         k_c, v_c, acc = carry
@@ -111,12 +138,15 @@ def _ring_attention_shard(q, k, v, *, axis_name: str, axis_size: int, causal: bo
     return out.transpose(0, 2, 1, 3).astype(q.dtype)
 
 
-def ring_attention(q, k, v, mesh=None, axis_name: str = "cp", causal: bool = True):
+def ring_attention(q, k, v, mesh=None, axis_name: str = "cp", causal: bool = True,
+                   inner_chunk: int = 1024):
     """Exact ring attention over the ``axis_name`` mesh axis.
 
     Args are *global* [B, S, H, D] arrays (sharded or not — shard_map
     partitions them on the sequence dim). With a trivial axis (size 1 or no
-    mesh) falls back to the plain attention dispatch.
+    mesh) falls back to the plain attention dispatch. ``inner_chunk`` bounds
+    the logits tile each step materializes ([B, H, S_local, inner_chunk]),
+    keeping per-device memory O(S_local x inner_chunk) at any length.
     """
     mesh = _resolve_mesh(mesh)
     axis_size = _axis_size(mesh, axis_name)
@@ -129,17 +159,30 @@ def ring_attention(q, k, v, mesh=None, axis_name: str = "cp", causal: bool = Tru
         raise ValueError(
             f"ring_attention: seq len {q.shape[1]} not divisible by {axis_name}={axis_size}"
         )
+    return _ring_fn(mesh, axis_name, axis_size, causal, inner_chunk)(q, k, v)
+
+
+@functools.lru_cache(maxsize=None)
+def _ring_fn(mesh, axis_name: str, axis_size: int, causal: bool, inner_chunk: int):
+    """Cached jitted shard_map for one ring configuration.
+
+    jit is required (the remat'd inner scan cannot evaluate eagerly inside
+    shard_map) and must be cached here: a fresh jit-of-fresh-shard_map per
+    call could never hit jax's compile cache, recompiling every invocation
+    for eager callers.
+    """
     spec = _qkv_spec(mesh, axis_name)
     fn = jax.shard_map(
         functools.partial(
-            _ring_attention_shard, axis_name=axis_name, axis_size=axis_size, causal=causal
+            _ring_attention_shard, axis_name=axis_name, axis_size=axis_size, causal=causal,
+            inner_chunk=inner_chunk,
         ),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
         check_vma=False,
     )
-    return fn(q, k, v)
+    return jax.jit(fn)
 
 
 def _ulysses_shard(q, k, v, *, axis_name: str, causal: bool, use_flash: bool):

@@ -29,7 +29,7 @@ class LRScheduler:
         self.count = 0
 
     def step(self):
-        """Advance the schedule (gated to sync boundaries when prepared)."""
+        """Advance the schedule by one step, unconditionally."""
         self.count += 1
 
     def get_last_lr(self):

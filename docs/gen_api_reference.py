@@ -76,10 +76,15 @@ def first_paragraph(obj) -> str:
 
 
 def signature_of(obj) -> str:
+    import re
+
     try:
-        return str(inspect.signature(obj))
+        sig = str(inspect.signature(obj))
     except (ValueError, TypeError):
         return "(...)"
+    # Default-value reprs can embed memory addresses; strip them so
+    # regeneration is deterministic (no address-only doc churn).
+    return re.sub(r" at 0x[0-9a-f]+", "", sig)
 
 
 def public_members(mod):

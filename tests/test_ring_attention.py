@@ -56,6 +56,39 @@ def test_cp_attention_grads_match(fn):
         np.testing.assert_allclose(np.asarray(b), np.asarray(a), atol=5e-5, rtol=5e-5)
 
 
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("inner_chunk", [4, 8, 16])
+def test_ring_attention_sub_chunked_inner_matches_full(causal, inner_chunk):
+    """The inner sub-chunking (logits tile bounded at [.., S_local, inner])
+    must stay exact for every tile/boundary alignment, incl. grads."""
+    mesh = cp_mesh(cp=4)  # remaining devices absorb into dp=2: B must divide
+    q, k, v = make_qkv(B=2, S=64, H=2, D=8, seed=1)  # S_local=16 > inner_chunk
+    ref = _einsum_attention(q, k, v, causal=causal)
+    out = ring_attention(q, k, v, mesh=mesh, causal=causal, inner_chunk=inner_chunk)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+    def loss_full(q, k, v):
+        return (_einsum_attention(q, k, v, causal=causal) ** 2).sum()
+
+    def loss_cp(q, k, v):
+        return (ring_attention(q, k, v, mesh=mesh, causal=causal,
+                               inner_chunk=inner_chunk) ** 2).sum()
+
+    g_ref = jax.grad(loss_full, argnums=(0, 1, 2))(q, k, v)
+    g_cp = jax.grad(loss_cp, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ref, g_cp):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a), atol=5e-5, rtol=5e-5)
+
+
+def test_ring_attention_indivisible_inner_chunk_falls_back():
+    """inner_chunk not dividing S_local: whole-block path, still exact."""
+    mesh = cp_mesh(cp=4)
+    q, k, v = make_qkv(B=2, S=64, H=2, D=8, seed=2)  # S_local=16, inner 5 -> fallback
+    ref = _einsum_attention(q, k, v, causal=True)
+    out = ring_attention(q, k, v, mesh=mesh, causal=True, inner_chunk=5)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
 def test_ring_attention_under_jit_with_sharded_inputs():
     """Ring attention composes with jit + seq-sharded global arrays."""
     mesh = cp_mesh()
