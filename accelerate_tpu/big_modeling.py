@@ -258,12 +258,22 @@ def block_specs_for(module) -> Optional[list[BlockSpec]]:
     from .models.mixtral import MixtralForCausalLM
     from .models.t5 import T5ForConditionalGeneration
 
+    from .models.gpt_neox import GPTNeoXForCausalLM
+    from .models.gptj import GPTJForCausalLM
+    from .models.opt import OPTForCausalLM
+
     if isinstance(module, MixtralForCausalLM):  # before its Llama parent check
         return _mixtral_block_specs(module.config)
     if isinstance(module, LlamaForCausalLM):
         return _llama_block_specs(module.config)
     if isinstance(module, GPT2LMHeadModel):
         return _gpt2_block_specs(module.config)
+    if isinstance(module, GPTJForCausalLM):
+        return _gptj_block_specs(module.config)
+    if isinstance(module, GPTNeoXForCausalLM):
+        return _gpt_neox_block_specs(module.config)
+    if isinstance(module, OPTForCausalLM):
+        return _opt_block_specs(module.config)
     if isinstance(module, T5ForConditionalGeneration):
         return _t5_block_specs(module.config)
     return None
@@ -349,11 +359,15 @@ def cache_factory_for(module) -> Optional[Callable]:
     order, with the specs marked ``cache_slot=True`` (``kind == "layer"`` is
     honored as a legacy alias for externally-built spec lists)."""
     from .models.gpt2 import GPT2LMHeadModel
+    from .models.gpt_neox import GPTNeoXForCausalLM
+    from .models.gptj import GPTJForCausalLM
     from .models.llama import LlamaForCausalLM, init_kv_cache
     from .models.mixtral import MixtralForCausalLM
+    from .models.opt import OPTForCausalLM
 
-    if isinstance(module, (LlamaForCausalLM, GPT2LMHeadModel, MixtralForCausalLM)):
-        cfg = module.config  # GPT2Config duck-types the kv-cache fields
+    if isinstance(module, (LlamaForCausalLM, GPT2LMHeadModel, MixtralForCausalLM,
+                           GPTJForCausalLM, GPTNeoXForCausalLM, OPTForCausalLM)):
+        cfg = module.config  # non-Llama configs duck-type the kv-cache fields
 
         def factory(batch, max_len, dtype=jnp.bfloat16):
             return init_kv_cache(cfg, batch, max_len, dtype)
@@ -425,6 +439,93 @@ def _gpt2_block_specs(cfg) -> list[BlockSpec]:
     specs.append(BlockSpec("head", ("ln_f", "wte"), head_apply, kind="head",
                            cached_apply=head_cached))
     return specs
+
+
+def _gptlike_block_specs(cfg, block, layer_fmt: str, embed_prefixes: tuple,
+                         embed_fn, head_prefixes: tuple, head_fn) -> list[BlockSpec]:
+    """Shared builder for GPT-J / GPT-NeoX / OPT streaming: blocks take
+    (x[, cache, cache_pos]) and compute their own positions, so only the
+    embedding and head closures differ per family."""
+
+    def embed_apply(ptrees, input_ids):
+        return (embed_fn(ptrees, input_ids, 0),)
+
+    def layer_apply(ptrees, x):
+        return (block.apply({"params": ptrees[0]}, x),)
+
+    def head_apply(ptrees, x):
+        return head_fn(ptrees, x)
+
+    def embed_cached(ptrees, args, cache, pos):
+        (input_ids,) = args
+        return (embed_fn(ptrees, input_ids, pos),), None
+
+    def layer_cached(ptrees, args, cache, pos):
+        (x,) = args
+        x, new_cache = block.apply({"params": ptrees[0]}, x, cache=cache, cache_pos=pos)
+        return (x,), new_cache
+
+    def head_cached(ptrees, args, cache, pos):
+        (x,) = args
+        return (head_fn(ptrees, x),), None
+
+    specs = [BlockSpec("embed", embed_prefixes, embed_apply, kind="embed",
+                       cached_apply=embed_cached)]
+    for i in range(cfg.num_hidden_layers):
+        name = layer_fmt.format(i=i)
+        specs.append(BlockSpec(name, (name,), layer_apply, kind="layer",
+                               cache_slot=True, cached_apply=layer_cached))
+    specs.append(BlockSpec("head", head_prefixes, head_apply, kind="head",
+                           cached_apply=head_cached))
+    return specs
+
+
+def _gptj_block_specs(cfg) -> list[BlockSpec]:
+    import flax.linen as nn
+    from .models.gptj import GPTJBlock
+
+    def embed(ptrees, input_ids, pos):
+        return ptrees[0]["embedding"][input_ids]
+
+    def head(ptrees, x):
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps).apply({"params": ptrees[0]}, x)
+        return h @ ptrees[1]["kernel"].astype(h.dtype) + ptrees[1]["bias"].astype(h.dtype)
+
+    return _gptlike_block_specs(cfg, GPTJBlock(cfg), "h_{i}", ("wte",), embed,
+                                ("ln_f", "lm_head"), head)
+
+
+def _gpt_neox_block_specs(cfg) -> list[BlockSpec]:
+    import flax.linen as nn
+    from .models.gpt_neox import GPTNeoXBlock
+
+    def embed(ptrees, input_ids, pos):
+        return ptrees[0]["embedding"][input_ids]
+
+    def head(ptrees, x):
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps).apply({"params": ptrees[0]}, x)
+        return h @ ptrees[1]["kernel"].astype(h.dtype)
+
+    return _gptlike_block_specs(cfg, GPTNeoXBlock(cfg), "layers_{i}", ("embed_in",), embed,
+                                ("final_layer_norm", "embed_out"), head)
+
+
+def _opt_block_specs(cfg) -> list[BlockSpec]:
+    import flax.linen as nn
+    from .models.opt import POSITION_OFFSET, OPTBlock
+
+    def embed(ptrees, input_ids, pos):
+        positions = POSITION_OFFSET + pos + jnp.arange(input_ids.shape[1], dtype=jnp.int32)
+        return (ptrees[0]["embedding"][input_ids]
+                + ptrees[1]["embedding"][positions][None, :])
+
+    def head(ptrees, x):
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps).apply({"params": ptrees[0]}, x)
+        return h @ ptrees[1]["embedding"].T.astype(h.dtype)  # tied
+
+    return _gptlike_block_specs(cfg, OPTBlock(cfg), "layers_{i}",
+                                ("embed_tokens", "embed_positions"), embed,
+                                ("final_layer_norm", "embed_tokens"), head)
 
 
 def _mixtral_block_specs(cfg) -> list[BlockSpec]:
@@ -1065,7 +1166,8 @@ def load_hf_checkpoint_and_dispatch(
     refs into the original HF shards (the transpose happens at block-fetch
     time). Returns ``(streamed_model, module)``.
 
-    Supported: llama, mistral, gpt2, mixtral (per-expert HF shards aggregate
+    Supported: llama, mistral, gpt2, gptj, gpt_neox, opt (the reference's
+    big-model benchmark families), mixtral (per-expert HF shards aggregate
     lazily into stacked (E, in, out) tensors — LazyStack — so even the
     disk tier never holds more than a block of experts), and t5
     (encoder-decoder; generate via ``streamed.seq2seq_generate``).
@@ -1073,9 +1175,10 @@ def load_hf_checkpoint_and_dispatch(
     from .utils.hf_interop import map_hf_key, open_hf_checkpoint
 
     family, config, module = open_hf_checkpoint(checkpoint_dir, config)
-    if family not in ("llama", "mistral", "gpt2", "t5", "mixtral"):
+    streamable = ("llama", "mistral", "gpt2", "gptj", "gpt_neox", "opt", "t5", "mixtral")
+    if family not in streamable:
         raise ValueError(
-            f"streamed dispatch supports llama/mistral/gpt2/t5/mixtral (got "
+            f"streamed dispatch supports {'/'.join(streamable)} (got "
             f"{family!r}); use utils.load_hf_checkpoint + dispatch_model for "
             "other families")
 

@@ -21,9 +21,18 @@ def _model_registry():
     def llama(name):
         return lambda: LlamaForCausalLM(getattr(LlamaConfig, name)())
 
+    from ..models.gpt_neox import GPTNeoXConfig, GPTNeoXForCausalLM
+    from ..models.gptj import GPTJConfig, GPTJForCausalLM
+    from ..models.opt import OPTConfig, OPTForCausalLM
+
     reg = {
         "llama3-8b": llama("llama3_8b"),
         "llama-tiny": llama("tiny"),
+        # The reference's own big-model benchmark families
+        # (reference: benchmarks/big_model_inference/README.md:31-37).
+        "gptj-6b": lambda: GPTJForCausalLM(GPTJConfig.gptj_6b()),
+        "gpt-neox-20b": lambda: GPTNeoXForCausalLM(GPTNeoXConfig.neox_20b()),
+        "opt-30b": lambda: OPTForCausalLM(OPTConfig.opt_30b()),
     }
     for attr in ("llama2_7b", "llama2_13b", "llama3_70b"):
         if hasattr(LlamaConfig, attr):

@@ -1,0 +1,152 @@
+"""GPT-NeoX decoder (EleutherAI) — one of the reference's big-model
+benchmark families (reference: benchmarks/big_model_inference/README.md:33-34
+measures GPT-NeoX-20B incl. disk offload).
+
+Architecture: fused per-head QKV projection, partial rotary embeddings
+(``rotary_pct`` of each head, split-half/NeoX style), parallel residual
+(``x + attn(ln1(x)) + mlp(ln2(x))``) with a sequential fallback for
+checkpoints trained without it, untied ``embed_out`` head.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from .llama import (
+    apply_rotary,
+    multi_head_attention,
+    rotary_embedding,
+    update_kv_cache_and_attend,
+)
+
+
+@dataclasses.dataclass
+class GPTNeoXConfig:
+    vocab_size: int = 50432
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    max_position_embeddings: int = 2048
+    rotary_pct: float = 0.25
+    rope_theta: float = 10000.0
+    use_parallel_residual: bool = True
+    hidden_act: str = "gelu"   # "gelu" = exact erf (HF semantics); "gelu_new" = tanh
+    layer_norm_eps: float = 1e-5
+    use_flash_attention: bool = True
+    attention_backend: str = "auto"
+
+    @classmethod
+    def neox_20b(cls):
+        return cls(hidden_size=6144, intermediate_size=24576,
+                   num_hidden_layers=44, num_attention_heads=64)
+
+    @classmethod
+    def tiny(cls, **overrides):
+        cfg = cls(vocab_size=256, hidden_size=64, intermediate_size=128,
+                  num_hidden_layers=2, num_attention_heads=4,
+                  max_position_embeddings=128)
+        return dataclasses.replace(cfg, **overrides)
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+    @property
+    def rotary_ndims(self):
+        return int(self.head_dim * self.rotary_pct)
+
+    @property
+    def num_key_value_heads(self):
+        # No GQA; duck-types llama.init_kv_cache.
+        return self.num_attention_heads
+
+
+def _partial_rope(x, cos, sin, rot: int):
+    """Rotate the first ``rot`` dims of each head (NeoX split-half style),
+    pass the rest through."""
+    if rot == x.shape[-1]:
+        return apply_rotary(x, cos, sin)
+    x_rot, x_pass = x[..., :rot], x[..., rot:]
+    return jnp.concatenate([apply_rotary(x_rot, cos, sin), x_pass], axis=-1)
+
+
+class GPTNeoXBlock(nn.Module):
+    """NeoX layer; ``cache``/``cache_pos`` switch to KV-cached decode (same
+    threading contract as LlamaBlock)."""
+
+    config: GPTNeoXConfig
+
+    @nn.compact
+    def __call__(self, x, cache=None, cache_pos=None):
+        cfg = self.config
+        B, S, _ = x.shape
+        H, D = cfg.num_attention_heads, cfg.head_dim
+        dense = lambda n, name: nn.Dense(n, name=name, dtype=x.dtype, param_dtype=jnp.float32)
+
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="input_layernorm",
+                         param_dtype=jnp.float32)(x)
+        # HF fuses QKV per head: the output dim is H blocks of [q|k|v] (3D).
+        qkv = dense(3 * H * D, "query_key_value")(h).reshape(B, S, H, 3 * D)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        start = 0 if cache_pos is None else cache_pos
+        positions = start + jnp.arange(S, dtype=jnp.int32)
+        rot = cfg.rotary_ndims
+        cos, sin = rotary_embedding(positions[None], rot, cfg.rope_theta, dtype=x.dtype)
+        q = _partial_rope(q, cos, sin, rot)
+        k = _partial_rope(k, cos, sin, rot)
+
+        new_cache = None
+        if cache is not None:
+            attn, new_cache = update_kv_cache_and_attend(cache, q, k, v, cache_pos, 1)
+        else:
+            attn = multi_head_attention(
+                q, k, v, causal=True, use_flash=cfg.use_flash_attention,
+                backend=cfg.attention_backend,
+            )
+        attn = dense(cfg.hidden_size, "dense")(attn.reshape(B, S, H * D))
+
+        h2 = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="post_attention_layernorm",
+                          param_dtype=jnp.float32)(x if cfg.use_parallel_residual
+                                                   else x + attn)
+        act = lambda t: jax.nn.gelu(t, approximate=cfg.hidden_act != "gelu")
+        mlp = dense(cfg.hidden_size, "dense_4h_to_h")(
+            act(dense(cfg.intermediate_size, "dense_h_to_4h")(h2))
+        )
+        if cfg.use_parallel_residual:
+            out = x + attn + mlp
+        else:
+            out = (x + attn) + mlp
+        return out if cache is None else (out, new_cache)
+
+
+class GPTNeoXForCausalLM(nn.Module):
+    config: GPTNeoXConfig
+
+    @nn.compact
+    def __call__(self, input_ids, cache=None, cache_pos=None):
+        cfg = self.config
+        x = nn.Embed(cfg.vocab_size, cfg.hidden_size, name="embed_in",
+                     param_dtype=jnp.float32)(input_ids)
+        new_caches = []
+        for i in range(cfg.num_hidden_layers):
+            if cache is None:
+                x = GPTNeoXBlock(cfg, name=f"layers_{i}")(x)
+            else:
+                x, layer_cache = GPTNeoXBlock(cfg, name=f"layers_{i}")(
+                    x, cache=cache[i], cache_pos=cache_pos)
+                new_caches.append(layer_cache)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="final_layer_norm",
+                         param_dtype=jnp.float32)(x)
+        logits = nn.Dense(cfg.vocab_size, use_bias=False, name="embed_out",
+                          dtype=x.dtype, param_dtype=jnp.float32)(x)
+        return logits if cache is None else (logits, tuple(new_caches))
+
+    def init_params(self, rng, batch_size=1, seq_len=8):
+        dummy = jnp.zeros((batch_size, seq_len), jnp.int32)
+        return self.init(rng, dummy)["params"]

@@ -1,0 +1,147 @@
+"""GPT-J decoder (EleutherAI 6B) — one of the reference's big-model
+benchmark families (reference: benchmarks/big_model_inference/README.md:31-32
+measures GPT-J-6B fp16/fp32 load + per-token generation).
+
+Architecture: partial rotary embeddings in the *interleaved* ("rotate
+every two") convention — distinct from NeoX/Llama's split-half — a single
+layer norm feeding attention AND MLP in parallel
+(``x + attn(ln(x)) + mlp(ln(x))``), unbiased attention projections, and
+an untied, biased LM head.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from .llama import multi_head_attention, rotary_embedding, update_kv_cache_and_attend
+
+
+@dataclasses.dataclass
+class GPTJConfig:
+    vocab_size: int = 50400
+    hidden_size: int = 4096
+    intermediate_size: int = 16384
+    num_hidden_layers: int = 28
+    num_attention_heads: int = 16
+    max_position_embeddings: int = 2048
+    rotary_dim: int = 64
+    activation: str = "gelu_new"   # "gelu" = exact erf (HF semantics); "gelu_new" = tanh
+    layer_norm_eps: float = 1e-5
+    use_flash_attention: bool = True
+    attention_backend: str = "auto"
+
+    @classmethod
+    def gptj_6b(cls):
+        return cls()  # the defaults ARE GPT-J-6B
+
+    @classmethod
+    def tiny(cls, **overrides):
+        cfg = cls(vocab_size=256, hidden_size=64, intermediate_size=128,
+                  num_hidden_layers=2, num_attention_heads=4,
+                  max_position_embeddings=128, rotary_dim=8)
+        return dataclasses.replace(cfg, **overrides)
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+    @property
+    def num_key_value_heads(self):
+        # No GQA; duck-types llama.init_kv_cache.
+        return self.num_attention_heads
+
+
+def apply_rotary_interleaved(x, cos, sin):
+    """GPT-J's "rotate every two" RoPE: pairs are (x[2i], x[2i+1]), not the
+    split halves Llama/NeoX use. cos/sin: [..., seq, dim//2]."""
+    x1 = x[..., 0::2]
+    x2 = x[..., 1::2]
+    cos = cos[:, :, None, :]
+    sin = sin[:, :, None, :]
+    o1 = x1 * cos - x2 * sin
+    o2 = x2 * cos + x1 * sin
+    return jnp.stack([o1, o2], axis=-1).reshape(x.shape)
+
+
+def _partial_rope_interleaved(x, cos, sin, rot: int):
+    if rot == x.shape[-1]:
+        return apply_rotary_interleaved(x, cos, sin)
+    x_rot, x_pass = x[..., :rot], x[..., rot:]
+    return jnp.concatenate([apply_rotary_interleaved(x_rot, cos, sin), x_pass], axis=-1)
+
+
+class GPTJBlock(nn.Module):
+    """GPT-J layer: one LN feeds attention and MLP in parallel;
+    ``cache``/``cache_pos`` switch to KV-cached decode (same threading
+    contract as LlamaBlock)."""
+
+    config: GPTJConfig
+
+    @nn.compact
+    def __call__(self, x, cache=None, cache_pos=None):
+        cfg = self.config
+        B, S, _ = x.shape
+        H, D = cfg.num_attention_heads, cfg.head_dim
+
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="ln_1",
+                         param_dtype=jnp.float32)(x)
+        proj = lambda n, name, bias: nn.Dense(n, name=name, use_bias=bias,
+                                              dtype=x.dtype, param_dtype=jnp.float32)
+        q = proj(H * D, "q_proj", False)(h).reshape(B, S, H, D)
+        k = proj(H * D, "k_proj", False)(h).reshape(B, S, H, D)
+        v = proj(H * D, "v_proj", False)(h).reshape(B, S, H, D)
+
+        start = 0 if cache_pos is None else cache_pos
+        positions = start + jnp.arange(S, dtype=jnp.int32)
+        rot = cfg.rotary_dim
+        cos, sin = rotary_embedding(positions[None], rot, 10000.0, dtype=x.dtype)
+        q = _partial_rope_interleaved(q, cos, sin, rot)
+        k = _partial_rope_interleaved(k, cos, sin, rot)
+
+        new_cache = None
+        if cache is not None:
+            attn, new_cache = update_kv_cache_and_attend(cache, q, k, v, cache_pos, 1)
+        else:
+            attn = multi_head_attention(
+                q, k, v, causal=True, use_flash=cfg.use_flash_attention,
+                backend=cfg.attention_backend,
+            )
+        attn = proj(cfg.hidden_size, "out_proj", False)(attn.reshape(B, S, H * D))
+
+        act = lambda t: jax.nn.gelu(t, approximate=cfg.activation != "gelu")
+        mlp = proj(cfg.hidden_size, "fc_out", True)(
+            act(proj(cfg.intermediate_size, "fc_in", True)(h))
+        )
+        out = x + attn + mlp
+        return out if cache is None else (out, new_cache)
+
+
+class GPTJForCausalLM(nn.Module):
+    config: GPTJConfig
+
+    @nn.compact
+    def __call__(self, input_ids, cache=None, cache_pos=None):
+        cfg = self.config
+        x = nn.Embed(cfg.vocab_size, cfg.hidden_size, name="wte",
+                     param_dtype=jnp.float32)(input_ids)
+        new_caches = []
+        for i in range(cfg.num_hidden_layers):
+            if cache is None:
+                x = GPTJBlock(cfg, name=f"h_{i}")(x)
+            else:
+                x, layer_cache = GPTJBlock(cfg, name=f"h_{i}")(
+                    x, cache=cache[i], cache_pos=cache_pos)
+                new_caches.append(layer_cache)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="ln_f",
+                         param_dtype=jnp.float32)(x)
+        logits = nn.Dense(cfg.vocab_size, use_bias=True, name="lm_head",
+                          dtype=x.dtype, param_dtype=jnp.float32)(x)
+        return logits if cache is None else (logits, tuple(new_caches))
+
+    def init_params(self, rng, batch_size=1, seq_len=8):
+        dummy = jnp.zeros((batch_size, seq_len), jnp.int32)
+        return self.init(rng, dummy)["params"]

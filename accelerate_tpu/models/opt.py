@@ -1,0 +1,136 @@
+"""OPT decoder (Meta's OPT family) — one of the reference's big-model
+benchmark families (reference: benchmarks/big_model_inference/README.md:36-37
+measures OPT-30B under cpu/disk offload).
+
+Architecture vs GPT-2: separate q/k/v/out projections (all biased), ReLU
+MLP, learned positions with a constant offset of 2 (an OPT checkpoint
+quirk), pre-LN with a final layer norm. The 350m variant's
+``word_embed_proj_dim != hidden_size`` projection and post-LN mode are
+rejected loudly rather than silently mis-loaded.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from .llama import multi_head_attention, update_kv_cache_and_attend
+
+#: OPT's learned position table starts at index 2 (checkpoint layout quirk).
+POSITION_OFFSET = 2
+
+
+@dataclasses.dataclass
+class OPTConfig:
+    vocab_size: int = 50272
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    max_position_embeddings: int = 2048
+    activation: str = "relu"
+    layer_norm_eps: float = 1e-5
+    use_flash_attention: bool = True
+    attention_backend: str = "auto"
+
+    @classmethod
+    def opt_30b(cls):
+        return cls(hidden_size=7168, intermediate_size=28672,
+                   num_hidden_layers=48, num_attention_heads=56)
+
+    @classmethod
+    def tiny(cls, **overrides):
+        cfg = cls(vocab_size=256, hidden_size=64, intermediate_size=128,
+                  num_hidden_layers=2, num_attention_heads=4,
+                  max_position_embeddings=128)
+        return dataclasses.replace(cfg, **overrides)
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+    @property
+    def num_key_value_heads(self):
+        # No GQA; duck-types llama.init_kv_cache.
+        return self.num_attention_heads
+
+
+def _act(cfg):
+    if cfg.activation == "relu":
+        return jax.nn.relu
+    # HF "gelu" is the exact erf form (ACT2FN), not the tanh approximation.
+    return lambda t: jax.nn.gelu(t, approximate=False)
+
+
+class OPTBlock(nn.Module):
+    """Pre-LN OPT decoder layer; ``cache``/``cache_pos`` switch to KV-cached
+    decode (same threading contract as LlamaBlock)."""
+
+    config: OPTConfig
+
+    @nn.compact
+    def __call__(self, x, cache=None, cache_pos=None):
+        cfg = self.config
+        B, S, _ = x.shape
+        H, D = cfg.num_attention_heads, cfg.head_dim
+
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="self_attn_layer_norm",
+                         param_dtype=jnp.float32)(x)
+        dense = lambda n, name: nn.Dense(n, name=name, dtype=x.dtype, param_dtype=jnp.float32)
+        q = dense(H * D, "q_proj")(h).reshape(B, S, H, D)
+        k = dense(H * D, "k_proj")(h).reshape(B, S, H, D)
+        v = dense(H * D, "v_proj")(h).reshape(B, S, H, D)
+        new_cache = None
+        if cache is not None:
+            attn, new_cache = update_kv_cache_and_attend(cache, q, k, v, cache_pos, 1)
+        else:
+            attn = multi_head_attention(
+                q, k, v, causal=True, use_flash=cfg.use_flash_attention,
+                backend=cfg.attention_backend,
+            )
+        x = x + dense(cfg.hidden_size, "out_proj")(attn.reshape(B, S, H * D))
+
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="final_layer_norm",
+                         param_dtype=jnp.float32)(x)
+        h = dense(cfg.intermediate_size, "fc1")(h)
+        h = _act(cfg)(h)
+        out = x + dense(cfg.hidden_size, "fc2")(h)
+        return out if cache is None else (out, new_cache)
+
+
+class OPTForCausalLM(nn.Module):
+    config: OPTConfig
+
+    @nn.compact
+    def __call__(self, input_ids, cache=None, cache_pos=None):
+        cfg = self.config
+        B, S = input_ids.shape
+        embed_tokens = nn.Embed(cfg.vocab_size, cfg.hidden_size,
+                                name="embed_tokens", param_dtype=jnp.float32)
+        embed_positions = nn.Embed(cfg.max_position_embeddings + POSITION_OFFSET,
+                                   cfg.hidden_size, name="embed_positions",
+                                   param_dtype=jnp.float32)
+        start = 0 if cache_pos is None else cache_pos
+        positions = POSITION_OFFSET + start + jnp.arange(S, dtype=jnp.int32)
+        x = embed_tokens(input_ids) + embed_positions(jnp.broadcast_to(positions[None], (B, S)))
+        new_caches = []
+        for i in range(cfg.num_hidden_layers):
+            if cache is None:
+                x = OPTBlock(cfg, name=f"layers_{i}")(x)
+            else:
+                x, layer_cache = OPTBlock(cfg, name=f"layers_{i}")(
+                    x, cache=cache[i], cache_pos=cache_pos)
+                new_caches.append(layer_cache)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="final_layer_norm",
+                         param_dtype=jnp.float32)(x)
+        # tied head (OPT ties lm_head to embed_tokens)
+        embed = self.variables["params"]["embed_tokens"]["embedding"]
+        logits = x @ embed.T.astype(x.dtype)
+        return logits if cache is None else (logits, tuple(new_caches))
+
+    def init_params(self, rng, batch_size=1, seq_len=8):
+        dummy = jnp.zeros((batch_size, seq_len), jnp.int32)
+        return self.init(rng, dummy)["params"]

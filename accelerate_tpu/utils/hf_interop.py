@@ -101,6 +101,76 @@ _GPT2_RULES = [
     ("ln_f.bias", "ln_f/bias", "copy", None),
 ]
 
+_OPT_RULES = [
+    ("embed_tokens.weight", "embed_tokens/embedding", "copy", None),
+    ("embed_positions.weight", "embed_positions/embedding", "copy", None),
+    ("layers.{i}.self_attn.{p}_proj.weight",
+     "layers_{i}/{p}_proj/kernel", "t", ("q", "k", "v", "out")),
+    ("layers.{i}.self_attn.{p}_proj.bias",
+     "layers_{i}/{p}_proj/bias", "copy", ("q", "k", "v", "out")),
+    ("layers.{i}.self_attn_layer_norm.weight",
+     "layers_{i}/self_attn_layer_norm/scale", "copy", None),
+    ("layers.{i}.self_attn_layer_norm.bias",
+     "layers_{i}/self_attn_layer_norm/bias", "copy", None),
+    ("layers.{i}.fc1.weight", "layers_{i}/fc1/kernel", "t", None),
+    ("layers.{i}.fc1.bias", "layers_{i}/fc1/bias", "copy", None),
+    ("layers.{i}.fc2.weight", "layers_{i}/fc2/kernel", "t", None),
+    ("layers.{i}.fc2.bias", "layers_{i}/fc2/bias", "copy", None),
+    ("layers.{i}.final_layer_norm.weight",
+     "layers_{i}/final_layer_norm/scale", "copy", None),
+    ("layers.{i}.final_layer_norm.bias",
+     "layers_{i}/final_layer_norm/bias", "copy", None),
+    ("final_layer_norm.weight", "final_layer_norm/scale", "copy", None),
+    ("final_layer_norm.bias", "final_layer_norm/bias", "copy", None),
+]
+
+_GPTJ_RULES = [
+    ("wte.weight", "wte/embedding", "copy", None),
+    ("h.{i}.ln_1.weight", "h_{i}/ln_1/scale", "copy", None),
+    ("h.{i}.ln_1.bias", "h_{i}/ln_1/bias", "copy", None),
+    ("h.{i}.attn.{p}_proj.weight",
+     "h_{i}/{p}_proj/kernel", "t", ("q", "k", "v", "out")),
+    ("h.{i}.mlp.fc_in.weight", "h_{i}/fc_in/kernel", "t", None),
+    ("h.{i}.mlp.fc_in.bias", "h_{i}/fc_in/bias", "copy", None),
+    ("h.{i}.mlp.fc_out.weight", "h_{i}/fc_out/kernel", "t", None),
+    ("h.{i}.mlp.fc_out.bias", "h_{i}/fc_out/bias", "copy", None),
+    ("ln_f.weight", "ln_f/scale", "copy", None),
+    ("ln_f.bias", "ln_f/bias", "copy", None),
+    # GPT-J's head is untied AND biased.
+    ("lm_head.weight", "lm_head/kernel", "t", None),
+    ("lm_head.bias", "lm_head/bias", "copy", None),
+]
+
+_GPT_NEOX_RULES = [
+    ("embed_in.weight", "embed_in/embedding", "copy", None),
+    ("layers.{i}.input_layernorm.weight",
+     "layers_{i}/input_layernorm/scale", "copy", None),
+    ("layers.{i}.input_layernorm.bias",
+     "layers_{i}/input_layernorm/bias", "copy", None),
+    # Fused per-head QKV: output-dim layout (H x [q|k|v]) matches after "t".
+    ("layers.{i}.attention.query_key_value.weight",
+     "layers_{i}/query_key_value/kernel", "t", None),
+    ("layers.{i}.attention.query_key_value.bias",
+     "layers_{i}/query_key_value/bias", "copy", None),
+    ("layers.{i}.attention.dense.weight", "layers_{i}/dense/kernel", "t", None),
+    ("layers.{i}.attention.dense.bias", "layers_{i}/dense/bias", "copy", None),
+    ("layers.{i}.post_attention_layernorm.weight",
+     "layers_{i}/post_attention_layernorm/scale", "copy", None),
+    ("layers.{i}.post_attention_layernorm.bias",
+     "layers_{i}/post_attention_layernorm/bias", "copy", None),
+    ("layers.{i}.mlp.dense_h_to_4h.weight",
+     "layers_{i}/dense_h_to_4h/kernel", "t", None),
+    ("layers.{i}.mlp.dense_h_to_4h.bias",
+     "layers_{i}/dense_h_to_4h/bias", "copy", None),
+    ("layers.{i}.mlp.dense_4h_to_h.weight",
+     "layers_{i}/dense_4h_to_h/kernel", "t", None),
+    ("layers.{i}.mlp.dense_4h_to_h.bias",
+     "layers_{i}/dense_4h_to_h/bias", "copy", None),
+    ("final_layer_norm.weight", "final_layer_norm/scale", "copy", None),
+    ("final_layer_norm.bias", "final_layer_norm/bias", "copy", None),
+    ("embed_out.weight", "embed_out/kernel", "t", None),
+]
+
 _BERT_RULES = [
     ("embeddings.word_embeddings.weight", "encoder/word_embeddings/embedding", "copy", None),
     ("embeddings.position_embeddings.weight",
@@ -245,6 +315,9 @@ _FAMILY_RULES = {
     "mistral": _LLAMA_RULES,
     "mixtral": _MIXTRAL_RULES,
     "gpt2": _GPT2_RULES,
+    "gptj": _GPTJ_RULES,
+    "gpt_neox": _GPT_NEOX_RULES,
+    "opt": _OPT_RULES,
     "bert": _BERT_RULES,
     "t5": _T5_RULES,
 }
@@ -253,6 +326,9 @@ _FAMILY_RULES = {
 # before matching so both BertModel and BertForSequenceClassification load.
 _STRIP_PREFIXES = {
     "gpt2": ("transformer.",),
+    "gptj": ("transformer.",),
+    "gpt_neox": ("gpt_neox.",),
+    "opt": ("model.decoder.", "decoder."),
     "bert": ("bert.",),
     "vit": ("vit.",),
     "llama": (),
@@ -264,7 +340,8 @@ _STRIP_PREFIXES = {
 _SKIPPABLE = re.compile(
     r"(^|\.)(lm_head\.weight|predictions\..*|position_ids"
     r"|encoder\.embed_tokens\.weight|decoder\.embed_tokens\.weight"
-    r"|attn\.(bias|masked_bias))$"
+    r"|attn\.(bias|masked_bias)|attention\.(bias|masked_bias)"
+    r"|rotary_emb\.inv_freq)$"
 )
 
 
@@ -392,6 +469,73 @@ def config_from_hf(hf_config: dict, family: Optional[str] = None):
             max_position_embeddings=get("n_positions", 1024),
             layer_norm_eps=get("layer_norm_epsilon", 1e-5),
         )
+    if family == "opt":
+        from ..models.opt import OPTConfig
+
+        if not get("do_layer_norm_before", True):
+            raise NotImplementedError(
+                "do_layer_norm_before=False OPT variants (350m) are post-LN; "
+                "the flax decoder is pre-LN only")
+        if get("word_embed_proj_dim", get("hidden_size")) != get("hidden_size"):
+            raise NotImplementedError(
+                "word_embed_proj_dim != hidden_size (OPT-350m projection) is "
+                "not representable")
+        if not get("enable_bias", True) or not get("layer_norm_elementwise_affine", True):
+            raise NotImplementedError(
+                "bias-less / non-affine-LN OPT variants are not representable "
+                "(the flax decoder declares biased projections and affine norms)")
+        act = get("activation_function", "relu")
+        if act not in ("relu", "gelu"):
+            raise NotImplementedError(f"activation_function {act!r} (relu/gelu only)")
+        return OPTConfig(
+            vocab_size=get("vocab_size", 50272),
+            hidden_size=get("hidden_size", 768),
+            intermediate_size=get("ffn_dim", 3072),
+            num_hidden_layers=get("num_hidden_layers", 12),
+            num_attention_heads=get("num_attention_heads", 12),
+            max_position_embeddings=get("max_position_embeddings", 2048),
+            activation=act,
+        )
+    if family == "gptj":
+        from ..models.gptj import GPTJConfig
+
+        act = get("activation_function", "gelu_new")
+        if not act.startswith("gelu"):
+            raise NotImplementedError(f"activation_function {act!r} (gelu only)")
+        return GPTJConfig(
+            vocab_size=get("vocab_size", 50400),
+            hidden_size=get("n_embd", 4096),
+            intermediate_size=get("n_inner") or 4 * get("n_embd", 4096),
+            num_hidden_layers=get("n_layer", 28),
+            num_attention_heads=get("n_head", 16),
+            max_position_embeddings=get("n_positions", 2048),
+            rotary_dim=get("rotary_dim") or (get("n_embd", 4096) // get("n_head", 16)),
+            activation=act,
+            layer_norm_eps=get("layer_norm_epsilon", 1e-5),
+        )
+    if family == "gpt_neox":
+        from ..models.gpt_neox import GPTNeoXConfig
+
+        act = get("hidden_act", "gelu")
+        if not act.startswith("gelu"):
+            raise NotImplementedError(f"hidden_act {act!r} (gelu only)")
+        if not get("attention_bias", True):
+            raise NotImplementedError(
+                "attention_bias=False GPT-NeoX variants are not representable "
+                "(the flax projections declare biases)")
+        return GPTNeoXConfig(
+            vocab_size=get("vocab_size", 50432),
+            hidden_size=get("hidden_size", 768),
+            intermediate_size=get("intermediate_size", 3072),
+            num_hidden_layers=get("num_hidden_layers", 12),
+            num_attention_heads=get("num_attention_heads", 12),
+            max_position_embeddings=get("max_position_embeddings", 2048),
+            rotary_pct=get("rotary_pct", 0.25),
+            rope_theta=get("rotary_emb_base", get("rope_theta", 10000.0)),
+            use_parallel_residual=get("use_parallel_residual", True),
+            hidden_act=act,
+            layer_norm_eps=get("layer_norm_eps", 1e-5),
+        )
     if family == "vit":
         from ..models.vit import ViTConfig
 
@@ -487,6 +631,18 @@ def model_from_config(config, family: str):
         from ..models.gpt2 import GPT2LMHeadModel
 
         return GPT2LMHeadModel(config)
+    if family == "gptj":
+        from ..models.gptj import GPTJForCausalLM
+
+        return GPTJForCausalLM(config)
+    if family == "gpt_neox":
+        from ..models.gpt_neox import GPTNeoXForCausalLM
+
+        return GPTNeoXForCausalLM(config)
+    if family == "opt":
+        from ..models.opt import OPTForCausalLM
+
+        return OPTForCausalLM(config)
     if family == "bert":
         from ..models.bert import BertForSequenceClassification
 

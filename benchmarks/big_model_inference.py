@@ -56,6 +56,34 @@ def build_and_save(size: str, ckpt_dir: str, family: str = "llama"):
                        head_dim=max(h // heads, 8), dropout_rate=0.0)
         module = T5ForConditionalGeneration(cfg)
         params = module.init_params(jax.random.PRNGKey(0))
+    elif family == "gptj":
+        # Reference table rows :31-32 (GPT-J-6B) use this architecture.
+        from accelerate_tpu.models.gptj import GPTJConfig, GPTJForCausalLM
+
+        cfg = GPTJConfig(vocab_size=vocab, hidden_size=h, intermediate_size=inter,
+                         num_hidden_layers=layers, num_attention_heads=heads,
+                         max_position_embeddings=2048,
+                         rotary_dim=min(64, h // heads), use_flash_attention=False)
+        module = GPTJForCausalLM(cfg)
+        params = module.init_params(jax.random.PRNGKey(0), batch_size=1, seq_len=8)
+    elif family == "neox":
+        # Reference table rows :33-34 (GPT-NeoX-20B).
+        from accelerate_tpu.models.gpt_neox import GPTNeoXConfig, GPTNeoXForCausalLM
+
+        cfg = GPTNeoXConfig(vocab_size=vocab, hidden_size=h, intermediate_size=inter,
+                            num_hidden_layers=layers, num_attention_heads=heads,
+                            max_position_embeddings=2048, use_flash_attention=False)
+        module = GPTNeoXForCausalLM(cfg)
+        params = module.init_params(jax.random.PRNGKey(0), batch_size=1, seq_len=8)
+    elif family == "opt":
+        # Reference table rows :36-37 (OPT-30B, cpu/disk offload).
+        from accelerate_tpu.models.opt import OPTConfig, OPTForCausalLM
+
+        cfg = OPTConfig(vocab_size=vocab, hidden_size=h, intermediate_size=inter,
+                        num_hidden_layers=layers, num_attention_heads=heads,
+                        max_position_embeddings=2048, use_flash_attention=False)
+        module = OPTForCausalLM(cfg)
+        params = module.init_params(jax.random.PRNGKey(0), batch_size=1, seq_len=8)
     else:
         from accelerate_tpu.models.llama import LlamaConfig, LlamaForCausalLM
 
@@ -137,7 +165,8 @@ def bench_tier(module, ckpt_dir: str, tier: str, prompt_len: int, tokens: int,
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--size", default="tiny", choices=sorted(SIZES))
-    ap.add_argument("--family", default="llama", choices=["llama", "t5"])
+    ap.add_argument("--family", default="llama",
+                choices=["llama", "t5", "gptj", "neox", "opt"])
     ap.add_argument("--tiers", default="device,cpu")
     ap.add_argument("--tokens", type=int, default=16)
     ap.add_argument("--prompt-len", type=int, default=64)

@@ -189,6 +189,159 @@ class TestGPT2:
         _roundtrip(params, "gpt2", hf.state_dict(), prefix="transformer.")
 
 
+class TestGPTJ:
+    """GPT-J: interleaved partial rope + single-LN parallel residual +
+    untied biased head (one of the reference's benchmark families)."""
+
+    def _pair(self):
+        hf_cfg = transformers.GPTJConfig(
+            vocab_size=96, n_embd=32, n_layer=2, n_head=4, n_positions=64,
+            rotary_dim=4, resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0)
+        torch.manual_seed(0)
+        with torch.no_grad():
+            hf = transformers.GPTJForCausalLM(hf_cfg).eval()
+        assert detect_family(hf_cfg.to_dict()) == "gptj"
+        cfg = config_from_hf(hf_cfg.to_dict())
+        assert cfg.rotary_dim == 4
+        cfg.use_flash_attention = False
+        from accelerate_tpu.models.gptj import GPTJForCausalLM
+
+        params = convert_hf_state_dict(hf.state_dict(), "gptj", strict=True)
+        return hf, GPTJForCausalLM(cfg), params
+
+    def test_forward_parity(self):
+        hf, model, params = self._pair()
+        ids = (np.arange(20, dtype=np.int64).reshape(2, 10) * 3) % 96
+        ours = model.apply({"params": params}, jnp.asarray(ids, jnp.int32))
+        with torch.no_grad():
+            theirs = hf(torch.from_numpy(ids)).logits
+        _logits_close(ours, theirs)
+
+    def test_greedy_decode_parity(self):
+        hf, model, params = self._pair()
+        from accelerate_tpu.generation import generate
+
+        ids = np.array([[5, 17, 3, 29, 11]], dtype=np.int64)
+        ours = generate(model, params, jnp.asarray(ids, jnp.int32), max_new_tokens=8,
+                        cache_dtype=jnp.float32)
+        with torch.no_grad():
+            theirs = hf.generate(torch.from_numpy(ids), max_new_tokens=8,
+                                 do_sample=False)
+        np.testing.assert_array_equal(np.asarray(ours), theirs.numpy())
+
+    def test_roundtrip(self):
+        hf, _, params = self._pair()
+        _roundtrip(params, "gptj", hf.state_dict(), prefix="transformer.")
+
+
+class TestGPTNeoX:
+    """GPT-NeoX: fused per-head QKV + partial split-half rope + parallel
+    residual + untied head (one of the reference's benchmark families)."""
+
+    def _pair(self, parallel=True):
+        hf_cfg = transformers.GPTNeoXConfig(
+            vocab_size=96, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4,
+            max_position_embeddings=64, rotary_pct=0.5,
+            use_parallel_residual=parallel,
+            hidden_dropout=0.0, attention_dropout=0.0)
+        torch.manual_seed(0)
+        with torch.no_grad():
+            hf = transformers.GPTNeoXForCausalLM(hf_cfg).eval()
+        assert detect_family(hf_cfg.to_dict()) == "gpt_neox"
+        cfg = config_from_hf(hf_cfg.to_dict())
+        assert cfg.rotary_ndims == 4 and cfg.use_parallel_residual is parallel
+        cfg.use_flash_attention = False
+        from accelerate_tpu.models.gpt_neox import GPTNeoXForCausalLM
+
+        params = convert_hf_state_dict(hf.state_dict(), "gpt_neox", strict=True)
+        return hf, GPTNeoXForCausalLM(cfg), params
+
+    @pytest.mark.parametrize("parallel", [True, False])
+    def test_forward_parity(self, parallel):
+        hf, model, params = self._pair(parallel)
+        ids = (np.arange(20, dtype=np.int64).reshape(2, 10) * 3) % 96
+        ours = model.apply({"params": params}, jnp.asarray(ids, jnp.int32))
+        with torch.no_grad():
+            theirs = hf(torch.from_numpy(ids)).logits
+        _logits_close(ours, theirs)
+
+    def test_greedy_decode_parity(self):
+        hf, model, params = self._pair()
+        from accelerate_tpu.generation import generate
+
+        ids = np.array([[5, 17, 3, 29, 11]], dtype=np.int64)
+        ours = generate(model, params, jnp.asarray(ids, jnp.int32), max_new_tokens=8,
+                        cache_dtype=jnp.float32)
+        with torch.no_grad():
+            theirs = hf.generate(torch.from_numpy(ids), max_new_tokens=8,
+                                 do_sample=False)
+        np.testing.assert_array_equal(np.asarray(ours), theirs.numpy())
+
+    def test_roundtrip(self):
+        hf, _, params = self._pair()
+        _roundtrip(params, "gpt_neox", hf.state_dict(), prefix="gpt_neox.")
+
+
+class TestOPT:
+    """OPT: offset learned positions + ReLU pre-LN decoder (one of the
+    reference's benchmark families)."""
+
+    def _pair(self):
+        hf_cfg = transformers.OPTConfig(
+            vocab_size=96, hidden_size=32, ffn_dim=64, num_hidden_layers=2,
+            num_attention_heads=4, max_position_embeddings=64,
+            do_layer_norm_before=True, dropout=0.0, attention_dropout=0.0,
+            word_embed_proj_dim=32)
+        torch.manual_seed(0)
+        with torch.no_grad():
+            hf = transformers.OPTForCausalLM(hf_cfg).eval()
+        assert detect_family(hf_cfg.to_dict()) == "opt"
+        cfg = config_from_hf(hf_cfg.to_dict())
+        assert cfg.intermediate_size == 64 and cfg.activation == "relu"
+        cfg.use_flash_attention = False
+        from accelerate_tpu.models.opt import OPTForCausalLM
+
+        params = convert_hf_state_dict(hf.state_dict(), "opt", strict=True)
+        return hf, OPTForCausalLM(cfg), params
+
+    def test_forward_parity(self):
+        hf, model, params = self._pair()
+        ids = (np.arange(20, dtype=np.int64).reshape(2, 10) * 3) % 96
+        ours = model.apply({"params": params}, jnp.asarray(ids, jnp.int32))
+        with torch.no_grad():
+            theirs = hf(torch.from_numpy(ids)).logits
+        _logits_close(ours, theirs)
+
+    def test_greedy_decode_parity(self):
+        """OPT's config carries eos_token_id=2; compare up to and including
+        HF's first EOS (past it HF stops, ours repeats EOS — static shapes)."""
+        hf, model, params = self._pair()
+        from accelerate_tpu.generation import generate
+
+        ids = np.array([[5, 17, 3, 29, 11]], dtype=np.int64)
+        ours = np.asarray(generate(model, params, jnp.asarray(ids, jnp.int32),
+                                   max_new_tokens=8, eos_token_id=2,
+                                   cache_dtype=jnp.float32))
+        with torch.no_grad():
+            theirs = hf.generate(torch.from_numpy(ids), max_new_tokens=8,
+                                 do_sample=False).numpy()
+        for row_ours, row_hf in zip(ours, theirs):
+            hf_eos = np.where(row_hf == 2)[0]
+            stop = (hf_eos[0] + 1) if hf_eos.size else len(row_hf)
+            np.testing.assert_array_equal(row_ours[:stop], row_hf[:stop])
+            if hf_eos.size:
+                assert (row_ours[hf_eos[0]:] == 2).all()
+
+    def test_roundtrip(self):
+        hf, _, params = self._pair()
+        _roundtrip(params, "opt", hf.state_dict(), prefix="model.decoder.")
+
+    def test_post_ln_variant_rejected(self):
+        with pytest.raises(NotImplementedError, match="post-LN"):
+            config_from_hf({"model_type": "opt", "do_layer_norm_before": False})
+
+
 class TestBert:
     def _pair(self):
         hf_cfg = transformers.BertConfig(
@@ -679,6 +832,57 @@ class TestStreamedDispatch:
         with torch.no_grad():
             theirs = hf(torch.from_numpy(ids)).logits
         _logits_close(ours, theirs)
+
+    @pytest.mark.parametrize("family", ["gptj", "gpt_neox", "opt"])
+    def test_benchmark_families_stream_and_decode(self, tmp_path, family):
+        """The reference's benchmark families (GPT-J / GPT-NeoX / OPT) run
+        through the block-streaming executor off a raw HF dir: forward
+        logits parity at the disk tier + KV-cached streamed greedy decode
+        matching the full-forward argmax path."""
+        import json
+
+        from safetensors.numpy import save_file
+
+        from accelerate_tpu.big_modeling import load_hf_checkpoint_and_dispatch
+
+        mk = {
+            "gptj": lambda: transformers.GPTJForCausalLM(transformers.GPTJConfig(
+                vocab_size=96, n_embd=32, n_layer=2, n_head=4, n_positions=64,
+                rotary_dim=4, resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0)),
+            "gpt_neox": lambda: transformers.GPTNeoXForCausalLM(transformers.GPTNeoXConfig(
+                vocab_size=96, hidden_size=32, intermediate_size=64,
+                num_hidden_layers=2, num_attention_heads=4,
+                max_position_embeddings=64, rotary_pct=0.5,
+                hidden_dropout=0.0, attention_dropout=0.0)),
+            "opt": lambda: transformers.OPTForCausalLM(transformers.OPTConfig(
+                vocab_size=96, hidden_size=32, ffn_dim=64, num_hidden_layers=2,
+                num_attention_heads=4, max_position_embeddings=64,
+                do_layer_norm_before=True, dropout=0.0, attention_dropout=0.0,
+                word_embed_proj_dim=32)),
+        }
+        torch.manual_seed(0)
+        with torch.no_grad():
+            hf = mk[family]().eval()
+        save_file({k: v.numpy() for k, v in hf.state_dict().items()},
+                  str(tmp_path / "model.safetensors"))
+        (tmp_path / "config.json").write_text(json.dumps(hf.config.to_dict()))
+
+        streamed, module = load_hf_checkpoint_and_dispatch(
+            str(tmp_path), device_map={"": "disk"})
+        module.config.use_flash_attention = False
+        ids = np.arange(16, dtype=np.int64).reshape(2, 8) % 96
+        ours = streamed(jnp.asarray(ids, jnp.int32))
+        with torch.no_grad():
+            theirs = hf(torch.from_numpy(ids)).logits
+        _logits_close(ours, theirs)
+
+        prompt = jnp.asarray([[5, 17, 3, 29, 11]], jnp.int32)
+        toks = np.asarray(streamed.generate(prompt, max_new_tokens=4))
+        with torch.no_grad():
+            hf_toks = hf.generate(torch.tensor([[5, 17, 3, 29, 11]]),
+                                  max_new_tokens=4, do_sample=False,
+                                  eos_token_id=None).numpy()
+        np.testing.assert_array_equal(toks, hf_toks)
 
     def test_mistral_sliding_window_through_block_executor(self, tmp_path):
         """The streamed executor must thread sliding_window into the cached
