@@ -2,8 +2,8 @@
 # tests/lanes.py — the single source of truth, guarded by tests/test_lanes.py.
 #
 #   make test-fast          unit core             (~5 min on a 1-core box)
-#   make test-models        model zoo + HF parity (~8 min)
-#   make test-subproc       CLI + example scripts (~9 min)
+#   make test-models        model zoo + HF parity (~12 min)
+#   make test-subproc       CLI + example scripts (~12 min)
 #   make test-multiprocess  real jax.distributed  (~8 min)
 #   make test-all           full suite, no -x (one flake can't hide the rest)
 #

@@ -213,12 +213,19 @@ def validate_launch(args, cfg: ClusterConfig) -> list[str]:
     problems = []
     if not args.module and not os.path.exists(args.training_script):
         problems.append(f"training script not found: {args.training_script}")
-    for axis in ("mesh_fsdp", "mesh_tp", "mesh_cp", "mesh_ep", "mesh_pp"):
+    # MeshConfig's contract: any ONE axis may be -1 (absorb the remaining
+    # devices); everything else must be positive.
+    absorbing = []
+    for axis in ("mesh_dp", "mesh_fsdp", "mesh_tp", "mesh_cp", "mesh_ep", "mesh_pp"):
         val = getattr(cfg, axis)
-        if val is not None and val < 1:
-            problems.append(f"{axis} must be >= 1, got {val}")
-    if cfg.mesh_dp is not None and cfg.mesh_dp < -1 or cfg.mesh_dp == 0:
-        problems.append(f"mesh_dp must be positive or -1 (all remaining), got {cfg.mesh_dp}")
+        if val is None:
+            continue
+        if val == -1:
+            absorbing.append(axis)
+        elif val < 1:
+            problems.append(f"{axis} must be positive or -1 (all remaining), got {val}")
+    if len(absorbing) > 1:
+        problems.append(f"only one mesh axis may be -1, got {absorbing}")
     if args.num_processes is not None and args.num_processes < 1:
         problems.append(f"--num_processes must be >= 1, got {args.num_processes}")
     if args.max_restarts < 0:

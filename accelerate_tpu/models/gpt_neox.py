@@ -35,7 +35,7 @@ class GPTNeoXConfig:
     rotary_pct: float = 0.25
     rope_theta: float = 10000.0
     use_parallel_residual: bool = True
-    hidden_act: str = "gelu"   # "gelu" = exact erf (HF semantics); "gelu_new" = tanh
+    hidden_act: str = "gelu"   # "gelu"/"gelu_python" = exact erf; gelu_new/fast/pytorch_tanh = tanh
     layer_norm_eps: float = 1e-5
     use_flash_attention: bool = True
     attention_backend: str = "auto"
@@ -114,7 +114,7 @@ class GPTNeoXBlock(nn.Module):
         h2 = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="post_attention_layernorm",
                           param_dtype=jnp.float32)(x if cfg.use_parallel_residual
                                                    else x + attn)
-        act = lambda t: jax.nn.gelu(t, approximate=cfg.hidden_act != "gelu")
+        act = lambda t: jax.nn.gelu(t, approximate=cfg.hidden_act not in ("gelu", "gelu_python"))
         mlp = dense(cfg.hidden_size, "dense_4h_to_h")(
             act(dense(cfg.intermediate_size, "dense_h_to_4h")(h2))
         )

@@ -29,7 +29,7 @@ class GPTJConfig:
     num_attention_heads: int = 16
     max_position_embeddings: int = 2048
     rotary_dim: int = 64
-    activation: str = "gelu_new"   # "gelu" = exact erf (HF semantics); "gelu_new" = tanh
+    activation: str = "gelu_new"   # "gelu"/"gelu_python" = exact erf; gelu_new/fast/pytorch_tanh = tanh
     layer_norm_eps: float = 1e-5
     use_flash_attention: bool = True
     attention_backend: str = "auto"
@@ -112,7 +112,7 @@ class GPTJBlock(nn.Module):
             )
         attn = proj(cfg.hidden_size, "out_proj", False)(attn.reshape(B, S, H * D))
 
-        act = lambda t: jax.nn.gelu(t, approximate=cfg.activation != "gelu")
+        act = lambda t: jax.nn.gelu(t, approximate=cfg.activation not in ("gelu", "gelu_python"))
         mlp = proj(cfg.hidden_size, "fc_out", True)(
             act(proj(cfg.intermediate_size, "fc_in", True)(h))
         )

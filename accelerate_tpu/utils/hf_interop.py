@@ -405,6 +405,12 @@ def _flatten(tree: dict, prefix: str = "") -> dict[str, np.ndarray]:
     return flat
 
 
+#: HF gelu spellings the flax models evaluate faithfully: "gelu" and
+#: "gelu_python" are the exact erf form, the rest the tanh approximation.
+#: Anything else (quick_gelu, gelu_10, ...) is rejected loudly.
+_GELU_VARIANTS = {"gelu", "gelu_python", "gelu_new", "gelu_fast", "gelu_pytorch_tanh"}
+
+
 def detect_family(hf_config: dict) -> str:
     """Family name from an HF ``config.json`` dict (its ``model_type``)."""
     model_type = str(hf_config.get("model_type", "")).lower()
@@ -500,8 +506,9 @@ def config_from_hf(hf_config: dict, family: Optional[str] = None):
         from ..models.gptj import GPTJConfig
 
         act = get("activation_function", "gelu_new")
-        if not act.startswith("gelu"):
-            raise NotImplementedError(f"activation_function {act!r} (gelu only)")
+        if act not in _GELU_VARIANTS:
+            raise NotImplementedError(
+                f"activation_function {act!r} (supported: {sorted(_GELU_VARIANTS)})")
         return GPTJConfig(
             vocab_size=get("vocab_size", 50400),
             hidden_size=get("n_embd", 4096),
@@ -517,8 +524,9 @@ def config_from_hf(hf_config: dict, family: Optional[str] = None):
         from ..models.gpt_neox import GPTNeoXConfig
 
         act = get("hidden_act", "gelu")
-        if not act.startswith("gelu"):
-            raise NotImplementedError(f"hidden_act {act!r} (gelu only)")
+        if act not in _GELU_VARIANTS:
+            raise NotImplementedError(
+                f"hidden_act {act!r} (supported: {sorted(_GELU_VARIANTS)})")
         if not get("attention_bias", True):
             raise NotImplementedError(
                 "attention_bias=False GPT-NeoX variants are not representable "

@@ -66,7 +66,7 @@ def build_and_save(size: str, ckpt_dir: str, family: str = "llama"):
                          rotary_dim=min(64, h // heads), use_flash_attention=False)
         module = GPTJForCausalLM(cfg)
         params = module.init_params(jax.random.PRNGKey(0), batch_size=1, seq_len=8)
-    elif family == "neox":
+    elif family == "gpt_neox":
         # Reference table rows :33-34 (GPT-NeoX-20B).
         from accelerate_tpu.models.gpt_neox import GPTNeoXConfig, GPTNeoXForCausalLM
 
@@ -166,7 +166,7 @@ def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--size", default="tiny", choices=sorted(SIZES))
     ap.add_argument("--family", default="llama",
-                choices=["llama", "t5", "gptj", "neox", "opt"])
+                choices=["llama", "t5", "gptj", "gpt_neox", "opt"])
     ap.add_argument("--tiers", default="device,cpu")
     ap.add_argument("--tokens", type=int, default=16)
     ap.add_argument("--prompt-len", type=int, default=64)

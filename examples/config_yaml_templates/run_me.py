@@ -1,0 +1,21 @@
+"""Print the environment a config template resolves to (the counterpart of
+the reference's config_yaml_templates/run_me.py): launch this with any
+template to see the mesh/precision/world the Accelerator actually built.
+
+    accelerate-tpu launch --config_file examples/config_yaml_templates/fsdp.yaml \
+        examples/config_yaml_templates/run_me.py
+"""
+
+from accelerate_tpu import Accelerator
+
+
+def main():
+    accelerator = Accelerator()
+    accelerator.print(repr(accelerator.state._partial))
+    accelerator.print(f"mesh axes: {dict(accelerator.mesh.shape)}")
+    accelerator.print(f"mixed precision: {accelerator.mixed_precision}")
+    accelerator.print("config resolved OK")
+
+
+if __name__ == "__main__":
+    main()

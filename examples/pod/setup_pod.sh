@@ -1,0 +1,11 @@
+#!/usr/bin/env bash
+# One-time pod setup: push the same setup commands to every worker
+# (the reference's `accelerate tpu-config` workflow).
+set -euo pipefail
+
+TPU_NAME=${TPU_NAME:-my-pod}
+TPU_ZONE=${TPU_ZONE:-us-central2-b}
+
+accelerate-tpu tpu-config \
+  --tpu_name "$TPU_NAME" --tpu_zone "$TPU_ZONE" \
+  --command "pip install -e /path/to/accelerate-tpu"

@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# Multi-slice: one coordinator, N slices; run once per slice with RANK set
+# by your provisioning tool. The dcn-major mesh axis (dp by default) keeps
+# layer-wise collectives on ICI — only gradient reduction crosses slices.
+set -euo pipefail
+
+COORD_IP=${COORD_IP:-10.0.0.1}
+NUM_SLICES=${NUM_SLICES:-2}
+RANK=${RANK:-0}
+
+accelerate-tpu launch \
+  --num_machines "$NUM_SLICES" --machine_rank "$RANK" \
+  --main_process_ip "$COORD_IP" --main_process_port 8476 \
+  --dp 2 --fsdp 8 \
+  examples/nlp_example.py

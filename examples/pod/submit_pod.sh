@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# Train on every worker of a TPU pod slice. Run from your workstation;
+# the launcher ssh-fans the command to all workers via gcloud.
+set -euo pipefail
+
+TPU_NAME=${TPU_NAME:-my-pod}
+TPU_ZONE=${TPU_ZONE:-us-central2-b}
+
+accelerate-tpu launch \
+  --gcloud --tpu_name "$TPU_NAME" --tpu_zone "$TPU_ZONE" \
+  --fsdp 8 --max_restarts 3 \
+  examples/nlp_example.py

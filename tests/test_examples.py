@@ -86,6 +86,67 @@ class TestCanonicalExamples:
         _run_example(EXAMPLES / "cv_example.py", ["--epochs", "1"])
 
 
+class TestInferenceExamples:
+    """examples/inference/ — the reference's examples/inference/{pippy,
+    distributed} counterparts."""
+
+    def test_pipeline_inference_over_pp_mesh(self):
+        env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+        res = subprocess.run(
+            [sys.executable, "-m", "accelerate_tpu.commands.accelerate_cli", "launch",
+             "--use_cpu_emulation", "--emulated_device_count", "8",
+             "--pp", "2", "--tp", "2",
+             str(EXAMPLES / "inference" / "pipeline_inference.py")],
+            capture_output=True, text=True, timeout=600, cwd=str(REPO), env=env)
+        assert res.returncode == 0, res.stdout[-2500:] + res.stderr[-2500:]
+        assert "'pp': 2" in res.stdout and "'tp': 2" in res.stdout
+        assert "pipeline inference example: OK" in res.stdout
+
+    def test_distributed_inference_two_processes(self):
+        env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+        res = subprocess.run(
+            [sys.executable, "-m", "accelerate_tpu.commands.accelerate_cli", "launch",
+             "--num_processes", "2", "--emulated_device_count", "1",
+             str(EXAMPLES / "inference" / "distributed_inference.py")],
+            capture_output=True, text=True, timeout=600, cwd=str(REPO), env=env)
+        assert res.returncode == 0, res.stdout[-2500:] + res.stderr[-2500:]
+        assert "distributed inference example: OK" in res.stdout
+
+
+class TestConfigTemplates:
+    def test_every_template_resolves(self):
+        """Each shipped YAML template must launch run_me.py cleanly (the
+        reference's config_yaml_templates/run_me.py drill)."""
+        templates = sorted((EXAMPLES / "config_yaml_templates").glob("*.yaml"))
+        assert len(templates) >= 5
+        for tpl in templates:
+            env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+            env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+            flags = env.get("XLA_FLAGS", "")
+            if "--xla_force_host_platform_device_count" not in flags:
+                env["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+            # Topology-bound templates are scaled down to the 8-device
+            # emulation via CLI flags (which must take priority over the file).
+            overrides = {
+                "multi_node.yaml": ["--num_machines", "1"],
+                "composed_3d.yaml": ["--dp", "1", "--fsdp", "4", "--tp", "2"],
+            }
+            args = overrides.get(tpl.name, [])
+            res = subprocess.run(
+                [sys.executable, "-m", "accelerate_tpu.commands.accelerate_cli",
+                 "launch", "--config_file", str(tpl), *args,
+                 str(EXAMPLES / "config_yaml_templates" / "run_me.py")],
+                capture_output=True, text=True, timeout=300, cwd=str(REPO), env=env)
+            assert res.returncode == 0, (
+                f"{tpl.name}:\n{res.stdout[-1500:]}\n{res.stderr[-1500:]}")
+            assert "config resolved OK" in res.stdout, tpl.name
+
+
 class TestByFeatureExamples:
     @pytest.mark.parametrize("script", sorted(SCRIPTS))
     def test_runs_one_epoch(self, script, tmp_path):
