@@ -40,6 +40,7 @@ class BertConfig:
 
     @property
     def head_dim(self):
+        """Per-head width: hidden_size // num_attention_heads."""
         return self.hidden_size // self.num_attention_heads
 
 
@@ -115,6 +116,7 @@ class BertForSequenceClassification(nn.Module):
         return nn.Dense(cfg.num_labels, name="classifier", param_dtype=jnp.float32)(pooled)
 
     def init_params(self, rng, batch_size=1, seq_len=8):
+        """Initialize a parameter pytree from a PRNG key (shape-driving args are traced-free)."""
         dummy = jnp.zeros((batch_size, seq_len), jnp.int32)
         return self.init(rng, dummy)["params"]
 

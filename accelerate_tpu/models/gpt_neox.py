@@ -54,14 +54,17 @@ class GPTNeoXConfig:
 
     @property
     def head_dim(self):
+        """Per-head width: hidden_size // num_attention_heads."""
         return self.hidden_size // self.num_attention_heads
 
     @property
     def rotary_ndims(self):
+        """Rotated dims per head: head_dim * rotary_pct."""
         return int(self.head_dim * self.rotary_pct)
 
     @property
     def num_key_value_heads(self):
+        """KV head count (== query heads when no GQA); drives init_kv_cache."""
         # No GQA; duck-types llama.init_kv_cache.
         return self.num_attention_heads
 
@@ -148,5 +151,6 @@ class GPTNeoXForCausalLM(nn.Module):
         return logits if cache is None else (logits, tuple(new_caches))
 
     def init_params(self, rng, batch_size=1, seq_len=8):
+        """Initialize a parameter pytree from a PRNG key (shape-driving args are traced-free)."""
         dummy = jnp.zeros((batch_size, seq_len), jnp.int32)
         return self.init(rng, dummy)["params"]

@@ -86,6 +86,7 @@ class LlamaConfig:
 
     @property
     def head_dim(self):
+        """Per-head width: hidden_size // num_attention_heads."""
         return self.hidden_size // self.num_attention_heads
 
 
@@ -419,6 +420,7 @@ class LlamaForCausalLM(nn.Module):
         return logits if cache is None else (logits, new_cache)
 
     def init_params(self, rng, batch_size=1, seq_len=8):
+        """Initialize a parameter pytree from a PRNG key (shape-driving args are traced-free)."""
         dummy = jnp.zeros((batch_size, seq_len), jnp.int32)
         return self.init(rng, dummy)["params"]
 
@@ -447,6 +449,7 @@ class PipelinedLlamaForCausalLM:
     # -- parameter init / layout ------------------------------------------
 
     def init_params(self, rng, seq_len: int = 8):
+        """Initialize a parameter pytree from a PRNG key (shape-driving args are traced-free)."""
         cfg = self.config
         r_embed, r_blocks, r_head = jax.random.split(rng, 3)
         dummy_x = jnp.zeros((1, seq_len, cfg.hidden_size), jnp.float32)
@@ -495,6 +498,7 @@ class PipelinedLlamaForCausalLM:
     # -- forward -----------------------------------------------------------
 
     def apply(self, variables, input_ids, positions=None):
+        """Flax apply over stacked per-stage params (pipeline schedule inside)."""
         from ..parallel.pipeline import pipeline_apply
 
         cfg = self.config

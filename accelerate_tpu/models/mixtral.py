@@ -175,6 +175,7 @@ class MixtralForCausalLM(nn.Module):
         return logits, {"load_balance_loss": lb / n, "router_z_loss": zl / n}
 
     def init_params(self, rng, batch_size=1, seq_len=8):
+        """Initialize a parameter pytree from a PRNG key (shape-driving args are traced-free)."""
         dummy = jnp.zeros((batch_size, seq_len), jnp.int32)
         return self.init(rng, dummy)["params"]
 

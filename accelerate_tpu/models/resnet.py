@@ -91,5 +91,6 @@ class ResNet(nn.Module):
         return nn.Dense(cfg.num_classes, name="classifier", param_dtype=jnp.float32)(x)
 
     def init_variables(self, rng, image_size=32):
+        """Initialize the full variable collection (params + batch stats)."""
         dummy = jnp.zeros((1, image_size, image_size, 3))
         return self.init(rng, dummy, train=False)

@@ -30,4 +30,5 @@ class MLP(nn.Module):
         return nn.Dense(self.num_outputs, name="out", param_dtype=jnp.float32)(x)
 
     def init_params(self, rng, input_dim):
+        """Initialize a parameter pytree from a PRNG key (shape-driving args are traced-free)."""
         return self.init(rng, jnp.zeros((1, input_dim)))["params"]

@@ -356,6 +356,7 @@ class T5ForConditionalGeneration(nn.Module):
         )
 
     def init_params(self, rng, batch_size=1, src_len=8, tgt_len=8):
+        """Initialize a parameter pytree from a PRNG key (shape-driving args are traced-free)."""
         src = jnp.zeros((batch_size, src_len), jnp.int32)
         tgt = jnp.zeros((batch_size, tgt_len), jnp.int32)
         return self.init(rng, src, tgt)["params"]

@@ -48,10 +48,12 @@ class ViTConfig:
 
     @property
     def head_dim(self):
+        """Per-head width: hidden_size // num_attention_heads."""
         return self.hidden_size // self.num_attention_heads
 
     @property
     def num_patches(self):
+        """Patch-token count for the configured image size."""
         return (self.image_size // self.patch_size) ** 2
 
 
@@ -133,6 +135,7 @@ class ViTForImageClassification(nn.Module):
         return nn.Dense(cfg.num_labels, name="classifier", param_dtype=jnp.float32)(x[:, 0])
 
     def init_params(self, rng, batch_size=1):
+        """Initialize a parameter pytree from a PRNG key (shape-driving args are traced-free)."""
         cfg = self.config
         dummy = jnp.zeros((batch_size, cfg.image_size, cfg.image_size,
                            cfg.num_channels), jnp.float32)

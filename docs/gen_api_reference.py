@@ -55,6 +55,14 @@ GROUPS = [
       "accelerate_tpu.ops.ring_attention", "accelerate_tpu.ops.moe",
       "accelerate_tpu.ops.quant", "accelerate_tpu.ops.fused_loss"],
      "Pallas flash attention, ring/Ulysses attention, MoE dispatch, fp8 matmul."),
+    ("models", "Model zoo",
+     ["accelerate_tpu.models.llama", "accelerate_tpu.models.mixtral",
+      "accelerate_tpu.models.gpt2", "accelerate_tpu.models.gptj",
+      "accelerate_tpu.models.gpt_neox", "accelerate_tpu.models.opt",
+      "accelerate_tpu.models.bert", "accelerate_tpu.models.t5",
+      "accelerate_tpu.models.vit", "accelerate_tpu.models.resnet"],
+     "Flax model families, all shardable by the same mesh rules and loadable "
+     "from HF checkpoints."),
     ("kwargs", "Plugins & kwargs handlers", ["accelerate_tpu.utils.dataclasses"],
      "Every plugin/config dataclass `Accelerator` accepts."),
     ("precision", "Precision policies", ["accelerate_tpu.precision"], None),
