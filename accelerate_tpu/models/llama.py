@@ -229,8 +229,11 @@ def multi_head_attention(
                 return context_parallel_attention(
                     q, k, v, mesh=mesh, causal=causal, strategy=backend, use_flash=use_flash
                 )
-    if backend != "einsum" and use_flash and segment_ids is None and flash_attention_available(q):
-        return flash_attention(q, k, v, causal=causal, block_q=block_q, block_k=block_k)
+    if backend != "einsum" and use_flash and flash_attention_available(q):
+        # segment_ids are masked inside the Pallas kernel, so packed-sequence
+        # training keeps flash's memory asymptotics.
+        return flash_attention(q, k, v, causal=causal, block_q=block_q, block_k=block_k,
+                               segment_ids=segment_ids)
     return _einsum_attention(q, k, v, causal=causal, segment_ids=segment_ids)
 
 

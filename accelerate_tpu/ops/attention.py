@@ -61,19 +61,24 @@ def _einsum_attention(q, k, v, causal: bool, segment_ids=None, sliding_window=No
 
 
 def flash_attention(q, k, v, causal: bool = True, block_q: int = 128, block_k: int = 128,
-                    sliding_window=None):
+                    sliding_window=None, segment_ids=None):
     """Flash attention entry point.
 
     Args are [batch, seq, heads, head_dim]. Dispatches to the Pallas kernel
-    on TPU; einsum fallback elsewhere.
+    on TPU; einsum fallback elsewhere. ``segment_ids`` (packed sequences)
+    are masked inside the kernel; the sliding_window+segments combination
+    routes to the einsum path.
     """
     if sliding_window is not None and not causal:
         # Validated here (not just in the kernel) so CPU-fallback runs fail
         # identically to TPU runs instead of silently clamping causally.
         raise ValueError("sliding_window requires causal=True")
-    if not flash_attention_available(q):
-        return _einsum_attention(q, k, v, causal, sliding_window=sliding_window)
+    if not flash_attention_available(q) or (
+        sliding_window is not None and segment_ids is not None
+    ):
+        return _einsum_attention(q, k, v, causal, segment_ids=segment_ids,
+                                 sliding_window=sliding_window)
     from .flash_pallas import pallas_flash_attention
 
     return pallas_flash_attention(q, k, v, causal=causal, block_q=block_q, block_k=block_k,
-                                  sliding_window=sliding_window)
+                                  sliding_window=sliding_window, segment_ids=segment_ids)

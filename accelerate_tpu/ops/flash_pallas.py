@@ -89,9 +89,13 @@ def _pair_mask(qi, ki, block_q: int, block_k: int, causal: bool, window):
     return mask
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
+def _fwd_kernel(*refs,
                 sm_scale: float, causal: bool, window, block_q: int, block_k: int,
-                num_k_blocks: int, band: int):
+                num_k_blocks: int, band: int, has_segments: bool):
+    if has_segments:
+        q_ref, k_ref, v_ref, qs_ref, ks_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
     qi = pl.program_id(2)
     kj = pl.program_id(3)
     _, k_start = _k_band(window, block_q, block_k, num_k_blocks)
@@ -116,8 +120,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * sm_scale  # [block_q, block_k]
 
-        if causal or window is not None:
-            s = jnp.where(_pair_mask(qi, ki, block_q, block_k, causal, window), s, NEG_INF)
+        if causal or window is not None or has_segments:
+            mask = _pair_mask(qi, ki, block_q, block_k, causal, window)
+            if has_segments:
+                mask &= qs_ref[0][:, None] == ks_ref[0][None, :]
+            s = jnp.where(mask, s, NEG_INF)
 
         m_prev = m_scr[:, :1]                       # [block_q, 1]
         l_prev = l_scr[:, :1]
@@ -143,7 +150,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
         lse_ref[0, 0] = jnp.broadcast_to(lse, lse_ref.shape[2:])
 
 
-def _flash_fwd(q, k, v, sm_scale, causal, window, block_q, block_k):
+def _flash_fwd(q, k, v, sm_scale, causal, window, block_q, block_k, segment_ids=None):
     B, H, S_q, D = q.shape
     S_k = k.shape[2]
     num_q = S_q // block_q
@@ -154,18 +161,30 @@ def _flash_fwd(q, k, v, sm_scale, causal, window, block_q, block_k):
     def k_index(b, h, qi, kj):
         return (b, h, jnp.minimum(k_start(qi) + kj, num_k - 1), 0)
 
+    has_segments = segment_ids is not None
     kernel = functools.partial(
         _fwd_kernel, sm_scale=sm_scale, causal=causal, window=window,
         block_q=block_q, block_k=block_k, num_k_blocks=num_k, band=band,
+        has_segments=has_segments,
     )
+    in_specs = [
+        pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, kj: (b, h, qi, 0)),
+        pl.BlockSpec((1, 1, block_k, D), k_index),
+        pl.BlockSpec((1, 1, block_k, D), k_index),
+    ]
+    inputs = [q, k, v]
+    if has_segments:
+        # The same [B, S] array enters twice: q-block rows and k-block rows.
+        in_specs += [
+            pl.BlockSpec((1, block_q), lambda b, h, qi, kj: (b, qi)),
+            pl.BlockSpec((1, block_k),
+                         lambda b, h, qi, kj: (b, jnp.minimum(k_start(qi) + kj, num_k - 1))),
+        ]
+        inputs += [segment_ids, segment_ids]
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, kj: (b, h, qi, 0)),
-            pl.BlockSpec((1, 1, block_k, D), k_index),
-            pl.BlockSpec((1, 1, block_k, D), k_index),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, kj: (b, h, qi, 0)),
             pl.BlockSpec((1, 1, block_q, LANES), lambda b, h, qi, kj: (b, h, qi, 0)),
@@ -183,7 +202,7 @@ def _flash_fwd(q, k, v, sm_scale, causal, window, block_q, block_k):
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
         ),
         interpret=_interpret(),
-    )(q, k, v)
+    )(*inputs)
     return out, lse
 
 
@@ -196,9 +215,14 @@ def _flash_fwd(q, k, v, sm_scale, causal, window, block_q, block_k):
 # over k blocks. P is recomputed blockwise from the lse residual.
 # ---------------------------------------------------------------------------
 
-def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-                     dk_scr, dv_scr, *, sm_scale, causal, window, block_q, block_k,
-                     num_q_blocks, band: int):
+def _bwd_dkdv_kernel(*refs, sm_scale, causal, window, block_q, block_k,
+                     num_q_blocks, band: int, has_segments: bool):
+    if has_segments:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qs_ref, ks_ref,
+         dk_ref, dv_ref, dk_scr, dv_scr) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dk_scr, dv_scr) = refs
     ki = pl.program_id(2)
     qj = pl.program_id(3)
     _, q_start = _q_band(window, block_q, block_k, num_q_blocks)
@@ -225,8 +249,11 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * sm_scale             # [bq, bk]
-        if causal or window is not None:
-            s = jnp.where(_pair_mask(qi, ki, block_q, block_k, causal, window), s, NEG_INF)
+        if causal or window is not None or has_segments:
+            mask = _pair_mask(qi, ki, block_q, block_k, causal, window)
+            if has_segments:
+                mask &= qs_ref[0][:, None] == ks_ref[0][None, :]
+            s = jnp.where(mask, s, NEG_INF)
         p = jnp.exp(s - lse)     # [bq, bk] fp32
 
         # dV += P^T dO
@@ -249,8 +276,13 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv
         dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr, *,
-                   sm_scale, causal, window, block_q, block_k, num_k_blocks, band: int):
+def _bwd_dq_kernel(*refs, sm_scale, causal, window, block_q, block_k,
+                   num_k_blocks, band: int, has_segments: bool):
+    if has_segments:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qs_ref, ks_ref,
+         dq_ref, dq_scr) = refs
+    else:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr = refs
     qi = pl.program_id(2)
     kj = pl.program_id(3)
     _, k_start = _k_band(window, block_q, block_k, num_k_blocks)
@@ -276,8 +308,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_s
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * sm_scale
-        if causal or window is not None:
-            s = jnp.where(_pair_mask(qi, ki, block_q, block_k, causal, window), s, NEG_INF)
+        if causal or window is not None or has_segments:
+            mask = _pair_mask(qi, ki, block_q, block_k, causal, window)
+            if has_segments:
+                mask &= qs_ref[0][:, None] == ks_ref[0][None, :]
+            s = jnp.where(mask, s, NEG_INF)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -292,13 +327,15 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_s
         dq_ref[0, 0] = dq_scr[:].astype(dq_ref.dtype)
 
 
-def _flash_bwd(sm_scale, causal, window, block_q, block_k, residuals, d_out):
+def _flash_bwd(sm_scale, causal, window, block_q, block_k, residuals, d_out,
+               segment_ids=None):
     q, k, v, out, lse = residuals
     do = d_out
     B, H, S_q, D = q.shape
     S_k = k.shape[2]
     num_q = S_q // block_q
     num_k = S_k // block_k
+    has_segments = segment_ids is not None
 
     # delta = rowsum(dO * O)  [B, H, S_q] broadcast to LANES for tiling.
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1, keepdims=True)
@@ -309,20 +346,31 @@ def _flash_bwd(sm_scale, causal, window, block_q, block_k, residuals, d_out):
     def q_index(b, h, ki, qj):
         return (b, h, jnp.minimum(q_start(ki) + qj, num_q - 1), 0)
 
+    dkdv_specs = [
+        pl.BlockSpec((1, 1, block_q, D), q_index),
+        pl.BlockSpec((1, 1, block_k, D), lambda b, h, ki, qj: (b, h, ki, 0)),
+        pl.BlockSpec((1, 1, block_k, D), lambda b, h, ki, qj: (b, h, ki, 0)),
+        pl.BlockSpec((1, 1, block_q, D), q_index),
+        pl.BlockSpec((1, 1, block_q, LANES), q_index),
+        pl.BlockSpec((1, 1, block_q, LANES), q_index),
+    ]
+    dkdv_inputs = [q, k, v, do, lse, delta]
+    if has_segments:
+        dkdv_specs += [
+            pl.BlockSpec((1, block_q),
+                         lambda b, h, ki, qj: (b, jnp.minimum(q_start(ki) + qj, num_q - 1))),
+            pl.BlockSpec((1, block_k), lambda b, h, ki, qj: (b, ki)),
+        ]
+        dkdv_inputs += [segment_ids, segment_ids]
+
     dkdv = pl.pallas_call(
         functools.partial(
             _bwd_dkdv_kernel, sm_scale=sm_scale, causal=causal, window=window,
             block_q=block_q, block_k=block_k, num_q_blocks=num_q, band=band_q,
+            has_segments=has_segments,
         ),
         grid=(B, H, num_k, band_q),
-        in_specs=[
-            pl.BlockSpec((1, 1, block_q, D), q_index),
-            pl.BlockSpec((1, 1, block_k, D), lambda b, h, ki, qj: (b, h, ki, 0)),
-            pl.BlockSpec((1, 1, block_k, D), lambda b, h, ki, qj: (b, h, ki, 0)),
-            pl.BlockSpec((1, 1, block_q, D), q_index),
-            pl.BlockSpec((1, 1, block_q, LANES), q_index),
-            pl.BlockSpec((1, 1, block_q, LANES), q_index),
-        ],
+        in_specs=dkdv_specs,
         out_specs=[
             pl.BlockSpec((1, 1, block_k, D), lambda b, h, ki, qj: (b, h, ki, 0)),
             pl.BlockSpec((1, 1, block_k, D), lambda b, h, ki, qj: (b, h, ki, 0)),
@@ -339,7 +387,7 @@ def _flash_bwd(sm_scale, causal, window, block_q, block_k, residuals, d_out):
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
         ),
         interpret=_interpret(),
-    )(q, k, v, do, lse, delta)
+    )(*dkdv_inputs)
     dk, dv = dkdv
 
     band_k, k_start = _k_band(window, block_q, block_k, num_k)
@@ -347,20 +395,31 @@ def _flash_bwd(sm_scale, causal, window, block_q, block_k, residuals, d_out):
     def k_index(b, h, qi, kj):
         return (b, h, jnp.minimum(k_start(qi) + kj, num_k - 1), 0)
 
+    dq_specs = [
+        pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, kj: (b, h, qi, 0)),
+        pl.BlockSpec((1, 1, block_k, D), k_index),
+        pl.BlockSpec((1, 1, block_k, D), k_index),
+        pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, kj: (b, h, qi, 0)),
+        pl.BlockSpec((1, 1, block_q, LANES), lambda b, h, qi, kj: (b, h, qi, 0)),
+        pl.BlockSpec((1, 1, block_q, LANES), lambda b, h, qi, kj: (b, h, qi, 0)),
+    ]
+    dq_inputs = [q, k, v, do, lse, delta]
+    if has_segments:
+        dq_specs += [
+            pl.BlockSpec((1, block_q), lambda b, h, qi, kj: (b, qi)),
+            pl.BlockSpec((1, block_k),
+                         lambda b, h, qi, kj: (b, jnp.minimum(k_start(qi) + kj, num_k - 1))),
+        ]
+        dq_inputs += [segment_ids, segment_ids]
+
     dq = pl.pallas_call(
         functools.partial(
             _bwd_dq_kernel, sm_scale=sm_scale, causal=causal, window=window,
             block_q=block_q, block_k=block_k, num_k_blocks=num_k, band=band_k,
+            has_segments=has_segments,
         ),
         grid=(B, H, num_q, band_k),
-        in_specs=[
-            pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, kj: (b, h, qi, 0)),
-            pl.BlockSpec((1, 1, block_k, D), k_index),
-            pl.BlockSpec((1, 1, block_k, D), k_index),
-            pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, kj: (b, h, qi, 0)),
-            pl.BlockSpec((1, 1, block_q, LANES), lambda b, h, qi, kj: (b, h, qi, 0)),
-            pl.BlockSpec((1, 1, block_q, LANES), lambda b, h, qi, kj: (b, h, qi, 0)),
-        ],
+        in_specs=dq_specs,
         out_specs=pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, kj: (b, h, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((B, H, S_q, D), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
@@ -368,7 +427,7 @@ def _flash_bwd(sm_scale, causal, window, block_q, block_k, residuals, d_out):
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
         ),
         interpret=_interpret(),
-    )(q, k, v, do, lse, delta)
+    )(*dq_inputs)
 
     return dq, dk, dv
 
@@ -391,21 +450,59 @@ def _fwd_rule(q, k, v, sm_scale, causal, window, block_q, block_k):
 _flash_bhsd.defvjp(_fwd_rule, _flash_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash_bhsd_seg(q, k, v, segment_ids, sm_scale, causal, window, block_q, block_k):
+    out, _ = _flash_fwd(q, k, v, sm_scale, causal, window, block_q, block_k,
+                        segment_ids=segment_ids)
+    return out
+
+
+def _seg_fwd_rule(q, k, v, segment_ids, sm_scale, causal, window, block_q, block_k):
+    out, lse = _flash_fwd(q, k, v, sm_scale, causal, window, block_q, block_k,
+                          segment_ids=segment_ids)
+    return out, (q, k, v, out, lse, segment_ids)
+
+
+def _seg_bwd_rule(sm_scale, causal, window, block_q, block_k, residuals, d_out):
+    q, k, v, out, lse, segment_ids = residuals
+    dq, dk, dv = _flash_bwd(sm_scale, causal, window, block_q, block_k,
+                            (q, k, v, out, lse), d_out, segment_ids=segment_ids)
+    # Integer segment ids carry a float0 cotangent (no gradient flows).
+    dseg = jnp.zeros(segment_ids.shape, jax.dtypes.float0)
+    return dq, dk, dv, dseg
+
+
+_flash_bhsd_seg.defvjp(_seg_fwd_rule, _seg_bwd_rule)
+
+
 def pallas_flash_attention(q, k, v, causal: bool = True, block_q: int = 128, block_k: int = 128,
-                           sm_scale: float | None = None, sliding_window: int | None = None):
+                           sm_scale: float | None = None, sliding_window: int | None = None,
+                           segment_ids=None):
     """Public entry. q/k/v: [batch, seq, heads, head_dim] (models layout).
 
     ``sliding_window=w`` masks k_pos outside (q_pos - w, q_pos] and *skips*
     fully-masked K blocks, so long-sequence local attention (Mistral) costs
-    O(S * w) instead of O(S^2)."""
+    O(S * w) instead of O(S^2).
+
+    ``segment_ids`` [batch, seq] (packed sequences, data_loader.pack_sequences):
+    pairs in different segments are masked inside the kernel, so packed
+    training keeps flash's O(seq x block) memory instead of falling back to
+    the einsum path's O(seq^2) logits."""
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
     if sliding_window is not None and not causal:
         raise ValueError("sliding_window requires causal=True")
+    if sliding_window is not None and segment_ids is not None:
+        raise ValueError("sliding_window with segment_ids is not supported in the "
+                         "Pallas kernel (use the einsum path)")
     S = q.shape[1]
     block_q = min(block_q, S)
     block_k = min(block_k, k.shape[1])
     # [B, S, H, D] -> [B, H, S, D]
     qt, kt, vt = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
-    out = _flash_bhsd(qt, kt, vt, sm_scale, causal, sliding_window, block_q, block_k)
+    if segment_ids is not None:
+        out = _flash_bhsd_seg(qt, kt, vt, segment_ids.astype(jnp.int32),
+                              sm_scale, causal, None, block_q, block_k)
+    else:
+        out = _flash_bhsd(qt, kt, vt, sm_scale, causal, sliding_window, block_q, block_k)
     return jnp.swapaxes(out, 1, 2)

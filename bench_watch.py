@@ -205,6 +205,34 @@ def run_kernels() -> dict:
     want = _einsum_attention(qw, kw, vw, causal=True, sliding_window=window)
     check("flash_window_fwd_fp32", got, want, 2e-2)
 
+    # -- packed-sequence (segment_ids) parity --------------------------------
+    import numpy as np
+
+    Sseg = 128 if tiny else 512
+    qp, kp, vp = qkv(1, Sseg, 1 if tiny else 2, 32 if tiny else 64, jnp.float32, seed=9)
+    segs = np.ones((1, Sseg), np.int32)
+    segs[0, Sseg // 3:] = 2
+    segs[0, 2 * Sseg // 3:] = 3
+    segs = jnp.asarray(segs)
+    got = jax.jit(
+        lambda q, k, v: pallas_flash_attention(q, k, v, causal=True, block_q=128,
+                                               block_k=128, segment_ids=segs)
+    )(qp, kp, vp)
+    want = _einsum_attention(qp, kp, vp, causal=True, segment_ids=segs)
+    check("flash_segments_fwd_fp32", got, want, 2e-2)
+
+    def seg_loss_flash(q, k, v):
+        return (pallas_flash_attention(q, k, v, causal=True, block_q=128, block_k=128,
+                                       segment_ids=segs) ** 2).sum()
+
+    def seg_loss_ref(q, k, v):
+        return (_einsum_attention(q, k, v, causal=True, segment_ids=segs) ** 2).sum()
+
+    gseg = jax.jit(jax.grad(seg_loss_flash, argnums=(0, 1, 2)))(qp, kp, vp)
+    gref = jax.grad(seg_loss_ref, argnums=(0, 1, 2))(qp, kp, vp)
+    for gf, gr, nm in zip(gseg, gref, "qkv"):
+        check(f"flash_segments_bwd_d{nm}_fp32", gf, gr, 2e-2)
+
     # -- fp8 delayed-scaling matmul ------------------------------------------
     from accelerate_tpu.ops.quant import E4M3, _quantize, fp8_matmul
 
