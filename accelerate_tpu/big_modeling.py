@@ -261,6 +261,7 @@ def block_specs_for(module) -> Optional[list[BlockSpec]]:
     from .models.gpt_neox import GPTNeoXForCausalLM
     from .models.gptj import GPTJForCausalLM
     from .models.opt import OPTForCausalLM
+    from .models.phi import PhiForCausalLM
 
     if isinstance(module, MixtralForCausalLM):  # before its Llama parent check
         return _mixtral_block_specs(module.config)
@@ -274,6 +275,8 @@ def block_specs_for(module) -> Optional[list[BlockSpec]]:
         return _gpt_neox_block_specs(module.config)
     if isinstance(module, OPTForCausalLM):
         return _opt_block_specs(module.config)
+    if isinstance(module, PhiForCausalLM):
+        return _phi_block_specs(module.config)
     if isinstance(module, T5ForConditionalGeneration):
         return _t5_block_specs(module.config)
     return None
@@ -364,9 +367,11 @@ def cache_factory_for(module) -> Optional[Callable]:
     from .models.llama import LlamaForCausalLM, init_kv_cache
     from .models.mixtral import MixtralForCausalLM
     from .models.opt import OPTForCausalLM
+    from .models.phi import PhiForCausalLM
 
     if isinstance(module, (LlamaForCausalLM, GPT2LMHeadModel, MixtralForCausalLM,
-                           GPTJForCausalLM, GPTNeoXForCausalLM, OPTForCausalLM)):
+                           GPTJForCausalLM, GPTNeoXForCausalLM, OPTForCausalLM,
+                           PhiForCausalLM)):
         cfg = module.config  # non-Llama configs duck-type the kv-cache fields
 
         def factory(batch, max_len, dtype=jnp.bfloat16):
@@ -526,6 +531,21 @@ def _opt_block_specs(cfg) -> list[BlockSpec]:
     return _gptlike_block_specs(cfg, OPTBlock(cfg), "layers_{i}",
                                 ("embed_tokens", "embed_positions"), embed,
                                 ("final_layer_norm", "embed_tokens"), head)
+
+
+def _phi_block_specs(cfg) -> list[BlockSpec]:
+    import flax.linen as nn
+    from .models.phi import PhiBlock
+
+    def embed(ptrees, input_ids, pos):
+        return ptrees[0]["embedding"][input_ids]
+
+    def head(ptrees, x):
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps).apply({"params": ptrees[0]}, x)
+        return h @ ptrees[1]["kernel"].astype(h.dtype) + ptrees[1]["bias"].astype(h.dtype)
+
+    return _gptlike_block_specs(cfg, PhiBlock(cfg), "layers_{i}", ("embed_tokens",), embed,
+                                ("final_layernorm", "lm_head"), head)
 
 
 def _mixtral_block_specs(cfg) -> list[BlockSpec]:
@@ -1175,7 +1195,7 @@ def load_hf_checkpoint_and_dispatch(
     from .utils.hf_interop import map_hf_key, open_hf_checkpoint
 
     family, config, module = open_hf_checkpoint(checkpoint_dir, config)
-    streamable = ("llama", "mistral", "gpt2", "gptj", "gpt_neox", "opt", "t5", "mixtral")
+    streamable = ("llama", "mistral", "gpt2", "gptj", "gpt_neox", "opt", "phi", "t5", "mixtral")
     if family not in streamable:
         raise ValueError(
             f"streamed dispatch supports {'/'.join(streamable)} (got "

@@ -24,6 +24,7 @@ def _model_registry():
     from ..models.gpt_neox import GPTNeoXConfig, GPTNeoXForCausalLM
     from ..models.gptj import GPTJConfig, GPTJForCausalLM
     from ..models.opt import OPTConfig, OPTForCausalLM
+    from ..models.phi import PhiConfig, PhiForCausalLM
 
     reg = {
         "llama3-8b": llama("llama3_8b"),
@@ -33,6 +34,7 @@ def _model_registry():
         "gptj-6b": lambda: GPTJForCausalLM(GPTJConfig.gptj_6b()),
         "gpt-neox-20b": lambda: GPTNeoXForCausalLM(GPTNeoXConfig.neox_20b()),
         "opt-30b": lambda: OPTForCausalLM(OPTConfig.opt_30b()),
+        "phi-2": lambda: PhiForCausalLM(PhiConfig.phi_2()),
     }
     for attr in ("llama2_7b", "llama2_13b", "llama3_70b"):
         if hasattr(LlamaConfig, attr):

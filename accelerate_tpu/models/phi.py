@@ -1,0 +1,136 @@
+"""Phi decoder (Microsoft Phi-1/1.5/2) — the model family the reference's
+distributed-inference example drives (reference:
+examples/inference/distributed/phi2.py).
+
+Architecture: a single layer norm feeds attention and MLP in parallel
+(GPT-J-style residual), separate biased q/k/v/dense projections with
+optional GQA, partial rotary embeddings in the split-half/NeoX convention,
+and an untied biased LM head. ``qk_layernorm`` variants are rejected
+loudly rather than silently mis-loaded.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from .gpt_neox import _partial_rope
+from .llama import multi_head_attention, rotary_embedding, update_kv_cache_and_attend
+
+
+@dataclasses.dataclass
+class PhiConfig:
+    vocab_size: int = 51200
+    hidden_size: int = 2560
+    intermediate_size: int = 10240
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 32
+    max_position_embeddings: int = 2048
+    partial_rotary_factor: float = 0.4
+    rope_theta: float = 10000.0
+    hidden_act: str = "gelu_new"   # "gelu"/"gelu_python" = exact erf; else tanh
+    layer_norm_eps: float = 1e-5
+    use_flash_attention: bool = True
+    attention_backend: str = "auto"
+
+    @classmethod
+    def phi_2(cls):
+        return cls()  # the defaults ARE phi-2 (2.7B)
+
+    @classmethod
+    def tiny(cls, **overrides):
+        cfg = cls(vocab_size=256, hidden_size=64, intermediate_size=128,
+                  num_hidden_layers=2, num_attention_heads=4,
+                  num_key_value_heads=2, max_position_embeddings=128,
+                  partial_rotary_factor=0.5)
+        return dataclasses.replace(cfg, **overrides)
+
+    @property
+    def head_dim(self):
+        """Per-head width: hidden_size // num_attention_heads."""
+        return self.hidden_size // self.num_attention_heads
+
+    @property
+    def rotary_ndims(self):
+        """Rotated dims per head: head_dim * partial_rotary_factor."""
+        return int(self.head_dim * self.partial_rotary_factor)
+
+
+class PhiBlock(nn.Module):
+    """Phi layer: one LN feeds attention and MLP in parallel;
+    ``cache``/``cache_pos`` switch to KV-cached decode (same threading
+    contract as LlamaBlock)."""
+
+    config: PhiConfig
+
+    @nn.compact
+    def __call__(self, x, cache=None, cache_pos=None):
+        cfg = self.config
+        B, S, _ = x.shape
+        n_q, n_kv, D = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
+        dense = lambda n, name: nn.Dense(n, name=name, dtype=x.dtype, param_dtype=jnp.float32)
+
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="input_layernorm",
+                         param_dtype=jnp.float32)(x)
+        q = dense(n_q * D, "q_proj")(h).reshape(B, S, n_q, D)
+        k = dense(n_kv * D, "k_proj")(h).reshape(B, S, n_kv, D)
+        v = dense(n_kv * D, "v_proj")(h).reshape(B, S, n_kv, D)
+
+        start = 0 if cache_pos is None else cache_pos
+        positions = start + jnp.arange(S, dtype=jnp.int32)
+        rot = cfg.rotary_ndims
+        cos, sin = rotary_embedding(positions[None], rot, cfg.rope_theta, dtype=x.dtype)
+        q = _partial_rope(q, cos, sin, rot)
+        k = _partial_rope(k, cos, sin, rot)
+
+        new_cache = None
+        if cache is not None:
+            attn, new_cache = update_kv_cache_and_attend(cache, q, k, v, cache_pos,
+                                                         n_q // n_kv)
+        else:
+            if n_kv != n_q:
+                rep = n_q // n_kv
+                k = jnp.repeat(k, rep, axis=2)
+                v = jnp.repeat(v, rep, axis=2)
+            attn = multi_head_attention(
+                q, k, v, causal=True, use_flash=cfg.use_flash_attention,
+                backend=cfg.attention_backend,
+            )
+        attn = dense(cfg.hidden_size, "dense")(attn.reshape(B, S, n_q * D))
+
+        act = lambda t: jax.nn.gelu(t, approximate=cfg.hidden_act not in ("gelu", "gelu_python"))
+        mlp = dense(cfg.hidden_size, "fc2")(act(dense(cfg.intermediate_size, "fc1")(h)))
+        out = x + attn + mlp
+        return out if cache is None else (out, new_cache)
+
+
+class PhiForCausalLM(nn.Module):
+    config: PhiConfig
+
+    @nn.compact
+    def __call__(self, input_ids, cache=None, cache_pos=None):
+        cfg = self.config
+        x = nn.Embed(cfg.vocab_size, cfg.hidden_size, name="embed_tokens",
+                     param_dtype=jnp.float32)(input_ids)
+        new_caches = []
+        for i in range(cfg.num_hidden_layers):
+            if cache is None:
+                x = PhiBlock(cfg, name=f"layers_{i}")(x)
+            else:
+                x, layer_cache = PhiBlock(cfg, name=f"layers_{i}")(
+                    x, cache=cache[i], cache_pos=cache_pos)
+                new_caches.append(layer_cache)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="final_layernorm",
+                         param_dtype=jnp.float32)(x)
+        logits = nn.Dense(cfg.vocab_size, use_bias=True, name="lm_head",
+                          dtype=x.dtype, param_dtype=jnp.float32)(x)
+        return logits if cache is None else (logits, tuple(new_caches))
+
+    def init_params(self, rng, batch_size=1, seq_len=8):
+        """Initialize a parameter pytree from a PRNG key."""
+        dummy = jnp.zeros((batch_size, seq_len), jnp.int32)
+        return self.init(rng, dummy)["params"]

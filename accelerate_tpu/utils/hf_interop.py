@@ -171,6 +171,27 @@ _GPT_NEOX_RULES = [
     ("embed_out.weight", "embed_out/kernel", "t", None),
 ]
 
+_PHI_RULES = [
+    ("embed_tokens.weight", "embed_tokens/embedding", "copy", None),
+    ("layers.{i}.input_layernorm.weight", "layers_{i}/input_layernorm/scale", "copy", None),
+    ("layers.{i}.input_layernorm.bias", "layers_{i}/input_layernorm/bias", "copy", None),
+    ("layers.{i}.self_attn.{p}_proj.weight",
+     "layers_{i}/{p}_proj/kernel", "t", ("q", "k", "v")),
+    ("layers.{i}.self_attn.{p}_proj.bias",
+     "layers_{i}/{p}_proj/bias", "copy", ("q", "k", "v")),
+    ("layers.{i}.self_attn.dense.weight", "layers_{i}/dense/kernel", "t", None),
+    ("layers.{i}.self_attn.dense.bias", "layers_{i}/dense/bias", "copy", None),
+    ("layers.{i}.mlp.fc1.weight", "layers_{i}/fc1/kernel", "t", None),
+    ("layers.{i}.mlp.fc1.bias", "layers_{i}/fc1/bias", "copy", None),
+    ("layers.{i}.mlp.fc2.weight", "layers_{i}/fc2/kernel", "t", None),
+    ("layers.{i}.mlp.fc2.bias", "layers_{i}/fc2/bias", "copy", None),
+    ("final_layernorm.weight", "final_layernorm/scale", "copy", None),
+    ("final_layernorm.bias", "final_layernorm/bias", "copy", None),
+    # Phi's head is untied AND biased.
+    ("lm_head.weight", "lm_head/kernel", "t", None),
+    ("lm_head.bias", "lm_head/bias", "copy", None),
+]
+
 _BERT_RULES = [
     ("embeddings.word_embeddings.weight", "encoder/word_embeddings/embedding", "copy", None),
     ("embeddings.position_embeddings.weight",
@@ -318,6 +339,7 @@ _FAMILY_RULES = {
     "gptj": _GPTJ_RULES,
     "gpt_neox": _GPT_NEOX_RULES,
     "opt": _OPT_RULES,
+    "phi": _PHI_RULES,
     "bert": _BERT_RULES,
     "t5": _T5_RULES,
 }
@@ -329,6 +351,7 @@ _STRIP_PREFIXES = {
     "gptj": ("transformer.",),
     "gpt_neox": ("gpt_neox.",),
     "opt": ("model.decoder.", "decoder."),
+    "phi": ("model.",),
     "bert": ("bert.",),
     "vit": ("vit.",),
     "llama": (),
@@ -520,6 +543,31 @@ def config_from_hf(hf_config: dict, family: Optional[str] = None):
             activation=act,
             layer_norm_eps=get("layer_norm_epsilon", 1e-5),
         )
+    if family == "phi":
+        from ..models.phi import PhiConfig
+
+        act = get("hidden_act", "gelu_new")
+        if act not in _GELU_VARIANTS:
+            raise NotImplementedError(
+                f"hidden_act {act!r} (supported: {sorted(_GELU_VARIANTS)})")
+        if get("qk_layernorm", False):
+            raise NotImplementedError(
+                "qk_layernorm Phi variants are not representable (the flax "
+                "attention has no per-head q/k norms)")
+        return PhiConfig(
+            vocab_size=get("vocab_size", 51200),
+            hidden_size=get("hidden_size", 2560),
+            intermediate_size=get("intermediate_size", 10240),
+            num_hidden_layers=get("num_hidden_layers", 32),
+            num_attention_heads=get("num_attention_heads", 32),
+            num_key_value_heads=get("num_key_value_heads",
+                                    get("num_attention_heads", 32)),
+            max_position_embeddings=get("max_position_embeddings", 2048),
+            partial_rotary_factor=get("partial_rotary_factor", 0.4),
+            rope_theta=get("rope_theta", 10000.0),
+            hidden_act=act,
+            layer_norm_eps=get("layer_norm_eps", 1e-5),
+        )
     if family == "gpt_neox":
         from ..models.gpt_neox import GPTNeoXConfig
 
@@ -651,6 +699,10 @@ def model_from_config(config, family: str):
         from ..models.opt import OPTForCausalLM
 
         return OPTForCausalLM(config)
+    if family == "phi":
+        from ..models.phi import PhiForCausalLM
+
+        return PhiForCausalLM(config)
     if family == "bert":
         from ..models.bert import BertForSequenceClassification
 

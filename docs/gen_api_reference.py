@@ -59,6 +59,7 @@ GROUPS = [
      ["accelerate_tpu.models.llama", "accelerate_tpu.models.mixtral",
       "accelerate_tpu.models.gpt2", "accelerate_tpu.models.gptj",
       "accelerate_tpu.models.gpt_neox", "accelerate_tpu.models.opt",
+      "accelerate_tpu.models.phi",
       "accelerate_tpu.models.bert", "accelerate_tpu.models.t5",
       "accelerate_tpu.models.vit", "accelerate_tpu.models.resnet"],
      "Flax model families, all shardable by the same mesh rules and loadable "

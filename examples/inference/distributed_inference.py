@@ -1,10 +1,11 @@
 """Prompt-parallel distributed inference example.
 
 TPU-native counterpart of the reference's
-examples/inference/distributed/phi2.py pattern: each process takes its
-slice of the prompt list with ``split_between_processes``, generates
-locally with a KV-cached compiled decode, and one ``gather_object``
-collects the ragged results in rank order.
+examples/inference/distributed/phi2.py — same model family, same pattern:
+each process takes its slice of the prompt list with
+``split_between_processes``, generates locally with a KV-cached compiled
+decode, and one ``gather_object`` collects the ragged results in rank
+order.
 
 Run:
 
@@ -18,7 +19,7 @@ import numpy as np
 
 from accelerate_tpu import Accelerator
 from accelerate_tpu.generation import generate
-from accelerate_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from accelerate_tpu.models.phi import PhiConfig, PhiForCausalLM
 from accelerate_tpu.utils.operations import gather_object
 
 PROMPTS = [[5, 17, 3], [29, 11, 7], [2, 41, 19], [23, 13, 31], [9, 25, 6]]
@@ -26,8 +27,8 @@ PROMPTS = [[5, 17, 3], [29, 11, 7], [2, 41, 19], [23, 13, 31], [9, 25, 6]]
 
 def main():
     accelerator = Accelerator()
-    cfg = LlamaConfig.tiny(use_flash_attention=False)
-    model = LlamaForCausalLM(cfg)
+    cfg = PhiConfig.tiny(use_flash_attention=False)
+    model = PhiForCausalLM(cfg)
     params = model.init_params(jax.random.PRNGKey(0), batch_size=1, seq_len=8)
 
     completions = []

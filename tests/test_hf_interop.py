@@ -342,6 +342,58 @@ class TestOPT:
             config_from_hf({"model_type": "opt", "do_layer_norm_before": False})
 
 
+class TestPhi:
+    """Phi: single-LN parallel residual + partial split-half rope + GQA +
+    untied biased head (the reference's distributed-inference example
+    family)."""
+
+    def _pair(self):
+        hf_cfg = transformers.PhiConfig(
+            vocab_size=96, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=64, partial_rotary_factor=0.5,
+            resid_pdrop=0.0, embd_pdrop=0.0, attention_dropout=0.0)
+        torch.manual_seed(0)
+        with torch.no_grad():
+            hf = transformers.PhiForCausalLM(hf_cfg).eval()
+        assert detect_family(hf_cfg.to_dict()) == "phi"
+        cfg = config_from_hf(hf_cfg.to_dict())
+        assert cfg.rotary_ndims == 4 and cfg.num_key_value_heads == 2
+        cfg.use_flash_attention = False
+        from accelerate_tpu.models.phi import PhiForCausalLM
+
+        params = convert_hf_state_dict(hf.state_dict(), "phi", strict=True)
+        return hf, PhiForCausalLM(cfg), params
+
+    def test_forward_parity(self):
+        hf, model, params = self._pair()
+        ids = (np.arange(20, dtype=np.int64).reshape(2, 10) * 3) % 96
+        ours = model.apply({"params": params}, jnp.asarray(ids, jnp.int32))
+        with torch.no_grad():
+            theirs = hf(torch.from_numpy(ids)).logits
+        _logits_close(ours, theirs)
+
+    def test_greedy_decode_parity(self):
+        hf, model, params = self._pair()
+        from accelerate_tpu.generation import generate
+
+        ids = np.array([[5, 17, 3, 29, 11]], dtype=np.int64)
+        ours = generate(model, params, jnp.asarray(ids, jnp.int32), max_new_tokens=8,
+                        cache_dtype=jnp.float32)
+        with torch.no_grad():
+            theirs = hf.generate(torch.from_numpy(ids), max_new_tokens=8,
+                                 do_sample=False)
+        np.testing.assert_array_equal(np.asarray(ours), theirs.numpy())
+
+    def test_roundtrip(self):
+        hf, _, params = self._pair()
+        _roundtrip(params, "phi", hf.state_dict(), prefix="model.")
+
+    def test_qk_layernorm_rejected(self):
+        with pytest.raises(NotImplementedError, match="qk_layernorm"):
+            config_from_hf({"model_type": "phi", "qk_layernorm": True})
+
+
 class TestBert:
     def _pair(self):
         hf_cfg = transformers.BertConfig(
@@ -833,7 +885,7 @@ class TestStreamedDispatch:
             theirs = hf(torch.from_numpy(ids)).logits
         _logits_close(ours, theirs)
 
-    @pytest.mark.parametrize("family", ["gptj", "gpt_neox", "opt"])
+    @pytest.mark.parametrize("family", ["gptj", "gpt_neox", "opt", "phi"])
     def test_benchmark_families_stream_and_decode(self, tmp_path, family):
         """The reference's benchmark families (GPT-J / GPT-NeoX / OPT) run
         through the block-streaming executor off a raw HF dir: forward
@@ -859,6 +911,11 @@ class TestStreamedDispatch:
                 num_attention_heads=4, max_position_embeddings=64,
                 do_layer_norm_before=True, dropout=0.0, attention_dropout=0.0,
                 word_embed_proj_dim=32)),
+            "phi": lambda: transformers.PhiForCausalLM(transformers.PhiConfig(
+                vocab_size=96, hidden_size=32, intermediate_size=64,
+                num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+                max_position_embeddings=64, partial_rotary_factor=0.5,
+                resid_pdrop=0.0, embd_pdrop=0.0, attention_dropout=0.0)),
         }
         torch.manual_seed(0)
         with torch.no_grad():
