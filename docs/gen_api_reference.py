@@ -78,10 +78,15 @@ GROUPS = [
 
 
 def first_paragraph(obj) -> str:
+    import re
+
     doc = inspect.getdoc(obj)
     if not doc:
         return "*(no docstring)*"
-    return doc.split("\n\n")[0].replace("\n", " ").strip()
+    para = doc.split("\n\n")[0].replace("\n", " ").strip()
+    # Dataclass reprs in docstrings can embed memory addresses; scrub them
+    # so regeneration is deterministic (same policy as signature_of).
+    return re.sub(r" at 0x[0-9a-f]+", "", para)
 
 
 def signature_of(obj) -> str:

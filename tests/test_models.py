@@ -4,6 +4,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+import pytest
 
 from accelerate_tpu import Accelerator, Model, NumpyDataLoader
 from accelerate_tpu.models import (
@@ -118,6 +119,39 @@ class TestGPT2:
         params = model.init_params(jax.random.PRNGKey(0))
         out = model.apply({"params": params}, jnp.zeros((2, 8), jnp.int32))
         assert out.shape == (2, 8, cfg.vocab_size)
+
+
+class TestBenchmarkFamiliesTrain:
+    """The reference-benchmark decoder families (GPT-J/NeoX/OPT/Phi) must
+    TRAIN through the fused step, not just run inference — gradient flow
+    through their rope variants/parallel residuals/fused QKV is distinct
+    from Llama's."""
+
+    @pytest.mark.parametrize("family", ["gptj", "gpt_neox", "opt", "phi"])
+    def test_fused_step_reduces_loss(self, family):
+        from accelerate_tpu.models import gpt_neox, gptj, opt, phi
+
+        mk = {
+            "gptj": lambda: gptj.GPTJForCausalLM(gptj.GPTJConfig.tiny(use_flash_attention=False)),
+            "gpt_neox": lambda: gpt_neox.GPTNeoXForCausalLM(
+                gpt_neox.GPTNeoXConfig.tiny(use_flash_attention=False)),
+            "opt": lambda: opt.OPTForCausalLM(opt.OPTConfig.tiny(use_flash_attention=False)),
+            "phi": lambda: phi.PhiForCausalLM(phi.PhiConfig.tiny(use_flash_attention=False)),
+        }
+        model_def = mk[family]()
+        cfg = model_def.config
+        params = model_def.init_params(jax.random.PRNGKey(0), batch_size=4, seq_len=16)
+        acc = Accelerator(mixed_precision="bf16")
+        ids = np.tile(np.arange(16, dtype=np.int32)[None], (8, 1)) % cfg.vocab_size
+        data = [{"input_ids": ids[i]} for i in range(8)]
+        loader = NumpyDataLoader(data, batch_size=8)
+        model, tx, loader = acc.prepare(Model(model_def, params), optax.adam(1e-2), loader)
+        step = acc.compile_train_step(causal_lm_loss(model_def.apply))
+        losses = []
+        for _ in range(10):
+            for batch in loader:
+                losses.append(float(step(batch)["loss"]))
+        assert losses[-1] < losses[0] * 0.5, f"{family}: {losses[0]} -> {losses[-1]}"
 
 
 class TestResNet:
