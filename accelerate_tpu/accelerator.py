@@ -1040,11 +1040,11 @@ class Accelerator:
         return pad_across_processes(tensor, dim=dim, pad_index=pad_index, pad_first=pad_first)
 
     def unwrap_model(self, model, keep_fp32_wrapper: bool = True):
-        """Return the inner Model (reference: extract_model_from_parallel)."""
-        if isinstance(model, AcceleratedModel):
-            inner = Model(model.module if model.module is not None else model.apply_fn, model.params)
-            return inner
-        return model
+        """Return the inner Model (reference: unwrap_model delegates to
+        extract_model_from_parallel — same layering here)."""
+        from .utils.other import extract_model_from_parallel
+
+        return extract_model_from_parallel(model, keep_fp32_wrapper=keep_fp32_wrapper)
 
     def get_state_dict(self, model, unwrap: bool = True):
         """Full (host-gathered) parameter pytree (reference: :3291 — the

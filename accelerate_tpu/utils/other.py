@@ -1,0 +1,155 @@
+"""Small general-purpose utilities from the reference's public surface
+(reference: src/accelerate/utils/other.py — clear_environment :211,
+get_pretty_name :282, merge_dicts :295, is_port_in_use :313, convert_bytes
+:324, recursive_getattr :352, save :176, clean_state_dict_for_safetensors
+:141, extract_model_from_parallel :56).
+
+A user migrating ``from accelerate.utils import ...`` finds the same names
+here, reimplemented for the JAX world: tensors are pytree leaves (no
+storage aliasing to chase — tying is by name), "unwrapping" a prepared
+model means recovering the plain ``Model``, and saving routes through
+safetensors/pickle with main-process gating.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+from contextlib import contextmanager
+from typing import Any
+
+
+@contextmanager
+def clear_environment():
+    """Temporarily empty ``os.environ``; restores the previous environment
+    on exit even on error (reference: :211)."""
+    saved = dict(os.environ)
+    os.environ.clear()
+    try:
+        yield
+    finally:
+        os.environ.clear()
+        os.environ.update(saved)
+
+
+def get_pretty_name(obj) -> str:
+    """Readable name for an object: class or function name when available,
+    else its repr (reference: :282)."""
+    if not hasattr(obj, "__qualname__") and not hasattr(obj, "__name__"):
+        obj = getattr(obj, "__class__", obj)
+    if hasattr(obj, "__qualname__"):
+        return obj.__qualname__
+    if hasattr(obj, "__name__"):
+        return obj.__name__
+    return str(obj)
+
+
+def merge_dicts(source: dict, destination: dict) -> dict:
+    """Recursively merge ``source`` into ``destination`` (in place), nested
+    dicts deep-merged rather than replaced (reference: :295)."""
+    for key, value in source.items():
+        if isinstance(value, dict):
+            node = destination.setdefault(key, {})
+            merge_dicts(value, node)
+        else:
+            destination[key] = value
+    return destination
+
+
+def is_port_in_use(port: int | None = None) -> bool:
+    """Whether something is already listening on ``port`` (reference: :313 —
+    used to catch stale rendezvous ports before launching)."""
+    if port is None:
+        port = 29500
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        return s.connect_ex(("localhost", int(port))) == 0
+
+
+def convert_bytes(size: float) -> str:
+    """Human-readable byte count: ``convert_bytes(1024) == '1.0 KB'``
+    (reference: :324)."""
+    for unit in ["B", "KB", "MB", "GB", "TB", "PB"]:
+        if size < 1024.0:
+            return f"{round(size, 2)} {unit}"
+        size /= 1024.0
+    return f"{round(size, 2)} EB"
+
+
+def recursive_getattr(obj, attr: str):
+    """``getattr`` through dotted paths: ``recursive_getattr(m, "a.b.c")``
+    (reference: :352)."""
+    out = obj
+    for part in attr.split("."):
+        out = getattr(out, part)
+    return out
+
+
+def extract_model_from_parallel(model, keep_fp32_wrapper: bool = True):
+    """Recover the plain model from a prepared one (reference: :56 unwraps
+    DDP/FSDP/compile wrappers; here the only wrapper is AcceleratedModel).
+    ``Accelerator.unwrap_model`` delegates here, matching the reference's
+    layering."""
+    from ..accelerator import AcceleratedModel, Model
+
+    if isinstance(model, AcceleratedModel):
+        return Model(model.module if model.module is not None else model.apply_fn,
+                     model.params)
+    return model
+
+
+def clean_state_dict_for_safetensors(state_dict: dict) -> dict:
+    """Normalize a flat state dict for safetensors: host numpy arrays,
+    contiguous, duplicate (tied, same-buffer) entries dropped with the
+    first name kept (reference: :141 chases torch storage pointers; jax
+    arrays expose no storage identity, so duplicates are detected by
+    object identity — the way ties actually occur in a pytree)."""
+    import numpy as np
+
+    seen: dict[int, str] = {}
+    out: dict[str, Any] = {}
+    dropped = []
+    for name, tensor in state_dict.items():
+        if isinstance(tensor, str):
+            out[name] = tensor
+            continue
+        key = id(tensor)
+        if key in seen:
+            dropped.append(name)
+            continue
+        seen[key] = name
+        out[name] = np.ascontiguousarray(np.asarray(tensor))
+    if dropped:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "Removed shared tensors %s while saving (tied entries keep their "
+            "first name)", dropped)
+    return out
+
+
+def save(obj, f, save_on_each_node: bool = False, safe_serialization: bool = False):
+    """Save ``obj`` on the main process only (or each node's main process
+    with ``save_on_each_node``) — reference: :176. ``safe_serialization``
+    writes flat array dicts via safetensors; everything else pickles."""
+    from ..state import PartialState
+
+    state = PartialState()
+    should = (state.is_local_main_process if save_on_each_node
+              else state.is_main_process)
+    if not should:
+        return
+    file_like = hasattr(f, "write")
+    if safe_serialization:
+        from safetensors.numpy import save as st_save, save_file
+
+        cleaned = clean_state_dict_for_safetensors(dict(obj))
+        if file_like:
+            f.write(st_save(cleaned))
+        else:
+            save_file(cleaned, os.fspath(f))
+    elif file_like:
+        pickle.dump(obj, f)
+    else:
+        with open(os.fspath(f), "wb") as fh:
+            pickle.dump(obj, fh)

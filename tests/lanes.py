@@ -37,6 +37,7 @@ LANES: dict[str, tuple[int, list[str]]] = {
         "test_moe.py",
         "test_native.py",
         "test_operations.py",
+        "test_other_utils.py",
         "test_packing.py",
         "test_ring_attention.py",
         "test_state.py",

@@ -1,0 +1,137 @@
+"""utils/other.py — the reference's small general-purpose utils surface
+(reference: src/accelerate/utils/other.py)."""
+
+import os
+import pickle
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from accelerate_tpu.utils import (
+    clean_state_dict_for_safetensors,
+    clear_environment,
+    convert_bytes,
+    extract_model_from_parallel,
+    get_pretty_name,
+    is_port_in_use,
+    merge_dicts,
+    recursive_getattr,
+    save,
+)
+
+
+def test_clear_environment_restores_even_on_error():
+    os.environ["ATPU_OTHER_TEST"] = "1"
+    with clear_environment():
+        assert "ATPU_OTHER_TEST" not in os.environ
+        os.environ["LEAKED"] = "x"
+    assert os.environ["ATPU_OTHER_TEST"] == "1"
+    assert "LEAKED" not in os.environ
+    with pytest.raises(RuntimeError):
+        with clear_environment():
+            raise RuntimeError("boom")
+    assert os.environ["ATPU_OTHER_TEST"] == "1"
+    del os.environ["ATPU_OTHER_TEST"]
+
+
+def test_get_pretty_name():
+    class Thing:
+        pass
+
+    assert get_pretty_name(Thing) .endswith("Thing")
+    assert get_pretty_name(Thing()).endswith("Thing")
+    assert get_pretty_name(convert_bytes) == "convert_bytes"
+
+
+def test_merge_dicts_deep():
+    dst = {"a": 1, "nested": {"x": 1, "y": 2}}
+    out = merge_dicts({"b": 2, "nested": {"y": 3, "z": 4}}, dst)
+    assert out is dst
+    assert dst == {"a": 1, "b": 2, "nested": {"x": 1, "y": 3, "z": 4}}
+
+
+def test_is_port_in_use():
+    import socket
+
+    s = socket.socket()
+    s.bind(("localhost", 0))
+    s.listen(1)
+    port = s.getsockname()[1]
+    try:
+        assert is_port_in_use(port) is True
+    finally:
+        s.close()
+
+
+def test_convert_bytes():
+    assert convert_bytes(512) == "512 B"
+    assert convert_bytes(1024) == "1.0 KB"
+    assert convert_bytes(5 * 1024**3) == "5.0 GB"
+
+
+def test_recursive_getattr():
+    class A:
+        pass
+
+    a = A()
+    a.b = A()
+    a.b.c = 7
+    assert recursive_getattr(a, "b.c") == 7
+    with pytest.raises(AttributeError):
+        recursive_getattr(a, "b.missing")
+
+
+def test_extract_model_from_parallel_roundtrip():
+    import jax
+    import optax
+
+    from accelerate_tpu import Accelerator, Model
+    from accelerate_tpu.test_utils.training import init_mlp, mlp_apply
+
+    acc = Accelerator()
+    prepared, _ = acc.prepare(Model(mlp_apply, init_mlp()), optax.sgd(0.1))
+    plain = extract_model_from_parallel(prepared)
+    assert isinstance(plain, Model)
+    x = jnp.ones((2, 4))
+    np.testing.assert_allclose(np.asarray(plain.apply_fn(plain.params, x)),
+                               np.asarray(prepared(x)), atol=1e-4, rtol=1e-4)
+    # Non-wrapped objects pass through.
+    assert extract_model_from_parallel("anything") == "anything"
+
+
+def test_clean_state_dict_drops_tied_duplicates():
+    w = jnp.ones((2, 2))
+    sd = {"a": w, "tied_copy": w, "b": jnp.zeros((3,))}
+    out = clean_state_dict_for_safetensors(sd)
+    assert set(out) == {"a", "b"}
+    assert isinstance(out["a"], np.ndarray) and out["a"].flags["C_CONTIGUOUS"]
+
+
+def test_save_pickle_and_safetensors(tmp_path):
+    obj = {"x": [1, 2, 3]}
+    p = tmp_path / "obj.pkl"
+    save(obj, p)
+    assert pickle.load(open(p, "rb")) == obj
+
+    sd = {"w": jnp.arange(4.0)}
+    sp = tmp_path / "sd.safetensors"
+    save(sd, sp, safe_serialization=True)
+    from safetensors.numpy import load_file
+
+    np.testing.assert_array_equal(load_file(str(sp))["w"], np.arange(4.0, dtype=np.float32))
+
+
+def test_save_accepts_file_objects(tmp_path):
+    import io
+
+    obj = {"x": 1}
+    with open(tmp_path / "o.pkl", "wb") as fh:
+        save(obj, fh)
+    assert pickle.load(open(tmp_path / "o.pkl", "rb")) == obj
+
+    buf = io.BytesIO()
+    save({"w": jnp.ones((2,))}, buf, safe_serialization=True)
+    from safetensors.numpy import load
+
+    np.testing.assert_array_equal(load(buf.getvalue())["w"], np.ones(2, np.float32))
