@@ -35,6 +35,9 @@ if _os.environ.get("ACCELERATE_TPU_PLATFORM") or _os.environ.get("JAX_PLATFORMS"
 from .accelerator import AcceleratedModel, Accelerator, Model
 from .big_modeling import (
     BlockSpec,
+    UserCpuOffloadHook,
+    cpu_offload_with_hook,
+    init_on_device,
     StreamedModel,
     cpu_offload,
     disk_offload,
@@ -46,7 +49,7 @@ from .big_modeling import (
 )
 from .data_loader import NumpyDataLoader, prepare_data_loader, skip_first_batches
 from .generation import beam_search_generate, generate, greedy_generate, seq2seq_generate
-from .inference import PipelinedInferencer, prepare_pipeline
+from .inference import PipelinedInferencer, prepare_pipeline, prepare_pippy
 from .launchers import debug_launcher, notebook_launcher
 from .local_sgd import LocalSGD
 from .logging import get_logger
@@ -59,7 +62,9 @@ from .utils.dataclasses import (
     AutocastKwargs,
     ContextParallelPlugin,
     DataLoaderConfiguration,
+    DDPCommunicationHookType,
     DeepSpeedPlugin,
+    DistributedDataParallelKwargs,
     DistributedInitKwargs,
     DistributedType,
     ExpertParallelPlugin,
@@ -81,4 +86,5 @@ from .utils.modeling import (
     get_max_memory,
     infer_auto_device_map,
 )
-from .utils.random import set_seed
+from .utils.memory import find_executable_batch_size
+from .utils.random import set_seed, synchronize_rng_states

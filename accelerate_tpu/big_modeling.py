@@ -1219,6 +1219,55 @@ def cpu_offload(module, params, execution_device=None, block_specs=None) -> Stre
                           block_specs=block_specs, execution_device=execution_device)
 
 
+class UserCpuOffloadHook:
+    """Manual-offload handle (reference: UserCpuOffloadHook, hooks.py —
+    returned by cpu_offload_with_hook so model pipelines can free the
+    accelerator between stages). Streaming already keeps weights
+    host-resident between calls, so ``offload`` only releases whatever the
+    executor left resident on device."""
+
+    def __init__(self, model: StreamedModel):
+        self.model = model
+
+    def offload(self):
+        """Release device-resident buffers (host copies stay)."""
+        # Stop (and drain) the prefetch pool FIRST: an in-flight fetch
+        # finishing after the clear would silently repopulate the cache.
+        if self.model._pool is not None:
+            self.model._pool.shutdown(wait=True, cancel_futures=True)
+            self.model._pool = None
+        self.model._resident_cache.clear()
+
+    def remove(self):
+        """Reference-parity alias: detaching the hook == releasing residency."""
+        self.offload()
+
+
+def cpu_offload_with_hook(module, params, execution_device=None, block_specs=None,
+                          prev_module_hook: Optional[UserCpuOffloadHook] = None):
+    """``(streamed_model, hook)`` pair (reference: cpu_offload_with_hook,
+    big_modeling.py:231): run several models on one chip and call
+    ``hook.offload()`` between them. ``prev_module_hook`` (the previous
+    stage's hook, reference-parity chaining) is offloaded immediately —
+    with the streaming executor residency is lazy, so "offload before the
+    next model runs" and "offload now" coincide."""
+    if prev_module_hook is not None:
+        prev_module_hook.offload()
+    streamed = cpu_offload(module, params, execution_device=execution_device,
+                           block_specs=block_specs)
+    return streamed, UserCpuOffloadHook(streamed)
+
+
+def init_on_device(device, include_buffers: Optional[bool] = None):
+    """Context manager placing newly created arrays on ``device``
+    (reference: init_on_device, big_modeling.py:125 patches torch's
+    register_parameter; JAX has a first-class ambient default device).
+    ``include_buffers`` is accepted for signature parity and ignored —
+    jax has no parameter/buffer distinction."""
+    del include_buffers
+    return jax.default_device(device)
+
+
 def disk_offload(module, checkpoint: str, offload_folder: Optional[str] = None,
                  execution_device=None, block_specs=None, example_args=()) -> StreamedModel:
     """All weights on disk, streamed per block (reference: disk_offload,

@@ -432,10 +432,12 @@ def save_model(accelerator, model, save_directory: str, max_shard_size: str = "1
     os.makedirs(save_directory, exist_ok=True)
     params = model.params if hasattr(model, "params") else model
     flat = flatten_params(params)
-    # device_get of TPU arrays can yield F-contiguous numpy (tiled layouts);
-    # safetensors serializes the raw buffer without checking contiguity, so
-    # non-C-contiguous arrays would be silently written transposed.
-    host_flat = {k: np.ascontiguousarray(np.asarray(jax.device_get(v))) for k, v in flat.items()}
+    # One normalization path with utils/other.py: host numpy, C-contiguous
+    # (TPU tiled layouts can device_get as F-contiguous), tied duplicates
+    # dropped by identity.
+    from .utils.other import clean_state_dict_for_safetensors
+
+    host_flat = clean_state_dict_for_safetensors(flat)
     if not accelerator.is_main_process:
         accelerator.wait_for_everyone()
         return

@@ -139,3 +139,8 @@ def prepare_pipeline(
         if num_microbatches is None:
             num_microbatches = 1
     return PipelinedInferencer(apply_fn, params, num_microbatches, policy=policy, mesh=mesh)
+
+
+#: Reference-parity alias (reference: inference.py:124 ``prepare_pippy``) —
+#: the stage-parallel inference builder under the name migrating scripts use.
+prepare_pippy = prepare_pipeline

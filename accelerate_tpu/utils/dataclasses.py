@@ -155,6 +155,59 @@ class AutocastKwargs(KwargsHandler):
     cache_enabled: bool = True  # accepted for API parity; meaningless under jit
 
 
+class DDPCommunicationHookType(BaseEnum):
+    """Reference enum (utils/dataclasses.py DDPCommunicationHookType) kept
+    for import parity. GSPMD emits gradient collectives inside the compiled
+    step; there is no DDP allreduce to hook, so only NO is meaningful."""
+
+    NO = "no"
+    FP16 = "fp16"
+    BF16 = "bf16"
+    POWER_SGD = "power_sgd"
+    BATCHED_POWER_SGD = "batched_power_sgd"
+
+
+@dataclass
+class DistributedDataParallelKwargs(KwargsHandler):
+    """Reference parity (utils/dataclasses.py DistributedDataParallelKwargs).
+
+    Every field configures torch DDP's allreduce machinery, which does not
+    exist here — GSPMD schedules gradient reduction inside the compiled
+    train step, bucketing and overlap included. Accepted so migrating
+    scripts keep constructing it; non-default values warn that they have
+    no effect rather than silently pretending to.
+    """
+
+    dim: int = 0
+    broadcast_buffers: bool = True
+    bucket_cap_mb: int = 25
+    find_unused_parameters: bool = False
+    check_reduction: bool = False
+    gradient_as_bucket_view: bool = False
+    static_graph: bool = False
+    comm_hook: DDPCommunicationHookType = DDPCommunicationHookType.NO
+    comm_wrapper: DDPCommunicationHookType = DDPCommunicationHookType.NO
+    comm_state_option: dict = field(default_factory=dict)
+
+    def __post_init__(self):
+        import dataclasses as _dc
+
+        # Compare against field defaults directly: to_kwargs() builds a
+        # default instance, which would re-enter this __post_init__.
+        non_default = [
+            f.name for f in _dc.fields(self)
+            if getattr(self, f.name) != (
+                f.default_factory() if f.default is _dc.MISSING else f.default
+            )
+        ]
+        if non_default:
+            warnings.warn(
+                f"DistributedDataParallelKwargs({', '.join(sorted(non_default))}) has no "
+                "effect on TPU: gradient reduction is compiled into the train step by "
+                "GSPMD (bucketing/overlap included); there is no DDP engine to configure."
+            )
+
+
 @dataclass
 class GradScalerKwargs(KwargsHandler):
     """Dynamic loss-scaling config for fp16 (reference: utils/dataclasses.py:209).

@@ -99,25 +99,32 @@ def extract_model_from_parallel(model, keep_fp32_wrapper: bool = True):
 
 
 def clean_state_dict_for_safetensors(state_dict: dict) -> dict:
-    """Normalize a flat state dict for safetensors: host numpy arrays,
+    """Normalize a flat state dict for safetensors: host numpy arrays
+    (explicit device_get — TPU tiled layouts can come back F-contiguous),
     contiguous, duplicate (tied, same-buffer) entries dropped with the
     first name kept (reference: :141 chases torch storage pointers; jax
     arrays expose no storage identity, so duplicates are detected by
-    object identity — the way ties actually occur in a pytree)."""
+    object identity — the way ties actually occur in a pytree). Non-array
+    values are rejected up front: safetensors cannot serialize them, and
+    a clear error here beats a cryptic one deep inside the writer."""
+    import jax
     import numpy as np
 
     seen: dict[int, str] = {}
     out: dict[str, Any] = {}
     dropped = []
     for name, tensor in state_dict.items():
-        if isinstance(tensor, str):
-            out[name] = tensor
-            continue
+        if isinstance(tensor, (str, bytes)) or not hasattr(tensor, "__array__"):
+            raise TypeError(
+                f"state dict entry {name!r} is {type(tensor).__name__}, not an "
+                "array; safetensors stores tensors only (put metadata elsewhere)")
         key = id(tensor)
         if key in seen:
             dropped.append(name)
             continue
         seen[key] = name
+        if isinstance(tensor, jax.Array):
+            tensor = jax.device_get(tensor)
         out[name] = np.ascontiguousarray(np.asarray(tensor))
     if dropped:
         import logging

@@ -135,3 +135,51 @@ def test_save_accepts_file_objects(tmp_path):
     from safetensors.numpy import load
 
     np.testing.assert_array_equal(load(buf.getvalue())["w"], np.ones(2, np.float32))
+
+
+class TestReferenceParitySurface:
+    """Top-level names a migrating `from accelerate import ...` user needs."""
+
+    def test_ddp_kwargs_default_is_silent_nondefault_warns(self):
+        import warnings as w
+
+        from accelerate_tpu import DDPCommunicationHookType, DistributedDataParallelKwargs
+
+        with w.catch_warnings():
+            w.simplefilter("error")
+            DistributedDataParallelKwargs()  # defaults: no warning
+        with pytest.warns(UserWarning, match="no effect on TPU"):
+            DistributedDataParallelKwargs(bucket_cap_mb=100)
+        assert DDPCommunicationHookType.NO.value == "no"
+
+    def test_prepare_pippy_is_prepare_pipeline(self):
+        from accelerate_tpu import prepare_pipeline, prepare_pippy
+
+        assert prepare_pippy is prepare_pipeline
+
+    def test_init_on_device_places_new_arrays(self):
+        import jax
+
+        from accelerate_tpu import init_on_device
+
+        dev = jax.devices()[-1]
+        with init_on_device(dev):
+            x = jnp.ones((2, 2))
+        assert x.devices() == {dev}
+
+    def test_cpu_offload_with_hook_reusable_after_offload(self):
+        import jax
+
+        from accelerate_tpu import cpu_offload_with_hook
+        from accelerate_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+
+        cfg = GPT2Config.tiny(use_flash_attention=False)
+        module = GPT2LMHeadModel(cfg)
+        params = module.init_params(jax.random.PRNGKey(0))
+        streamed, hook = cpu_offload_with_hook(module, params)
+        ids = jnp.zeros((1, 8), jnp.int32)
+        out1 = np.asarray(streamed(ids))
+        hook.offload()
+        assert streamed.hbm_resident_bytes == 0 or not streamed._resident_cache
+        out2 = np.asarray(streamed(ids))  # usable again after offload
+        np.testing.assert_allclose(out1, out2, atol=1e-5)
