@@ -138,16 +138,32 @@ def _ring_attention_shard(q, k, v, *, axis_name: str, axis_size: int, causal: bo
     return out.transpose(0, 2, 1, 3).astype(q.dtype)
 
 
+def _ambient_inner_chunk() -> int:
+    """ContextParallelPlugin.ring_inner_chunk when an AcceleratorState is
+    live, else the plugin field's default (one source of truth)."""
+    from ..state import AcceleratorState
+    from ..utils.dataclasses import ContextParallelPlugin
+
+    if AcceleratorState._shared_state:
+        plugin = AcceleratorState().cp_plugin
+        if plugin is not None:
+            return int(plugin.ring_inner_chunk)
+    return ContextParallelPlugin.ring_inner_chunk
+
+
 def ring_attention(q, k, v, mesh=None, axis_name: str = "cp", causal: bool = True,
-                   inner_chunk: int = 1024):
+                   inner_chunk: int | None = None):
     """Exact ring attention over the ``axis_name`` mesh axis.
 
     Args are *global* [B, S, H, D] arrays (sharded or not — shard_map
     partitions them on the sequence dim). With a trivial axis (size 1 or no
     mesh) falls back to the plain attention dispatch. ``inner_chunk`` bounds
     the logits tile each step materializes ([B, H, S_local, inner_chunk]),
-    keeping per-device memory O(S_local x inner_chunk) at any length.
+    keeping per-device memory O(S_local x inner_chunk) at any length;
+    ``None`` reads ``ContextParallelPlugin.ring_inner_chunk`` (default 1024).
     """
+    if inner_chunk is None:
+        inner_chunk = _ambient_inner_chunk()
     mesh = _resolve_mesh(mesh)
     axis_size = _axis_size(mesh, axis_name)
     if axis_size == 1:

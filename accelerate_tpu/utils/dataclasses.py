@@ -443,6 +443,15 @@ class ContextParallelPlugin(KwargsHandler):
     cp_size: int = 1
     mode: Literal["ring", "all_gather"] = "ring"
     causal: bool = True
+    #: Ring attention's inner tile width: each arriving KV block is consumed
+    #: in sub-tiles of this many keys, bounding the logits tile at
+    #: [B, H, S_local, ring_inner_chunk] (ops/ring_attention.py).
+    ring_inner_chunk: int = 1024
+
+    def __post_init__(self):
+        if self.ring_inner_chunk < 1:
+            raise ValueError(
+                f"ring_inner_chunk must be >= 1, got {self.ring_inner_chunk}")
 
 
 @dataclass

@@ -80,6 +80,37 @@ def test_ring_attention_sub_chunked_inner_matches_full(causal, inner_chunk):
         np.testing.assert_allclose(np.asarray(b), np.asarray(a), atol=5e-5, rtol=5e-5)
 
 
+def test_ring_inner_chunk_reads_context_parallel_plugin():
+    """inner_chunk=None resolves from ContextParallelPlugin.ring_inner_chunk
+    (the framework-wide knob) and stays exact."""
+    from unittest import mock
+
+    import importlib
+
+    from accelerate_tpu import AcceleratorState
+    from accelerate_tpu.utils.dataclasses import ContextParallelPlugin
+
+    # ops/__init__ re-exports the same-named function over the submodule
+    # attribute; resolve the module itself for patching.
+    ra = importlib.import_module("accelerate_tpu.ops.ring_attention")
+
+    AcceleratorState(cp_plugin=ContextParallelPlugin(cp_size=4, ring_inner_chunk=8))
+    mesh = cp_mesh(cp=4)
+    q, k, v = make_qkv(B=2, S=64, H=2, D=8, seed=4)
+    seen = {}
+    real = ra._ring_fn
+
+    def spy(mesh_, axis, size, causal, inner):
+        seen["inner"] = inner
+        return real(mesh_, axis, size, causal, inner)
+
+    with mock.patch.object(ra, "_ring_fn", side_effect=spy):
+        out = ra.ring_attention(q, k, v, mesh=mesh, causal=True)
+    assert seen["inner"] == 8
+    ref = _einsum_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
 def test_ring_attention_indivisible_inner_chunk_falls_back():
     """inner_chunk not dividing S_local: whole-block path, still exact."""
     mesh = cp_mesh(cp=4)
