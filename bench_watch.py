@@ -53,7 +53,9 @@ LIVENESS_BUDGET = 120.0
 KERNELS_BUDGET = 600.0
 TIER1_BUDGET = 480.0
 SWEEP_BUDGET = 900.0
-DOWN_SLEEP = 600.0      # tunnel down: re-probe every 10 min
+DOWN_SLEEP = 240.0      # tunnel down: re-probe every ~5.5 min incl. probe
+                        # (observed to flicker: probes can succeed minutes
+                        # after a timeout, so a tight cadence catches windows)
 SUCCESS_SLEEP = 2700.0  # after a full success: don't hammer the shared chip
 PARTIAL_SLEEP = 900.0   # tunnel up but a tier failed: retry in 15 min
 
