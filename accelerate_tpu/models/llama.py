@@ -202,6 +202,15 @@ def multi_head_attention(
         raise ValueError(
             f"unknown attention_backend {backend!r}; expected auto/ring/ulysses/flash/einsum"
         )
+    # GQA: the dense paths (flash/einsum) need expanded KV; the CP paths get
+    # the raw G-wide tensors so the interconnect moves H/G times less data.
+    # Expansion is lazy so eager CP runs never materialize the wide copy.
+    def _kv_full():
+        if k.shape[2] == q.shape[2]:
+            return k, v
+        rep = q.shape[2] // k.shape[2]
+        return jnp.repeat(k, rep, axis=2), jnp.repeat(v, rep, axis=2)
+
     if sliding_window is not None and sliding_window < q.shape[1]:
         # Only a window narrower than the sequence masks anything; when
         # window >= seq, full causal attention is exact and every fast path
@@ -212,10 +221,13 @@ def multi_head_attention(
         if backend in ("ring", "ulysses"):
             raise ValueError(
                 f"attention_backend={backend!r} does not support sliding_window")
+        kf, vf = _kv_full()
         if backend != "einsum" and use_flash and segment_ids is None and causal:
-            return flash_attention(q, k, v, causal=True, sliding_window=sliding_window,
+            return flash_attention(q, kf, vf, causal=True,
+                                   sliding_window=sliding_window,
                                    block_q=block_q, block_k=block_k)
-        return _einsum_attention(q, k, v, causal=causal, segment_ids=segment_ids,
+        return _einsum_attention(q, kf, vf, causal=causal,
+                                 segment_ids=segment_ids,
                                  sliding_window=sliding_window)
     if backend in ("auto", "ring", "ulysses"):
         from ..ops.ring_attention import _axis_size, _resolve_mesh, context_parallel_attention
@@ -226,15 +238,23 @@ def multi_head_attention(
         cp = _axis_size(mesh, "cp")
         if backend != "auto" or (cp > 1 and segment_ids is None and q.shape[1] % cp == 0):
             if cp > 1:
+                # GQA KV stays unrepeated here: the ring rotates (and
+                # Ulysses all_to_alls) G-wide KV over the interconnect,
+                # expanding only at the local contraction. Exception: a tp
+                # axis that cannot shard G heads needs the expanded copy.
+                tp = _axis_size(mesh, "tp")
+                kc, vc = (k, v) if (tp <= 1 or k.shape[2] % tp == 0) else _kv_full()
                 return context_parallel_attention(
-                    q, k, v, mesh=mesh, causal=causal, strategy=backend, use_flash=use_flash
+                    q, kc, vc, mesh=mesh, causal=causal, strategy=backend, use_flash=use_flash
                 )
+    kf, vf = _kv_full()
     if backend != "einsum" and use_flash and flash_attention_available(q):
         # segment_ids are masked inside the Pallas kernel, so packed-sequence
         # training keeps flash's memory asymptotics.
-        return flash_attention(q, k, v, causal=causal, block_q=block_q, block_k=block_k,
+        return flash_attention(q, kf, vf, causal=causal,
+                               block_q=block_q, block_k=block_k,
                                segment_ids=segment_ids)
-    return _einsum_attention(q, k, v, causal=causal, segment_ids=segment_ids)
+    return _einsum_attention(q, kf, vf, causal=causal, segment_ids=segment_ids)
 
 
 def init_kv_cache(config: "LlamaConfig", batch_size: int, max_len: int, dtype=jnp.bfloat16):
@@ -316,11 +336,8 @@ class LlamaAttention(nn.Module):
             out = out.reshape(B, S, n_q * hd)
             return dense(cfg.hidden_size, "o_proj")(out), new_cache
 
-        if n_kv != n_q:  # GQA: repeat kv heads
-            rep = n_q // n_kv
-            k = jnp.repeat(k, rep, axis=2)
-            v = jnp.repeat(v, rep, axis=2)
-
+        # GQA KV goes in unrepeated: multi_head_attention expands only for
+        # the dense paths, so CP strategies move G-wide KV over ICI.
         out = multi_head_attention(
             q, k, v, causal=causal, use_flash=cfg.use_flash_attention,
             segment_ids=segment_ids,

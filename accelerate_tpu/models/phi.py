@@ -92,10 +92,7 @@ class PhiBlock(nn.Module):
             attn, new_cache = update_kv_cache_and_attend(cache, q, k, v, cache_pos,
                                                          n_q // n_kv)
         else:
-            if n_kv != n_q:
-                rep = n_q // n_kv
-                k = jnp.repeat(k, rep, axis=2)
-                v = jnp.repeat(v, rep, axis=2)
+            # GQA KV unrepeated: multi_head_attention expands where needed.
             attn = multi_head_attention(
                 q, k, v, causal=True, use_flash=cfg.use_flash_attention,
                 backend=cfg.attention_backend,

@@ -52,19 +52,25 @@ def _ring_attention_shard(q, k, v, *, axis_name: str, axis_size: int, causal: bo
                           inner_chunk: int):
     """Per-shard body (runs inside shard_map).
 
-    q, k, v: [B, S_local, H, D] — this device's contiguous sequence chunk.
+    q: [B, S_local, H, D]; k/v: [B, S_local, G, D] with G dividing H (GQA
+    KV stays *unrepeated* — the ring rotates G-wide KV over ICI, H/G times
+    less interconnect traffic than rotating expanded heads; the grouped
+    einsum contracts queries against shared KV directly).
     Returns [B, S_local, H, D].
     """
     my_idx = jax.lax.axis_index(axis_name)
     B, q_len, H, D = q.shape
     k_len = k.shape[1]
+    G = k.shape[2]
+    rep = H // G
     scale = D ** -0.5
 
     q_pos = my_idx * q_len + jnp.arange(q_len, dtype=jnp.int32)
-    qf = (q * scale).astype(jnp.float32)
+    # [B, q_len, G, rep, D] — grouped view for GQA contraction.
+    qf = (q * scale).astype(jnp.float32).reshape(B, q_len, G, rep, D)
 
     # The arriving KV block is itself processed in sub-chunks so the logits
-    # tile is [B, H, q_len, sub] instead of [B, H, q_len, k_len] — at the
+    # tile is [B, G, rep, q_len, sub] instead of [.., k_len] — at the
     # sequence lengths ring attention exists for, the full tile would be
     # gigabytes (e.g. cp=4, S=32k: 8k x 8k f32 per head). Falls back to one
     # sub-chunk when k_len doesn't divide.
@@ -74,29 +80,29 @@ def _ring_attention_shard(q, k, v, *, axis_name: str, axis_size: int, causal: bo
     n_sub = k_len // sub
 
     # Accumulators in f32: running max m, denominator l, unnormalized out o.
-    m0 = jnp.full((B, H, q_len), _BIG_NEG, jnp.float32)
-    l0 = jnp.zeros((B, H, q_len), jnp.float32)
-    o0 = jnp.zeros((B, H, q_len, D), jnp.float32)
+    m0 = jnp.full((B, G, rep, q_len), _BIG_NEG, jnp.float32)
+    l0 = jnp.zeros((B, G, rep, q_len), jnp.float32)
+    o0 = jnp.zeros((B, G, rep, q_len, D), jnp.float32)
 
     perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
 
     def _tile_update(acc, k_t, v_t, k_pos):
-        """Online-softmax merge of one [*, sub, H, D] KV tile."""
+        """Online-softmax merge of one [*, sub, G, D] KV tile."""
         m, l, o = acc
-        logits = jnp.einsum("bqhd,bkhd->bhqk", qf, k_t.astype(jnp.float32))
+        logits = jnp.einsum("bqgrd,bkgd->bgrqk", qf, k_t.astype(jnp.float32))
         if causal:
             mask = q_pos[:, None] >= k_pos[None, :]
-            logits = jnp.where(mask[None, None], logits, _BIG_NEG)
+            logits = jnp.where(mask[None, None, None], logits, _BIG_NEG)
         m_new = jnp.maximum(m, logits.max(axis=-1))
         p = jnp.exp(logits - m_new[..., None])
         if causal:
             # Fully-masked rows would otherwise contribute exp(0)=1 terms
             # when m_new is still the sentinel.
-            p = jnp.where(mask[None, None], p, 0.0)
+            p = jnp.where(mask[None, None, None], p, 0.0)
         corr = jnp.exp(m - m_new)
         l_new = l * corr + p.sum(axis=-1)
         o_new = o * corr[..., None] + jnp.einsum(
-            "bhqk,bkhd->bhqd", p, v_t.astype(jnp.float32)
+            "bgrqk,bkgd->bgrqd", p, v_t.astype(jnp.float32)
         )
         return m_new, l_new, o_new
 
@@ -105,9 +111,9 @@ def _ring_attention_shard(q, k, v, *, axis_name: str, axis_size: int, causal: bo
         base = chunk * k_len
         if n_sub == 1:
             return _tile_update(acc, k_c, v_c, base + jnp.arange(k_len, dtype=jnp.int32))
-        # [B, k_len, H, D] -> [n_sub, B, sub, H, D] for the inner scan.
-        k_tiles = jnp.moveaxis(k_c.reshape(B, n_sub, sub, H, D), 1, 0)
-        v_tiles = jnp.moveaxis(v_c.reshape(B, n_sub, sub, H, D), 1, 0)
+        # [B, k_len, G, D] -> [n_sub, B, sub, G, D] for the inner scan.
+        k_tiles = jnp.moveaxis(k_c.reshape(B, n_sub, sub, G, D), 1, 0)
+        v_tiles = jnp.moveaxis(v_c.reshape(B, n_sub, sub, G, D), 1, 0)
         offsets = base + jnp.arange(n_sub, dtype=jnp.int32) * sub
 
         def sub_step(acc, tile):
@@ -134,8 +140,18 @@ def _ring_attention_shard(q, k, v, *, axis_name: str, axis_size: int, causal: bo
         step, (k, v, (m0, l0, o0)), jnp.arange(axis_size - 1, dtype=jnp.int32)
     )
     _, l, o = block_update(acc, k, v, (my_idx - (axis_size - 1)) % axis_size)
-    out = o / jnp.maximum(l, 1e-30)[..., None]
-    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+    out = o / jnp.maximum(l, 1e-30)[..., None]        # [B, G, rep, q_len, D]
+    out = out.transpose(0, 3, 1, 2, 4).reshape(B, q_len, H, D)
+    return out.astype(q.dtype)
+
+
+def _expand_kv(q, k, v):
+    """Repeat GQA KV heads to match q (used on non-CP fallback paths — the
+    dense attentions require equal head counts)."""
+    if k.shape[2] == q.shape[2]:
+        return k, v
+    rep = q.shape[2] // k.shape[2]
+    return jnp.repeat(k, rep, axis=2), jnp.repeat(v, rep, axis=2)
 
 
 def _ambient_inner_chunk() -> int:
@@ -158,7 +174,7 @@ def ring_attention(q, k, v, mesh=None, axis_name: str = "cp", causal: bool = Tru
     Args are *global* [B, S, H, D] arrays (sharded or not — shard_map
     partitions them on the sequence dim). With a trivial axis (size 1 or no
     mesh) falls back to the plain attention dispatch. ``inner_chunk`` bounds
-    the logits tile each step materializes ([B, H, S_local, inner_chunk]),
+    the logits tile each step materializes ([B, G, H/G, S_local, inner_chunk]),
     keeping per-device memory O(S_local x inner_chunk) at any length;
     ``None`` reads ``ContextParallelPlugin.ring_inner_chunk`` (default 1024).
     """
@@ -169,8 +185,18 @@ def ring_attention(q, k, v, mesh=None, axis_name: str = "cp", causal: bool = Tru
     if axis_size == 1:
         from .attention import flash_attention
 
+        k, v = _expand_kv(q, k, v)  # dense fallback needs equal heads
         return flash_attention(q, k, v, causal=causal)
 
+    if q.shape[2] % k.shape[2]:
+        raise ValueError(
+            f"ring_attention: q heads {q.shape[2]} not a multiple of kv heads {k.shape[2]}")
+    tp = _axis_size(mesh, "tp")
+    if tp > 1 and k.shape[2] % tp:
+        # The head axis is tp-sharded inside shard_map; G-wide KV that can't
+        # split over tp must enter expanded (costs bandwidth, keeps configs
+        # that predate unrepeated-KV support working).
+        k, v = _expand_kv(q, k, v)
     if q.shape[1] % axis_size:
         raise ValueError(
             f"ring_attention: seq len {q.shape[1]} not divisible by {axis_name}={axis_size}"
@@ -217,6 +243,13 @@ def _ulysses_shard(q, k, v, *, axis_name: str, causal: bool, use_flash: bool):
         return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
 
     ql, kl, vl = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    if kl.shape[2] != ql.shape[2]:
+        # GQA KV crossed the wire unrepeated (G/cp heads per device);
+        # expand locally for the dense attention — free compared to
+        # shipping repeated heads through the all_to_all.
+        rep = ql.shape[2] // kl.shape[2]
+        kl = jnp.repeat(kl, rep, axis=2)
+        vl = jnp.repeat(vl, rep, axis=2)
     from .attention import _einsum_attention, flash_attention, flash_attention_available
 
     if use_flash and flash_attention_available(ql):
@@ -239,9 +272,14 @@ def ulysses_attention(
     if axis_size == 1:
         from .attention import flash_attention
 
+        k, v = _expand_kv(q, k, v)  # dense fallback needs equal heads
         return flash_attention(q, k, v, causal=causal)
 
     tp = _axis_size(mesh, "tp")
+    if (tp > 1 and k.shape[2] % tp) or (k.shape[2] // max(tp, 1)) % axis_size:
+        # Unrepeated GQA KV that can't split over tp x cp: expand up front
+        # (the pre-unrepeated-KV behavior) so such configs keep working.
+        k, v = _expand_kv(q, k, v)
     local_q_heads, local_kv_heads = q.shape[2] // tp, k.shape[2] // tp
     if local_q_heads % axis_size or local_kv_heads % axis_size:
         raise ValueError(

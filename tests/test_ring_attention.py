@@ -80,6 +80,75 @@ def test_ring_attention_sub_chunked_inner_matches_full(causal, inner_chunk):
         np.testing.assert_allclose(np.asarray(b), np.asarray(a), atol=5e-5, rtol=5e-5)
 
 
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("strategy", ["ring", "ulysses"])
+def test_cp_attention_gqa_unrepeated_kv_matches_expanded(strategy, causal):
+    """GQA KV enters the CP strategies UNREPEATED (G-wide over the wire —
+    H/G times less ICI traffic); results must equal attention over
+    explicitly expanded KV."""
+    mesh = MeshConfig(dp=1, cp=2, devices=jax.devices()[:2]).build()  # kv=2 % cp=2 == 0
+    keys = jax.random.split(jax.random.PRNGKey(7), 3)
+    q = jax.random.normal(keys[0], (2, 64, 8, 16), jnp.float32)
+    k = jax.random.normal(keys[1], (2, 64, 2, 16), jnp.float32)
+    v = jax.random.normal(keys[2], (2, 64, 2, 16), jnp.float32)
+    k_full = jnp.repeat(k, 4, axis=2)
+    v_full = jnp.repeat(v, 4, axis=2)
+    ref = _einsum_attention(q, k_full, v_full, causal=causal)
+    fn = ring_attention if strategy == "ring" else ulysses_attention
+    out = fn(q, k, v, mesh=mesh, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_ring_gqa_grads_match_expanded():
+    mesh = MeshConfig(dp=1, cp=2, devices=jax.devices()[:2]).build()
+    keys = jax.random.split(jax.random.PRNGKey(8), 3)
+    q = jax.random.normal(keys[0], (2, 32, 4, 8), jnp.float32)
+    k = jax.random.normal(keys[1], (2, 32, 2, 8), jnp.float32)
+    v = jax.random.normal(keys[2], (2, 32, 2, 8), jnp.float32)
+
+    def loss_ref(q, k, v):
+        kf, vf = jnp.repeat(k, 2, axis=2), jnp.repeat(v, 2, axis=2)
+        return (_einsum_attention(q, kf, vf, causal=True) ** 2).sum()
+
+    def loss_ring(q, k, v):
+        return (ring_attention(q, k, v, mesh=mesh, causal=True) ** 2).sum()
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    for a, b, nm in zip(g_ring, g_ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5, rtol=5e-5,
+                                   err_msg=f"d{nm}")
+
+
+@pytest.mark.parametrize("fn", [ring_attention, ulysses_attention])
+def test_cp_gqa_trivial_axis_fallback_expands(fn):
+    """axis_size==1: the dense fallback needs equal heads — unrepeated GQA
+    KV must be expanded, not crash."""
+    mesh = MeshConfig(dp=1, cp=1, devices=jax.devices()[:1]).build()
+    keys = jax.random.split(jax.random.PRNGKey(9), 3)
+    q = jax.random.normal(keys[0], (1, 32, 4, 8), jnp.float32)
+    k = jax.random.normal(keys[1], (1, 32, 2, 8), jnp.float32)
+    v = jax.random.normal(keys[2], (1, 32, 2, 8), jnp.float32)
+    ref = _einsum_attention(q, jnp.repeat(k, 2, axis=2), jnp.repeat(v, 2, axis=2),
+                            causal=True)
+    out = fn(q, k, v, mesh=mesh, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_ring_gqa_kv_unshardable_over_tp_expands():
+    """tp axis that cannot split G kv heads: the entry expands KV (the
+    pre-unrepeated behavior) instead of failing in shard_map."""
+    mesh = MeshConfig(dp=1, cp=2, tp=4, devices=jax.devices()).build()
+    keys = jax.random.split(jax.random.PRNGKey(10), 3)
+    q = jax.random.normal(keys[0], (1, 32, 8, 8), jnp.float32)
+    k = jax.random.normal(keys[1], (1, 32, 2, 8), jnp.float32)  # 2 % tp=4 != 0
+    v = jax.random.normal(keys[2], (1, 32, 2, 8), jnp.float32)
+    ref = _einsum_attention(q, jnp.repeat(k, 4, axis=2), jnp.repeat(v, 4, axis=2),
+                            causal=True)
+    out = ring_attention(q, k, v, mesh=mesh, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
 def test_ring_inner_chunk_reads_context_parallel_plugin():
     """inner_chunk=None resolves from ContextParallelPlugin.ring_inner_chunk
     (the framework-wide knob) and stays exact."""
