@@ -23,6 +23,7 @@ def artifacts(tmp_path, monkeypatch):
     monkeypatch.setattr(bench_watch, "HISTORY", str(d / "history.jsonl"))
     monkeypatch.setattr(bench_watch, "BEST", str(d / "best.json"))
     monkeypatch.setattr(bench_watch, "KERNELS", str(d / "kernels.json"))
+    monkeypatch.setattr(bench_watch, "KERNELS_PARTIAL", str(d / "kernels_partial.json"))
     monkeypatch.setattr(bench_watch, "SWEEP", str(d / "sweep.json"))
     monkeypatch.setattr(bench_watch, "LOG", str(d / "watch.log"))
     return d
