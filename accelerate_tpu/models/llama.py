@@ -517,8 +517,18 @@ class PipelinedLlamaForCausalLM:
 
     # -- forward -----------------------------------------------------------
 
-    def apply(self, variables, input_ids, positions=None):
-        """Flax apply over stacked per-stage params (pipeline schedule inside)."""
+    def apply(self, variables, input_ids, positions=None, segment_ids=None,
+              return_hidden=False):
+        """Flax apply over stacked per-stage params (pipeline schedule inside).
+
+        ``return_hidden=True`` yields the pre-head normed hidden states so
+        :func:`fused_causal_lm_loss` can run its chunked LM head — the same
+        contract as ``LlamaForCausalLM(..., return_hidden=True)``. Packed
+        batches ride along as ``segment_ids`` (they join ``positions`` in the
+        pipeline's per-example extras). Besides pipelining, this layout is
+        the fast-compile path for deep stacks: the block is traced/compiled
+        once and scanned, not inlined per layer.
+        """
         from ..parallel.pipeline import pipeline_apply
 
         cfg = self.config
@@ -531,18 +541,29 @@ class PipelinedLlamaForCausalLM:
 
         block = LlamaBlock(cfg)
 
-        def block_fn(p_layer, h, pos):
-            return block.apply({"params": p_layer}, h, pos)
+        if segment_ids is None:
+            extras = positions
+
+            def block_fn(p_layer, h, pos):
+                return block.apply({"params": p_layer}, h, pos)
+        else:
+            extras = (positions, segment_ids)
+
+            def block_fn(p_layer, h, exs):
+                pos, seg = exs
+                return block.apply({"params": p_layer}, h, pos, segment_ids=seg)
 
         x = pipeline_apply(
             block_fn,
             p["model"]["blocks"],
             x,
-            extras=positions,
+            extras=extras,
             num_microbatches=self.num_microbatches,
             remat=cfg.remat,
         )
         x = RMSNorm(cfg.rms_norm_eps).apply({"params": p["model"]["norm"]}, x)
+        if return_hidden:
+            return x
         if cfg.tie_word_embeddings:
             return x @ emb.T.astype(x.dtype)
         return x @ p["lm_head"]["kernel"].astype(x.dtype)
@@ -589,12 +610,18 @@ def causal_lm_loss(apply_fn):
     return loss_fn
 
 
-def fused_causal_lm_loss(module: "LlamaForCausalLM", num_chunks: int = 8):
+def fused_causal_lm_loss(module, num_chunks: int = 8):
     """Memory-efficient loss: the [tokens, vocab] logits are never
     materialized — the LM head runs chunked over the vocabulary with an
     online softmax (ops/fused_loss.py). Numerics match `causal_lm_loss`
     to fp32-accumulation tolerance; peak activation memory drops by
-    ~vocab/num_chunks at the head."""
+    ~vocab/num_chunks at the head.
+
+    ``module`` is any model exposing ``.config`` and an
+    ``apply(variables, input_ids, ..., return_hidden=True)`` that yields
+    pre-head hidden states: both `LlamaForCausalLM` and the scan-based
+    `PipelinedLlamaForCausalLM` qualify, including packed-sequence batches
+    (``positions`` + ``segment_ids``)."""
     from ..ops.fused_loss import chunked_softmax_xent
 
     cfg = module.config

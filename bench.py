@@ -54,7 +54,18 @@ def run_bench(on_tpu: bool) -> dict:
 
     from accelerate_tpu import Accelerator, Model
     from accelerate_tpu.data_loader import make_global_batch
-    from accelerate_tpu.models.llama import LlamaConfig, LlamaForCausalLM, fused_causal_lm_loss
+    from accelerate_tpu.models.llama import (
+        LlamaConfig,
+        LlamaForCausalLM,
+        PipelinedLlamaForCausalLM,
+        fused_causal_lm_loss,
+    )
+
+    def mark(stage):
+        # Progress markers: let the parent pinpoint which stage ate a killed
+        # child's budget (backend init vs param init vs train-step compile).
+        if on_tpu:
+            print(f"ATPU_BENCH_{stage}", flush=True)
 
     if on_tpu:
         cfg = LlamaConfig(
@@ -63,15 +74,25 @@ def run_bench(on_tpu: bool) -> dict:
             max_position_embeddings=2048, remat=False, use_flash_attention=True,
         )
         batch, seq, iters, warmup = 8, 1024, 20, 3
+        # Scan-over-layers layout: the decoder block is traced and
+        # Mosaic-compiled ONCE and lax.scan'd over the stacked [L, ...]
+        # params, instead of inlining 10 copies — over the tunnel the
+        # unrolled compile alone blew a 480 s budget (watch history
+        # 2026-07-31T04:05). Same math, same flash kernel, ~10x less compile.
+        model_def = PipelinedLlamaForCausalLM(cfg)
+        jax.devices()  # force backend init under its own marker
+        mark("BACKEND_UP")
+        params = model_def.init_params(jax.random.PRNGKey(0))
     else:  # CPU smoke fallback so the bench always emits a line
         cfg = LlamaConfig.tiny(use_flash_attention=False)
         batch, seq, iters, warmup = 4, 32, 3, 1
-
-    model_def = LlamaForCausalLM(cfg)
-    params = model_def.init_params(jax.random.PRNGKey(0), batch_size=1, seq_len=8)
+        model_def = LlamaForCausalLM(cfg)
+        params = model_def.init_params(jax.random.PRNGKey(0), batch_size=1, seq_len=8)
+    mark("PARAMS_INIT")
 
     acc = Accelerator(mixed_precision="bf16")
     model, opt = acc.prepare(Model(model_def, params), optax.adamw(1e-4))
+    mark("PREPARED")
     # Chunked LM-head loss: never materializes the [tokens, vocab] logits —
     # at vocab 32k that's the train step's largest activation (~1 GB at
     # this config) and pure HBM traffic saved.
@@ -90,10 +111,7 @@ def run_bench(on_tpu: bool) -> dict:
     # NB: device_get, not block_until_ready — the latter is a no-op on some
     # experimental PJRT platforms (observed on the axon tunnel).
     jax.device_get(metrics["loss"])
-    if on_tpu:
-        # Progress marker: lets the parent distinguish "compile blew the
-        # budget" from "tunnel never answered" when the child is killed.
-        print("ATPU_BENCH_COMPILED", flush=True)
+    mark("COMPILED")
 
     t0 = time.perf_counter()
     for i in range(iters):
@@ -163,11 +181,16 @@ def _tpu_subprocess(timeout: float = 480.0) -> tuple[dict | None, str | None]:
             except ValueError:
                 continue
     if rc is None:
-        # Disambiguate for the round artifact: a child killed at its budget
-        # with no progress marker = backend init hung (tunnel down); a child
-        # that got past compile = the config itself blew the budget.
-        stage = "after compile finished" if "ATPU_BENCH_COMPILED" in stdout else (
-            "during backend init/compile (no progress marker — tunnel likely down)"
+        # Disambiguate for the round artifact by the last progress marker: no
+        # marker at all = backend init hung (tunnel down); otherwise report
+        # which stage the budget died in.
+        last = None
+        for m in ("BACKEND_UP", "PARAMS_INIT", "PREPARED", "COMPILED"):
+            if f"ATPU_BENCH_{m}" in stdout:
+                last = m
+        stage = (
+            "during backend init (no progress marker — tunnel likely down)"
+            if last is None else f"after stage {last}"
         )
         return None, f"child killed at {timeout:.0f}s budget, {stage}"
     return None, f"child exited rc={rc} without a result line"

@@ -47,6 +47,7 @@ BEST = os.path.join(ARTIFACT_DIR, "best.json")
 KERNELS = os.path.join(ARTIFACT_DIR, "kernels.json")
 KERNELS_PARTIAL = os.path.join(ARTIFACT_DIR, "kernels_partial.json")
 SWEEP = os.path.join(ARTIFACT_DIR, "sweep.json")
+SWEEP_PARTIAL = os.path.join(ARTIFACT_DIR, "sweep_partial.json")
 LOG = os.path.join(ARTIFACT_DIR, "watch.log")
 
 PROBE_TIMEOUT = 90.0
@@ -327,6 +328,15 @@ def run_sweep() -> dict:
     sizes = (128, 256) if tiny else (128, 256, 512)
     combos = [(bq, bk) for bq in sizes for bk in sizes]
     rows = []
+    out = {
+        "ok": False,
+        "shape": {"batch": B, "seq": S, "heads": H, "head_dim": D, "dtype": "bf16"},
+        "rows": rows,
+        "best": None,
+        "backend": jax.default_backend(),
+        "tiny_smoke": tiny,
+        "interpret_mode": flash_pallas._interpret(),
+    }
     for bq, bk in combos:
         fn = jax.jit(
             jax.grad(
@@ -341,16 +351,13 @@ def run_sweep() -> dict:
             rows.append({"block_q": bq, "block_k": bk, "fwdbwd_ms": round(ms, 3)})
         except Exception as e:  # noqa: BLE001 - record per-combo failures
             rows.append({"block_q": bq, "block_k": bk, "error": f"{type(e).__name__}: {e}"})
-
-    timed = [r for r in rows if "fwdbwd_ms" in r]
-    best = min(timed, key=lambda r: r["fwdbwd_ms"]) if timed else None
-    return {
-        "ok": bool(timed),
-        "shape": {"batch": B, "seq": S, "heads": H, "head_dim": D, "dtype": "bf16"},
-        "rows": rows,
-        "best": best,
-        "backend": jax.default_backend(),
-    }
+        # Checkpoint per combo: each adds a ~30-60 s Mosaic compile over the
+        # tunnel, so a budget kill must keep the rows already timed.
+        timed = [r for r in rows if "fwdbwd_ms" in r]
+        out["ok"] = bool(timed)
+        out["best"] = min(timed, key=lambda r: r["fwdbwd_ms"]) if timed else None
+        _save_json(SWEEP_PARTIAL, out)
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -438,6 +445,7 @@ def merge_evidence(result: dict) -> dict:
     if sweep:
         extra["flash_block_sweep"] = {
             "best": sweep.get("best"),
+            "partial": sweep.get("partial", False),
             "rows": sweep.get("rows"),
             "captured_at": sweep.get("ts"),
         }
@@ -520,8 +528,21 @@ def run_cycle() -> float:
         _log(f"tier1 failed: {err}")
 
     prior_sweep = _load_json(SWEEP)
-    if prior_sweep is None or not prior_sweep.get("ok"):
+    # A salvaged partial sweep is better than nothing but must not stop a
+    # healthy cycle from completing the full grid.
+    if prior_sweep is None or not prior_sweep.get("ok") or prior_sweep.get("partial"):
+        try:
+            os.remove(SWEEP_PARTIAL)
+        except OSError:
+            pass
         sw, err = _run_child("--sweep-run", SWEEP_BUDGET)
+        if sw is None:
+            partial = _load_json(SWEEP_PARTIAL)
+            if partial and not partial.get("tiny_smoke") and not partial.get(
+                    "interpret_mode") and partial.get("backend") == "tpu" and partial.get("ok"):
+                partial["partial"] = True
+                sw = partial
+                err = f"{err} (salvaged {len(partial['rows'])} rows)"
         if sw is not None and sw.get("ok"):
             sw["ts"] = _now()
             _save_json(SWEEP, sw)
