@@ -10,14 +10,17 @@ Every ~10 minutes it probes the default backend out-of-process; the moment
 a TPU answers it runs a tiered benchmark, each tier in its own throwaway
 subprocess with a hard group timeout:
 
-* **liveness** (60 s budget): device inventory + one jitted matmul — proves
+* **liveness** (120 s budget): device inventory + one jitted matmul — proves
   the tunnel end-to-end and records the chip generation.
+* **tier1** (900 s): the full ``bench.py`` training-throughput/MFU run —
+  run FIRST after liveness because observed tunnel-up windows can be short
+  and this is the headline artifact.
 * **kernels** (1500 s): the Pallas flash-attention forward/backward, the
   sliding-window variant, and the fp8 delayed-scaling matmul, all
   Mosaic-COMPILED (interpret=False) on the chip, checked numerically
   against exact einsum/fp32 references and timed against the XLA einsum
-  path at the training benchmark's shape.
-* **tier1** (480 s): the full ``bench.py`` training-throughput/MFU run.
+  path at the training benchmark's shape; each check/timing is
+  checkpointed so a budget kill keeps the evidence so far.
 * **sweep** (900 s, once per history file): flash block-size sweep over
   {128,256,512}^2 at the benchmark shape, to pick LlamaConfig defaults.
 
@@ -53,7 +56,7 @@ LOG = os.path.join(ARTIFACT_DIR, "watch.log")
 PROBE_TIMEOUT = 90.0
 LIVENESS_BUDGET = 120.0
 KERNELS_BUDGET = 1500.0  # ~11 Mosaic compiles at ~25 s each over the tunnel
-TIER1_BUDGET = 480.0
+TIER1_BUDGET = 900.0   # headroom over bench.py's own 480 s default
 SWEEP_BUDGET = 900.0
 DOWN_SLEEP = 240.0      # tunnel down: re-probe every ~5.5 min incl. probe
                         # (observed to flicker: probes can succeed minutes
@@ -482,6 +485,22 @@ def run_cycle() -> float:
         return PARTIAL_SLEEP
     _log(f"liveness ok: {live['device_kind']} matmul in {live['first_matmul_s']}s")
 
+    # Tier 1 FIRST: the tunnel has been observed up for windows as short as
+    # ~25 min, and the headline MFU number is the single most valuable
+    # artifact — don't let a long kernels run eat the window before it.
+    t1, err = _run_child("--tpu-run", TIER1_BUDGET)
+    if t1 is not None:
+        t1_extra = t1.get("extra", {})
+        _append_history({"event": "tier1", "ok": True, "value": t1.get("value"),
+                         "mfu": t1_extra.get("mfu"), "step_ms": t1_extra.get("step_ms")})
+        _log(f"tier1 ok: {t1.get('value')} tok/s/chip, mfu={t1_extra.get('mfu')}")
+        if persist_best_if_better(t1):
+            _log("new best persisted")
+    else:
+        all_ok = False
+        _append_history({"event": "tier1", "ok": False, "error": err})
+        _log(f"tier1 failed: {err}")
+
     # Clear the partial checkpoint so a kill can't surface stale evidence.
     try:
         os.remove(KERNELS_PARTIAL)
@@ -513,19 +532,11 @@ def run_cycle() -> float:
         _log(f"kernels failed: {err or (kern or {}).get('checks')}")
     _append_history({"event": "kernels", "ok": kern is not None and kern.get("ok"),
                      "error": err, **({k: v for k, v in (kern or {}).items() if k != "ts"})})
-
-    t1, err = _run_child("--tpu-run", TIER1_BUDGET)
-    if t1 is not None:
-        t1_extra = t1.get("extra", {})
-        _append_history({"event": "tier1", "ok": True, "value": t1.get("value"),
-                         "mfu": t1_extra.get("mfu"), "step_ms": t1_extra.get("step_ms")})
-        _log(f"tier1 ok: {t1.get('value')} tok/s/chip, mfu={t1_extra.get('mfu')}")
-        if persist_best_if_better(t1):
-            _log("new best persisted")
-    else:
-        all_ok = False
-        _append_history({"event": "tier1", "ok": False, "error": err})
-        _log(f"tier1 failed: {err}")
+    if kern is not None and kern.get("ok"):
+        # Fresh kernel evidence after tier1 already persisted: re-merge.
+        best = _load_json(BEST)
+        if best:
+            _save_json(BEST, merge_evidence(best))
 
     prior_sweep = _load_json(SWEEP)
     # A salvaged partial sweep is better than nothing but must not stop a

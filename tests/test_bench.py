@@ -177,7 +177,9 @@ class TestWatcherCycle:
         assert best["extra"]["flash_block_sweep"]["best"]["block_q"] == 512
         events = [json.loads(l) for l in open(bench_watch.HISTORY)]
         kinds = [e["event"] for e in events]
-        assert kinds == ["probe", "liveness", "kernels", "tier1", "sweep"]
+        # tier1 runs right after liveness: tunnel-up windows can be short
+        # and the MFU number is the headline artifact.
+        assert kinds == ["probe", "liveness", "tier1", "kernels", "sweep"]
 
     def test_tier_failure_retries_sooner(self, artifacts, monkeypatch):
         self._patch_probe(monkeypatch, {"platform": "tpu", "device_count": 1,
