@@ -202,15 +202,11 @@ def multi_head_attention(
         raise ValueError(
             f"unknown attention_backend {backend!r}; expected auto/ring/ulysses/flash/einsum"
         )
-    # GQA: the dense paths (flash/einsum) need expanded KV; the CP paths get
-    # the raw G-wide tensors so the interconnect moves H/G times less data.
-    # Expansion is lazy so eager CP runs never materialize the wide copy.
-    def _kv_full():
-        if k.shape[2] == q.shape[2]:
-            return k, v
-        rep = q.shape[2] // k.shape[2]
-        return jnp.repeat(k, rep, axis=2), jnp.repeat(v, rep, axis=2)
-
+    # GQA: every path is narrow-KV-native — the flash kernel indexes the
+    # shared kv head in its BlockSpecs, the einsum path contracts grouped,
+    # and the CP paths rotate G-wide KV over the interconnect. The expanded
+    # copy survives only for a tp axis that cannot shard G heads
+    # (ring_attention._expand_kv, below).
     if sliding_window is not None and sliding_window < q.shape[1]:
         # Only a window narrower than the sequence masks anything; when
         # window >= seq, full causal attention is exact and every fast path
@@ -221,16 +217,20 @@ def multi_head_attention(
         if backend in ("ring", "ulysses"):
             raise ValueError(
                 f"attention_backend={backend!r} does not support sliding_window")
-        kf, vf = _kv_full()
         if backend != "einsum" and use_flash and segment_ids is None and causal:
-            return flash_attention(q, kf, vf, causal=True,
+            return flash_attention(q, k, v, causal=True,
                                    sliding_window=sliding_window,
                                    block_q=block_q, block_k=block_k)
-        return _einsum_attention(q, kf, vf, causal=causal,
+        return _einsum_attention(q, k, v, causal=causal,
                                  segment_ids=segment_ids,
                                  sliding_window=sliding_window)
     if backend in ("auto", "ring", "ulysses"):
-        from ..ops.ring_attention import _axis_size, _resolve_mesh, context_parallel_attention
+        from ..ops.ring_attention import (
+            _axis_size,
+            _expand_kv,
+            _resolve_mesh,
+            context_parallel_attention,
+        )
 
         if segment_ids is not None and backend != "auto":
             raise ValueError(f"attention_backend={backend!r} does not support segment_ids")
@@ -243,18 +243,17 @@ def multi_head_attention(
                 # expanding only at the local contraction. Exception: a tp
                 # axis that cannot shard G heads needs the expanded copy.
                 tp = _axis_size(mesh, "tp")
-                kc, vc = (k, v) if (tp <= 1 or k.shape[2] % tp == 0) else _kv_full()
+                kc, vc = (k, v) if (tp <= 1 or k.shape[2] % tp == 0) else _expand_kv(q, k, v)
                 return context_parallel_attention(
                     q, kc, vc, mesh=mesh, causal=causal, strategy=backend, use_flash=use_flash
                 )
-    kf, vf = _kv_full()
     if backend != "einsum" and use_flash and flash_attention_available(q):
         # segment_ids are masked inside the Pallas kernel, so packed-sequence
         # training keeps flash's memory asymptotics.
-        return flash_attention(q, kf, vf, causal=causal,
+        return flash_attention(q, k, v, causal=causal,
                                block_q=block_q, block_k=block_k,
                                segment_ids=segment_ids)
-    return _einsum_attention(q, kf, vf, causal=causal, segment_ids=segment_ids)
+    return _einsum_attention(q, k, v, causal=causal, segment_ids=segment_ids)
 
 
 def init_kv_cache(config: "LlamaConfig", batch_size: int, max_len: int, dtype=jnp.bfloat16):

@@ -35,10 +35,23 @@ def flash_attention_available(q=None) -> bool:
 def _einsum_attention(q, k, v, causal: bool, segment_ids=None, sliding_window=None):
     """XLA-fused reference path: [B, S, H, D] -> [B, S, H, D].
 
+    GQA-native: when k/v carry fewer heads (``G`` with ``H = G * rep``) the
+    queries contract *grouped* against the narrow K/V — no ``jnp.repeat``
+    copy is ever materialized (same trick as llama._cached_attention).
+
     ``sliding_window=w`` (Mistral-style) restricts each query to the last
     ``w`` keys: k_pos in (q_pos - w, q_pos]."""
     scale = q.shape[-1] ** -0.5
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q * scale, k)
+    B, Sq, H, D = q.shape
+    G = k.shape[2]
+    if H != G:
+        if H % G:
+            raise ValueError(f"q heads {H} not a multiple of kv heads {G}")
+        qg = (q * scale).reshape(B, Sq, G, H // G, D)
+        logits = jnp.einsum("bqgrd,bkgd->bgrqk", qg, k)
+    else:
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q * scale, k)
+    head_dims = logits.ndim - 3  # axes between batch and [q, k]
     big_neg = jnp.finfo(logits.dtype).min
     if causal or sliding_window is not None:
         q_len, k_len = q.shape[1], k.shape[1]
@@ -52,11 +65,14 @@ def _einsum_attention(q, k, v, causal: bool, segment_ids=None, sliding_window=No
             # bounds apply regardless of `causal`, so a non-causal caller
             # still gets a window, never unmasked future keys.
             mask &= (k_pos > q_pos - sliding_window) & (k_pos <= q_pos)
-        logits = jnp.where(mask[None, None], logits, big_neg)
+        logits = jnp.where(mask[(None,) * (head_dims + 1)], logits, big_neg)
     if segment_ids is not None:
         seg_mask = segment_ids[:, :, None] == segment_ids[:, None, :]
-        logits = jnp.where(seg_mask[:, None], logits, big_neg)
+        logits = jnp.where(seg_mask[(slice(None),) + (None,) * head_dims], logits, big_neg)
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    if H != G:
+        out = jnp.einsum("bgrqk,bkgd->bqgrd", probs, v)
+        return out.reshape(B, Sq, H, D)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 

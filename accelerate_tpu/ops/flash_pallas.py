@@ -157,9 +157,13 @@ def _flash_fwd(q, k, v, sm_scale, causal, window, block_q, block_k, segment_ids=
     num_k = S_k // block_k
     band, k_start = _k_band(window, block_q, block_k, num_k)
     grid = (B, H, num_q, band)
+    # GQA-native: K/V may carry fewer heads (G = H // rep); the index_map
+    # points q head h at kv head h // rep, so the wide repeated copy the
+    # einsum path would need is never materialized in HBM.
+    rep = H // k.shape[1]
 
     def k_index(b, h, qi, kj):
-        return (b, h, jnp.minimum(k_start(qi) + kj, num_k - 1), 0)
+        return (b, h // rep, jnp.minimum(k_start(qi) + kj, num_k - 1), 0)
 
     has_segments = segment_ids is not None
     kernel = functools.partial(
@@ -216,7 +220,12 @@ def _flash_fwd(q, k, v, sm_scale, causal, window, block_q, block_k, segment_ids=
 # ---------------------------------------------------------------------------
 
 def _bwd_dkdv_kernel(*refs, sm_scale, causal, window, block_q, block_k,
-                     num_q_blocks, band: int, has_segments: bool):
+                     num_q_blocks, band: int, rep: int, has_segments: bool):
+    """Grid (B, G, num_k, rep * band): dim 1 is the *kv* head; the innermost
+    dim walks the ``rep`` query heads sharing it r-major (inner = r * band +
+    qj), accumulating all their dk/dv contributions in the same VMEM scratch.
+    GQA thus writes narrow [B, G, S_k, D] grads in one pass — no H-wide
+    partials in HBM, no bf16 rounding between per-head partial sums."""
     if has_segments:
         (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qs_ref, ks_ref,
          dk_ref, dv_ref, dk_scr, dv_scr) = refs
@@ -224,13 +233,14 @@ def _bwd_dkdv_kernel(*refs, sm_scale, causal, window, block_q, block_k,
         (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
          dk_ref, dv_ref, dk_scr, dv_scr) = refs
     ki = pl.program_id(2)
-    qj = pl.program_id(3)
+    inner = pl.program_id(3)
+    qj = inner % band
     _, q_start = _q_band(window, block_q, block_k, num_q_blocks)
     qi = q_start(ki) + qj
     band_valid = qi < num_q_blocks
     qi = jnp.minimum(qi, num_q_blocks - 1)
 
-    @pl.when(qj == 0)
+    @pl.when(inner == 0)
     def _init():
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
@@ -270,7 +280,7 @@ def _bwd_dkdv_kernel(*refs, sm_scale, causal, window, block_q, block_k,
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
 
-    @pl.when(qj == band - 1)
+    @pl.when(inner == rep * band - 1)
     def _finalize():
         dk_ref[0, 0] = dk_scr[:].astype(dk_ref.dtype)
         dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
@@ -336,6 +346,10 @@ def _flash_bwd(sm_scale, causal, window, block_q, block_k, residuals, d_out,
     num_q = S_q // block_q
     num_k = S_k // block_k
     has_segments = segment_ids is not None
+    # GQA: kernels read the narrow K/V via h // rep; dk/dv are produced
+    # per *query* head below and group-summed back to the kv heads.
+    G = k.shape[1]
+    rep = H // G
 
     # delta = rowsum(dO * O)  [B, H, S_q] broadcast to LANES for tiling.
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1, keepdims=True)
@@ -343,13 +357,16 @@ def _flash_bwd(sm_scale, causal, window, block_q, block_k, residuals, d_out,
 
     band_q, q_start = _q_band(window, block_q, block_k, num_q)
 
-    def q_index(b, h, ki, qj):
-        return (b, h, jnp.minimum(q_start(ki) + qj, num_q - 1), 0)
+    # Grid dim 1 is the KV head g; the innermost dim folds (r, qj) r-major.
+    # Q-side blocks for (g, inner) belong to query head g * rep + r.
+    def q_index(b, g, ki, inner):
+        return (b, g * rep + inner // band_q,
+                jnp.minimum(q_start(ki) + inner % band_q, num_q - 1), 0)
 
     dkdv_specs = [
         pl.BlockSpec((1, 1, block_q, D), q_index),
-        pl.BlockSpec((1, 1, block_k, D), lambda b, h, ki, qj: (b, h, ki, 0)),
-        pl.BlockSpec((1, 1, block_k, D), lambda b, h, ki, qj: (b, h, ki, 0)),
+        pl.BlockSpec((1, 1, block_k, D), lambda b, g, ki, inner: (b, g, ki, 0)),
+        pl.BlockSpec((1, 1, block_k, D), lambda b, g, ki, inner: (b, g, ki, 0)),
         pl.BlockSpec((1, 1, block_q, D), q_index),
         pl.BlockSpec((1, 1, block_q, LANES), q_index),
         pl.BlockSpec((1, 1, block_q, LANES), q_index),
@@ -358,8 +375,9 @@ def _flash_bwd(sm_scale, causal, window, block_q, block_k, residuals, d_out,
     if has_segments:
         dkdv_specs += [
             pl.BlockSpec((1, block_q),
-                         lambda b, h, ki, qj: (b, jnp.minimum(q_start(ki) + qj, num_q - 1))),
-            pl.BlockSpec((1, block_k), lambda b, h, ki, qj: (b, ki)),
+                         lambda b, g, ki, inner: (
+                             b, jnp.minimum(q_start(ki) + inner % band_q, num_q - 1))),
+            pl.BlockSpec((1, block_k), lambda b, g, ki, inner: (b, ki)),
         ]
         dkdv_inputs += [segment_ids, segment_ids]
 
@@ -367,17 +385,17 @@ def _flash_bwd(sm_scale, causal, window, block_q, block_k, residuals, d_out,
         functools.partial(
             _bwd_dkdv_kernel, sm_scale=sm_scale, causal=causal, window=window,
             block_q=block_q, block_k=block_k, num_q_blocks=num_q, band=band_q,
-            has_segments=has_segments,
+            rep=rep, has_segments=has_segments,
         ),
-        grid=(B, H, num_k, band_q),
+        grid=(B, G, num_k, rep * band_q),
         in_specs=dkdv_specs,
         out_specs=[
-            pl.BlockSpec((1, 1, block_k, D), lambda b, h, ki, qj: (b, h, ki, 0)),
-            pl.BlockSpec((1, 1, block_k, D), lambda b, h, ki, qj: (b, h, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, g, ki, inner: (b, g, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, g, ki, inner: (b, g, ki, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B, H, S_k, D), k.dtype),
-            jax.ShapeDtypeStruct((B, H, S_k, D), v.dtype),
+            jax.ShapeDtypeStruct((B, G, S_k, D), k.dtype),
+            jax.ShapeDtypeStruct((B, G, S_k, D), v.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, D), jnp.float32),
@@ -393,7 +411,7 @@ def _flash_bwd(sm_scale, causal, window, block_q, block_k, residuals, d_out,
     band_k, k_start = _k_band(window, block_q, block_k, num_k)
 
     def k_index(b, h, qi, kj):
-        return (b, h, jnp.minimum(k_start(qi) + kj, num_k - 1), 0)
+        return (b, h // rep, jnp.minimum(k_start(qi) + kj, num_k - 1), 0)
 
     dq_specs = [
         pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, kj: (b, h, qi, 0)),
@@ -480,6 +498,13 @@ def pallas_flash_attention(q, k, v, causal: bool = True, block_q: int = 128, blo
                            segment_ids=None):
     """Public entry. q/k/v: [batch, seq, heads, head_dim] (models layout).
 
+    GQA-native: k/v may carry fewer heads than q (``n_q = rep * n_kv``).
+    The fwd/dq kernels index the shared kv head directly (``h // rep`` in
+    the BlockSpec index maps) and the dk/dv kernel grids over kv heads,
+    accumulating the ``rep`` query heads in VMEM scratch — narrow
+    [B, G, S, D] grads in one pass, no repeated K/V copy in HBM (the
+    einsum path avoids the copy too, via a grouped contraction).
+
     ``sliding_window=w`` masks k_pos outside (q_pos - w, q_pos] and *skips*
     fully-masked K blocks, so long-sequence local attention (Mistral) costs
     O(S * w) instead of O(S^2).
@@ -495,6 +520,8 @@ def pallas_flash_attention(q, k, v, causal: bool = True, block_q: int = 128, blo
     if sliding_window is not None and segment_ids is not None:
         raise ValueError("sliding_window with segment_ids is not supported in the "
                          "Pallas kernel (use the einsum path)")
+    if q.shape[2] % k.shape[2]:
+        raise ValueError(f"q heads {q.shape[2]} not a multiple of kv heads {k.shape[2]}")
     S = q.shape[1]
     block_q = min(block_q, S)
     block_k = min(block_k, k.shape[1])

@@ -251,6 +251,18 @@ def run_kernels() -> dict:
     for gf, gr, nm in zip(gseg, gref, "qkv"):
         check(f"flash_segments_bwd_d{nm}_fp32", gf, gr, 2e-2)
 
+    # -- GQA parity (narrow KV, h // rep BlockSpec indexing) -----------------
+    Hg, Gg = (2, 1) if tiny else (4, 2)
+    Sg = 128 if tiny else 256
+    kq, kk2, kv2 = jax.random.split(jax.random.PRNGKey(11), 3)
+    qg = jax.random.normal(kq, (1, Sg, Hg, 64), jnp.float32)
+    kg = jax.random.normal(kk2, (1, Sg, Gg, 64), jnp.float32)
+    vg = jax.random.normal(kv2, (1, Sg, Gg, 64), jnp.float32)
+    got = jax.jit(lambda q, k, v: pallas_flash_attention(q, k, v, causal=True,
+                                                         block_q=128, block_k=128))(qg, kg, vg)
+    want = jax.jit(lambda q, k, v: _einsum_attention(q, k, v, causal=True))(qg, kg, vg)
+    check("flash_gqa_fwd_fp32", got, want, 2e-2)
+
     # -- fp8 delayed-scaling matmul ------------------------------------------
     from accelerate_tpu.ops.quant import E4M3, _quantize, fp8_matmul
 
