@@ -146,8 +146,9 @@ def _ring_attention_shard(q, k, v, *, axis_name: str, axis_size: int, causal: bo
 
 
 def _expand_kv(q, k, v):
-    """Repeat GQA KV heads to match q (used on non-CP fallback paths — the
-    dense attentions require equal head counts)."""
+    """Repeat GQA KV heads to match q. Only needed when a tp axis must shard
+    the head dim and G heads can't split over it — every dense attention
+    path is otherwise narrow-KV-native."""
     if k.shape[2] == q.shape[2]:
         return k, v
     rep = q.shape[2] // k.shape[2]
@@ -185,7 +186,7 @@ def ring_attention(q, k, v, mesh=None, axis_name: str = "cp", causal: bool = Tru
     if axis_size == 1:
         from .attention import flash_attention
 
-        k, v = _expand_kv(q, k, v)  # dense fallback needs equal heads
+        # flash/einsum are GQA-native; narrow KV goes straight through.
         return flash_attention(q, k, v, causal=causal)
 
     if q.shape[2] % k.shape[2]:
@@ -243,13 +244,9 @@ def _ulysses_shard(q, k, v, *, axis_name: str, causal: bool, use_flash: bool):
         return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
 
     ql, kl, vl = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
-    if kl.shape[2] != ql.shape[2]:
-        # GQA KV crossed the wire unrepeated (G/cp heads per device);
-        # expand locally for the dense attention — free compared to
-        # shipping repeated heads through the all_to_all.
-        rep = ql.shape[2] // kl.shape[2]
-        kl = jnp.repeat(kl, rep, axis=2)
-        vl = jnp.repeat(vl, rep, axis=2)
+    # GQA KV crossed the wire unrepeated (G/cp heads per device) and STAYS
+    # narrow: flash indexes the shared kv head in its BlockSpecs and the
+    # einsum path contracts grouped, so no expansion on either side.
     from .attention import _einsum_attention, flash_attention, flash_attention_available
 
     if use_flash and flash_attention_available(ql):
@@ -272,7 +269,7 @@ def ulysses_attention(
     if axis_size == 1:
         from .attention import flash_attention
 
-        k, v = _expand_kv(q, k, v)  # dense fallback needs equal heads
+        # flash/einsum are GQA-native; narrow KV goes straight through.
         return flash_attention(q, k, v, causal=causal)
 
     tp = _axis_size(mesh, "tp")
