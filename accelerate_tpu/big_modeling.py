@@ -289,9 +289,16 @@ def _decoder_block_specs(cfg, block_cls, scope: str, has_aux: bool) -> list[Bloc
     import flax.linen as nn
     from .models.llama import RMSNorm
 
+    # Gemma knobs (absent on non-llama configs): sqrt(hidden) embedding
+    # scaling and zero-centered (1 + w) final-norm scales.
+    embed_scale = (cfg.hidden_size ** 0.5) if getattr(cfg, "scale_embeddings", False) else None
+    norm_unit_offset = getattr(cfg, "rms_norm_unit_offset", False)
+
     def embed_apply(ptrees, input_ids):
         embed = nn.Embed(cfg.vocab_size, cfg.hidden_size, param_dtype=jnp.float32)
         x = embed.apply({"params": ptrees[0]}, input_ids)
+        if embed_scale is not None:
+            x = x * jnp.asarray(embed_scale, x.dtype)
         positions = jnp.broadcast_to(
             jnp.arange(input_ids.shape[1], dtype=jnp.int32)[None, :], input_ids.shape)
         return x, positions
@@ -305,7 +312,8 @@ def _decoder_block_specs(cfg, block_cls, scope: str, has_aux: bool) -> list[Bloc
         return out, positions
 
     def head_apply(ptrees, x, positions):
-        h = RMSNorm(cfg.rms_norm_eps).apply({"params": ptrees[0]}, x)
+        h = RMSNorm(cfg.rms_norm_eps, unit_offset=norm_unit_offset).apply(
+            {"params": ptrees[0]}, x)
         if cfg.tie_word_embeddings:
             kernel = ptrees[1]["embedding"].T
         else:
@@ -318,6 +326,8 @@ def _decoder_block_specs(cfg, block_cls, scope: str, has_aux: bool) -> list[Bloc
         (input_ids,) = args
         embed = nn.Embed(cfg.vocab_size, cfg.hidden_size, param_dtype=jnp.float32)
         x = embed.apply({"params": ptrees[0]}, input_ids)
+        if embed_scale is not None:
+            x = x * jnp.asarray(embed_scale, x.dtype)
         positions = pos + jnp.arange(input_ids.shape[1], dtype=jnp.int32)[None, :]
         positions = jnp.broadcast_to(positions, input_ids.shape)
         return (x, positions), None
@@ -1186,8 +1196,8 @@ def load_hf_checkpoint_and_dispatch(
     refs into the original HF shards (the transpose happens at block-fetch
     time). Returns ``(streamed_model, module)``.
 
-    Supported: llama, mistral, gpt2, gptj, gpt_neox, opt (the reference's
-    big-model benchmark families), mixtral (per-expert HF shards aggregate
+    Supported: llama, mistral, qwen2, gemma, gpt2, gptj, gpt_neox, opt (the
+    reference's big-model benchmark families), mixtral (per-expert HF shards aggregate
     lazily into stacked (E, in, out) tensors — LazyStack — so even the
     disk tier never holds more than a block of experts), and t5
     (encoder-decoder; generate via ``streamed.seq2seq_generate``).
@@ -1195,7 +1205,8 @@ def load_hf_checkpoint_and_dispatch(
     from .utils.hf_interop import map_hf_key, open_hf_checkpoint
 
     family, config, module = open_hf_checkpoint(checkpoint_dir, config)
-    streamable = ("llama", "mistral", "gpt2", "gptj", "gpt_neox", "opt", "phi", "t5", "mixtral")
+    streamable = ("llama", "mistral", "qwen2", "gemma", "gpt2", "gptj", "gpt_neox",
+                  "opt", "phi", "t5", "mixtral")
     if family not in streamable:
         raise ValueError(
             f"streamed dispatch supports {'/'.join(streamable)} (got "

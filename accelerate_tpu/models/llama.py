@@ -20,6 +20,7 @@ end-to-end. Design notes:
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Any, Optional
 
 import jax
@@ -46,6 +47,18 @@ class LlamaConfig:
     # Mistral-style local attention: each token sees only the last N keys.
     sliding_window: Optional[int] = None
     tie_word_embeddings: bool = False
+    # Family knobs that turn this skeleton into Qwen2 / Gemma:
+    # Qwen2 puts biases on the q/k/v projections (never on o_proj).
+    attention_qkv_bias: bool = False
+    attention_out_bias: bool = False
+    # Gemma: GeGLU MLP ("gelu_tanh"), zero-centered RMSNorm scales (the
+    # checkpoint stores w with the norm computing 1 + w), sqrt(hidden)
+    # embedding scaling, and a head_dim decoupled from hidden/heads
+    # (gemma-7b: 16 heads x 256 = 4096 != hidden 3072).
+    mlp_activation: str = "silu"  # "silu" (SwiGLU) | "gelu_tanh" (GeGLU)
+    rms_norm_unit_offset: bool = False
+    scale_embeddings: bool = False
+    head_dim_override: Optional[int] = None
     remat: bool = False
     use_flash_attention: bool = True
     # 'auto' uses ring/Ulysses context parallelism when the ambient mesh has
@@ -86,15 +99,18 @@ class LlamaConfig:
 
     @property
     def head_dim(self):
-        """Per-head width: hidden_size // num_attention_heads."""
+        """Per-head width: hidden_size // num_attention_heads, unless the
+        family decouples it (``head_dim_override``, e.g. Gemma)."""
+        if self.head_dim_override is not None:
+            return self.head_dim_override
         return self.hidden_size // self.num_attention_heads
 
 
 def _dense_factory(cfg: "LlamaConfig", compute_dtype):
     """Projection-layer constructor honoring ``cfg.use_fp8``."""
     if not cfg.use_fp8:
-        return lambda feats, name: nn.Dense(
-            feats, use_bias=False, name=name, dtype=compute_dtype, param_dtype=jnp.float32
+        return lambda feats, name, use_bias=False: nn.Dense(
+            feats, use_bias=use_bias, name=name, dtype=compute_dtype, param_dtype=jnp.float32
         )
     from ..ops.quant import E4M3, E5M2, Fp8Dense
 
@@ -103,8 +119,8 @@ def _dense_factory(cfg: "LlamaConfig", compute_dtype):
         "E4M3": (E4M3, E4M3),
         "E5M2": (E5M2, E5M2),
     }[cfg.fp8_format]
-    return lambda feats, name: Fp8Dense(
-        feats, use_bias=False, name=name, dtype=compute_dtype,
+    return lambda feats, name, use_bias=False: Fp8Dense(
+        feats, use_bias=use_bias, name=name, dtype=compute_dtype,
         margin=cfg.fp8_margin, amax_history_len=cfg.fp8_amax_history_len,
         amax_compute_algo=cfg.fp8_amax_compute_algo, fwd_dtype=fwd, bwd_dtype=bwd,
     )
@@ -112,6 +128,9 @@ def _dense_factory(cfg: "LlamaConfig", compute_dtype):
 
 class RMSNorm(nn.Module):
     eps: float = 1e-5
+    # Gemma convention: the checkpoint stores zero-centered scales and the
+    # norm computes (1 + w) * x̂; init is zeros so a fresh model is identity.
+    unit_offset: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -119,7 +138,10 @@ class RMSNorm(nn.Module):
         x32 = x.astype(jnp.float32)
         var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
         norm = x32 * jax.lax.rsqrt(var + self.eps)
-        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],), jnp.float32)
+        init = nn.initializers.zeros if self.unit_offset else nn.initializers.ones
+        scale = self.param("scale", init, (x.shape[-1],), jnp.float32)
+        if self.unit_offset:
+            scale = 1.0 + scale
         return (norm * scale).astype(dtype)
 
 
@@ -318,9 +340,10 @@ class LlamaAttention(nn.Module):
         B, S, _ = x.shape
         n_q, n_kv, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
         dense = _dense_factory(cfg, x.dtype)
-        q = dense(n_q * hd, "q_proj")(x).reshape(B, S, n_q, hd)
-        k = dense(n_kv * hd, "k_proj")(x).reshape(B, S, n_kv, hd)
-        v = dense(n_kv * hd, "v_proj")(x).reshape(B, S, n_kv, hd)
+        qkv_bias = cfg.attention_qkv_bias
+        q = dense(n_q * hd, "q_proj", use_bias=qkv_bias)(x).reshape(B, S, n_q, hd)
+        k = dense(n_kv * hd, "k_proj", use_bias=qkv_bias)(x).reshape(B, S, n_kv, hd)
+        v = dense(n_kv * hd, "v_proj", use_bias=qkv_bias)(x).reshape(B, S, n_kv, hd)
 
         cos, sin = rotary_embedding(positions, hd, cfg.rope_theta, dtype=x.dtype,
                                     rope_scaling=cfg.rope_scaling)
@@ -333,7 +356,7 @@ class LlamaAttention(nn.Module):
                 cache, q, k, v, cache_pos, n_q // n_kv,
                 sliding_window=cfg.sliding_window)
             out = out.reshape(B, S, n_q * hd)
-            return dense(cfg.hidden_size, "o_proj")(out), new_cache
+            return dense(cfg.hidden_size, "o_proj", use_bias=cfg.attention_out_bias)(out), new_cache
 
         # GQA KV goes in unrepeated: multi_head_attention expands only for
         # the dense paths, so CP strategies move G-wide KV over ICI.
@@ -344,7 +367,7 @@ class LlamaAttention(nn.Module):
             block_q=cfg.flash_block_q, block_k=cfg.flash_block_k,
         )
         out = out.reshape(B, S, n_q * hd)
-        return dense(cfg.hidden_size, "o_proj")(out)
+        return dense(cfg.hidden_size, "o_proj", use_bias=cfg.attention_out_bias)(out)
 
 
 class LlamaMLP(nn.Module):
@@ -356,7 +379,15 @@ class LlamaMLP(nn.Module):
         dense = _dense_factory(cfg, x.dtype)
         gate = dense(cfg.intermediate_size, "gate_proj")(x)
         up = dense(cfg.intermediate_size, "up_proj")(x)
-        return dense(cfg.hidden_size, "down_proj")(jax.nn.silu(gate) * up)
+        if cfg.mlp_activation == "gelu_tanh":    # GeGLU, tanh approx (Gemma)
+            act = jax.nn.gelu(gate, approximate=True)
+        elif cfg.mlp_activation == "gelu_exact":  # GeGLU, exact erf
+            act = jax.nn.gelu(gate, approximate=False)
+        elif cfg.mlp_activation == "silu":       # SwiGLU (Llama et al.)
+            act = jax.nn.silu(gate)
+        else:
+            raise NotImplementedError(f"mlp_activation {cfg.mlp_activation!r}")
+        return dense(cfg.hidden_size, "down_proj")(act * up)
 
 
 class LlamaBlock(nn.Module):
@@ -365,7 +396,8 @@ class LlamaBlock(nn.Module):
     @nn.compact
     def __call__(self, x, positions, cache=None, cache_pos=None, segment_ids=None):
         cfg = self.config
-        attn_in = RMSNorm(cfg.rms_norm_eps, name="input_norm")(x)
+        norm = functools.partial(RMSNorm, cfg.rms_norm_eps, unit_offset=cfg.rms_norm_unit_offset)
+        attn_in = norm(name="input_norm")(x)
         attn = LlamaAttention(cfg, name="self_attn")(attn_in, positions, cache=cache,
                                                       cache_pos=cache_pos,
                                                       segment_ids=segment_ids)
@@ -373,7 +405,7 @@ class LlamaBlock(nn.Module):
         if cache is not None:
             attn, new_cache = attn
         h = x + attn
-        h = h + LlamaMLP(cfg, name="mlp")(RMSNorm(cfg.rms_norm_eps, name="post_attn_norm")(h))
+        h = h + LlamaMLP(cfg, name="mlp")(norm(name="post_attn_norm")(h))
         return h if cache is None else (h, new_cache)
 
 
@@ -396,6 +428,10 @@ class LlamaModel(nn.Module):
                 "KV-cache decode path does not apply segment masking")
         embed = nn.Embed(cfg.vocab_size, cfg.hidden_size, name="embed_tokens", param_dtype=jnp.float32)
         x = embed(input_ids)
+        if cfg.scale_embeddings:
+            # Gemma: activations enter the stack scaled by sqrt(hidden); the
+            # scalar is cast to the compute dtype first (HF rounds it to bf16).
+            x = x * jnp.asarray(cfg.hidden_size ** 0.5, x.dtype)
         block_cls = LlamaBlock
         if cfg.remat:
             block_cls = nn.remat(LlamaBlock, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
@@ -408,7 +444,7 @@ class LlamaModel(nn.Module):
                     x, positions, cache=cache[i], cache_pos=cache_pos
                 )
                 new_caches.append(layer_cache)
-        x = RMSNorm(cfg.rms_norm_eps, name="norm")(x)
+        x = RMSNorm(cfg.rms_norm_eps, unit_offset=cfg.rms_norm_unit_offset, name="norm")(x)
         return x if cache is None else (x, tuple(new_caches))
 
 
@@ -479,11 +515,13 @@ class PipelinedLlamaForCausalLM:
         embed = nn.Embed(cfg.vocab_size, cfg.hidden_size, param_dtype=jnp.float32).init(
             r_embed, jnp.zeros((1, 1), jnp.int32)
         )["params"]
+        norm_scale = (jnp.zeros if cfg.rms_norm_unit_offset else jnp.ones)(
+            (cfg.hidden_size,), jnp.float32)
         params = {
             "model": {
                 "embed_tokens": embed,
                 "blocks": blocks,
-                "norm": {"scale": jnp.ones((cfg.hidden_size,), jnp.float32)},
+                "norm": {"scale": norm_scale},
             }
         }
         if not cfg.tie_word_embeddings:
@@ -537,6 +575,8 @@ class PipelinedLlamaForCausalLM:
             positions = jnp.broadcast_to(positions, input_ids.shape)
         emb = p["model"]["embed_tokens"]["embedding"]
         x = jnp.take(emb, input_ids, axis=0)
+        if cfg.scale_embeddings:
+            x = x * jnp.asarray(cfg.hidden_size ** 0.5, x.dtype)
 
         block = LlamaBlock(cfg)
 
@@ -560,7 +600,8 @@ class PipelinedLlamaForCausalLM:
             num_microbatches=self.num_microbatches,
             remat=cfg.remat,
         )
-        x = RMSNorm(cfg.rms_norm_eps).apply({"params": p["model"]["norm"]}, x)
+        x = RMSNorm(cfg.rms_norm_eps, unit_offset=cfg.rms_norm_unit_offset).apply(
+            {"params": p["model"]["norm"]}, x)
         if return_hidden:
             return x
         if cfg.tie_word_embeddings:

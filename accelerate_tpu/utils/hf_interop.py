@@ -328,12 +328,22 @@ _VIT_RULES = [
     ("classifier.bias", "classifier/bias", "copy", None),
 ]
 
+# Qwen2: llama-named tensors plus biases on the q/k/v projections.
+_QWEN2_RULES = _LLAMA_RULES + [
+    ("model.layers.{i}.self_attn.{p}_proj.bias",
+     "model/layers_{i}/self_attn/{p}_proj/bias", "copy", ("q", "k", "v")),
+]
+
 _FAMILY_RULES = {
     "llama": _LLAMA_RULES,
     "vit": _VIT_RULES,
     # Mistral checkpoints are llama-named tensor-for-tensor; the config adds
     # sliding_window (handled in config_from_hf).
     "mistral": _LLAMA_RULES,
+    "qwen2": _QWEN2_RULES,
+    # Gemma is llama-named too; the differences (GeGLU, 1+w norms, embedding
+    # scaling, decoupled head_dim, tied head) live in config_from_hf.
+    "gemma": _LLAMA_RULES,
     "mixtral": _MIXTRAL_RULES,
     "gpt2": _GPT2_RULES,
     "gptj": _GPTJ_RULES,
@@ -357,6 +367,8 @@ _STRIP_PREFIXES = {
     "llama": (),
     "mixtral": (),
     "t5": (),
+    "qwen2": (),
+    "gemma": (),
 }
 
 # HF keys that are legitimately rule-less: tied copies and index buffers.
@@ -449,14 +461,24 @@ def config_from_hf(hf_config: dict, family: Optional[str] = None):
     HF ``config.json`` dict."""
     family = family or detect_family(hf_config)
     get = hf_config.get
-    if family in ("llama", "mistral", "mixtral"):
+    if family in ("llama", "mistral", "mixtral", "qwen2", "gemma"):
         from ..models.llama import LlamaConfig, scale_rope_frequencies
         from ..models.mixtral import MixtralConfig
 
-        act = get("hidden_act", "silu")
-        if act not in ("silu", "swish"):
-            raise NotImplementedError(
-                f"hidden_act {act!r}: the flax {family} MLP is SwiGLU (silu)")
+        if family == "gemma":
+            # transformers: an ABSENT hidden_activation is coerced to the
+            # tanh-approximate gelu (the checkpoints were trained so, even
+            # where a legacy hidden_act says "gelu"); an EXPLICIT value is
+            # honored as written — "gelu" means the exact erf form.
+            act = get("hidden_activation") or "gelu_pytorch_tanh"
+            if act not in ("gelu", "gelu_pytorch_tanh"):
+                raise NotImplementedError(
+                    f"hidden_activation {act!r}: the flax gemma MLP is GeGLU (gelu)")
+        else:
+            act = get("hidden_act", "silu")
+            if act not in ("silu", "swish"):
+                raise NotImplementedError(
+                    f"hidden_act {act!r}: the flax {family} MLP is SwiGLU (silu)")
         rope_scaling = get("rope_scaling") or None
         if rope_scaling:
             import jax.numpy as jnp
@@ -483,6 +505,30 @@ def config_from_hf(hf_config: dict, family: Optional[str] = None):
             return LlamaConfig(**kwargs, sliding_window=get("sliding_window"))
         if family == "llama":
             return LlamaConfig(**kwargs)
+        if family == "qwen2":
+            # Qwen2 biases q/k/v (never o); sliding window only when the
+            # config opts in (use_sliding_window, off by default).
+            sliding = None
+            if get("use_sliding_window"):
+                n_layers = kwargs["num_hidden_layers"]
+                if get("max_window_layers", n_layers) < n_layers:
+                    # HF windows only layers >= max_window_layers; our
+                    # sliding_window is uniform — converting would silently
+                    # change the attention pattern of the full-attention
+                    # layers (same policy as the rope/act rejections above).
+                    raise NotImplementedError(
+                        f"qwen2 max_window_layers={get('max_window_layers')} < "
+                        f"num_hidden_layers={n_layers}: per-layer window "
+                        "mixtures are not representable")
+                sliding = get("sliding_window")
+            return LlamaConfig(**kwargs, attention_qkv_bias=True, sliding_window=sliding)
+        if family == "gemma":
+            return LlamaConfig(**{**kwargs, "rms_norm_eps": get("rms_norm_eps", 1e-6),
+                                  "tie_word_embeddings": get("tie_word_embeddings", True)},
+                               mlp_activation="gelu_tanh" if act == "gelu_pytorch_tanh"
+                                              else "gelu_exact",
+                               rms_norm_unit_offset=True,
+                               scale_embeddings=True, head_dim_override=get("head_dim"))
         return MixtralConfig(**kwargs,
                              sliding_window=get("sliding_window"),
                              num_experts=get("num_local_experts", 8),
@@ -675,7 +721,7 @@ def model_from_config(config, family: str):
     """Instantiate the flax module matching a converted config — the single
     family→model-class switch shared by the streamed HF dispatch
     (big_modeling) and the memory estimator (commands/estimate)."""
-    if family in ("llama", "mistral"):
+    if family in ("llama", "mistral", "qwen2", "gemma"):
         from ..models.llama import LlamaForCausalLM
 
         return LlamaForCausalLM(config)
@@ -776,17 +822,24 @@ def convert_hf_state_dict(
             return v.detach().cpu().numpy()
         return np.asarray(v)
 
+    def drop_tied_duplicate(head_key: str, ref_key: str) -> None:
+        # Tied checkpoints carry the head as a duplicate of the embedding;
+        # the tied flax model has no lm_head param, so drop it. A genuinely
+        # *untied* head converts via the lm_head rule and requires
+        # config.tie_word_embeddings=False. First-row precheck so untied
+        # loads (e.g. a 1 GB 70B head) don't pay a full elementwise compare.
+        head, ref = state_dict.get(head_key), state_dict.get(ref_key)
+        if head is None or ref is None:
+            return
+        h, r = as_np(head), as_np(ref)
+        if h.shape == r.shape and np.array_equal(h[:1], r[:1]) and np.array_equal(h, r):
+            drop_keys.add(head_key)
+
     if family == "t5":
-        # Tied checkpoints carry lm_head.weight as a duplicate of
-        # shared.weight; the tied flax model has no lm_head param, so drop
-        # it. A genuinely *untied* head (t5-v1.1/flan) converts via the
-        # lm_head rule and requires config.tie_word_embeddings=False.
-        head = state_dict.get("lm_head.weight")
-        shared = state_dict.get("shared.weight")
-        if head is not None and shared is not None and np.array_equal(
-            as_np(head), as_np(shared)
-        ):
-            drop_keys.add("lm_head.weight")
+        drop_tied_duplicate("lm_head.weight", "shared.weight")
+    if family in ("llama", "mistral", "qwen2", "gemma"):
+        # gemma always ties; small qwen2/llama variants often do.
+        drop_tied_duplicate("lm_head.weight", "model.embed_tokens.weight")
 
     for raw_key, raw_value in state_dict.items():
         if raw_key in drop_keys:

@@ -1225,3 +1225,173 @@ class TestErrors:
                     np.ones(shape, np.float32))
         with pytest.raises(KeyError, match=r"missing experts \[3\]"):
             convert_hf_state_dict(sd, "mixtral")
+
+
+class TestQwen2:
+    """Qwen2 = llama skeleton + q/k/v projection biases."""
+
+    def _pair(self, tie=False):
+        hf_cfg = transformers.Qwen2Config(
+            vocab_size=128, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=64, rms_norm_eps=1e-5,
+            tie_word_embeddings=tie, use_sliding_window=False)
+        torch.manual_seed(0)
+        with torch.no_grad():
+            hf = transformers.Qwen2ForCausalLM(hf_cfg).eval()
+        cfg = config_from_hf(hf_cfg.to_dict())
+        assert cfg.attention_qkv_bias and not cfg.attention_out_bias
+        assert cfg.sliding_window is None
+        from accelerate_tpu.models.llama import LlamaForCausalLM
+
+        cfg.use_flash_attention = False
+        params = convert_hf_state_dict(hf.state_dict(), "qwen2", strict=True)
+        return hf, LlamaForCausalLM(cfg), params
+
+    def test_forward_parity(self):
+        hf, model, params = self._pair()
+        ids = np.arange(24, dtype=np.int64).reshape(2, 12) % 128
+        ours = model.apply({"params": params}, jnp.asarray(ids, jnp.int32))
+        with torch.no_grad():
+            theirs = hf(torch.from_numpy(ids)).logits
+        _logits_close(ours, theirs)
+
+    def test_greedy_decode_parity(self):
+        from accelerate_tpu.generation import generate
+
+        hf, model, params = self._pair()
+        ids = (np.arange(8, dtype=np.int64)[None] * 5) % 128
+        ours = np.asarray(generate(model, params, jnp.asarray(ids, jnp.int32),
+                                   max_new_tokens=8, cache_dtype=jnp.float32))
+        with torch.no_grad():
+            theirs = hf.generate(torch.from_numpy(ids).long(), max_new_tokens=8,
+                                 do_sample=False)
+        np.testing.assert_array_equal(ours, theirs.numpy())
+
+    def test_tied_head_duplicate_dropped(self):
+        hf, model, params = self._pair(tie=True)
+        assert "lm_head" not in params
+        ids = np.arange(12, dtype=np.int64).reshape(1, 12) % 128
+        ours = model.apply({"params": params}, jnp.asarray(ids, jnp.int32))
+        with torch.no_grad():
+            theirs = hf(torch.from_numpy(ids)).logits
+        _logits_close(ours, theirs)
+
+    def test_roundtrip(self):
+        hf, _, params = self._pair()
+        _roundtrip(params, "qwen2", hf.state_dict())
+
+
+class TestGemma:
+    """Gemma = llama skeleton + GeGLU, (1+w) norms, sqrt(hidden) embedding
+    scaling, decoupled head_dim, always-tied head."""
+
+    def _pair(self):
+        hf_cfg = transformers.GemmaConfig(
+            vocab_size=128, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            head_dim=16, max_position_embeddings=64, rms_norm_eps=1e-6,
+            hidden_activation="gelu_pytorch_tanh")
+        torch.manual_seed(0)
+        with torch.no_grad():
+            hf = transformers.GemmaForCausalLM(hf_cfg).eval()
+        cfg = config_from_hf(hf_cfg.to_dict())
+        assert cfg.rms_norm_unit_offset and cfg.scale_embeddings
+        assert cfg.mlp_activation == "gelu_tanh"
+        assert cfg.head_dim == 16 and cfg.tie_word_embeddings
+        from accelerate_tpu.models.llama import LlamaForCausalLM
+
+        cfg.use_flash_attention = False
+        params = convert_hf_state_dict(hf.state_dict(), "gemma", strict=True)
+        assert "lm_head" not in params  # tied duplicate dropped
+        return hf, LlamaForCausalLM(cfg), params
+
+    def test_forward_parity(self):
+        hf, model, params = self._pair()
+        ids = np.arange(24, dtype=np.int64).reshape(2, 12) % 128
+        ours = model.apply({"params": params}, jnp.asarray(ids, jnp.int32))
+        with torch.no_grad():
+            theirs = hf(torch.from_numpy(ids)).logits
+        _logits_close(ours, theirs)
+
+    def test_greedy_decode_parity(self):
+        from accelerate_tpu.generation import generate
+
+        hf, model, params = self._pair()
+        ids = (np.arange(8, dtype=np.int64)[None] * 7) % 128
+        ours = np.asarray(generate(model, params, jnp.asarray(ids, jnp.int32),
+                                   max_new_tokens=8, cache_dtype=jnp.float32))
+        with torch.no_grad():
+            theirs = hf.generate(torch.from_numpy(ids).long(), max_new_tokens=8,
+                                 do_sample=False)
+        np.testing.assert_array_equal(ours, theirs.numpy())
+
+    def test_roundtrip(self):
+        hf, _, params = self._pair()
+        _roundtrip(params, "gemma", hf.state_dict())
+
+    def test_explicit_exact_gelu_honored(self):
+        # An EXPLICIT hidden_activation="gelu" means the exact erf form in
+        # transformers; parity must hold (not be coerced to tanh).
+        hf_cfg = transformers.GemmaConfig(
+            vocab_size=128, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            head_dim=16, max_position_embeddings=64, rms_norm_eps=1e-6,
+            hidden_activation="gelu")
+        torch.manual_seed(1)
+        with torch.no_grad():
+            hf = transformers.GemmaForCausalLM(hf_cfg).eval()
+        cfg = config_from_hf(hf_cfg.to_dict())
+        assert cfg.mlp_activation == "gelu_exact"
+        from accelerate_tpu.models.llama import LlamaForCausalLM
+
+        cfg.use_flash_attention = False
+        params = convert_hf_state_dict(hf.state_dict(), "gemma", strict=True)
+        ids = np.arange(12, dtype=np.int64).reshape(1, 12) % 128
+        ours = LlamaForCausalLM(cfg).apply({"params": params}, jnp.asarray(ids, jnp.int32))
+        with torch.no_grad():
+            theirs = hf(torch.from_numpy(ids)).logits
+        _logits_close(ours, theirs)
+
+    def test_streamed_dispatch(self, tmp_path):
+        # The big-model executor must honor gemma's embedding scaling,
+        # (1+w) final norm, and tied head block-by-block.
+        import json as _json
+
+        from safetensors.numpy import save_file
+
+        from accelerate_tpu import load_hf_checkpoint_and_dispatch
+
+        hf, model, params = self._pair()
+        d = tmp_path / "gemma"
+        d.mkdir()
+        save_file({k: v.numpy() for k, v in hf.state_dict().items()},
+                  str(d / "model.safetensors"))
+        _json.dump(hf.config.to_dict(), open(d / "config.json", "w"))
+        streamed, module = load_hf_checkpoint_and_dispatch(
+            str(d), device_map={"": "disk"}, dtype=jnp.float32)
+        ids = np.arange(1, 9, dtype=np.int32)[None]
+        ours = np.asarray(streamed.generate(ids, max_new_tokens=5))
+        with torch.no_grad():
+            theirs = hf.generate(torch.from_numpy(ids).long(), max_new_tokens=5,
+                                 do_sample=False)
+        np.testing.assert_array_equal(ours, theirs.numpy())
+
+
+class TestQwen2WindowMixture:
+    def test_partial_window_layers_rejected(self):
+        cfg = dict(model_type="qwen2", vocab_size=128, hidden_size=32,
+                   intermediate_size=64, num_hidden_layers=4,
+                   num_attention_heads=4, num_key_value_heads=2,
+                   use_sliding_window=True, sliding_window=16,
+                   max_window_layers=2)
+        with pytest.raises(NotImplementedError, match="max_window_layers"):
+            config_from_hf(cfg)
+
+    def test_full_window_layers_accepted(self):
+        cfg = dict(model_type="qwen2", vocab_size=128, hidden_size=32,
+                   intermediate_size=64, num_hidden_layers=4,
+                   num_attention_heads=4, num_key_value_heads=2,
+                   use_sliding_window=True, sliding_window=16,
+                   max_window_layers=4)
+        assert config_from_hf(cfg).sliding_window == 16
