@@ -289,10 +289,12 @@ def _decoder_block_specs(cfg, block_cls, scope: str, has_aux: bool) -> list[Bloc
     import flax.linen as nn
     from .models.llama import RMSNorm
 
-    # Gemma knobs (absent on non-llama configs): sqrt(hidden) embedding
-    # scaling and zero-centered (1 + w) final-norm scales.
+    # Gemma/Gemma2 knobs (absent on non-llama configs): sqrt(hidden)
+    # embedding scaling, zero-centered (1 + w) final-norm scales, final
+    # logit softcapping, and per-layer attention structure (layer_windows).
     embed_scale = (cfg.hidden_size ** 0.5) if getattr(cfg, "scale_embeddings", False) else None
     norm_unit_offset = getattr(cfg, "rms_norm_unit_offset", False)
+    final_softcap = getattr(cfg, "final_logit_softcapping", None)
 
     def embed_apply(ptrees, input_ids):
         embed = nn.Embed(cfg.vocab_size, cfg.hidden_size, param_dtype=jnp.float32)
@@ -303,13 +305,26 @@ def _decoder_block_specs(cfg, block_cls, scope: str, has_aux: bool) -> list[Bloc
             jnp.arange(input_ids.shape[1], dtype=jnp.int32)[None, :], input_ids.shape)
         return x, positions
 
-    block = block_cls(cfg)
+    # One block instance per layer: layer structure can differ (Gemma2's
+    # local/global window mixture keys off layer_idx). Field introspection,
+    # not try/except — an unrelated TypeError must not silently degrade
+    # every layer to layer_idx=0.
+    import dataclasses as _dc
 
-    def layer_apply(ptrees, x, positions):
-        out = block.apply({"params": ptrees[0]}, x, positions)
-        if has_aux:
-            out, _aux = out
-        return out, positions
+    takes_layer_idx = "layer_idx" in {f.name for f in _dc.fields(block_cls)}
+
+    def make_block(i):
+        return block_cls(cfg, layer_idx=i) if takes_layer_idx else block_cls(cfg)
+
+    blocks = [make_block(i) for i in range(cfg.num_hidden_layers)]
+
+    def layer_apply_for(block):
+        def layer_apply(ptrees, x, positions):
+            out = block.apply({"params": ptrees[0]}, x, positions)
+            if has_aux:
+                out, _aux = out
+            return out, positions
+        return layer_apply
 
     def head_apply(ptrees, x, positions):
         h = RMSNorm(cfg.rms_norm_eps, unit_offset=norm_unit_offset).apply(
@@ -318,7 +333,10 @@ def _decoder_block_specs(cfg, block_cls, scope: str, has_aux: bool) -> list[Bloc
             kernel = ptrees[1]["embedding"].T
         else:
             kernel = ptrees[1]["kernel"]
-        return h @ kernel.astype(h.dtype)
+        from .ops.attention import softcap_logits
+
+        logits = h @ kernel.astype(h.dtype)
+        return softcap_logits(logits, final_softcap)
 
     # KV-cached decode forms (StreamedModel.generate). ``pos`` is a traced
     # scalar, so every decode token reuses one executable per block kind.
@@ -332,14 +350,16 @@ def _decoder_block_specs(cfg, block_cls, scope: str, has_aux: bool) -> list[Bloc
         positions = jnp.broadcast_to(positions, input_ids.shape)
         return (x, positions), None
 
-    def layer_cached(ptrees, args, cache, pos):
-        x, positions = args
-        out = block.apply({"params": ptrees[0]}, x, positions, cache=cache, cache_pos=pos)
-        if has_aux:
-            x, _aux, new_cache = out
-        else:
-            x, new_cache = out
-        return (x, positions), new_cache
+    def layer_cached_for(block):
+        def layer_cached(ptrees, args, cache, pos):
+            x, positions = args
+            out = block.apply({"params": ptrees[0]}, x, positions, cache=cache, cache_pos=pos)
+            if has_aux:
+                x, _aux, new_cache = out
+            else:
+                x, new_cache = out
+            return (x, positions), new_cache
+        return layer_cached
 
     def head_cached(ptrees, args, cache, pos):
         x, positions = args
@@ -350,9 +370,16 @@ def _decoder_block_specs(cfg, block_cls, scope: str, has_aux: bool) -> list[Bloc
                   cached_apply=embed_cached)
     ]
     for i in range(cfg.num_hidden_layers):
-        specs.append(BlockSpec(f"layers_{i}", (f"{scope}layers_{i}",), layer_apply,
-                               kind="layer", cache_slot=True,
-                               cached_apply=layer_cached))
+        # Blocks sharing `kind` share one jitted executable, so per-layer
+        # structure MUST split the kind: Gemma2's local/global mixture gets
+        # one executable per distinct window (2 total), not one mis-shared
+        # trace for all layers.
+        window = cfg.window_for(i) if hasattr(cfg, "window_for") else None
+        kind = "layer" if window is None else f"layer_w{window}"
+        specs.append(BlockSpec(f"layers_{i}", (f"{scope}layers_{i}",),
+                               layer_apply_for(blocks[i]),
+                               kind=kind, cache_slot=True,
+                               cached_apply=layer_cached_for(blocks[i])))
     head_prefixes = ((f"{scope}norm", f"{scope}embed_tokens") if cfg.tie_word_embeddings
                      else (f"{scope}norm", "lm_head"))
     specs.append(BlockSpec("head", head_prefixes, head_apply, kind="head",
@@ -1205,8 +1232,8 @@ def load_hf_checkpoint_and_dispatch(
     from .utils.hf_interop import map_hf_key, open_hf_checkpoint
 
     family, config, module = open_hf_checkpoint(checkpoint_dir, config)
-    streamable = ("llama", "mistral", "qwen2", "gemma", "gpt2", "gptj", "gpt_neox",
-                  "opt", "phi", "t5", "mixtral")
+    streamable = ("llama", "mistral", "qwen2", "gemma", "gemma2", "gpt2", "gptj",
+                  "gpt_neox", "opt", "phi", "t5", "mixtral")
     if family not in streamable:
         raise ValueError(
             f"streamed dispatch supports {'/'.join(streamable)} (got "

@@ -55,10 +55,22 @@ class LlamaConfig:
     # checkpoint stores w with the norm computing 1 + w), sqrt(hidden)
     # embedding scaling, and a head_dim decoupled from hidden/heads
     # (gemma-7b: 16 heads x 256 = 4096 != hidden 3072).
-    mlp_activation: str = "silu"  # "silu" (SwiGLU) | "gelu_tanh" (GeGLU)
+    mlp_activation: str = "silu"  # "silu" (SwiGLU) | "gelu_tanh"/"gelu_exact" (GeGLU)
     rms_norm_unit_offset: bool = False
     scale_embeddings: bool = False
     head_dim_override: Optional[int] = None
+    # Gemma2: per-layer attention patterns and sandwich norms.
+    # layer_windows[i] is layer i's sliding window (None = full attention) —
+    # built from the HF config's layer_types; overrides the uniform
+    # sliding_window when set. post_norms adds the 4-norm block (attn/mlp
+    # outputs normed before their residual adds). Softcaps bound logits via
+    # cap * tanh(x / cap); query_pre_attn_scalar replaces head_dim in the
+    # attention scale.
+    layer_windows: Optional[tuple] = None
+    post_norms: bool = False
+    attn_logit_softcapping: Optional[float] = None
+    final_logit_softcapping: Optional[float] = None
+    query_pre_attn_scalar: Optional[float] = None
     remat: bool = False
     use_flash_attention: bool = True
     # 'auto' uses ring/Ulysses context parallelism when the ambient mesh has
@@ -104,6 +116,18 @@ class LlamaConfig:
         if self.head_dim_override is not None:
             return self.head_dim_override
         return self.hidden_size // self.num_attention_heads
+
+    @property
+    def sm_scale(self):
+        """Attention logit scale: 1/sqrt(query_pre_attn_scalar or head_dim)."""
+        base = self.query_pre_attn_scalar
+        return (base if base is not None else self.head_dim) ** -0.5
+
+    def window_for(self, layer_idx: int):
+        """Layer ``layer_idx``'s sliding window (None = full attention)."""
+        if self.layer_windows is not None:
+            return self.layer_windows[layer_idx]
+        return self.sliding_window
 
 
 def _dense_factory(cfg: "LlamaConfig", compute_dtype):
@@ -195,8 +219,14 @@ def multi_head_attention(
     q, k, v, causal: bool = True, use_flash: bool = True, segment_ids=None,
     backend: str = "auto", sliding_window: Optional[int] = None,
     block_q: int = 128, block_k: int = 128,
+    sm_scale: Optional[float] = None, logit_softcap: Optional[float] = None,
 ):
     """Dispatch between the attention implementations in ops/.
+
+    ``logit_softcap`` (Gemma2) bounds logits via cap * tanh(s / cap) and
+    routes to the einsum path (the flash kernel has no softcap; the CP
+    strategies reject it). ``sm_scale`` overrides the 1/sqrt(head_dim)
+    logit scale (Gemma2's query_pre_attn_scalar).
 
     ``sliding_window`` (Mistral) narrower than the sequence routes to the
     *windowed* flash kernel (banded grid — O(S*w) compute and HBM traffic)
@@ -224,6 +254,14 @@ def multi_head_attention(
         raise ValueError(
             f"unknown attention_backend {backend!r}; expected auto/ring/ulysses/flash/einsum"
         )
+    if logit_softcap is not None:
+        # Softcapped logits exist only on the einsum path; the CP strategies
+        # must reject rather than silently drop the cap.
+        if backend in ("ring", "ulysses"):
+            raise ValueError(f"attention_backend={backend!r} does not support logit_softcap")
+        return _einsum_attention(q, k, v, causal=causal, segment_ids=segment_ids,
+                                 sliding_window=sliding_window, sm_scale=sm_scale,
+                                 logit_softcap=logit_softcap)
     # GQA: every path is narrow-KV-native — the flash kernel indexes the
     # shared kv head in its BlockSpecs, the einsum path contracts grouped,
     # and the CP paths rotate G-wide KV over the interconnect. The expanded
@@ -242,10 +280,12 @@ def multi_head_attention(
         if backend != "einsum" and use_flash and segment_ids is None and causal:
             return flash_attention(q, k, v, causal=True,
                                    sliding_window=sliding_window,
-                                   block_q=block_q, block_k=block_k)
+                                   block_q=block_q, block_k=block_k,
+                                   sm_scale=sm_scale)
         return _einsum_attention(q, k, v, causal=causal,
                                  segment_ids=segment_ids,
-                                 sliding_window=sliding_window)
+                                 sliding_window=sliding_window,
+                                 sm_scale=sm_scale)
     if backend in ("auto", "ring", "ulysses"):
         from ..ops.ring_attention import (
             _axis_size,
@@ -256,9 +296,12 @@ def multi_head_attention(
 
         if segment_ids is not None and backend != "auto":
             raise ValueError(f"attention_backend={backend!r} does not support segment_ids")
+        if sm_scale is not None and backend != "auto":
+            raise ValueError(f"attention_backend={backend!r} does not support sm_scale")
         mesh = _resolve_mesh(None)
         cp = _axis_size(mesh, "cp")
-        if backend != "auto" or (cp > 1 and segment_ids is None and q.shape[1] % cp == 0):
+        if backend != "auto" or (cp > 1 and segment_ids is None and sm_scale is None
+                                 and q.shape[1] % cp == 0):
             if cp > 1:
                 # GQA KV stays unrepeated here: the ring rotates (and
                 # Ulysses all_to_alls) G-wide KV over the interconnect,
@@ -274,8 +317,9 @@ def multi_head_attention(
         # training keeps flash's memory asymptotics.
         return flash_attention(q, k, v, causal=causal,
                                block_q=block_q, block_k=block_k,
-                               segment_ids=segment_ids)
-    return _einsum_attention(q, k, v, causal=causal, segment_ids=segment_ids)
+                               segment_ids=segment_ids, sm_scale=sm_scale)
+    return _einsum_attention(q, k, v, causal=causal, segment_ids=segment_ids,
+                             sm_scale=sm_scale)
 
 
 def init_kv_cache(config: "LlamaConfig", batch_size: int, max_len: int, dtype=jnp.bfloat16):
@@ -289,7 +333,8 @@ def init_kv_cache(config: "LlamaConfig", batch_size: int, max_len: int, dtype=jn
     )
 
 
-def _cached_attention(q, k_all, v_all, cache_pos, n_rep: int, sliding_window=None):
+def _cached_attention(q, k_all, v_all, cache_pos, n_rep: int, sliding_window=None,
+                      sm_scale=None, logit_softcap=None):
     """Attention of q [B, S, H, hd] against the full cache [B, L, n_kv, hd].
 
     Valid keys are those at global index <= cache_pos + (local query index):
@@ -303,8 +348,12 @@ def _cached_attention(q, k_all, v_all, cache_pos, n_rep: int, sliding_window=Non
     """
     B, S, H, hd = q.shape
     L = k_all.shape[1]
-    qg = (q * hd**-0.5).astype(jnp.float32).reshape(B, S, H // n_rep, n_rep, hd)
+    scale = hd**-0.5 if sm_scale is None else sm_scale
+    qg = (q * scale).astype(jnp.float32).reshape(B, S, H // n_rep, n_rep, hd)
     logits = jnp.einsum("bqgrd,bkgd->bgrqk", qg, k_all.astype(jnp.float32))
+    from ..ops.attention import softcap_logits
+
+    logits = softcap_logits(logits, logit_softcap)
     q_pos = cache_pos + jnp.arange(S, dtype=jnp.int32)
     k_pos = jnp.arange(L, dtype=jnp.int32)[None, :]
     mask = k_pos <= q_pos[:, None]
@@ -316,7 +365,8 @@ def _cached_attention(q, k_all, v_all, cache_pos, n_rep: int, sliding_window=Non
     return out.reshape(B, S, H, hd).astype(q.dtype)
 
 
-def update_kv_cache_and_attend(cache, q, k, v, cache_pos, n_rep: int, sliding_window=None):
+def update_kv_cache_and_attend(cache, q, k, v, cache_pos, n_rep: int, sliding_window=None,
+                               sm_scale=None, logit_softcap=None):
     """Write this call's K/V into the cache at ``cache_pos`` and attend q
     against the whole buffer. Shared by every cached attention (Llama, GPT-2).
     Returns (out [B,S,H,hd], new_cache)."""
@@ -326,12 +376,17 @@ def update_kv_cache_and_attend(cache, q, k, v, cache_pos, n_rep: int, sliding_wi
         "v": jax.lax.dynamic_update_slice(cache["v"], v.astype(cache["v"].dtype), start),
     }
     out = _cached_attention(q, new_cache["k"], new_cache["v"], cache_pos, n_rep,
-                            sliding_window=sliding_window)
+                            sliding_window=sliding_window, sm_scale=sm_scale,
+                            logit_softcap=logit_softcap)
     return out, new_cache
 
 
 class LlamaAttention(nn.Module):
     config: LlamaConfig
+    # Per-layer sliding window: the sentinel "config" reads the uniform
+    # cfg.sliding_window (every pre-layer_windows caller, incl. mixtral);
+    # LlamaBlock passes cfg.window_for(layer_idx) for Gemma2-style mixtures.
+    window: Any = "config"
 
     @nn.compact
     def __call__(self, x, positions, causal=True, cache=None, cache_pos=None,
@@ -350,21 +405,28 @@ class LlamaAttention(nn.Module):
         q = apply_rotary(q, cos, sin)
         k = apply_rotary(k, cos, sin)
 
+        window = cfg.sliding_window if self.window == "config" else self.window
+        # query_pre_attn_scalar / softcap default to the vanilla scale / no
+        # cap, so non-Gemma2 configs hit the identical fast paths as before.
+        sm_scale = None if cfg.query_pre_attn_scalar is None else cfg.sm_scale
+        softcap = cfg.attn_logit_softcapping
+
         if cache is not None:
             # KV-cached path (generate).
             out, new_cache = update_kv_cache_and_attend(
                 cache, q, k, v, cache_pos, n_q // n_kv,
-                sliding_window=cfg.sliding_window)
+                sliding_window=window, sm_scale=sm_scale, logit_softcap=softcap)
             out = out.reshape(B, S, n_q * hd)
             return dense(cfg.hidden_size, "o_proj", use_bias=cfg.attention_out_bias)(out), new_cache
 
-        # GQA KV goes in unrepeated: multi_head_attention expands only for
-        # the dense paths, so CP strategies move G-wide KV over ICI.
+        # GQA KV goes in unrepeated: every dense path is narrow-KV-native,
+        # and CP strategies move G-wide KV over ICI.
         out = multi_head_attention(
             q, k, v, causal=causal, use_flash=cfg.use_flash_attention,
             segment_ids=segment_ids,
-            backend=cfg.attention_backend, sliding_window=cfg.sliding_window,
+            backend=cfg.attention_backend, sliding_window=window,
             block_q=cfg.flash_block_q, block_k=cfg.flash_block_k,
+            sm_scale=sm_scale, logit_softcap=softcap,
         )
         out = out.reshape(B, S, n_q * hd)
         return dense(cfg.hidden_size, "o_proj", use_bias=cfg.attention_out_bias)(out)
@@ -392,20 +454,29 @@ class LlamaMLP(nn.Module):
 
 class LlamaBlock(nn.Module):
     config: LlamaConfig
+    layer_idx: int = 0
 
     @nn.compact
     def __call__(self, x, positions, cache=None, cache_pos=None, segment_ids=None):
         cfg = self.config
         norm = functools.partial(RMSNorm, cfg.rms_norm_eps, unit_offset=cfg.rms_norm_unit_offset)
         attn_in = norm(name="input_norm")(x)
-        attn = LlamaAttention(cfg, name="self_attn")(attn_in, positions, cache=cache,
-                                                      cache_pos=cache_pos,
-                                                      segment_ids=segment_ids)
+        attn = LlamaAttention(cfg, window=cfg.window_for(self.layer_idx),
+                              name="self_attn")(attn_in, positions, cache=cache,
+                                                cache_pos=cache_pos,
+                                                segment_ids=segment_ids)
         new_cache = None
         if cache is not None:
             attn, new_cache = attn
-        h = x + attn
-        h = h + LlamaMLP(cfg, name="mlp")(norm(name="post_attn_norm")(h))
+        if cfg.post_norms:
+            # Gemma2 sandwich block: sublayer OUTPUTS are normed before their
+            # residual adds, and the MLP gets its own pre-norm.
+            h = x + norm(name="post_attn_norm")(attn)
+            mlp_in = norm(name="pre_ffn_norm")(h)
+            h = h + norm(name="post_ffn_norm")(LlamaMLP(cfg, name="mlp")(mlp_in))
+        else:
+            h = x + attn
+            h = h + LlamaMLP(cfg, name="mlp")(norm(name="post_attn_norm")(h))
         return h if cache is None else (h, new_cache)
 
 
@@ -438,9 +509,10 @@ class LlamaModel(nn.Module):
         new_caches = []
         for i in range(cfg.num_hidden_layers):
             if cache is None:
-                x = block_cls(cfg, name=f"layers_{i}")(x, positions, segment_ids=segment_ids)
+                x = block_cls(cfg, layer_idx=i, name=f"layers_{i}")(
+                    x, positions, segment_ids=segment_ids)
             else:
-                x, layer_cache = block_cls(cfg, name=f"layers_{i}")(
+                x, layer_cache = block_cls(cfg, layer_idx=i, name=f"layers_{i}")(
                     x, positions, cache=cache[i], cache_pos=cache_pos
                 )
                 new_caches.append(layer_cache)
@@ -472,6 +544,9 @@ class LlamaForCausalLM(nn.Module):
             # feeds the softmax directly (standard TE practice).
             logits = nn.Dense(cfg.vocab_size, use_bias=False, name="lm_head", dtype=x.dtype,
                               param_dtype=jnp.float32)(x)
+        from ..ops.attention import softcap_logits
+
+        logits = softcap_logits(logits, cfg.final_logit_softcapping)
         return logits if cache is None else (logits, new_cache)
 
     def init_params(self, rng, batch_size=1, seq_len=8):
@@ -498,6 +573,11 @@ class PipelinedLlamaForCausalLM:
     """
 
     def __init__(self, config: LlamaConfig, num_microbatches: Optional[int] = None):
+        if config.layer_windows is not None and len(set(config.layer_windows)) > 1:
+            raise NotImplementedError(
+                "PipelinedLlamaForCausalLM scans one block over stacked params; "
+                "heterogeneous per-layer windows (layer_windows) need the "
+                "sequential LlamaForCausalLM")
         self.config = config
         self.num_microbatches = num_microbatches
 
@@ -604,9 +684,13 @@ class PipelinedLlamaForCausalLM:
             {"params": p["model"]["norm"]}, x)
         if return_hidden:
             return x
+        from ..ops.attention import softcap_logits
+
         if cfg.tie_word_embeddings:
-            return x @ emb.T.astype(x.dtype)
-        return x @ p["lm_head"]["kernel"].astype(x.dtype)
+            logits = x @ emb.T.astype(x.dtype)
+        else:
+            logits = x @ p["lm_head"]["kernel"].astype(x.dtype)
+        return softcap_logits(logits, cfg.final_logit_softcapping)
 
     __call__ = apply
 
@@ -665,6 +749,11 @@ def fused_causal_lm_loss(module, num_chunks: int = 8):
     from ..ops.fused_loss import chunked_softmax_xent
 
     cfg = module.config
+
+    if cfg.final_logit_softcapping is not None:
+        raise NotImplementedError(
+            "fused_causal_lm_loss computes the head chunk-by-chunk and does "
+            "not apply final_logit_softcapping; use causal_lm_loss")
 
     def loss_fn(params, batch, rng=None):
         p = params["params"] if isinstance(params, dict) and "params" in params else params

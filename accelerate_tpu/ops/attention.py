@@ -32,7 +32,18 @@ def flash_attention_available(q=None) -> bool:
     return True
 
 
-def _einsum_attention(q, k, v, causal: bool, segment_ids=None, sliding_window=None):
+def softcap_logits(logits, cap):
+    """Gemma2-style logit bounding: ``cap * tanh(logits / cap)`` computed in
+    fp32, returned in the input dtype. ``cap=None`` is the identity — the
+    single implementation every softcap site shares (einsum path, cached
+    decode, both model heads, the streamed executor's head)."""
+    if cap is None:
+        return logits
+    return (cap * jnp.tanh(logits.astype(jnp.float32) / cap)).astype(logits.dtype)
+
+
+def _einsum_attention(q, k, v, causal: bool, segment_ids=None, sliding_window=None,
+                      sm_scale=None, logit_softcap=None):
     """XLA-fused reference path: [B, S, H, D] -> [B, S, H, D].
 
     GQA-native: when k/v carry fewer heads (``G`` with ``H = G * rep``) the
@@ -40,8 +51,10 @@ def _einsum_attention(q, k, v, causal: bool, segment_ids=None, sliding_window=No
     copy is ever materialized (same trick as llama._cached_attention).
 
     ``sliding_window=w`` (Mistral-style) restricts each query to the last
-    ``w`` keys: k_pos in (q_pos - w, q_pos]."""
-    scale = q.shape[-1] ** -0.5
+    ``w`` keys: k_pos in (q_pos - w, q_pos]. ``sm_scale`` overrides the
+    1/sqrt(head_dim) logit scale; ``logit_softcap`` bounds logits via
+    cap * tanh(s / cap) before masking (Gemma2)."""
+    scale = q.shape[-1] ** -0.5 if sm_scale is None else sm_scale
     B, Sq, H, D = q.shape
     G = k.shape[2]
     if H != G:
@@ -51,6 +64,7 @@ def _einsum_attention(q, k, v, causal: bool, segment_ids=None, sliding_window=No
         logits = jnp.einsum("bqgrd,bkgd->bgrqk", qg, k)
     else:
         logits = jnp.einsum("bqhd,bkhd->bhqk", q * scale, k)
+    logits = softcap_logits(logits, logit_softcap)
     head_dims = logits.ndim - 3  # axes between batch and [q, k]
     big_neg = jnp.finfo(logits.dtype).min
     if causal or sliding_window is not None:
@@ -77,13 +91,13 @@ def _einsum_attention(q, k, v, causal: bool, segment_ids=None, sliding_window=No
 
 
 def flash_attention(q, k, v, causal: bool = True, block_q: int = 128, block_k: int = 128,
-                    sliding_window=None, segment_ids=None):
+                    sliding_window=None, segment_ids=None, sm_scale=None):
     """Flash attention entry point.
 
     Args are [batch, seq, heads, head_dim]. Dispatches to the Pallas kernel
     on TPU; einsum fallback elsewhere. ``segment_ids`` (packed sequences)
     are masked inside the kernel; the sliding_window+segments combination
-    routes to the einsum path.
+    routes to the einsum path. ``sm_scale`` overrides 1/sqrt(head_dim).
     """
     if sliding_window is not None and not causal:
         # Validated here (not just in the kernel) so CPU-fallback runs fail
@@ -93,8 +107,9 @@ def flash_attention(q, k, v, causal: bool = True, block_q: int = 128, block_k: i
         sliding_window is not None and segment_ids is not None
     ):
         return _einsum_attention(q, k, v, causal, segment_ids=segment_ids,
-                                 sliding_window=sliding_window)
+                                 sliding_window=sliding_window, sm_scale=sm_scale)
     from .flash_pallas import pallas_flash_attention
 
     return pallas_flash_attention(q, k, v, causal=causal, block_q=block_q, block_k=block_k,
-                                  sliding_window=sliding_window, segment_ids=segment_ids)
+                                  sliding_window=sliding_window, segment_ids=segment_ids,
+                                  sm_scale=sm_scale)

@@ -334,6 +334,16 @@ _QWEN2_RULES = _LLAMA_RULES + [
      "model/layers_{i}/self_attn/{p}_proj/bias", "copy", ("q", "k", "v")),
 ]
 
+# Gemma2: llama-named tensors plus the sandwich-norm pair around the MLP
+# (input/post_attention norms reuse the llama rules; semantics switch on
+# LlamaConfig.post_norms).
+_GEMMA2_RULES = _LLAMA_RULES + [
+    ("model.layers.{i}.pre_feedforward_layernorm.weight",
+     "model/layers_{i}/pre_ffn_norm/scale", "copy", None),
+    ("model.layers.{i}.post_feedforward_layernorm.weight",
+     "model/layers_{i}/post_ffn_norm/scale", "copy", None),
+]
+
 _FAMILY_RULES = {
     "llama": _LLAMA_RULES,
     "vit": _VIT_RULES,
@@ -344,6 +354,7 @@ _FAMILY_RULES = {
     # Gemma is llama-named too; the differences (GeGLU, 1+w norms, embedding
     # scaling, decoupled head_dim, tied head) live in config_from_hf.
     "gemma": _LLAMA_RULES,
+    "gemma2": _GEMMA2_RULES,
     "mixtral": _MIXTRAL_RULES,
     "gpt2": _GPT2_RULES,
     "gptj": _GPTJ_RULES,
@@ -369,6 +380,7 @@ _STRIP_PREFIXES = {
     "t5": (),
     "qwen2": (),
     "gemma": (),
+    "gemma2": (),
 }
 
 # HF keys that are legitimately rule-less: tied copies and index buffers.
@@ -461,11 +473,11 @@ def config_from_hf(hf_config: dict, family: Optional[str] = None):
     HF ``config.json`` dict."""
     family = family or detect_family(hf_config)
     get = hf_config.get
-    if family in ("llama", "mistral", "mixtral", "qwen2", "gemma"):
+    if family in ("llama", "mistral", "mixtral", "qwen2", "gemma", "gemma2"):
         from ..models.llama import LlamaConfig, scale_rope_frequencies
         from ..models.mixtral import MixtralConfig
 
-        if family == "gemma":
+        if family in ("gemma", "gemma2"):
             # transformers: an ABSENT hidden_activation is coerced to the
             # tanh-approximate gelu (the checkpoints were trained so, even
             # where a legacy hidden_act says "gelu"); an EXPLICIT value is
@@ -473,7 +485,7 @@ def config_from_hf(hf_config: dict, family: Optional[str] = None):
             act = get("hidden_activation") or "gelu_pytorch_tanh"
             if act not in ("gelu", "gelu_pytorch_tanh"):
                 raise NotImplementedError(
-                    f"hidden_activation {act!r}: the flax gemma MLP is GeGLU (gelu)")
+                    f"hidden_activation {act!r}: the flax {family} MLP is GeGLU (gelu)")
         else:
             act = get("hidden_act", "silu")
             if act not in ("silu", "swish"):
@@ -506,29 +518,55 @@ def config_from_hf(hf_config: dict, family: Optional[str] = None):
         if family == "llama":
             return LlamaConfig(**kwargs)
         if family == "qwen2":
-            # Qwen2 biases q/k/v (never o); sliding window only when the
-            # config opts in (use_sliding_window, off by default).
+            # Qwen2 biases q/k/v (never o). Sliding window only when the
+            # config opts in (use_sliding_window, off by default); the first
+            # max_window_layers layers stay full-attention, represented as a
+            # per-layer mixture via LlamaConfig.layer_windows.
             sliding = None
+            windows = None
             if get("use_sliding_window"):
                 n_layers = kwargs["num_hidden_layers"]
-                if get("max_window_layers", n_layers) < n_layers:
-                    # HF windows only layers >= max_window_layers; our
-                    # sliding_window is uniform — converting would silently
-                    # change the attention pattern of the full-attention
-                    # layers (same policy as the rope/act rejections above).
-                    raise NotImplementedError(
-                        f"qwen2 max_window_layers={get('max_window_layers')} < "
-                        f"num_hidden_layers={n_layers}: per-layer window "
-                        "mixtures are not representable")
-                sliding = get("sliding_window")
-            return LlamaConfig(**kwargs, attention_qkv_bias=True, sliding_window=sliding)
-        if family == "gemma":
-            return LlamaConfig(**{**kwargs, "rms_norm_eps": get("rms_norm_eps", 1e-6),
-                                  "tie_word_embeddings": get("tie_word_embeddings", True)},
-                               mlp_activation="gelu_tanh" if act == "gelu_pytorch_tanh"
-                                              else "gelu_exact",
-                               rms_norm_unit_offset=True,
-                               scale_embeddings=True, head_dim_override=get("head_dim"))
+                layer_types = get("layer_types")
+                if layer_types:
+                    windows = tuple(
+                        get("sliding_window") if t == "sliding_attention" else None
+                        for t in layer_types)
+                else:
+                    full = get("max_window_layers", n_layers)
+                    windows = tuple(
+                        None if i < full else get("sliding_window")
+                        for i in range(n_layers))
+                if len(set(windows)) == 1:  # uniform: keep the simple knob
+                    sliding, windows = windows[0], None
+            return LlamaConfig(**kwargs, attention_qkv_bias=True,
+                               sliding_window=sliding, layer_windows=windows)
+        if family in ("gemma", "gemma2"):
+            gemma_kwargs = dict(
+                **{**kwargs, "rms_norm_eps": get("rms_norm_eps", 1e-6),
+                   "tie_word_embeddings": get("tie_word_embeddings", True)},
+                mlp_activation="gelu_tanh" if act == "gelu_pytorch_tanh" else "gelu_exact",
+                rms_norm_unit_offset=True,
+                scale_embeddings=True, head_dim_override=get("head_dim"))
+            if family == "gemma":
+                return LlamaConfig(**gemma_kwargs)
+            # Gemma2: sandwich norms, logit softcaps, decoupled attention
+            # scale, and the local/global mixture from layer_types.
+            layer_types = get("layer_types")
+            if layer_types:
+                windows = tuple(
+                    get("sliding_window") if t == "sliding_attention" else None
+                    for t in layer_types)
+            else:  # older configs: even layers slide
+                windows = tuple(
+                    get("sliding_window") if i % 2 == 0 else None
+                    for i in range(kwargs["num_hidden_layers"]))
+            return LlamaConfig(
+                **gemma_kwargs,
+                post_norms=True,
+                layer_windows=windows,
+                attn_logit_softcapping=get("attn_logit_softcapping"),
+                final_logit_softcapping=get("final_logit_softcapping"),
+                query_pre_attn_scalar=get("query_pre_attn_scalar"))
         return MixtralConfig(**kwargs,
                              sliding_window=get("sliding_window"),
                              num_experts=get("num_local_experts", 8),
@@ -721,7 +759,7 @@ def model_from_config(config, family: str):
     """Instantiate the flax module matching a converted config — the single
     family→model-class switch shared by the streamed HF dispatch
     (big_modeling) and the memory estimator (commands/estimate)."""
-    if family in ("llama", "mistral", "qwen2", "gemma"):
+    if family in ("llama", "mistral", "qwen2", "gemma", "gemma2"):
         from ..models.llama import LlamaForCausalLM
 
         return LlamaForCausalLM(config)
@@ -837,7 +875,7 @@ def convert_hf_state_dict(
 
     if family == "t5":
         drop_tied_duplicate("lm_head.weight", "shared.weight")
-    if family in ("llama", "mistral", "qwen2", "gemma"):
+    if family in ("llama", "mistral", "qwen2", "gemma", "gemma2"):
         # gemma always ties; small qwen2/llama variants often do.
         drop_tied_duplicate("lm_head.weight", "model.embed_tokens.weight")
 

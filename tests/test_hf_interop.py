@@ -1379,19 +1379,138 @@ class TestGemma:
 
 
 class TestQwen2WindowMixture:
-    def test_partial_window_layers_rejected(self):
+    def test_partial_window_layers_become_layer_windows(self):
+        # HF: the first max_window_layers layers are full-attention, the
+        # rest slide — represented as a per-layer mixture.
         cfg = dict(model_type="qwen2", vocab_size=128, hidden_size=32,
                    intermediate_size=64, num_hidden_layers=4,
                    num_attention_heads=4, num_key_value_heads=2,
                    use_sliding_window=True, sliding_window=16,
                    max_window_layers=2)
-        with pytest.raises(NotImplementedError, match="max_window_layers"):
-            config_from_hf(cfg)
+        out = config_from_hf(cfg)
+        assert out.sliding_window is None
+        assert out.layer_windows == (None, None, 16, 16)
 
-    def test_full_window_layers_accepted(self):
+    def test_full_window_layers_stay_uniform(self):
         cfg = dict(model_type="qwen2", vocab_size=128, hidden_size=32,
                    intermediate_size=64, num_hidden_layers=4,
                    num_attention_heads=4, num_key_value_heads=2,
                    use_sliding_window=True, sliding_window=16,
-                   max_window_layers=4)
-        assert config_from_hf(cfg).sliding_window == 16
+                   max_window_layers=0)
+        out = config_from_hf(cfg)
+        assert out.sliding_window == 16 and out.layer_windows is None
+
+    def test_window_mixture_forward_parity(self):
+        hf_cfg = transformers.Qwen2Config(
+            vocab_size=128, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=64, rms_norm_eps=1e-5,
+            tie_word_embeddings=False, use_sliding_window=True,
+            sliding_window=8, max_window_layers=2, attn_implementation="eager")
+        torch.manual_seed(0)
+        with torch.no_grad():
+            hf = transformers.Qwen2ForCausalLM(hf_cfg).eval()
+        cfg = config_from_hf(hf_cfg.to_dict())
+        assert cfg.layer_windows == (None, None, 8, 8)
+        from accelerate_tpu.models.llama import LlamaForCausalLM
+
+        cfg.use_flash_attention = False
+        params = convert_hf_state_dict(hf.state_dict(), "qwen2", strict=True)
+        ids = np.arange(24, dtype=np.int64).reshape(2, 12) % 128
+        ours = LlamaForCausalLM(cfg).apply({"params": params}, jnp.asarray(ids, jnp.int32))
+        with torch.no_grad():
+            theirs = hf(torch.from_numpy(ids)).logits
+        _logits_close(ours, theirs)
+
+
+class TestGemma2:
+    """Gemma2 = gemma + sandwich norms, logit softcaps, query_pre_attn_scalar,
+    and the alternating local/global attention mixture (layer_types)."""
+
+    def _pair(self):
+        hf_cfg = transformers.Gemma2Config(
+            vocab_size=128, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
+            head_dim=16, max_position_embeddings=64, rms_norm_eps=1e-6,
+            sliding_window=8, attn_logit_softcapping=50.0,
+            final_logit_softcapping=30.0, query_pre_attn_scalar=32,
+            hidden_activation="gelu_pytorch_tanh", attn_implementation="eager")
+        torch.manual_seed(0)
+        with torch.no_grad():
+            hf = transformers.Gemma2ForCausalLM(hf_cfg).eval()
+        cfg = config_from_hf(hf_cfg.to_dict())
+        assert cfg.post_norms and cfg.attn_logit_softcapping == 50.0
+        assert cfg.final_logit_softcapping == 30.0
+        # layer_types alternate: even layers slide, odd are global.
+        assert cfg.layer_windows == (8, None, 8, None)
+        from accelerate_tpu.models.llama import LlamaForCausalLM
+
+        cfg.use_flash_attention = False
+        params = convert_hf_state_dict(hf.state_dict(), "gemma2", strict=True)
+        assert "lm_head" not in params
+        return hf, LlamaForCausalLM(cfg), params
+
+    def test_forward_parity(self):
+        # seq 12 > window 8, so the local/global mixture actually masks.
+        hf, model, params = self._pair()
+        ids = np.arange(24, dtype=np.int64).reshape(2, 12) % 128
+        ours = model.apply({"params": params}, jnp.asarray(ids, jnp.int32))
+        with torch.no_grad():
+            theirs = hf(torch.from_numpy(ids)).logits
+        _logits_close(ours, theirs)
+
+    def test_greedy_decode_parity(self):
+        from accelerate_tpu.generation import generate
+
+        hf, model, params = self._pair()
+        ids = (np.arange(10, dtype=np.int64)[None] * 3) % 128
+        ours = np.asarray(generate(model, params, jnp.asarray(ids, jnp.int32),
+                                   max_new_tokens=8, cache_dtype=jnp.float32))
+        with torch.no_grad():
+            theirs = hf.generate(torch.from_numpy(ids).long(), max_new_tokens=8,
+                                 do_sample=False)
+        np.testing.assert_array_equal(ours, theirs.numpy())
+
+    def test_roundtrip(self):
+        hf, _, params = self._pair()
+        _roundtrip(params, "gemma2", hf.state_dict())
+
+    def test_streamed_dispatch(self, tmp_path):
+        import json as _json
+
+        from safetensors.numpy import save_file
+
+        from accelerate_tpu import load_hf_checkpoint_and_dispatch
+
+        hf, model, params = self._pair()
+        d = tmp_path / "gemma2"
+        d.mkdir()
+        save_file({k: v.numpy() for k, v in hf.state_dict().items()},
+                  str(d / "model.safetensors"))
+        _json.dump(hf.config.to_dict(), open(d / "config.json", "w"))
+        streamed, module = load_hf_checkpoint_and_dispatch(
+            str(d), device_map={"": "disk"}, dtype=jnp.float32)
+        ids = np.arange(1, 11, dtype=np.int32)[None]
+        ours = np.asarray(streamed.generate(ids, max_new_tokens=5))
+        with torch.no_grad():
+            theirs = hf.generate(torch.from_numpy(ids).long(), max_new_tokens=5,
+                                 do_sample=False)
+        np.testing.assert_array_equal(ours, theirs.numpy())
+
+    def test_pipelined_rejects_window_mixture(self):
+        from accelerate_tpu.models.llama import LlamaConfig, PipelinedLlamaForCausalLM
+
+        cfg = LlamaConfig.tiny(layer_windows=(8, None))
+        with pytest.raises(NotImplementedError, match="heterogeneous"):
+            PipelinedLlamaForCausalLM(cfg)
+
+    def test_fused_loss_rejects_final_softcap(self):
+        from accelerate_tpu.models.llama import (
+            LlamaConfig,
+            LlamaForCausalLM,
+            fused_causal_lm_loss,
+        )
+
+        cfg = LlamaConfig.tiny(final_logit_softcapping=30.0)
+        with pytest.raises(NotImplementedError, match="softcapping"):
+            fused_causal_lm_loss(LlamaForCausalLM(cfg))
