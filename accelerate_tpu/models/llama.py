@@ -255,10 +255,19 @@ def multi_head_attention(
             f"unknown attention_backend {backend!r}; expected auto/ring/ulysses/flash/einsum"
         )
     if logit_softcap is not None:
-        # Softcapped logits exist only on the einsum path; the CP strategies
-        # must reject rather than silently drop the cap.
+        # Softcap lives inside the flash kernel and the einsum path; the CP
+        # strategies must reject rather than silently drop the cap.
         if backend in ("ring", "ulysses"):
             raise ValueError(f"attention_backend={backend!r} does not support logit_softcap")
+        window = (sliding_window if sliding_window is not None
+                  and sliding_window < q.shape[1] else None)
+        if (backend != "einsum" and use_flash and causal
+                and flash_attention_available(q)
+                and not (window is not None and segment_ids is not None)):
+            return flash_attention(
+                q, k, v, causal=True, sliding_window=window,
+                block_q=block_q, block_k=block_k, segment_ids=segment_ids,
+                sm_scale=sm_scale, logit_softcap=logit_softcap)
         return _einsum_attention(q, k, v, causal=causal, segment_ids=segment_ids,
                                  sliding_window=sliding_window, sm_scale=sm_scale,
                                  logit_softcap=logit_softcap)

@@ -91,13 +91,15 @@ def _einsum_attention(q, k, v, causal: bool, segment_ids=None, sliding_window=No
 
 
 def flash_attention(q, k, v, causal: bool = True, block_q: int = 128, block_k: int = 128,
-                    sliding_window=None, segment_ids=None, sm_scale=None):
+                    sliding_window=None, segment_ids=None, sm_scale=None,
+                    logit_softcap=None):
     """Flash attention entry point.
 
     Args are [batch, seq, heads, head_dim]. Dispatches to the Pallas kernel
     on TPU; einsum fallback elsewhere. ``segment_ids`` (packed sequences)
     are masked inside the kernel; the sliding_window+segments combination
-    routes to the einsum path. ``sm_scale`` overrides 1/sqrt(head_dim).
+    routes to the einsum path. ``sm_scale`` overrides 1/sqrt(head_dim);
+    ``logit_softcap`` (Gemma2) is applied inside the kernel pre-mask.
     """
     if sliding_window is not None and not causal:
         # Validated here (not just in the kernel) so CPU-fallback runs fail
@@ -107,9 +109,10 @@ def flash_attention(q, k, v, causal: bool = True, block_q: int = 128, block_k: i
         sliding_window is not None and segment_ids is not None
     ):
         return _einsum_attention(q, k, v, causal, segment_ids=segment_ids,
-                                 sliding_window=sliding_window, sm_scale=sm_scale)
+                                 sliding_window=sliding_window, sm_scale=sm_scale,
+                                 logit_softcap=logit_softcap)
     from .flash_pallas import pallas_flash_attention
 
     return pallas_flash_attention(q, k, v, causal=causal, block_q=block_q, block_k=block_k,
                                   sliding_window=sliding_window, segment_ids=segment_ids,
-                                  sm_scale=sm_scale)
+                                  sm_scale=sm_scale, logit_softcap=logit_softcap)

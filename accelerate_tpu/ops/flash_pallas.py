@@ -91,7 +91,7 @@ def _pair_mask(qi, ki, block_q: int, block_k: int, causal: bool, window):
 
 def _fwd_kernel(*refs,
                 sm_scale: float, causal: bool, window, block_q: int, block_k: int,
-                num_k_blocks: int, band: int, has_segments: bool):
+                num_k_blocks: int, band: int, has_segments: bool, softcap=None):
     if has_segments:
         q_ref, k_ref, v_ref, qs_ref, ks_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
     else:
@@ -120,6 +120,10 @@ def _fwd_kernel(*refs,
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * sm_scale  # [block_q, block_k]
 
+        if softcap is not None:
+            # Gemma2 logit bounding — BEFORE masking (tanh(NEG_INF) would
+            # otherwise saturate masked slots to -cap, un-masking them).
+            s = softcap * jnp.tanh(s / softcap)
         if causal or window is not None or has_segments:
             mask = _pair_mask(qi, ki, block_q, block_k, causal, window)
             if has_segments:
@@ -150,7 +154,8 @@ def _fwd_kernel(*refs,
         lse_ref[0, 0] = jnp.broadcast_to(lse, lse_ref.shape[2:])
 
 
-def _flash_fwd(q, k, v, sm_scale, causal, window, block_q, block_k, segment_ids=None):
+def _flash_fwd(q, k, v, sm_scale, causal, window, block_q, block_k, segment_ids=None,
+               softcap=None):
     B, H, S_q, D = q.shape
     S_k = k.shape[2]
     num_q = S_q // block_q
@@ -169,7 +174,7 @@ def _flash_fwd(q, k, v, sm_scale, causal, window, block_q, block_k, segment_ids=
     kernel = functools.partial(
         _fwd_kernel, sm_scale=sm_scale, causal=causal, window=window,
         block_q=block_q, block_k=block_k, num_k_blocks=num_k, band=band,
-        has_segments=has_segments,
+        has_segments=has_segments, softcap=softcap,
     )
     in_specs = [
         pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, kj: (b, h, qi, 0)),
@@ -220,7 +225,8 @@ def _flash_fwd(q, k, v, sm_scale, causal, window, block_q, block_k, segment_ids=
 # ---------------------------------------------------------------------------
 
 def _bwd_dkdv_kernel(*refs, sm_scale, causal, window, block_q, block_k,
-                     num_q_blocks, band: int, rep: int, has_segments: bool):
+                     num_q_blocks, band: int, rep: int, has_segments: bool,
+                     softcap=None):
     """Grid (B, G, num_k, rep * band): dim 1 is the *kv* head; the innermost
     dim walks the ``rep`` query heads sharing it r-major (inner = r * band +
     qj), accumulating all their dk/dv contributions in the same VMEM scratch.
@@ -259,6 +265,9 @@ def _bwd_dkdv_kernel(*refs, sm_scale, causal, window, block_q, block_k,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * sm_scale             # [bq, bk]
+        if softcap is not None:
+            s_cap = softcap * jnp.tanh(s / softcap)  # bounded: |s_cap| <= cap
+            s = s_cap
         if causal or window is not None or has_segments:
             mask = _pair_mask(qi, ki, block_q, block_k, causal, window)
             if has_segments:
@@ -274,7 +283,14 @@ def _bwd_dkdv_kernel(*refs, sm_scale, causal, window, block_q, block_k,
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        ds = p * (dp - delta) * sm_scale            # [bq, bk]
+        ds = p * (dp - delta)                       # dL/ds_postcap  [bq, bk]
+        if softcap is not None:
+            # chain through s_post = cap * tanh(s_pre / cap):
+            # ds_pre = ds_post * (1 - (s_post / cap)^2). Uses the PRE-mask
+            # s_cap (bounded by cap) — the masked s is -1e30 and would
+            # square to inf, turning p == 0 slots into 0 * inf = NaN.
+            ds = ds * (1.0 - jnp.square(s_cap / softcap))
+        ds = ds * sm_scale
         # dK += dS^T Q
         dk_scr[:] += jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
@@ -287,7 +303,7 @@ def _bwd_dkdv_kernel(*refs, sm_scale, causal, window, block_q, block_k,
 
 
 def _bwd_dq_kernel(*refs, sm_scale, causal, window, block_q, block_k,
-                   num_k_blocks, band: int, has_segments: bool):
+                   num_k_blocks, band: int, has_segments: bool, softcap=None):
     if has_segments:
         (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qs_ref, ks_ref,
          dq_ref, dq_scr) = refs
@@ -318,6 +334,9 @@ def _bwd_dq_kernel(*refs, sm_scale, causal, window, block_q, block_k,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * sm_scale
+        if softcap is not None:
+            s_cap = softcap * jnp.tanh(s / softcap)
+            s = s_cap
         if causal or window is not None or has_segments:
             mask = _pair_mask(qi, ki, block_q, block_k, causal, window)
             if has_segments:
@@ -327,7 +346,11 @@ def _bwd_dq_kernel(*refs, sm_scale, causal, window, block_q, block_k,
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        ds = p * (dp - delta) * sm_scale
+        ds = p * (dp - delta)
+        if softcap is not None:
+            # pre-mask s_cap, not the masked s — see _bwd_dkdv_kernel.
+            ds = ds * (1.0 - jnp.square(s_cap / softcap))
+        ds = ds * sm_scale
         dq_scr[:] += jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -337,7 +360,7 @@ def _bwd_dq_kernel(*refs, sm_scale, causal, window, block_q, block_k,
         dq_ref[0, 0] = dq_scr[:].astype(dq_ref.dtype)
 
 
-def _flash_bwd(sm_scale, causal, window, block_q, block_k, residuals, d_out,
+def _flash_bwd(sm_scale, causal, window, block_q, block_k, softcap, residuals, d_out,
                segment_ids=None):
     q, k, v, out, lse = residuals
     do = d_out
@@ -385,7 +408,7 @@ def _flash_bwd(sm_scale, causal, window, block_q, block_k, residuals, d_out,
         functools.partial(
             _bwd_dkdv_kernel, sm_scale=sm_scale, causal=causal, window=window,
             block_q=block_q, block_k=block_k, num_q_blocks=num_q, band=band_q,
-            rep=rep, has_segments=has_segments,
+            rep=rep, has_segments=has_segments, softcap=softcap,
         ),
         grid=(B, G, num_k, rep * band_q),
         in_specs=dkdv_specs,
@@ -434,7 +457,7 @@ def _flash_bwd(sm_scale, causal, window, block_q, block_k, residuals, d_out,
         functools.partial(
             _bwd_dq_kernel, sm_scale=sm_scale, causal=causal, window=window,
             block_q=block_q, block_k=block_k, num_k_blocks=num_k, band=band_k,
-            has_segments=has_segments,
+            has_segments=has_segments, softcap=softcap,
         ),
         grid=(B, H, num_q, band_k),
         in_specs=dq_specs,
@@ -454,36 +477,45 @@ def _flash_bwd(sm_scale, causal, window, block_q, block_k, residuals, d_out,
 # custom_vjp wrapper
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash_bhsd(q, k, v, sm_scale, causal, window, block_q, block_k):
-    out, _ = _flash_fwd(q, k, v, sm_scale, causal, window, block_q, block_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_bhsd(q, k, v, sm_scale, causal, window, block_q, block_k, softcap):
+    out, _ = _flash_fwd(q, k, v, sm_scale, causal, window, block_q, block_k,
+                        softcap=softcap)
     return out
 
 
-def _fwd_rule(q, k, v, sm_scale, causal, window, block_q, block_k):
-    out, lse = _flash_fwd(q, k, v, sm_scale, causal, window, block_q, block_k)
+def _fwd_rule(q, k, v, sm_scale, causal, window, block_q, block_k, softcap):
+    out, lse = _flash_fwd(q, k, v, sm_scale, causal, window, block_q, block_k,
+                          softcap=softcap)
     return out, (q, k, v, out, lse)
 
 
-_flash_bhsd.defvjp(_fwd_rule, _flash_bwd)
+def _bwd_rule(sm_scale, causal, window, block_q, block_k, softcap, residuals, d_out):
+    return _flash_bwd(sm_scale, causal, window, block_q, block_k, softcap,
+                      residuals, d_out)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
-def _flash_bhsd_seg(q, k, v, segment_ids, sm_scale, causal, window, block_q, block_k):
+_flash_bhsd.defvjp(_fwd_rule, _bwd_rule)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _flash_bhsd_seg(q, k, v, segment_ids, sm_scale, causal, window, block_q, block_k,
+                    softcap):
     out, _ = _flash_fwd(q, k, v, sm_scale, causal, window, block_q, block_k,
-                        segment_ids=segment_ids)
+                        segment_ids=segment_ids, softcap=softcap)
     return out
 
 
-def _seg_fwd_rule(q, k, v, segment_ids, sm_scale, causal, window, block_q, block_k):
+def _seg_fwd_rule(q, k, v, segment_ids, sm_scale, causal, window, block_q, block_k,
+                  softcap):
     out, lse = _flash_fwd(q, k, v, sm_scale, causal, window, block_q, block_k,
-                          segment_ids=segment_ids)
+                          segment_ids=segment_ids, softcap=softcap)
     return out, (q, k, v, out, lse, segment_ids)
 
 
-def _seg_bwd_rule(sm_scale, causal, window, block_q, block_k, residuals, d_out):
+def _seg_bwd_rule(sm_scale, causal, window, block_q, block_k, softcap, residuals, d_out):
     q, k, v, out, lse, segment_ids = residuals
-    dq, dk, dv = _flash_bwd(sm_scale, causal, window, block_q, block_k,
+    dq, dk, dv = _flash_bwd(sm_scale, causal, window, block_q, block_k, softcap,
                             (q, k, v, out, lse), d_out, segment_ids=segment_ids)
     # Integer segment ids carry a float0 cotangent (no gradient flows).
     dseg = jnp.zeros(segment_ids.shape, jax.dtypes.float0)
@@ -495,7 +527,7 @@ _flash_bhsd_seg.defvjp(_seg_fwd_rule, _seg_bwd_rule)
 
 def pallas_flash_attention(q, k, v, causal: bool = True, block_q: int = 128, block_k: int = 128,
                            sm_scale: float | None = None, sliding_window: int | None = None,
-                           segment_ids=None):
+                           segment_ids=None, logit_softcap: float | None = None):
     """Public entry. q/k/v: [batch, seq, heads, head_dim] (models layout).
 
     GQA-native: k/v may carry fewer heads than q (``n_q = rep * n_kv``).
@@ -529,7 +561,8 @@ def pallas_flash_attention(q, k, v, causal: bool = True, block_q: int = 128, blo
     qt, kt, vt = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
     if segment_ids is not None:
         out = _flash_bhsd_seg(qt, kt, vt, segment_ids.astype(jnp.int32),
-                              sm_scale, causal, None, block_q, block_k)
+                              sm_scale, causal, None, block_q, block_k, logit_softcap)
     else:
-        out = _flash_bhsd(qt, kt, vt, sm_scale, causal, sliding_window, block_q, block_k)
+        out = _flash_bhsd(qt, kt, vt, sm_scale, causal, sliding_window, block_q, block_k,
+                          logit_softcap)
     return jnp.swapaxes(out, 1, 2)

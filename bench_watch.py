@@ -263,6 +263,13 @@ def run_kernels() -> dict:
     want = jax.jit(lambda q, k, v: _einsum_attention(q, k, v, causal=True))(qg, kg, vg)
     check("flash_gqa_fwd_fp32", got, want, 2e-2)
 
+    # -- softcapped logits (Gemma2) fwd+bwd ---------------------------------
+    got = jax.jit(lambda q, k, v: pallas_flash_attention(
+        q, k, v, causal=True, block_q=128, block_k=128, logit_softcap=7.0))(qf, kf, vf)
+    want = jax.jit(lambda q, k, v: _einsum_attention(
+        q, k, v, causal=True, logit_softcap=7.0))(qf, kf, vf)
+    check("flash_softcap_fwd_fp32", got, want, 2e-2)
+
     # -- fp8 delayed-scaling matmul ------------------------------------------
     from accelerate_tpu.ops.quant import E4M3, _quantize, fp8_matmul
 

@@ -238,3 +238,62 @@ def test_gqa_rejects_indivisible_heads():
     q, k, v = make_gqa_qkv(H=4, G=3)
     with pytest.raises(ValueError, match="not a multiple"):
         pallas_flash_attention(q, k, v, causal=True)
+
+
+# -- logit softcapping (Gemma2: cap * tanh(s / cap) inside the kernel) -------
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_softcap_forward_matches_reference(causal):
+    q, k, v = make_qkv(B=1, S=128, H=2, D=32)
+    ref = _einsum_attention(q, k, v, causal=causal, logit_softcap=7.0)
+    out = pallas_flash_attention(q, k, v, causal=causal, block_q=64, block_k=64,
+                                 logit_softcap=7.0)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+    # the cap must actually change the result
+    plain = pallas_flash_attention(q, k, v, causal=causal, block_q=64, block_k=64)
+    assert np.abs(np.asarray(out) - np.asarray(plain)).max() > 1e-4
+
+
+def test_softcap_backward_matches_reference():
+    q, k, v = make_qkv(B=1, S=128, H=2, D=32)
+
+    def loss_flash(q, k, v):
+        return (pallas_flash_attention(q, k, v, causal=True, block_q=64, block_k=64,
+                                       logit_softcap=7.0) ** 2).sum()
+
+    def loss_ref(q, k, v):
+        return (_einsum_attention(q, k, v, causal=True, logit_softcap=7.0) ** 2).sum()
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g_flash, g_ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4, rtol=5e-4,
+                                   err_msg=f"d{name} mismatch")
+        assert np.isfinite(np.asarray(a)).all(), f"d{name} has NaN/inf"
+
+
+def test_softcap_with_window_and_gqa_backward():
+    # softcap + banded grid + narrow KV + custom scale, all at once.
+    q, k, v = make_gqa_qkv(S=256, H=4, G=2)
+
+    kw = dict(causal=True, block_q=64, block_k=64, sliding_window=70,
+              logit_softcap=5.0, sm_scale=0.17)
+
+    def loss_flash(q, k, v):
+        return (pallas_flash_attention(q, k, v, **kw) ** 2).sum()
+
+    rep = 2
+    kf, vf = _repeat_kv(q, k, v)
+
+    def loss_ref(q, kf, vf):
+        return (_einsum_attention(q, kf, vf, causal=True, sliding_window=70,
+                                  logit_softcap=5.0, sm_scale=0.17) ** 2).sum()
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gq, gkf, gvf = jax.grad(loss_ref, argnums=(0, 1, 2))(q, kf, vf)
+    B, S, H, D = q.shape
+    gk = gkf.reshape(B, S, 2, rep, D).sum(axis=3)
+    gv = gvf.reshape(B, S, 2, rep, D).sum(axis=3)
+    for a, b, name in zip(g_flash, (gq, gk, gv), ("dq", "dk", "dv")):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=7e-4, rtol=7e-4,
+                                   err_msg=f"{name} mismatch")
