@@ -153,6 +153,32 @@ class TestBenchmarkFamiliesTrain:
                 losses.append(float(step(batch)["loss"]))
         assert losses[-1] < losses[0] * 0.5, f"{family}: {losses[0]} -> {losses[-1]}"
 
+    def test_gemma2_knobs_train(self):
+        # Gemma2's training-path novelties — sandwich norms, attn/final
+        # softcaps, per-layer window mixture, decoupled scale — must flow
+        # gradients through the fused step, not just decode.
+        from accelerate_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+        cfg = LlamaConfig.tiny(
+            use_flash_attention=False, post_norms=True, rms_norm_unit_offset=True,
+            scale_embeddings=True, tie_word_embeddings=True,
+            layer_windows=(8, None), attn_logit_softcapping=50.0,
+            final_logit_softcapping=30.0, query_pre_attn_scalar=32.0,
+            mlp_activation="gelu_tanh")
+        model_def = LlamaForCausalLM(cfg)
+        params = model_def.init_params(jax.random.PRNGKey(0), batch_size=4, seq_len=16)
+        acc = Accelerator(mixed_precision="bf16")
+        ids = np.tile(np.arange(16, dtype=np.int32)[None], (8, 1)) % cfg.vocab_size
+        loader = NumpyDataLoader([{"input_ids": ids[i]} for i in range(8)], batch_size=8)
+        model, tx, loader = acc.prepare(Model(model_def, params), optax.adam(1e-2), loader)
+        step = acc.compile_train_step(causal_lm_loss(model_def.apply))
+        losses = []
+        for _ in range(10):
+            for batch in loader:
+                losses.append(float(step(batch)["loss"]))
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0] * 0.5, f"gemma2 knobs: {losses[0]} -> {losses[-1]}"
+
 
 class TestResNet:
     def test_forward(self):
