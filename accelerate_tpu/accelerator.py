@@ -1170,10 +1170,22 @@ class Accelerator:
     # save_state/load_state live in checkpointing.py and are bound here to
     # keep this module focused.
     def save_state(self, output_dir: Optional[str] = None, **save_model_kwargs):
-        """Checkpoint params/optimizer/RNG/loaders/custom objects (reference: :2915)."""
+        """Checkpoint params/optimizer/RNG/loaders/custom objects (reference: :2915).
+
+        Pass ``blocking=False`` for an async checkpoint: arrays are
+        snapshotted to host synchronously, the filesystem write streams in
+        the background, and training continues. Durability points:
+        :meth:`wait_for_checkpoint`, the next save/load, or process exit."""
         from .checkpointing import save_accelerator_state
 
         return save_accelerator_state(self, output_dir, **save_model_kwargs)
+
+    def wait_for_checkpoint(self):
+        """Block until every in-flight async ``save_state(blocking=False)``
+        is durable on disk."""
+        from .checkpointing import wait_for_saves
+
+        wait_for_saves()
 
     def load_state(self, input_dir: Optional[str] = None, **load_model_kwargs):
         """Restore a save_state checkpoint, resharding on topology changes (reference: :3081)."""

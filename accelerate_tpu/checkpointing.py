@@ -56,18 +56,37 @@ def _is_orbax_available():
 # Array-tree IO (orbax primary, msgpack fallback)
 # ---------------------------------------------------------------------------
 
-def save_array_tree(tree, path: str | Path):
+#: In-flight async array saves: (AsyncCheckpointer, path). Drained by
+#: wait_for_saves() — called before any new save/load and at interpreter
+#: exit, so an async checkpoint can never be half-written silently.
+_INFLIGHT: list = []
+
+
+def save_array_tree(tree, path: str | Path, *, blocking: bool = True):
     """Write a pytree of (possibly sharded) arrays.
 
     orbax/tensorstore handles multi-host coordination: each host writes only
     its addressable shards (the torch.distributed.checkpoint equivalent).
+
+    ``blocking=False`` returns as soon as the arrays are snapshotted to host
+    memory (orbax's async protocol does the device->host copy synchronously,
+    so later donation/mutation of the live buffers is safe) and streams the
+    filesystem write in the background — training continues during the save,
+    which the reference's torch.save path cannot do. Call
+    :func:`wait_for_saves` (or ``Accelerator.wait_for_checkpoint``) to make
+    it durable; loads and subsequent saves drain automatically.
     """
     path = Path(path).absolute()
     if _is_orbax_available():
         import orbax.checkpoint as ocp
 
-        with ocp.PyTreeCheckpointer() as ckptr:
+        if blocking:
+            with ocp.PyTreeCheckpointer() as ckptr:
+                ckptr.save(path, tree, force=True)
+        else:
+            ckptr = ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
             ckptr.save(path, tree, force=True)
+            _INFLIGHT.append((ckptr, str(path)))
     else:  # pragma: no cover - orbax is baked into the image
         import jax
         from flax import serialization
@@ -75,6 +94,20 @@ def save_array_tree(tree, path: str | Path):
         host_tree = jax.tree_util.tree_map(lambda x: np.asarray(jax.device_get(x)), tree)
         path.mkdir(parents=True, exist_ok=True)
         (path / "tree.msgpack").write_bytes(serialization.to_bytes(host_tree))
+
+
+def wait_for_saves() -> None:
+    """Block until every in-flight async array save is durable on disk."""
+    global _INFLIGHT
+    pending, _INFLIGHT = _INFLIGHT, []
+    for ckptr, _ in pending:
+        ckptr.wait_until_finished()
+        ckptr.close()
+
+
+import atexit as _atexit  # noqa: E402 - registered right after definition
+
+_atexit.register(wait_for_saves)
 
 
 def load_array_tree(path: str | Path, target=None, shardings=None, via_host: bool = False):
@@ -224,8 +257,16 @@ def _prune_checkpoints(accelerator, base: Path):
             shutil.rmtree(victim, ignore_errors=True)
 
 
-def save_accelerator_state(accelerator, output_dir: Optional[str] = None, safe_serialization: bool = True):
-    """Save the whole training state (reference: save_state :2915)."""
+def save_accelerator_state(accelerator, output_dir: Optional[str] = None,
+                           safe_serialization: bool = True, blocking: bool = True):
+    """Save the whole training state (reference: save_state :2915).
+
+    ``blocking=False`` streams the array writes (model params, optimizer
+    state) in the background — see :func:`save_array_tree`; the small JSON
+    sidecars are written synchronously either way."""
+    # Never overlap two checkpoint writes (orbax renames the directory at
+    # commit time; interleaved saves could commit out of order).
+    wait_for_saves()
     out = _checkpoint_dir(accelerator, output_dir)
     pc = accelerator.project_configuration
     if pc.automatic_checkpoint_naming and output_dir is None:
@@ -246,11 +287,13 @@ def save_accelerator_state(accelerator, output_dir: Optional[str] = None, safe_s
 
     # Models (sharded arrays via orbax — all hosts participate).
     for i, model in enumerate(accelerator._models):
-        save_array_tree(model.params, out / f"{MODEL_NAME}_{i}" if i > 0 else out / MODEL_NAME)
+        save_array_tree(model.params, out / f"{MODEL_NAME}_{i}" if i > 0 else out / MODEL_NAME,
+                        blocking=blocking)
 
     # Optimizers: opt_state arrays + scalar state.
     for i, opt in enumerate(accelerator._optimizers):
-        save_array_tree(opt.opt_state, out / (f"{OPTIMIZER_NAME}_{i}" if i > 0 else OPTIMIZER_NAME))
+        save_array_tree(opt.opt_state, out / (f"{OPTIMIZER_NAME}_{i}" if i > 0 else OPTIMIZER_NAME),
+                        blocking=blocking)
         meta = {"steps_applied": opt.steps_applied}
         if opt.loss_scale is not None:
             meta["loss_scale"] = [
@@ -300,6 +343,7 @@ def save_accelerator_state(accelerator, output_dir: Optional[str] = None, safe_s
 
 def load_accelerator_state(accelerator, input_dir: Optional[str] = None, load_kwargs: Optional[dict] = None):
     """Restore the whole training state (reference: load_state :3081)."""
+    wait_for_saves()  # an in-flight async save must be durable before reads
     src = _checkpoint_dir(accelerator, input_dir, for_load=True)
     if not Path(src).exists():
         raise FileNotFoundError(f"Checkpoint directory {src} does not exist")

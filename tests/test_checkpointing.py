@@ -84,6 +84,42 @@ class TestSaveLoadState:
         assert sched.scheduler.count == 4  # scheduler state restored
         assert opt.steps_applied == 4
 
+    def test_async_save_durable_and_resumable(self, tmp_path):
+        """save_state(blocking=False) returns before the write is durable;
+        training continues (mutating the live state) without corrupting the
+        snapshot, and wait_for_checkpoint + load restores the at-save values."""
+        acc, model, opt, loader, sched = build(tmp_path)
+        train_steps(acc, model, opt, loader, sched)
+        params_at_save = jax.tree_util.tree_map(np.asarray, model.params)
+        out = acc.save_state(blocking=False)
+
+        # keep training WHILE the write streams in the background
+        train_steps(acc, model, opt, loader, sched)
+        acc.wait_for_checkpoint()
+        from accelerate_tpu import checkpointing
+
+        assert checkpointing._INFLIGHT == []
+        assert os.path.isdir(out)
+
+        acc.load_state()
+        restored = jax.tree_util.tree_map(np.asarray, model.params)
+        np.testing.assert_allclose(restored["w1"], params_at_save["w1"], atol=1e-6)
+        assert opt.steps_applied == 4
+
+    def test_async_save_drained_by_next_save(self, tmp_path):
+        """A second save (or a load) must drain the in-flight write first —
+        no interleaved orbax commits."""
+        acc, model, opt, loader, sched = build(tmp_path)
+        train_steps(acc, model, opt, loader, sched)
+        acc.save_state(blocking=False)
+        from accelerate_tpu import checkpointing
+
+        assert len(checkpointing._INFLIGHT) >= 1
+        out2 = acc.save_state(blocking=False)   # drains the first
+        acc.wait_for_checkpoint()
+        assert checkpointing._INFLIGHT == []
+        acc.load_state(out2)
+
     def test_resume_continues_identically(self, tmp_path):
         """Save at step 4, run 4 more; fresh process loads + runs 4 -> same
         params (reference: test_utils/scripts/test_checkpointing semantics)."""
