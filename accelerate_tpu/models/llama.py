@@ -331,15 +331,40 @@ def multi_head_attention(
                              sm_scale=sm_scale)
 
 
+def _layer_window(config, layer_idx: int):
+    """Duck-typed per-layer window: LlamaConfig.window_for when present,
+    else a uniform ``sliding_window`` attribute (mixtral et al.)."""
+    if hasattr(config, "window_for"):
+        return config.window_for(layer_idx)
+    return getattr(config, "sliding_window", None)
+
+
 def init_kv_cache(config: "LlamaConfig", batch_size: int, max_len: int, dtype=jnp.bfloat16):
     """Per-layer KV cache: tuple of ``{"k", "v"}`` with [B, max_len, n_kv, hd]
     buffers (KV heads stored *unrepeated* — GQA expansion happens at attention
-    time, so the cache is ``n_q/n_kv``× smaller than the score matrices)."""
-    shape = (batch_size, max_len, config.num_key_value_heads, config.head_dim)
-    return tuple(
-        {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
-        for _ in range(config.num_hidden_layers)
-    )
+    time, so the cache is ``n_q/n_kv``× smaller than the score matrices).
+
+    Sliding-window layers (Mistral; Gemma2's local layers) get a RING buffer
+    of ``window`` slots instead — a query only ever sees the last ``window``
+    keys, so decode-cache memory is O(window), not O(max_len) (32k-context
+    Mistral-7B: 8x smaller). Ring caches carry a ``pos`` buffer [B, window]
+    recording each slot's global position (-1 = never written); the batch
+    dim exists so beam search's batch-axis cache reordering maps over it
+    like any other leaf."""
+    caches = []
+    n_kv, hd = config.num_key_value_heads, config.head_dim
+    for i in range(config.num_hidden_layers):
+        w = _layer_window(config, i)
+        if w is not None and w < max_len:
+            caches.append({
+                "k": jnp.zeros((batch_size, w, n_kv, hd), dtype),
+                "v": jnp.zeros((batch_size, w, n_kv, hd), dtype),
+                "pos": jnp.full((batch_size, w), -1, jnp.int32),
+            })
+        else:
+            shape = (batch_size, max_len, n_kv, hd)
+            caches.append({"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)})
+    return tuple(caches)
 
 
 def _cached_attention(q, k_all, v_all, cache_pos, n_rep: int, sliding_window=None,
@@ -374,19 +399,88 @@ def _cached_attention(q, k_all, v_all, cache_pos, n_rep: int, sliding_window=Non
     return out.reshape(B, S, H, hd).astype(q.dtype)
 
 
+def _ring_cached_attention(q, cache, cache_pos, n_rep: int, window: int,
+                           sm_scale=None, logit_softcap=None):
+    """Grouped attention of q [B, S, H, hd] against a ring cache of
+    ``window`` slots. Validity comes from the per-slot ``pos`` buffer:
+    a slot is visible iff it has been written (pos >= 0), is not in the
+    query's future, and lies inside the window."""
+    from ..ops.attention import softcap_logits
+
+    B, S, H, hd = q.shape
+    scale = hd**-0.5 if sm_scale is None else sm_scale
+    qg = (q * scale).astype(jnp.float32).reshape(B, S, H // n_rep, n_rep, hd)
+    logits = jnp.einsum("bqgrd,bkgd->bgrqk", qg, cache["k"].astype(jnp.float32))
+    logits = softcap_logits(logits, logit_softcap)
+    q_pos = cache_pos + jnp.arange(S, dtype=jnp.int32)          # [S]
+    slot_pos = cache["pos"]                                     # [B, W]
+    mask = (
+        (slot_pos[:, None, :] >= 0)
+        & (slot_pos[:, None, :] <= q_pos[None, :, None])
+        & (slot_pos[:, None, :] > q_pos[None, :, None] - window)
+    )  # [B, S, W]
+    # logits: [B, G, rep, S, W] <- mask broadcast over the two head dims.
+    logits = jnp.where(mask[:, None, None, :, :], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bgrqk,bkgd->bqgrd", probs, cache["v"].astype(jnp.float32))
+    return out.reshape(B, S, H, hd).astype(q.dtype)
+
+
 def update_kv_cache_and_attend(cache, q, k, v, cache_pos, n_rep: int, sliding_window=None,
                                sm_scale=None, logit_softcap=None):
     """Write this call's K/V into the cache at ``cache_pos`` and attend q
     against the whole buffer. Shared by every cached attention (Llama, GPT-2).
-    Returns (out [B,S,H,hd], new_cache)."""
-    start = (0, cache_pos, 0, 0)
+    Returns (out [B,S,H,hd], new_cache).
+
+    Ring caches (``"pos"`` present — sliding-window layers) write slot
+    ``pos % window``. The multi-token prefill computes its attention
+    directly from the chunk (windowed causal — the cache is empty before
+    the single generate() prefill at position 0) and scatters only the last
+    ``window`` entries into the ring; decode steps write one slot and
+    attend against the ring with per-slot position masking."""
+    if "pos" not in cache:
+        start = (0, cache_pos, 0, 0)
+        new_cache = {
+            "k": jax.lax.dynamic_update_slice(cache["k"], k.astype(cache["k"].dtype), start),
+            "v": jax.lax.dynamic_update_slice(cache["v"], v.astype(cache["v"].dtype), start),
+        }
+        out = _cached_attention(q, new_cache["k"], new_cache["v"], cache_pos, n_rep,
+                                sliding_window=sliding_window, sm_scale=sm_scale,
+                                logit_softcap=logit_softcap)
+        return out, new_cache
+
+    from ..ops.attention import _einsum_attention
+
+    window = cache["k"].shape[1]
+    B, S = q.shape[0], q.shape[1]
+    if S > 1:
+        # Prefill: attention over the chunk itself (windowed causal).
+        out = _einsum_attention(
+            q, k, v, causal=True, sliding_window=min(sliding_window or window, window),
+            sm_scale=sm_scale, logit_softcap=logit_softcap)
+        # Scatter the last `window` entries (unique slots) into the ring.
+        take = min(S, window)
+        idx = cache_pos + jnp.arange(S - take, S, dtype=jnp.int32)   # global positions
+        slots = idx % window
+        new_cache = {
+            "k": cache["k"].at[:, slots].set(k[:, S - take:].astype(cache["k"].dtype)),
+            "v": cache["v"].at[:, slots].set(v[:, S - take:].astype(cache["v"].dtype)),
+            "pos": cache["pos"].at[:, slots].set(jnp.broadcast_to(idx, (B, take))),
+        }
+        return out, new_cache
+
+    slot = jax.lax.rem(cache_pos, window)
     new_cache = {
-        "k": jax.lax.dynamic_update_slice(cache["k"], k.astype(cache["k"].dtype), start),
-        "v": jax.lax.dynamic_update_slice(cache["v"], v.astype(cache["v"].dtype), start),
+        "k": jax.lax.dynamic_update_slice(cache["k"], k.astype(cache["k"].dtype),
+                                          (0, slot, 0, 0)),
+        "v": jax.lax.dynamic_update_slice(cache["v"], v.astype(cache["v"].dtype),
+                                          (0, slot, 0, 0)),
+        "pos": jax.lax.dynamic_update_slice(
+            cache["pos"], jnp.broadcast_to(cache_pos, (B, 1)).astype(jnp.int32), (0, slot)),
     }
-    out = _cached_attention(q, new_cache["k"], new_cache["v"], cache_pos, n_rep,
-                            sliding_window=sliding_window, sm_scale=sm_scale,
-                            logit_softcap=logit_softcap)
+    out = _ring_cached_attention(q, new_cache, cache_pos, n_rep,
+                                 window=min(sliding_window or window, window),
+                                 sm_scale=sm_scale, logit_softcap=logit_softcap)
     return out, new_cache
 
 
