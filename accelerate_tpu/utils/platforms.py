@@ -78,6 +78,27 @@ def force_cpu_platform(num_virtual_devices: int | None = None) -> None:
 PROBE_FILE_CACHE_TTL = 120.0
 
 
+def enable_compilation_cache(path: str | None = None) -> str:
+    """Turn on jax's persistent compilation cache (XLA + Mosaic executables
+    keyed by HLO/platform).
+
+    On a remote/tunneled backend every compile costs ~25 s of round trips;
+    with the cache a re-run of the same program (a retried benchmark, a
+    relaunched trainer after preemption) skips straight to execution.
+    Honors ``ACCELERATE_TPU_COMPILATION_CACHE`` when ``path`` is None;
+    defaults to ``~/.cache/accelerate_tpu/jax``. Returns the directory."""
+    import jax
+
+    path = path or os.environ.get(
+        "ACCELERATE_TPU_COMPILATION_CACHE",
+        os.path.join(os.path.expanduser("~"), ".cache", "accelerate_tpu", "jax"),
+    )
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    return path
+
+
 def _probe_cache_path() -> str:
     import tempfile
 

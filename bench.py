@@ -52,6 +52,13 @@ def run_bench(on_tpu: bool) -> dict:
     import numpy as np
     import optax
 
+    from accelerate_tpu.utils.platforms import enable_compilation_cache
+
+    # Persistent compile cache: a tier-1 attempt that got as far as
+    # compiling pays the tunnel's ~25 s/program cost ONCE — later attempts
+    # (next watcher cycle, the driver's own run) skip straight to execution.
+    enable_compilation_cache()
+
     from accelerate_tpu import Accelerator, Model
     from accelerate_tpu.data_loader import make_global_batch
     from accelerate_tpu.models.llama import (

@@ -145,6 +145,10 @@ def run_kernels() -> dict:
     import jax
     import jax.numpy as jnp
 
+    from accelerate_tpu.utils.platforms import enable_compilation_cache
+
+    enable_compilation_cache()
+
     from accelerate_tpu.ops.attention import _einsum_attention
     from accelerate_tpu.ops import flash_pallas
     from accelerate_tpu.ops.flash_pallas import pallas_flash_attention
@@ -336,6 +340,10 @@ def run_kernels() -> dict:
 def run_sweep() -> dict:
     import jax
     import jax.numpy as jnp
+
+    from accelerate_tpu.utils.platforms import enable_compilation_cache
+
+    enable_compilation_cache()
 
     from accelerate_tpu.ops import flash_pallas
     from accelerate_tpu.ops.flash_pallas import pallas_flash_attention
