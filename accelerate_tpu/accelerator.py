@@ -27,6 +27,7 @@ import contextlib
 import functools
 import inspect
 import math
+import os
 import warnings
 from typing import Any, Callable, Optional
 
@@ -219,6 +220,14 @@ class Accelerator:
         self.project_configuration = project_config or ProjectConfiguration(project_dir=project_dir)
         if project_dir is not None and self.project_configuration.project_dir is None:
             self.project_configuration.set_directories(project_dir)
+
+        # Opt-in persistent compile cache: a relaunched trainer (preemption,
+        # --max_restarts) skips recompilation entirely. Env-gated so library
+        # import never mutates global jax config uninvited.
+        if os.environ.get("ACCELERATE_TPU_COMPILATION_CACHE"):
+            from .utils.platforms import enable_compilation_cache
+
+            enable_compilation_cache()
 
         # kwargs handlers (reference: accelerator.py:347-381)
         self.autocast_handler: Optional[AutocastKwargs] = None
