@@ -853,11 +853,6 @@ def fused_causal_lm_loss(module, num_chunks: int = 8):
 
     cfg = module.config
 
-    if cfg.final_logit_softcapping is not None:
-        raise NotImplementedError(
-            "fused_causal_lm_loss computes the head chunk-by-chunk and does "
-            "not apply final_logit_softcapping; use causal_lm_loss")
-
     def loss_fn(params, batch, rng=None):
         p = params["params"] if isinstance(params, dict) and "params" in params else params
         kwargs = {}
@@ -879,6 +874,7 @@ def fused_causal_lm_loss(module, num_chunks: int = 8):
         return chunked_softmax_xent(
             h.reshape(B * S, H), kernel.astype(h.dtype),
             safe.reshape(-1), mask.reshape(-1), num_chunks,
+            cfg.final_logit_softcapping,
         )
 
     return loss_fn

@@ -30,8 +30,9 @@ def _chunk_logits(h, w_c):
     )
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
-def chunked_softmax_xent(h, kernel, targets, mask, num_chunks: int = 8):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def chunked_softmax_xent(h, kernel, targets, mask, num_chunks: int = 8,
+                         logit_softcap: float | None = None):
     """Mean masked cross-entropy of ``softmax(h @ kernel)`` vs ``targets``.
 
     Args:
@@ -40,14 +41,17 @@ def chunked_softmax_xent(h, kernel, targets, mask, num_chunks: int = 8):
       targets: [N] int class ids (already made safe — no -100 sentinels).
       mask: [N] float weights (0 drops a token).
       num_chunks: vocab tiles; higher = less memory, same FLOPs.
+      logit_softcap: Gemma2 final-logit bounding, applied per chunk inside
+        the online softmax (cap * tanh(logit / cap)); the backward chains
+        the tanh derivative through the recomputed chunk.
 
     Returns scalar: sum(nll * mask) / max(sum(mask), 1).
     """
-    loss, _ = _forward(h, kernel, targets, mask, num_chunks)
+    loss, _ = _forward(h, kernel, targets, mask, num_chunks, logit_softcap)
     return loss
 
 
-def _forward(h, kernel, targets, mask, num_chunks):
+def _forward(h, kernel, targets, mask, num_chunks, logit_softcap=None):
     N, H = h.shape
     V = kernel.shape[1]
     if V % num_chunks:
@@ -59,6 +63,8 @@ def _forward(h, kernel, targets, mask, num_chunks):
         m, l, t = carry
         k, w_c = inputs
         logits = _chunk_logits(h, w_c)                       # [N, C] fp32
+        if logit_softcap is not None:
+            logits = logit_softcap * jnp.tanh(logits / logit_softcap)
         m_new = jnp.maximum(m, logits.max(axis=-1))
         l = l * jnp.exp(m - m_new) + jnp.exp(logits - m_new[:, None]).sum(axis=-1)
         local = targets - k * C
@@ -82,12 +88,12 @@ def _forward(h, kernel, targets, mask, num_chunks):
     return loss, (lse, denom)
 
 
-def _fwd(h, kernel, targets, mask, num_chunks):
-    loss, (lse, denom) = _forward(h, kernel, targets, mask, num_chunks)
+def _fwd(h, kernel, targets, mask, num_chunks, logit_softcap):
+    loss, (lse, denom) = _forward(h, kernel, targets, mask, num_chunks, logit_softcap)
     return loss, (h, kernel, targets, mask, lse, denom)
 
 
-def _bwd(num_chunks, res, g):
+def _bwd(num_chunks, logit_softcap, res, g):
     h, kernel, targets, mask, lse, denom = res
     N, H = h.shape
     V = kernel.shape[1]
@@ -99,6 +105,8 @@ def _bwd(num_chunks, res, g):
     def body(dh, inputs):
         k, w_c = inputs
         logits = _chunk_logits(h, w_c)                       # recompute [N, C]
+        if logit_softcap is not None:
+            logits = logit_softcap * jnp.tanh(logits / logit_softcap)
         p = jnp.exp(logits - lse[:, None])
         local = targets - k * C
         in_chunk = (local >= 0) & (local < C)
@@ -107,6 +115,10 @@ def _bwd(num_chunks, res, g):
             * in_chunk[:, None]
         )
         dlogits = (p - onehot) * scale[:, None]              # [N, C] fp32
+        if logit_softcap is not None:
+            # chain d(cap * tanh(pre/cap)) = 1 - (post/cap)^2; `logits` holds
+            # the bounded post-cap values, so the factor is in [0, 1].
+            dlogits = dlogits * (1.0 - jnp.square(logits / logit_softcap))
         dh = dh + jax.lax.dot_general(
             dlogits, w_c, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )

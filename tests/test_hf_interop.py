@@ -1504,13 +1504,27 @@ class TestGemma2:
         with pytest.raises(NotImplementedError, match="heterogeneous"):
             PipelinedLlamaForCausalLM(cfg)
 
-    def test_fused_loss_rejects_final_softcap(self):
+    def test_fused_loss_applies_final_softcap(self):
+        # The chunked head must softcap per chunk — loss AND grads equal
+        # the materialized softcapped-logits CE.
         from accelerate_tpu.models.llama import (
             LlamaConfig,
             LlamaForCausalLM,
+            causal_lm_loss,
             fused_causal_lm_loss,
         )
 
-        cfg = LlamaConfig.tiny(final_logit_softcapping=30.0)
-        with pytest.raises(NotImplementedError, match="softcapping"):
-            fused_causal_lm_loss(LlamaForCausalLM(cfg))
+        cfg = LlamaConfig.tiny(use_flash_attention=False, final_logit_softcapping=5.0)
+        model = LlamaForCausalLM(cfg)
+        params = model.init_params(jax.random.PRNGKey(0), batch_size=2, seq_len=16)
+        ids = np.arange(32, dtype=np.int32).reshape(2, 16) % cfg.vocab_size
+        batch = {"input_ids": jnp.asarray(ids)}
+        ref, g_ref = jax.value_and_grad(causal_lm_loss(model.apply))(params, batch)
+        got, g_got = jax.value_and_grad(fused_causal_lm_loss(model, num_chunks=4))(params, batch)
+        np.testing.assert_allclose(float(got), float(ref), rtol=2e-5)
+        for (pa, a), (pb, b) in zip(
+            jax.tree_util.tree_leaves_with_path(g_got),
+            jax.tree_util.tree_leaves_with_path(g_ref),
+        ):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-3,
+                                       err_msg=jax.tree_util.keystr(pa))
