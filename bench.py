@@ -63,7 +63,6 @@ def run_bench(on_tpu: bool) -> dict:
     from accelerate_tpu.data_loader import make_global_batch
     from accelerate_tpu.models.llama import (
         LlamaConfig,
-        LlamaForCausalLM,
         PipelinedLlamaForCausalLM,
         fused_causal_lm_loss,
     )
@@ -81,20 +80,20 @@ def run_bench(on_tpu: bool) -> dict:
             max_position_embeddings=2048, remat=False, use_flash_attention=True,
         )
         batch, seq, iters, warmup = 8, 1024, 20, 3
-        # Scan-over-layers layout: the decoder block is traced and
-        # Mosaic-compiled ONCE and lax.scan'd over the stacked [L, ...]
-        # params, instead of inlining 10 copies — over the tunnel the
-        # unrolled compile alone blew a 480 s budget (watch history
-        # 2026-07-31T04:05). Same math, same flash kernel, ~10x less compile.
-        model_def = PipelinedLlamaForCausalLM(cfg)
-        jax.devices()  # force backend init under its own marker
-        mark("BACKEND_UP")
-        params = model_def.init_params(jax.random.PRNGKey(0))
     else:  # CPU smoke fallback so the bench always emits a line
         cfg = LlamaConfig.tiny(use_flash_attention=False)
         batch, seq, iters, warmup = 4, 32, 3, 1
-        model_def = LlamaForCausalLM(cfg)
-        params = model_def.init_params(jax.random.PRNGKey(0), batch_size=1, seq_len=8)
+    # Scan-over-layers layout for BOTH tiers: the decoder block is traced
+    # and compiled ONCE and lax.scan'd over the stacked [L, ...] params,
+    # instead of inlining N copies — over the tunnel the unrolled compile
+    # alone blew a 480 s budget (watch history 2026-07-31T04:05). Using the
+    # same model class + loss on CPU means every smoke run exercises the
+    # exact tier-1 code path.
+    model_def = PipelinedLlamaForCausalLM(cfg)
+    if on_tpu:
+        jax.devices()  # force backend init under its own marker
+        mark("BACKEND_UP")
+    params = model_def.init_params(jax.random.PRNGKey(0))
     mark("PARAMS_INIT")
 
     acc = Accelerator(mixed_precision="bf16")
