@@ -372,10 +372,13 @@ def _decoder_block_specs(cfg, block_cls, scope: str, has_aux: bool) -> list[Bloc
     for i in range(cfg.num_hidden_layers):
         # Blocks sharing `kind` share one jitted executable, so per-layer
         # structure MUST split the kind: Gemma2's local/global mixture gets
-        # one executable per distinct window (2 total), not one mis-shared
-        # trace for all layers.
+        # one executable per distinct window, and Qwen2-MoE's dense
+        # (mlp_only) layers must not reuse a sparse layer's trace (their
+        # param trees differ).
         window = cfg.window_for(i) if hasattr(cfg, "window_for") else None
         kind = "layer" if window is None else f"layer_w{window}"
+        if i in getattr(cfg, "mlp_only_layers", ()):
+            kind += "_dense"
         specs.append(BlockSpec(f"layers_{i}", (f"{scope}layers_{i}",),
                                layer_apply_for(blocks[i]),
                                kind=kind, cache_slot=True,
@@ -1232,8 +1235,8 @@ def load_hf_checkpoint_and_dispatch(
     from .utils.hf_interop import map_hf_key, open_hf_checkpoint
 
     family, config, module = open_hf_checkpoint(checkpoint_dir, config)
-    streamable = ("llama", "mistral", "qwen2", "gemma", "gemma2", "gpt2", "gptj",
-                  "gpt_neox", "opt", "phi", "t5", "mixtral")
+    streamable = ("llama", "mistral", "qwen2", "qwen2_moe", "gemma", "gemma2",
+                  "gpt2", "gptj", "gpt_neox", "opt", "phi", "t5", "mixtral")
     if family not in streamable:
         raise ValueError(
             f"streamed dispatch supports {'/'.join(streamable)} (got "

@@ -35,6 +35,17 @@ class MixtralConfig(LlamaConfig):
     router_noise_eps: float = 0.0
     # None = one routing group per data shard (ops/moe.py default_num_groups)
     num_expert_groups: Optional[int] = None
+    # Qwen2-MoE-style knobs (HF qwen2_moe):
+    # * norm_topk_prob: renormalize the selected top-k gate weights (None =
+    #   GShard default, True iff top_k > 1; Qwen2-MoE ships False).
+    # * shared_expert_intermediate_size: an always-on SwiGLU expert whose
+    #   output is added scaled by a learned per-token sigmoid gate.
+    # * mlp_only_layers: layer indices using a plain dense MLP of width
+    #   dense_intermediate_size instead of the sparse expert layer.
+    norm_topk_prob: Optional[bool] = None
+    shared_expert_intermediate_size: Optional[int] = None
+    mlp_only_layers: tuple = ()
+    dense_intermediate_size: Optional[int] = None
 
     @classmethod
     def mixtral_8x7b(cls, **overrides):
@@ -96,7 +107,7 @@ class MixtralSparseMLP(nn.Module):
         # capacity = ceil(top_k * T * factor / E): factor = E guarantees
         # top_k * T slots, i.e. zero drops.
         capacity_factor = float(cfg.num_experts) if self.no_drop else cfg.capacity_factor
-        return moe_mlp_apply(
+        out, aux = moe_mlp_apply(
             experts,
             router,
             x,
@@ -105,11 +116,25 @@ class MixtralSparseMLP(nn.Module):
             num_groups=cfg.num_expert_groups,
             router_noise_rng=router_noise_rng,
             router_noise_eps=cfg.router_noise_eps,
+            normalize_gates=cfg.norm_topk_prob,
         )
+        if cfg.shared_expert_intermediate_size:
+            # Qwen2-MoE shared expert: always-on SwiGLU, sigmoid-gated per
+            # token — rides alongside the routed experts, no dispatch.
+            Fs = cfg.shared_expert_intermediate_size
+            dense = lambda feats, name: nn.Dense(  # noqa: E731
+                feats, use_bias=False, name=name, dtype=x.dtype, param_dtype=jnp.float32)
+            gate_h = dense(Fs, "shared_gate_proj")(x)
+            up_h = dense(Fs, "shared_up_proj")(x)
+            shared = dense(D, "shared_down_proj")(jax.nn.silu(gate_h) * up_h)
+            gate_logit = dense(1, "shared_expert_gate")(x)
+            out = out + jax.nn.sigmoid(gate_logit.astype(jnp.float32)).astype(out.dtype) * shared
+        return out, aux
 
 
 class MixtralBlock(nn.Module):
     config: MixtralConfig
+    layer_idx: int = 0
 
     @nn.compact
     def __call__(self, x, positions, cache=None, cache_pos=None):
@@ -122,9 +147,21 @@ class MixtralBlock(nn.Module):
         if cache is not None:
             attn, new_cache = attn
         h = x + attn
-        mlp_out, aux = MixtralSparseMLP(cfg, no_drop=cache is not None, name="mlp")(
-            RMSNorm(cfg.rms_norm_eps, name="post_attn_norm")(h)
-        )
+        normed = RMSNorm(cfg.rms_norm_eps, name="post_attn_norm")(h)
+        if self.layer_idx in cfg.mlp_only_layers:
+            # Dense layer (Qwen2-MoE mlp_only_layers / decoder_sparse_step):
+            # a plain SwiGLU of dense_intermediate_size, zero router losses.
+            import dataclasses as _dc
+
+            from .llama import LlamaMLP
+
+            dense_cfg = _dc.replace(
+                cfg, intermediate_size=cfg.dense_intermediate_size or cfg.intermediate_size)
+            mlp_out = LlamaMLP(dense_cfg, name="mlp")(normed)
+            aux = {"load_balance_loss": jnp.zeros((), jnp.float32),
+                   "router_z_loss": jnp.zeros((), jnp.float32)}
+        else:
+            mlp_out, aux = MixtralSparseMLP(cfg, no_drop=cache is not None, name="mlp")(normed)
         out = h + mlp_out
         return (out, aux) if cache is None else (out, aux, new_cache)
 
@@ -151,9 +188,9 @@ class MixtralForCausalLM(nn.Module):
         new_caches = []
         for i in range(cfg.num_hidden_layers):
             if cache is None:
-                x, aux = block_cls(cfg, name=f"layers_{i}")(x, positions)
+                x, aux = block_cls(cfg, layer_idx=i, name=f"layers_{i}")(x, positions)
             else:
-                x, aux, layer_cache = block_cls(cfg, name=f"layers_{i}")(
+                x, aux, layer_cache = block_cls(cfg, layer_idx=i, name=f"layers_{i}")(
                     x, positions, cache=cache[i], cache_pos=cache_pos
                 )
                 new_caches.append(layer_cache)

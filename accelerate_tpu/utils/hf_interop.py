@@ -80,6 +80,25 @@ _MIXTRAL_EXPERT_RE = re.compile(
 # HF w1 = gate (F,D), w2 = down (D,F), w3 = up (F,D).
 _MIXTRAL_W_TO_NAME = {"1": "gate_proj", "2": "down_proj", "3": "up_proj"}
 
+_QWEN2_MOE_EXPERT_RE = re.compile(
+    r"model\.layers\.(\d+)\.mlp\.experts\.(\d+)\.(gate_proj|up_proj|down_proj)\.weight")
+
+# Per-expert HF Linears -> our stacked (E, in, out) tensors. Per family:
+# (regex with (layer, expert, proj-token) groups, token -> our proj name,
+# exporter (layer, expert, token) -> HF key).
+_EXPERT_CONVENTIONS = {
+    "mixtral": (
+        _MIXTRAL_EXPERT_RE,
+        _MIXTRAL_W_TO_NAME,
+        lambda layer, e, tok: f"model.layers.{layer}.block_sparse_moe.experts.{e}.w{tok}.weight",
+    ),
+    "qwen2_moe": (
+        _QWEN2_MOE_EXPERT_RE,
+        {p: p for p in ("gate_proj", "up_proj", "down_proj")},
+        lambda layer, e, tok: f"model.layers.{layer}.mlp.experts.{e}.{tok}.weight",
+    ),
+}
+
 _GPT2_RULES = [
     ("wte.weight", "wte/embedding", "copy", None),
     ("wpe.weight", "wpe/embedding", "copy", None),
@@ -334,6 +353,22 @@ _QWEN2_RULES = _LLAMA_RULES + [
      "model/layers_{i}/self_attn/{p}_proj/bias", "copy", ("q", "k", "v")),
 ]
 
+# Qwen2-MoE: qwen2 attention (qkv biases) + routed experts + an always-on
+# sigmoid-gated shared expert; dense (mlp_only) layers keep llama MLP names.
+# Flat scope like mixtral (our MixtralForCausalLM has no "model" wrapper).
+_QWEN2_MOE_RULES = [
+    (hf_t, ours_t.removeprefix("model/"), op, alts)
+    for hf_t, ours_t, op, alts in _QWEN2_RULES if ".mlp." not in hf_t
+] + [
+    ("model.layers.{i}.mlp.gate.weight", "layers_{i}/mlp/router", "t", None),
+    ("model.layers.{i}.mlp.shared_expert.{p}_proj.weight",
+     "layers_{i}/mlp/shared_{p}_proj/kernel", "t", ("gate", "up", "down")),
+    ("model.layers.{i}.mlp.shared_expert_gate.weight",
+     "layers_{i}/mlp/shared_expert_gate/kernel", "t", None),
+    ("model.layers.{i}.mlp.{p}_proj.weight",
+     "layers_{i}/mlp/{p}_proj/kernel", "t", ("gate", "up", "down")),
+]
+
 # Gemma2: llama-named tensors plus the sandwich-norm pair around the MLP
 # (input/post_attention norms reuse the llama rules; semantics switch on
 # LlamaConfig.post_norms).
@@ -351,6 +386,7 @@ _FAMILY_RULES = {
     # sliding_window (handled in config_from_hf).
     "mistral": _LLAMA_RULES,
     "qwen2": _QWEN2_RULES,
+    "qwen2_moe": _QWEN2_MOE_RULES,
     # Gemma is llama-named too; the differences (GeGLU, 1+w norms, embedding
     # scaling, decoupled head_dim, tied head) live in config_from_hf.
     "gemma": _LLAMA_RULES,
@@ -379,6 +415,7 @@ _STRIP_PREFIXES = {
     "mixtral": (),
     "t5": (),
     "qwen2": (),
+    "qwen2_moe": (),
     "gemma": (),
     "gemma2": (),
 }
@@ -473,7 +510,7 @@ def config_from_hf(hf_config: dict, family: Optional[str] = None):
     HF ``config.json`` dict."""
     family = family or detect_family(hf_config)
     get = hf_config.get
-    if family in ("llama", "mistral", "mixtral", "qwen2", "gemma", "gemma2"):
+    if family in ("llama", "mistral", "mixtral", "qwen2", "qwen2_moe", "gemma", "gemma2"):
         from ..models.llama import LlamaConfig, scale_rope_frequencies
         from ..models.mixtral import MixtralConfig
 
@@ -540,6 +577,27 @@ def config_from_hf(hf_config: dict, family: Optional[str] = None):
                     sliding, windows = windows[0], None
             return LlamaConfig(**kwargs, attention_qkv_bias=True,
                                sliding_window=sliding, layer_windows=windows)
+        if family == "qwen2_moe":
+            # Experts use moe_intermediate_size; the config's plain
+            # intermediate_size is the width of the DENSE (mlp_only /
+            # decoder_sparse_step) layers. HF: a layer is sparse iff it is
+            # not in mlp_only_layers and (i + 1) % decoder_sparse_step == 0.
+            step = get("decoder_sparse_step", 1) or 1
+            n_layers = kwargs["num_hidden_layers"]
+            only = set(get("mlp_only_layers") or ())
+            dense_layers = tuple(sorted(
+                i for i in range(n_layers) if i in only or (i + 1) % step != 0))
+            return MixtralConfig(
+                **{**kwargs, "intermediate_size": get("moe_intermediate_size", 1408)},
+                attention_qkv_bias=True,
+                num_experts=get("num_experts", 60),
+                top_k=get("num_experts_per_tok", 4),
+                norm_topk_prob=bool(get("norm_topk_prob", False)),
+                shared_expert_intermediate_size=get("shared_expert_intermediate_size"),
+                mlp_only_layers=dense_layers,
+                dense_intermediate_size=get("intermediate_size"),
+                router_aux_coef=get("router_aux_loss_coef", 0.001),
+            )
         if family in ("gemma", "gemma2"):
             gemma_kwargs = dict(
                 **{**kwargs, "rms_norm_eps": get("rms_norm_eps", 1e-6),
@@ -763,7 +821,7 @@ def model_from_config(config, family: str):
         from ..models.llama import LlamaForCausalLM
 
         return LlamaForCausalLM(config)
-    if family == "mixtral":
+    if family in ("mixtral", "qwen2_moe"):
         from ..models.mixtral import MixtralForCausalLM
 
         return MixtralForCausalLM(config)
@@ -816,11 +874,12 @@ def map_hf_key(key: str, family: str) -> Optional[tuple[str, str]]:
     if family not in _COMPILED:
         raise ValueError(f"unsupported family {family!r}; supported: {sorted(_COMPILED)}")
     key = _strip_prefix(key, family)
-    if family == "mixtral":
-        em = _MIXTRAL_EXPERT_RE.match(key)
+    if family in _EXPERT_CONVENTIONS:
+        expert_re, tok_to_name, _ = _EXPERT_CONVENTIONS[family]
+        em = expert_re.match(key)
         if em:
             layer, expert, w = em.group(1), int(em.group(2)), em.group(3)
-            ours = f"layers_{layer}.mlp.experts.{_MIXTRAL_W_TO_NAME[w]}"
+            ours = f"layers_{layer}.mlp.experts.{tok_to_name[w]}"
             return ours, f"stack:{expert}:t"
     for hf_re, _, _, ours_t, op in _COMPILED[family]:
         match = hf_re.match(key)
@@ -883,11 +942,12 @@ def convert_hf_state_dict(
         if raw_key in drop_keys:
             continue
         key = _strip_prefix(raw_key, family)
-        if family == "mixtral":
-            em = _MIXTRAL_EXPERT_RE.match(key)
+        if family in _EXPERT_CONVENTIONS:
+            expert_re, tok_to_name, _ = _EXPERT_CONVENTIONS[family]
+            em = expert_re.match(key)
             if em:
                 layer, expert, w = em.group(1), int(em.group(2)), em.group(3)
-                ours = f"layers_{layer}/mlp/experts/{_MIXTRAL_W_TO_NAME[w]}"
+                ours = f"layers_{layer}/mlp/experts/{tok_to_name[w]}"
                 # HF per-expert Linear is (out, in); batched einsum wants
                 # (in, out) per expert -> transpose, then stack on E below.
                 expert_parts.setdefault(ours, {})[expert] = as_np(raw_value).T
@@ -931,13 +991,13 @@ def export_hf_state_dict(params: dict, family: str, *, prefix: str = "",
     # projection as wi_0, not v1.0's wi — the first-match rule can't know.
     t5_gated = family == "t5" and any("intermediate_gate" in k for k in flat_params)
     for key, value in flat_params.items():
-        if family == "mixtral" and re.match(r"^layers_\d+/mlp/experts/", key):
+        if family in _EXPERT_CONVENTIONS and re.match(r"^layers_\d+/mlp/experts/", key):
+            _, tok_to_name, hf_key_for = _EXPERT_CONVENTIONS[family]
             layer = re.search(r"layers_(\d+)", key).group(1)
             name = key.rsplit("/", 1)[1]
-            w = {v: k for k, v in _MIXTRAL_W_TO_NAME.items()}[name]
+            w = {v: k for k, v in tok_to_name.items()}[name]
             for e in range(value.shape[0]):
-                hf_key = f"model.layers.{layer}.block_sparse_moe.experts.{e}.w{w}.weight"
-                out[prefix + hf_key] = np.ascontiguousarray(value[e].T)
+                out[prefix + hf_key_for(layer, e, w)] = np.ascontiguousarray(value[e].T)
             continue
         for _, ours_re, hf_t, _, op in rules:
             match = ours_re.match(key)

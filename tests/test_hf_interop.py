@@ -1528,3 +1528,94 @@ class TestGemma2:
         ):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-3,
                                        err_msg=jax.tree_util.keystr(pa))
+
+
+class TestQwen2Moe:
+    """Qwen2-MoE = qwen2 attention (qkv biases) + routed experts +
+    sigmoid-gated shared expert (+ optional dense mlp_only layers)."""
+
+    def _pair(self, mlp_only_layers=(), norm_topk=False):
+        hf_cfg = transformers.Qwen2MoeConfig(
+            vocab_size=96, hidden_size=32, intermediate_size=80,
+            moe_intermediate_size=48, shared_expert_intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            num_experts=4, num_experts_per_tok=2, norm_topk_prob=norm_topk,
+            decoder_sparse_step=1, mlp_only_layers=list(mlp_only_layers),
+            max_position_embeddings=64, rms_norm_eps=1e-5,
+            use_sliding_window=False, tie_word_embeddings=False,
+            router_jitter_noise=0.0, attention_dropout=0.0)
+        torch.manual_seed(0)
+        with torch.no_grad():
+            hf = transformers.Qwen2MoeForCausalLM(hf_cfg).eval()
+        cfg = config_from_hf(hf_cfg.to_dict())
+        assert detect_family(hf_cfg.to_dict()) == "qwen2_moe"
+        assert cfg.attention_qkv_bias and cfg.intermediate_size == 48
+        assert cfg.shared_expert_intermediate_size == 64
+        assert cfg.dense_intermediate_size == 80
+        assert cfg.mlp_only_layers == tuple(mlp_only_layers)
+        assert cfg.norm_topk_prob is norm_topk
+        # No-drop capacity so sparse dispatch is exact (matches HF's dense
+        # gather over selected experts).
+        cfg.capacity_factor = float(cfg.num_experts)
+        cfg.use_flash_attention = False
+        from accelerate_tpu.models.mixtral import MixtralForCausalLM
+
+        params = convert_hf_state_dict(hf.state_dict(), "qwen2_moe", strict=True)
+        return hf, MixtralForCausalLM(cfg), params
+
+    @pytest.mark.parametrize("norm_topk", [False, True])
+    def test_forward_parity(self, norm_topk):
+        hf, model, params = self._pair(norm_topk=norm_topk)
+        ids = (np.arange(16, dtype=np.int64).reshape(2, 8) * 5) % 96
+        out = model.apply({"params": params}, jnp.asarray(ids, jnp.int32))
+        ours = out[0] if isinstance(out, tuple) else out
+        with torch.no_grad():
+            theirs = hf(torch.from_numpy(ids)).logits
+        _logits_close(ours, theirs, atol=5e-4)
+
+    def test_dense_mlp_only_layer_parity(self):
+        hf, model, params = self._pair(mlp_only_layers=(1,))
+        ids = (np.arange(16, dtype=np.int64).reshape(2, 8) * 7) % 96
+        out = model.apply({"params": params}, jnp.asarray(ids, jnp.int32))
+        ours = out[0] if isinstance(out, tuple) else out
+        with torch.no_grad():
+            theirs = hf(torch.from_numpy(ids)).logits
+        _logits_close(ours, theirs, atol=5e-4)
+
+    def test_greedy_decode_parity(self):
+        from accelerate_tpu.generation import generate
+
+        hf, model, params = self._pair()
+        ids = (np.arange(8, dtype=np.int64)[None] * 3) % 96
+        ours = np.asarray(generate(model, params, jnp.asarray(ids, jnp.int32),
+                                   max_new_tokens=6, cache_dtype=jnp.float32))
+        with torch.no_grad():
+            theirs = hf.generate(torch.from_numpy(ids).long(), max_new_tokens=6,
+                                 do_sample=False)
+        np.testing.assert_array_equal(ours, theirs.numpy())
+
+    def test_roundtrip(self):
+        hf, _, params = self._pair()
+        _roundtrip(params, "qwen2_moe", hf.state_dict())
+
+    def test_streamed_dispatch(self, tmp_path):
+        import json as _json
+
+        from safetensors.numpy import save_file
+
+        from accelerate_tpu import load_hf_checkpoint_and_dispatch
+
+        hf, model, params = self._pair()
+        d = tmp_path / "qwen2moe"
+        d.mkdir()
+        save_file({k: v.numpy() for k, v in hf.state_dict().items()},
+                  str(d / "model.safetensors"))
+        _json.dump(hf.config.to_dict(), open(d / "config.json", "w"))
+        streamed, module = load_hf_checkpoint_and_dispatch(
+            str(d), device_map={"": "disk"}, dtype=jnp.float32)
+        ids = np.arange(1, 9, dtype=np.int32)[None]
+        ours = np.asarray(streamed.generate(ids, max_new_tokens=5))
+        with torch.no_grad():
+            theirs = hf.generate(torch.from_numpy(ids).long(), max_new_tokens=5,
+                                 do_sample=False)
+        np.testing.assert_array_equal(ours, theirs.numpy())
