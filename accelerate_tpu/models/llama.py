@@ -262,8 +262,7 @@ def multi_head_attention(
         window = (sliding_window if sliding_window is not None
                   and sliding_window < q.shape[1] else None)
         if (backend != "einsum" and use_flash and causal
-                and flash_attention_available(q)
-                and not (window is not None and segment_ids is not None)):
+                and flash_attention_available(q)):
             return flash_attention(
                 q, k, v, causal=True, sliding_window=window,
                 block_q=block_q, block_k=block_k, segment_ids=segment_ids,
@@ -286,11 +285,13 @@ def multi_head_attention(
         if backend in ("ring", "ulysses"):
             raise ValueError(
                 f"attention_backend={backend!r} does not support sliding_window")
-        if backend != "einsum" and use_flash and segment_ids is None and causal:
+        if backend != "einsum" and use_flash and causal:
+            # Window + segments compose inside the kernel (packed long-doc
+            # training keeps the banded O(S*w) asymptotics).
             return flash_attention(q, k, v, causal=True,
                                    sliding_window=sliding_window,
                                    block_q=block_q, block_k=block_k,
-                                   sm_scale=sm_scale)
+                                   segment_ids=segment_ids, sm_scale=sm_scale)
         return _einsum_attention(q, k, v, causal=causal,
                                  segment_ids=segment_ids,
                                  sliding_window=sliding_window,

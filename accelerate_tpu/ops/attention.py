@@ -97,17 +97,15 @@ def flash_attention(q, k, v, causal: bool = True, block_q: int = 128, block_k: i
 
     Args are [batch, seq, heads, head_dim]. Dispatches to the Pallas kernel
     on TPU; einsum fallback elsewhere. ``segment_ids`` (packed sequences)
-    are masked inside the kernel; the sliding_window+segments combination
-    routes to the einsum path. ``sm_scale`` overrides 1/sqrt(head_dim);
-    ``logit_softcap`` (Gemma2) is applied inside the kernel pre-mask.
+    are masked inside the kernel and compose with ``sliding_window``'s
+    banded grid. ``sm_scale`` overrides 1/sqrt(head_dim); ``logit_softcap``
+    (Gemma2) is applied inside the kernel pre-mask.
     """
     if sliding_window is not None and not causal:
         # Validated here (not just in the kernel) so CPU-fallback runs fail
         # identically to TPU runs instead of silently clamping causally.
         raise ValueError("sliding_window requires causal=True")
-    if not flash_attention_available(q) or (
-        sliding_window is not None and segment_ids is not None
-    ):
+    if not flash_attention_available(q):
         return _einsum_attention(q, k, v, causal, segment_ids=segment_ids,
                                  sliding_window=sliding_window, sm_scale=sm_scale,
                                  logit_softcap=logit_softcap)

@@ -549,9 +549,6 @@ def pallas_flash_attention(q, k, v, causal: bool = True, block_q: int = 128, blo
         sm_scale = q.shape[-1] ** -0.5
     if sliding_window is not None and not causal:
         raise ValueError("sliding_window requires causal=True")
-    if sliding_window is not None and segment_ids is not None:
-        raise ValueError("sliding_window with segment_ids is not supported in the "
-                         "Pallas kernel (use the einsum path)")
     if q.shape[2] % k.shape[2]:
         raise ValueError(f"q heads {q.shape[2]} not a multiple of kv heads {k.shape[2]}")
     S = q.shape[1]
@@ -560,8 +557,13 @@ def pallas_flash_attention(q, k, v, causal: bool = True, block_q: int = 128, blo
     # [B, S, H, D] -> [B, H, S, D]
     qt, kt, vt = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
     if segment_ids is not None:
+        # Window and segment masks compose inside the kernel: the banded
+        # grid skips out-of-window K blocks, the in-block mask ANDs the
+        # segment equality — packed long-doc training for windowed models
+        # keeps flash's O(S x w) asymptotics.
         out = _flash_bhsd_seg(qt, kt, vt, segment_ids.astype(jnp.int32),
-                              sm_scale, causal, None, block_q, block_k, logit_softcap)
+                              sm_scale, causal, sliding_window, block_q, block_k,
+                              logit_softcap)
     else:
         out = _flash_bhsd(qt, kt, vt, sm_scale, causal, sliding_window, block_q, block_k,
                           logit_softcap)

@@ -148,11 +148,30 @@ def test_segment_ids_rectangular_blocks():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
 
 
-def test_segment_ids_with_sliding_window_rejected():
-    q, k, v = make_qkv(B=1, S=128, H=1, D=32)
-    segs = _packed_segments(1, 128)
-    with pytest.raises(ValueError, match="sliding_window with segment_ids"):
-        pallas_flash_attention(q, k, v, causal=True, sliding_window=16, segment_ids=segs)
+def test_segment_ids_with_sliding_window_compose():
+    # Packed sequences + local attention: the banded grid and the segment
+    # mask must compose exactly (forward AND backward).
+    q, k, v = make_qkv(B=1, S=256, H=2, D=32)
+    segs = _packed_segments(1, 256)
+
+    def loss_flash(q, k, v):
+        return (pallas_flash_attention(q, k, v, causal=True, block_q=64, block_k=64,
+                                       sliding_window=70, segment_ids=segs) ** 2).sum()
+
+    def loss_ref(q, k, v):
+        return (_einsum_attention(q, k, v, causal=True, sliding_window=70,
+                                  segment_ids=segs) ** 2).sum()
+
+    out = pallas_flash_attention(q, k, v, causal=True, block_q=64, block_k=64,
+                                 sliding_window=70, segment_ids=segs)
+    ref = _einsum_attention(q, k, v, causal=True, sliding_window=70, segment_ids=segs)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g_flash, g_ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4, rtol=5e-4,
+                                   err_msg=f"d{name} mismatch")
 
 
 def test_bf16_inputs():
