@@ -827,31 +827,45 @@ class StreamedModel:
         return args[0] if len(args) == 1 else args
 
     # -- generation --------------------------------------------------------
-    def _apply_cached(self, spec: BlockSpec, ptrees: tuple, args: tuple, cache, pos):
-        key = spec.kind + "/cached"
+    def _apply_cached(self, spec: BlockSpec, ptrees: tuple, args: tuple, cache, pos,
+                      static_pos: bool = False):
+        key = spec.kind + ("/cached_prefill" if static_pos else "/cached")
         fn = self._jitted.get(key)
         if fn is None:
             # Donate the cache: its output aliases the input buffer, so the
             # decode loop never holds two copies of a layer's KV.
-            fn = jax.jit(spec.cached_apply, donate_argnums=(2,))
+            fn = jax.jit(spec.cached_apply, donate_argnums=(2,),
+                         static_argnums=(3,) if static_pos else ())
             self._jitted[key] = fn
         return fn(ptrees, args, cache, pos)
 
     def _cached_pass(self, args: tuple, caches: list, pos: int, specs=None):
         """One full pass (prefill or single-token decode) through the given
         blocks (default: all), updating layer caches in place. Returns the
-        next greedy token."""
-        pos = jnp.asarray(pos, jnp.int32)
+        next greedy token.
+
+        The multi-token prefill keeps ``pos`` STATIC (a Python int): its
+        executable is shape-distinct from the decode step anyway, and ring
+        KV caches (sliding-window layers) require a statically-known
+        prefill position to validate their write-into-empty-ring contract.
+        Decode passes a traced scalar so every token shares one executable."""
+        static_pos = args[0].shape[1] > 1
+        if static_pos:
+            pos = int(pos)
+        else:
+            pos = jnp.asarray(pos, jnp.int32)
         li = 0
         for spec, ptrees in self._iter_blocks(specs):
             # cache_slot is the contract; kind == "layer" kept for
             # externally-built spec lists written against the documented
             # decoder-only convention (cache_factory_for docstring).
             if spec.cache_slot or spec.kind == "layer":
-                args, caches[li] = self._apply_cached(spec, ptrees, args, caches[li], pos)
+                args, caches[li] = self._apply_cached(spec, ptrees, args, caches[li], pos,
+                                                      static_pos=static_pos)
                 li += 1
             else:
-                args, _ = self._apply_cached(spec, ptrees, args, None, pos)
+                args, _ = self._apply_cached(spec, ptrees, args, None, pos,
+                                             static_pos=static_pos)
         logits = args[0]
         return jnp.argmax(logits[:, -1, :], axis=-1)
 

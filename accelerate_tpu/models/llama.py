@@ -25,6 +25,7 @@ from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import flax.linen as nn
 
 
@@ -381,38 +382,23 @@ def _cached_attention(q, k_all, v_all, cache_pos, n_rep: int, sliding_window=Non
     contract directly against the unrepeated cache, so per-token HBM traffic
     scales with n_kv, never with a materialized n_q-wide K/V copy.
     """
-    B, S, H, hd = q.shape
+    B, S, _, _ = q.shape
     L = k_all.shape[1]
-    scale = hd**-0.5 if sm_scale is None else sm_scale
-    qg = (q * scale).astype(jnp.float32).reshape(B, S, H // n_rep, n_rep, hd)
-    logits = jnp.einsum("bqgrd,bkgd->bgrqk", qg, k_all.astype(jnp.float32))
-    from ..ops.attention import softcap_logits
-
-    logits = softcap_logits(logits, logit_softcap)
     q_pos = cache_pos + jnp.arange(S, dtype=jnp.int32)
     k_pos = jnp.arange(L, dtype=jnp.int32)[None, :]
     mask = k_pos <= q_pos[:, None]
     if sliding_window is not None:
         mask &= k_pos > q_pos[:, None] - sliding_window
-    logits = jnp.where(mask[None, None, None], logits, -1e30)
-    probs = jax.nn.softmax(logits, axis=-1)
-    out = jnp.einsum("bgrqk,bkgd->bqgrd", probs, v_all.astype(jnp.float32))
-    return out.reshape(B, S, H, hd).astype(q.dtype)
+    return _grouped_cached_attention(q, k_all, v_all, mask[None], n_rep,
+                                     sm_scale=sm_scale, logit_softcap=logit_softcap)
 
 
 def _ring_cached_attention(q, cache, cache_pos, n_rep: int, window: int,
                            sm_scale=None, logit_softcap=None):
-    """Grouped attention of q [B, S, H, hd] against a ring cache of
-    ``window`` slots. Validity comes from the per-slot ``pos`` buffer:
+    """Ring-cache decode: validity comes from the per-slot ``pos`` buffer —
     a slot is visible iff it has been written (pos >= 0), is not in the
     query's future, and lies inside the window."""
-    from ..ops.attention import softcap_logits
-
-    B, S, H, hd = q.shape
-    scale = hd**-0.5 if sm_scale is None else sm_scale
-    qg = (q * scale).astype(jnp.float32).reshape(B, S, H // n_rep, n_rep, hd)
-    logits = jnp.einsum("bqgrd,bkgd->bgrqk", qg, cache["k"].astype(jnp.float32))
-    logits = softcap_logits(logits, logit_softcap)
+    S = q.shape[1]
     q_pos = cache_pos + jnp.arange(S, dtype=jnp.int32)          # [S]
     slot_pos = cache["pos"]                                     # [B, W]
     mask = (
@@ -420,10 +406,28 @@ def _ring_cached_attention(q, cache, cache_pos, n_rep: int, window: int,
         & (slot_pos[:, None, :] <= q_pos[None, :, None])
         & (slot_pos[:, None, :] > q_pos[None, :, None] - window)
     )  # [B, S, W]
-    # logits: [B, G, rep, S, W] <- mask broadcast over the two head dims.
+    return _grouped_cached_attention(q, cache["k"], cache["v"], mask, n_rep,
+                                     sm_scale=sm_scale, logit_softcap=logit_softcap)
+
+
+def _grouped_cached_attention(q, k_all, v_all, mask, n_rep: int,
+                              sm_scale=None, logit_softcap=None):
+    """Shared cached-attention core: q [B, S, H, hd] against [B, L, n_kv, hd]
+    with a caller-built validity mask [B or 1, S, L]. GQA is a *grouped*
+    einsum — queries reshape to [B, S, n_kv, rep, hd] and contract directly
+    against the unrepeated cache, so per-token HBM traffic scales with n_kv,
+    never with a materialized n_q-wide K/V copy."""
+    from ..ops.attention import softcap_logits
+
+    B, S, H, hd = q.shape
+    scale = hd**-0.5 if sm_scale is None else sm_scale
+    qg = (q * scale).astype(jnp.float32).reshape(B, S, H // n_rep, n_rep, hd)
+    logits = jnp.einsum("bqgrd,bkgd->bgrqk", qg, k_all.astype(jnp.float32))
+    logits = softcap_logits(logits, logit_softcap)
+    # logits: [B, G, rep, S, L] <- mask broadcast over the two head dims.
     logits = jnp.where(mask[:, None, None, :, :], logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1)
-    out = jnp.einsum("bgrqk,bkgd->bqgrd", probs, cache["v"].astype(jnp.float32))
+    out = jnp.einsum("bgrqk,bkgd->bqgrd", probs, v_all.astype(jnp.float32))
     return out.reshape(B, S, H, hd).astype(q.dtype)
 
 
@@ -455,6 +459,16 @@ def update_kv_cache_and_attend(cache, q, k, v, cache_pos, n_rep: int, sliding_wi
     window = cache["k"].shape[1]
     B, S = q.shape[0], q.shape[1]
     if S > 1:
+        # The chunk path computes attention from the chunk ALONE — valid
+        # only for the initial prefill into an empty ring. Chunked prefill /
+        # multi-token decode at cache_pos > 0 would need the in-window keys
+        # already in the ring; fail loudly instead of silently ignoring them
+        # (the full-cache path above supports that case).
+        if not (isinstance(cache_pos, (int, np.integer)) and int(cache_pos) == 0):
+            raise NotImplementedError(
+                "ring KV caches support multi-token writes only as the initial "
+                "prefill (static cache_pos == 0); chunked prefill into a "
+                "partially-filled ring is not implemented")
         # Prefill: attention over the chunk itself (windowed causal).
         out = _einsum_attention(
             q, k, v, causal=True, sliding_window=min(sliding_window or window, window),

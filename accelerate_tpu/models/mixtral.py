@@ -139,7 +139,8 @@ class MixtralBlock(nn.Module):
     @nn.compact
     def __call__(self, x, positions, cache=None, cache_pos=None):
         cfg = self.config
-        attn = LlamaAttention(cfg, name="self_attn")(
+        attn = LlamaAttention(cfg, window=cfg.window_for(self.layer_idx),
+                              name="self_attn")(
             RMSNorm(cfg.rms_norm_eps, name="input_norm")(x), positions,
             cache=cache, cache_pos=cache_pos,
         )

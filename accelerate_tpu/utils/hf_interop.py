@@ -554,27 +554,31 @@ def config_from_hf(hf_config: dict, family: Optional[str] = None):
             return LlamaConfig(**kwargs, sliding_window=get("sliding_window"))
         if family == "llama":
             return LlamaConfig(**kwargs)
+        def qwen_windows():
+            # Sliding window only when the config opts in (use_sliding_window,
+            # off by default); the first max_window_layers layers stay
+            # full-attention, represented per layer via layer_windows.
+            # Returns (uniform_sliding, layer_windows) with one of them None.
+            if not get("use_sliding_window"):
+                return None, None
+            n_layers = kwargs["num_hidden_layers"]
+            layer_types = get("layer_types")
+            if layer_types:
+                windows = tuple(
+                    get("sliding_window") if t == "sliding_attention" else None
+                    for t in layer_types)
+            else:
+                full = get("max_window_layers", n_layers)
+                windows = tuple(
+                    None if i < full else get("sliding_window")
+                    for i in range(n_layers))
+            if len(set(windows)) == 1:  # uniform: keep the simple knob
+                return windows[0], None
+            return None, windows
+
         if family == "qwen2":
-            # Qwen2 biases q/k/v (never o). Sliding window only when the
-            # config opts in (use_sliding_window, off by default); the first
-            # max_window_layers layers stay full-attention, represented as a
-            # per-layer mixture via LlamaConfig.layer_windows.
-            sliding = None
-            windows = None
-            if get("use_sliding_window"):
-                n_layers = kwargs["num_hidden_layers"]
-                layer_types = get("layer_types")
-                if layer_types:
-                    windows = tuple(
-                        get("sliding_window") if t == "sliding_attention" else None
-                        for t in layer_types)
-                else:
-                    full = get("max_window_layers", n_layers)
-                    windows = tuple(
-                        None if i < full else get("sliding_window")
-                        for i in range(n_layers))
-                if len(set(windows)) == 1:  # uniform: keep the simple knob
-                    sliding, windows = windows[0], None
+            # Qwen2 biases q/k/v (never o).
+            sliding, windows = qwen_windows()
             return LlamaConfig(**kwargs, attention_qkv_bias=True,
                                sliding_window=sliding, layer_windows=windows)
         if family == "qwen2_moe":
@@ -587,9 +591,11 @@ def config_from_hf(hf_config: dict, family: Optional[str] = None):
             only = set(get("mlp_only_layers") or ())
             dense_layers = tuple(sorted(
                 i for i in range(n_layers) if i in only or (i + 1) % step != 0))
+            sliding, windows = qwen_windows()
             return MixtralConfig(
                 **{**kwargs, "intermediate_size": get("moe_intermediate_size", 1408)},
                 attention_qkv_bias=True,
+                sliding_window=sliding, layer_windows=windows,
                 num_experts=get("num_experts", 60),
                 top_k=get("num_experts_per_tok", 4),
                 norm_topk_prob=bool(get("norm_topk_prob", False)),

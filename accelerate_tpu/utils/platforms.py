@@ -86,13 +86,15 @@ def enable_compilation_cache(path: str | None = None) -> str:
     with the cache a re-run of the same program (a retried benchmark, a
     relaunched trainer after preemption) skips straight to execution.
     Honors ``ACCELERATE_TPU_COMPILATION_CACHE`` when ``path`` is None;
-    defaults to ``~/.cache/accelerate_tpu/jax``. Returns the directory."""
+    flag-style values ("1", "true", ...) select the default directory
+    ``~/.cache/accelerate_tpu/jax`` rather than becoming a literal path.
+    Returns the directory."""
     import jax
 
-    path = path or os.environ.get(
-        "ACCELERATE_TPU_COMPILATION_CACHE",
-        os.path.join(os.path.expanduser("~"), ".cache", "accelerate_tpu", "jax"),
-    )
+    default = os.path.join(os.path.expanduser("~"), ".cache", "accelerate_tpu", "jax")
+    if path is None:
+        env = os.environ.get("ACCELERATE_TPU_COMPILATION_CACHE", "")
+        path = default if env.lower() in ("", "1", "true", "yes", "on") else env
     os.makedirs(path, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", path)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

@@ -1619,3 +1619,47 @@ class TestQwen2Moe:
             theirs = hf.generate(torch.from_numpy(ids).long(), max_new_tokens=5,
                                  do_sample=False)
         np.testing.assert_array_equal(ours, theirs.numpy())
+
+    def test_sliding_window_parity(self):
+        # Uniform window (max_window_layers=0: every layer slides) — the one
+        # configuration transformers' EAGER path implements faithfully (its
+        # eager mask applies the window to all layers, ignoring
+        # max_window_layers; only its flash path is per-layer, matching our
+        # layer_windows semantics).
+        hf_cfg = transformers.Qwen2MoeConfig(
+            vocab_size=96, hidden_size=32, intermediate_size=80,
+            moe_intermediate_size=48, shared_expert_intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            num_experts=4, num_experts_per_tok=2, norm_topk_prob=False,
+            max_position_embeddings=64, rms_norm_eps=1e-5,
+            use_sliding_window=True, sliding_window=8, max_window_layers=0,
+            tie_word_embeddings=False, router_jitter_noise=0.0,
+            attention_dropout=0.0, attn_implementation="eager")
+        torch.manual_seed(0)
+        with torch.no_grad():
+            hf = transformers.Qwen2MoeForCausalLM(hf_cfg).eval()
+        cfg = config_from_hf(hf_cfg.to_dict())
+        assert cfg.sliding_window == 8 and cfg.layer_windows is None
+        cfg.capacity_factor = float(cfg.num_experts)
+        cfg.use_flash_attention = False
+        from accelerate_tpu.models.mixtral import MixtralForCausalLM
+
+        params = convert_hf_state_dict(hf.state_dict(), "qwen2_moe", strict=True)
+        ids = (np.arange(24, dtype=np.int64).reshape(2, 12) * 5) % 96
+        out = MixtralForCausalLM(cfg).apply({"params": params}, jnp.asarray(ids, jnp.int32))
+        ours = out[0] if isinstance(out, tuple) else out
+        with torch.no_grad():
+            theirs = hf(torch.from_numpy(ids)).logits
+        _logits_close(ours, theirs, atol=5e-4)
+
+    def test_window_mixture_conversion(self):
+        # Per-layer mixture (intended max_window_layers semantics; HF honors
+        # it only on the flash path, so no eager parity comparison here).
+        cfg = config_from_hf(dict(
+            model_type="qwen2_moe", vocab_size=96, hidden_size=32,
+            intermediate_size=80, moe_intermediate_size=48,
+            num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
+            num_experts=4, num_experts_per_tok=2,
+            use_sliding_window=True, sliding_window=8, max_window_layers=2))
+        assert cfg.sliding_window is None
+        assert cfg.layer_windows == (None, None, 8, 8)
