@@ -29,6 +29,8 @@ def _model_registry():
     reg = {
         "llama3-8b": llama("llama3_8b"),
         "llama-tiny": llama("tiny"),
+        "qwen2-7b": llama("qwen2_7b"),
+        "gemma2-9b": llama("gemma2_9b"),
         # The reference's own big-model benchmark families
         # (reference: benchmarks/big_model_inference/README.md:31-37).
         "gptj-6b": lambda: GPTJForCausalLM(GPTJConfig.gptj_6b()),

@@ -101,6 +101,30 @@ class LlamaConfig:
         return dataclasses.replace(cfg, **overrides)
 
     @classmethod
+    def qwen2_7b(cls, **overrides):
+        cfg = cls(
+            vocab_size=152064, hidden_size=3584, intermediate_size=18944,
+            num_hidden_layers=28, num_attention_heads=28, num_key_value_heads=4,
+            max_position_embeddings=32768, rope_theta=1e6, rms_norm_eps=1e-6,
+            attention_qkv_bias=True,
+        )
+        return dataclasses.replace(cfg, **overrides)
+
+    @classmethod
+    def gemma2_9b(cls, **overrides):
+        cfg = cls(
+            vocab_size=256000, hidden_size=3584, intermediate_size=14336,
+            num_hidden_layers=42, num_attention_heads=16, num_key_value_heads=8,
+            head_dim_override=256, max_position_embeddings=8192, rms_norm_eps=1e-6,
+            tie_word_embeddings=True, mlp_activation="gelu_tanh",
+            rms_norm_unit_offset=True, scale_embeddings=True, post_norms=True,
+            attn_logit_softcapping=50.0, final_logit_softcapping=30.0,
+            query_pre_attn_scalar=256.0,
+            layer_windows=tuple(4096 if i % 2 == 0 else None for i in range(42)),
+        )
+        return dataclasses.replace(cfg, **overrides)
+
+    @classmethod
     def tiny(cls, **overrides):
         """Test-size config (used by unit tests and dryrun_multichip)."""
         cfg = cls(
