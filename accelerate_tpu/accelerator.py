@@ -842,8 +842,10 @@ class Accelerator:
                 return scaled.astype(jnp.float32), loss
 
             if remat_loss:
+                from .parallel.sharding import resolve_remat_policy
+
                 compute = jax.checkpoint(
-                    compute, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+                    compute, policy=resolve_remat_policy(fsdp.remat_policy)
                 )
             (scaled, loss), grads = jax.value_and_grad(compute, has_aux=True)(params)
             return loss, grads

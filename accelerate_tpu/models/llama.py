@@ -73,6 +73,9 @@ class LlamaConfig:
     final_logit_softcapping: Optional[float] = None
     query_pre_attn_scalar: Optional[float] = None
     remat: bool = False
+    # Intermediates saved through a remat'd block: "dots" | "nothing" |
+    # "everything" (parallel/sharding.resolve_remat_policy).
+    remat_policy: str = "dots"
     use_flash_attention: bool = True
     # 'auto' uses ring/Ulysses context parallelism when the ambient mesh has
     # cp > 1 (ops/ring_attention.py), flash/einsum otherwise.
@@ -647,7 +650,9 @@ class LlamaModel(nn.Module):
             x = x * jnp.asarray(cfg.hidden_size ** 0.5, x.dtype)
         block_cls = LlamaBlock
         if cfg.remat:
-            block_cls = nn.remat(LlamaBlock, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+            from ..parallel.sharding import resolve_remat_policy
+
+            block_cls = nn.remat(LlamaBlock, policy=resolve_remat_policy(cfg.remat_policy))
         new_caches = []
         for i in range(cfg.num_hidden_layers):
             if cache is None:

@@ -181,9 +181,9 @@ class MixtralForCausalLM(nn.Module):
         x = embed(input_ids)
         block_cls = MixtralBlock
         if cfg.remat:
-            block_cls = nn.remat(
-                MixtralBlock, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable
-            )
+            from ..parallel.sharding import resolve_remat_policy
+
+            block_cls = nn.remat(MixtralBlock, policy=resolve_remat_policy(cfg.remat_policy))
         lb = jnp.zeros((), jnp.float32)
         zl = jnp.zeros((), jnp.float32)
         new_caches = []

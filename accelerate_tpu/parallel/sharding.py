@@ -27,6 +27,25 @@ import numpy as np
 logger = logging.getLogger(__name__)
 
 
+def resolve_remat_policy(name: str):
+    """Map a remat-policy name to a jax.checkpoint policy.
+
+    "dots": matmul outputs saveable (recompute only the cheap elementwise
+    work — the standard training trade). "nothing": full recompute, minimum
+    activation memory. "everything": save all (remat is a no-op; debugging).
+    """
+    import jax
+
+    policies = {
+        "dots": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        "nothing": jax.checkpoint_policies.nothing_saveable,
+        "everything": jax.checkpoint_policies.everything_saveable,
+    }
+    if name not in policies:
+        raise ValueError(f"unknown remat_policy {name!r}; expected {sorted(policies)}")
+    return policies[name]
+
+
 def _leaf_path_str(path) -> str:
     """jax KeyPath -> 'a/b/c' string for regex matching."""
     parts = []

@@ -376,6 +376,11 @@ class FullyShardedDataParallelPlugin(KwargsHandler):
     state_dict_type: Literal["FULL_STATE_DICT", "SHARDED_STATE_DICT"] = "SHARDED_STATE_DICT"
     cpu_offload: bool = False                   # host-DRAM optimizer/params offload
     activation_checkpointing: bool = False      # jax.checkpoint on block boundaries
+    # Which intermediates survive the forward when activation_checkpointing
+    # is on: "dots" (matmul outputs saveable — recompute elementwise only),
+    # "nothing" (full recompute, minimum memory), "everything" (save all —
+    # remat becomes a no-op; debugging).
+    remat_policy: str = "dots"
     min_weight_size_to_shard: int = 2**14       # small tensors stay replicated
     shard_largest_dim: bool = True              # shard dim with max size divisible by axis
     use_orig_params: bool = True                # parity no-op (params are always "orig" pytrees)

@@ -301,3 +301,37 @@ class TestMisc:
         with acc.no_sync():
             assert not acc.sync_gradients
         assert acc.sync_gradients
+
+
+class TestRematPolicy:
+    def test_resolve_names(self):
+        import jax
+
+        from accelerate_tpu.parallel.sharding import resolve_remat_policy
+
+        assert resolve_remat_policy("dots") is jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        assert resolve_remat_policy("nothing") is jax.checkpoint_policies.nothing_saveable
+        assert resolve_remat_policy("everything") is jax.checkpoint_policies.everything_saveable
+        with pytest.raises(ValueError, match="unknown remat_policy"):
+            resolve_remat_policy("some")
+
+    @pytest.mark.parametrize("policy_name", ["dots", "nothing", "everything"])
+    def test_train_step_runs_under_each_policy(self, policy_name):
+        import optax
+
+        from accelerate_tpu import Accelerator, Model
+        from accelerate_tpu.data_loader import make_global_batch
+        from accelerate_tpu.models.llama import LlamaConfig, LlamaForCausalLM, causal_lm_loss
+        acc = Accelerator(
+            mixed_precision="bf16",
+            fsdp_plugin=FullyShardedDataParallelPlugin(
+                min_weight_size_to_shard=1, activation_checkpointing=True,
+                remat_policy=policy_name))
+        cfg = LlamaConfig.tiny(use_flash_attention=False)
+        model_def = LlamaForCausalLM(cfg)
+        params = model_def.init_params(jax.random.PRNGKey(0), batch_size=2, seq_len=16)
+        model, opt = acc.prepare(Model(model_def, params), optax.adam(1e-3))
+        step = acc.compile_train_step(causal_lm_loss(model_def.apply))
+        ids = np.tile(np.arange(16, dtype=np.int32)[None], (4, 1)) % cfg.vocab_size
+        loss = float(step(make_global_batch({"input_ids": ids}, acc.mesh))["loss"])
+        assert np.isfinite(loss)
