@@ -26,6 +26,11 @@ def _model_registry():
     from ..models.opt import OPTConfig, OPTForCausalLM
     from ..models.phi import PhiConfig, PhiForCausalLM
 
+    def _mixtral_8x7b():
+        from ..models.mixtral import MixtralConfig, MixtralForCausalLM
+
+        return MixtralForCausalLM(MixtralConfig.mixtral_8x7b())
+
     reg = {
         "llama3-8b": llama("llama3_8b"),
         "llama-tiny": llama("tiny"),
@@ -34,6 +39,7 @@ def _model_registry():
         # The reference's own big-model benchmark families
         # (reference: benchmarks/big_model_inference/README.md:31-37).
         "gptj-6b": lambda: GPTJForCausalLM(GPTJConfig.gptj_6b()),
+        "mixtral-8x7b": _mixtral_8x7b,
         "gpt-neox-20b": lambda: GPTNeoXForCausalLM(GPTNeoXConfig.neox_20b()),
         "opt-30b": lambda: OPTForCausalLM(OPTConfig.opt_30b()),
         "phi-2": lambda: PhiForCausalLM(PhiConfig.phi_2()),
