@@ -844,11 +844,10 @@ class StreamedModel:
         blocks (default: all), updating layer caches in place. Returns the
         next greedy token.
 
-        The multi-token prefill keeps ``pos`` STATIC (a Python int): its
-        executable is shape-distinct from the decode step anyway, and ring
-        KV caches (sliding-window layers) require a statically-known
-        prefill position to validate their write-into-empty-ring contract.
-        Decode passes a traced scalar so every token shares one executable."""
+        The multi-token prefill keeps ``pos`` STATIC (a Python int) — its
+        executable is shape-distinct from the decode step anyway, so the
+        specialization is free and XLA sees the constant offset. Decode
+        passes a traced scalar so every token shares one executable."""
         static_pos = args[0].shape[1] > 1
         if static_pos:
             pos = int(pos)

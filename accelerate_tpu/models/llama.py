@@ -25,7 +25,6 @@ from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 import flax.linen as nn
 
 
@@ -481,25 +480,31 @@ def update_kv_cache_and_attend(cache, q, k, v, cache_pos, n_rep: int, sliding_wi
                                 logit_softcap=logit_softcap)
         return out, new_cache
 
-    from ..ops.attention import _einsum_attention
-
     window = cache["k"].shape[1]
     B, S = q.shape[0], q.shape[1]
     if S > 1:
-        # The chunk path computes attention from the chunk ALONE — valid
-        # only for the initial prefill into an empty ring. Chunked prefill /
-        # multi-token decode at cache_pos > 0 would need the in-window keys
-        # already in the ring; fail loudly instead of silently ignoring them
-        # (the full-cache path above supports that case).
-        if not (isinstance(cache_pos, (int, np.integer)) and int(cache_pos) == 0):
-            raise NotImplementedError(
-                "ring KV caches support multi-token writes only as the initial "
-                "prefill (static cache_pos == 0); chunked prefill into a "
-                "partially-filled ring is not implemented")
-        # Prefill: attention over the chunk itself (windowed causal).
-        out = _einsum_attention(
-            q, k, v, causal=True, sliding_window=min(sliding_window or window, window),
-            sm_scale=sm_scale, logit_softcap=logit_softcap)
+        # Multi-token write (prefill OR chunked prefill / speculative
+        # verification at any cache_pos): attend against the PRE-WRITE ring
+        # contents concatenated with the chunk itself. Ring slots hold
+        # positions < cache_pos and the chunk holds [cache_pos, cache_pos+S),
+        # so there are no duplicates; the per-position mask handles
+        # never-written (-1) and out-of-window slots uniformly. On the
+        # empty-ring initial prefill every ring slot is masked and this
+        # degenerates to windowed causal attention over the chunk.
+        eff_window = min(sliding_window or window, window)
+        k_comb = jnp.concatenate([cache["k"], k.astype(cache["k"].dtype)], axis=1)
+        v_comb = jnp.concatenate([cache["v"], v.astype(cache["v"].dtype)], axis=1)
+        chunk_pos = cache_pos + jnp.arange(S, dtype=jnp.int32)       # [S]
+        pos_comb = jnp.concatenate(
+            [cache["pos"], jnp.broadcast_to(chunk_pos, (B, S))], axis=1)  # [B, W+S]
+        q_pos = chunk_pos
+        mask = (
+            (pos_comb[:, None, :] >= 0)
+            & (pos_comb[:, None, :] <= q_pos[None, :, None])
+            & (pos_comb[:, None, :] > q_pos[None, :, None] - eff_window)
+        )  # [B, S, W+S]
+        out = _grouped_cached_attention(q, k_comb, v_comb, mask, n_rep,
+                                        sm_scale=sm_scale, logit_softcap=logit_softcap)
         # Scatter the last `window` entries (unique slots) into the ring.
         take = min(S, window)
         idx = cache_pos + jnp.arange(S - take, S, dtype=jnp.int32)   # global positions

@@ -269,3 +269,26 @@ class TestRingKVCache:
         full = beam_search_generate(LlamaForCausalLM(wide_cfg), params, jnp.asarray(ids),
                                     num_beams=3, max_new_tokens=6, cache_dtype=jnp.float32)
         np.testing.assert_array_equal(np.asarray(ring), np.asarray(full))
+
+    def test_ring_chunked_prefill_matches_eager(self):
+        """Multi-token writes at cache_pos > 0 (chunked prefill /
+        speculative verification) must see the in-window keys already in
+        the ring, matching a full eager windowed forward."""
+        from accelerate_tpu.models.llama import LlamaConfig, LlamaForCausalLM, init_kv_cache
+
+        cfg = LlamaConfig.tiny(use_flash_attention=False, sliding_window=8)
+        model = LlamaForCausalLM(cfg)
+        params = model.init_params(jax.random.PRNGKey(2), batch_size=1, seq_len=8)
+        ids = (np.arange(14, dtype=np.int32)[None] * 3) % cfg.vocab_size
+
+        cache = init_kv_cache(cfg, batch_size=1, max_len=20, dtype=jnp.float32)
+        assert "pos" in cache[0]  # window 8 < max_len: rings engaged
+        logits1, cache = model.apply({"params": params}, jnp.asarray(ids[:, :6]),
+                                     cache=cache, cache_pos=0)
+        logits2, cache = model.apply({"params": params}, jnp.asarray(ids[:, 6:14]),
+                                     cache=cache, cache_pos=6)
+
+        ref = model.apply({"params": params}, jnp.asarray(ids))
+        np.testing.assert_allclose(
+            np.asarray(logits2, np.float32), np.asarray(ref[:, 6:14], np.float32),
+            atol=2e-4, rtol=2e-3)
