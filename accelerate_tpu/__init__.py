@@ -48,7 +48,13 @@ from .big_modeling import (
     load_checkpoint_in_model,
 )
 from .data_loader import NumpyDataLoader, prepare_data_loader, skip_first_batches
-from .generation import beam_search_generate, generate, greedy_generate, seq2seq_generate
+from .generation import (
+    beam_search_generate,
+    generate,
+    greedy_generate,
+    prompt_lookup_generate,
+    seq2seq_generate,
+)
 from .inference import PipelinedInferencer, prepare_pipeline, prepare_pippy
 from .launchers import debug_launcher, notebook_launcher
 from .local_sgd import LocalSGD

@@ -414,8 +414,8 @@ def cache_factory_for(module) -> Optional[Callable]:
                            PhiForCausalLM)):
         cfg = module.config  # non-Llama configs duck-type the kv-cache fields
 
-        def factory(batch, max_len, dtype=jnp.bfloat16):
-            return init_kv_cache(cfg, batch, max_len, dtype)
+        def factory(batch, max_len, dtype=jnp.bfloat16, ring_slack=0):
+            return init_kv_cache(cfg, batch, max_len, dtype, ring_slack=ring_slack)
 
         return factory
 

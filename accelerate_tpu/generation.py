@@ -329,6 +329,153 @@ def greedy_generate(module, params, input_ids, max_new_tokens: int = 20,
                     eos_token_id=eos_token_id, cache_dtype=cache_dtype)
 
 
+def _compiled_lookup_generate(module, max_new_tokens: int, eos_token_id, cache_dtype,
+                              ngram: int, num_draft: int, prompt_len: int):
+    """(prefill, speculate_loop) jitted pair for prompt-lookup decoding.
+    Keyed per (module config, lengths, eos, dtype, ngram, K) like
+    _compiled_generate; prompt_len is part of the key because the token
+    buffer and position arithmetic are shaped by it."""
+    key = _cache_key(module, max_new_tokens, eos_token_id,
+                     jnp.dtype(cache_dtype).name, None, 1.0,
+                     ("lookup", ngram, num_draft, prompt_len))
+    hit = _generate_cache.get(key) if key is not None else None
+    if hit is not None:
+        return hit
+
+    K = num_draft
+    S = prompt_len
+    # Buffer slack: a verification chunk may scribble K + 1 tokens past the
+    # last committed position; committed entries always overwrite before
+    # they are read (or are sliced away at the end).
+    L = S + max_new_tokens + K + 1
+    eos = eos_token_id
+
+    @jax.jit
+    def prefill(params, ids, cache):
+        logits, cache = module.apply({"params": params}, ids, cache=cache, cache_pos=0)
+        return jnp.argmax(logits[:, -1], axis=-1).astype(ids.dtype), cache
+
+    @jax.jit
+    def speculate(params, buf, cache):
+        """buf: [1, L] with the prompt + first generated token committed
+        (n_gen starts at 1). Returns (buf, n_gen)."""
+
+        def cond(state):
+            _, n_gen, _, done = state
+            return (n_gen < max_new_tokens) & ~done
+
+        def body(state):
+            buf, n_gen, cache, done = state
+            cur = S + n_gen                       # committed length
+            # --- draft: continuation of the most recent earlier match of
+            # the last `ngram` committed tokens --------------------------
+            pattern = jax.lax.dynamic_slice(buf, (0, cur - ngram), (1, ngram))[0]
+            row = buf[0]
+            windows = jnp.stack(
+                [jnp.roll(row, -j) for j in range(ngram)], axis=1)     # [L, n]
+            idxs = jnp.arange(L, dtype=jnp.int32)
+            hit = (windows == pattern[None, :]).all(axis=1) & (idxs + ngram < cur)
+            best = jnp.max(jnp.where(hit, idxs, -1))                   # most recent
+            draft_start = jnp.clip(best + ngram, 0, L - K)
+            draft = jax.lax.dynamic_slice(buf, (0, draft_start), (1, K))[0]
+            # (no match: `draft` is whatever sits at the clamp target — a
+            # harmless suggestion the verifier rejects at its first token)
+
+            # --- verify: one forward over [last_committed, draft] --------
+            last = jax.lax.dynamic_slice(buf, (0, cur - 1), (1, 1))
+            chunk = jnp.concatenate([last, draft[None, :]], axis=1)    # [1, K+1]
+            logits, cache = module.apply({"params": params}, chunk,
+                                         cache=cache, cache_pos=cur - 1)
+            preds = jnp.argmax(logits[0], axis=-1).astype(buf.dtype)   # [K+1]
+
+            matches = draft == preds[:K]
+            m = jnp.sum(jnp.cumprod(matches.astype(jnp.int32)))        # accepted drafts
+            emit = preds                                               # m drafts + bonus
+            if eos is not None:
+                # generate()'s ragged-stop contract: after EOS, keep
+                # emitting EOS.
+                after = jnp.concatenate(
+                    [jnp.zeros((1,), bool), jnp.cumsum(emit == eos)[:-1] > 0])
+                emit = jnp.where(after, eos, emit)
+            n_emit = jnp.minimum(m + 1, max_new_tokens - n_gen)
+            buf = jax.lax.dynamic_update_slice(buf, emit[None, :], (0, cur))
+            if eos is not None:
+                done = done | jnp.any((jnp.arange(K + 1) < n_emit) & (emit == eos))
+            return buf, n_gen + n_emit, cache, done
+
+        # The first generated token may itself be EOS (ragged-stop from the
+        # very first step, like generate()).
+        done0 = (buf[0, S] == eos) if eos is not None else jnp.asarray(False)
+        buf, n_gen, _, _ = jax.lax.while_loop(
+            cond, body, (buf, jnp.asarray(1, jnp.int32), cache, done0))
+        if eos is not None:
+            # Early EOS stop: the un-generated tail keeps emitting EOS.
+            tail = jnp.arange(L) >= (S + n_gen)
+            committed = jnp.arange(L) < S + max_new_tokens
+            buf = jnp.where((tail & committed)[None, :], eos, buf)
+        return buf
+
+    return _cache_put(key, (prefill, speculate))
+
+
+def prompt_lookup_generate(
+    module,
+    params,
+    input_ids,
+    max_new_tokens: int = 20,
+    eos_token_id: Optional[int] = None,
+    cache_dtype=None,
+    ngram: int = 2,
+    num_draft: int = 5,
+):
+    """Greedy decoding accelerated by prompt-lookup speculation (assisted
+    generation without a draft model — transformers'
+    ``prompt_lookup_num_tokens``, which the reference's users reach through
+    ``model.generate``).
+
+    Each step drafts ``num_draft`` tokens by matching the last ``ngram``
+    committed tokens against their most recent earlier occurrence in the
+    sequence, then verifies the whole draft in ONE cached forward — the
+    model's own greedy predictions decide how many draft tokens commit, so
+    the output is EXACTLY ``generate``'s greedy output, reached in fewer
+    (and wider, MXU-friendlier) decode steps wherever the text repeats
+    itself (code, summaries-with-quotes, retrieval contexts). Rejected
+    positions leave stale KV entries that the next verification chunk
+    overwrites before any query can attend them; ring caches mask them by
+    stored position. Batch 1 only (per-row acceptance counts would
+    desynchronize a batched scan).
+    """
+    from .big_modeling import cache_factory_for
+
+    factory = cache_factory_for(module)
+    if factory is None:
+        raise TypeError(
+            f"{type(module).__name__} does not thread a KV cache")
+    ids = jnp.asarray(input_ids)
+    if ids.shape[0] != 1:
+        raise ValueError("prompt_lookup_generate is batch-1 only "
+                         f"(got batch {ids.shape[0]})")
+    if max_new_tokens <= 0:
+        return ids
+    B, S = ids.shape
+    K = int(num_draft)
+    _check_position_bound(module, S + max_new_tokens + K + 1)
+    dtype = cache_dtype or jnp.bfloat16
+    # ring_slack: rejected overshoot writes must not evict in-window keys
+    # from sliding-window layers' ring caches.
+    cache = factory(B, S + max_new_tokens + K + 1, dtype, ring_slack=K + 1)
+
+    prefill, speculate = _compiled_lookup_generate(
+        module, max_new_tokens, eos_token_id, dtype, int(ngram), K, S)
+    first_tok, cache = prefill(params, ids, cache)
+    L = S + max_new_tokens + K + 1
+    buf = jnp.zeros((1, L), ids.dtype)
+    buf = jax.lax.dynamic_update_slice(buf, ids, (0, 0))
+    buf = buf.at[0, S].set(first_tok[0])
+    buf = speculate(params, buf, cache)
+    return buf[:, : S + max_new_tokens]
+
+
 def beam_search_generate(
     module,
     params,

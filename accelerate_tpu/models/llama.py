@@ -367,7 +367,8 @@ def _layer_window(config, layer_idx: int):
     return getattr(config, "sliding_window", None)
 
 
-def init_kv_cache(config: "LlamaConfig", batch_size: int, max_len: int, dtype=jnp.bfloat16):
+def init_kv_cache(config: "LlamaConfig", batch_size: int, max_len: int, dtype=jnp.bfloat16,
+                  ring_slack: int = 0):
     """Per-layer KV cache: tuple of ``{"k", "v"}`` with [B, max_len, n_kv, hd]
     buffers (KV heads stored *unrepeated* — GQA expansion happens at attention
     time, so the cache is ``n_q/n_kv``× smaller than the score matrices).
@@ -378,16 +379,21 @@ def init_kv_cache(config: "LlamaConfig", batch_size: int, max_len: int, dtype=jn
     Mistral-7B: 8x smaller). Ring caches carry a ``pos`` buffer [B, window]
     recording each slot's global position (-1 = never written); the batch
     dim exists so beam search's batch-axis cache reordering maps over it
-    like any other leaf."""
+    like any other leaf.
+
+    ``ring_slack`` adds capacity beyond the window (speculative decoding:
+    a rejected overshoot write must not EVICT still-in-window committed
+    keys — the attention window itself stays ``w`` via the position mask)."""
     caches = []
     n_kv, hd = config.num_key_value_heads, config.head_dim
     for i in range(config.num_hidden_layers):
         w = _layer_window(config, i)
         if w is not None and w < max_len:
+            size = min(w + ring_slack, max_len)
             caches.append({
-                "k": jnp.zeros((batch_size, w, n_kv, hd), dtype),
-                "v": jnp.zeros((batch_size, w, n_kv, hd), dtype),
-                "pos": jnp.full((batch_size, w), -1, jnp.int32),
+                "k": jnp.zeros((batch_size, size, n_kv, hd), dtype),
+                "v": jnp.zeros((batch_size, size, n_kv, hd), dtype),
+                "pos": jnp.full((batch_size, size), -1, jnp.int32),
             })
         else:
             shape = (batch_size, max_len, n_kv, hd)
@@ -498,8 +504,16 @@ def update_kv_cache_and_attend(cache, q, k, v, cache_pos, n_rep: int, sliding_wi
         pos_comb = jnp.concatenate(
             [cache["pos"], jnp.broadcast_to(chunk_pos, (B, S))], axis=1)  # [B, W+S]
         q_pos = chunk_pos
+        # Ring slots are valid only for positions strictly BEFORE the chunk:
+        # a previous multi-token write may have left stale entries at
+        # positions this chunk covers (speculative overshoot) — the chunk
+        # segment supersedes them, and without this bound the same position
+        # would be attended twice (once stale, once fresh).
+        seg_valid = jnp.concatenate(
+            [cache["pos"] < cache_pos, jnp.ones((B, S), bool)], axis=1)
         mask = (
-            (pos_comb[:, None, :] >= 0)
+            seg_valid[:, None, :]
+            & (pos_comb[:, None, :] >= 0)
             & (pos_comb[:, None, :] <= q_pos[None, :, None])
             & (pos_comb[:, None, :] > q_pos[None, :, None] - eff_window)
         )  # [B, S, W+S]
