@@ -230,13 +230,13 @@ def _compiled_generate(module, max_new_tokens: int, eos_token_id, cache_dtype,
     return _cache_put(key, (prefill, decode))
 
 
-def _check_position_bound(module, total_len: int):
+def _check_position_bound(module, total_len: int, label: str = "prompt + max_new_tokens"):
     """Learned-position models silently clamp indices past their table (the
     wpe lookup clips under jit) — turn that corruption into an error."""
     bound = getattr(getattr(module, "config", None), "max_position_embeddings", None)
     if bound is not None and total_len > bound:
         raise ValueError(
-            f"prompt + max_new_tokens = {total_len} exceeds "
+            f"{label} = {total_len} exceeds "
             f"max_position_embeddings = {bound} for {type(module).__name__}"
         )
 
@@ -358,7 +358,7 @@ def _compiled_lookup_generate(module, max_new_tokens: int, eos_token_id, cache_d
     @jax.jit
     def speculate(params, buf, cache):
         """buf: [1, L] with the prompt + first generated token committed
-        (n_gen starts at 1). Returns (buf, n_gen)."""
+        (n_gen starts at 1). Returns the completed buf."""
 
         def cond(state):
             _, n_gen, _, done = state
@@ -447,6 +447,10 @@ def prompt_lookup_generate(
     """
     from .big_modeling import cache_factory_for
 
+    if hasattr(module, "init_decode_cache"):
+        raise TypeError(
+            "prompt_lookup_generate supports decoder-only models; use "
+            "seq2seq_generate for encoder-decoder families")
     factory = cache_factory_for(module)
     if factory is None:
         raise TypeError(
@@ -455,11 +459,16 @@ def prompt_lookup_generate(
     if ids.shape[0] != 1:
         raise ValueError("prompt_lookup_generate is batch-1 only "
                          f"(got batch {ids.shape[0]})")
+    if ngram < 1 or num_draft < 1:
+        raise ValueError(f"ngram and num_draft must be >= 1 (got {ngram}, {num_draft})")
     if max_new_tokens <= 0:
         return ids
     B, S = ids.shape
     K = int(num_draft)
-    _check_position_bound(module, S + max_new_tokens + K + 1)
+    # Highest position a verification chunk can touch: the last chunk
+    # starts at S + max_new_tokens - 2 and spans K + 1.
+    _check_position_bound(module, S + max_new_tokens + K - 1,
+                          label="prompt + max_new_tokens + speculative slack")
     dtype = cache_dtype or jnp.bfloat16
     # ring_slack: rejected overshoot writes must not evict in-window keys
     # from sliding-window layers' ring caches.

@@ -470,11 +470,10 @@ def update_kv_cache_and_attend(cache, q, k, v, cache_pos, n_rep: int, sliding_wi
     Returns (out [B,S,H,hd], new_cache).
 
     Ring caches (``"pos"`` present — sliding-window layers) write slot
-    ``pos % window``. The multi-token prefill computes its attention
-    directly from the chunk (windowed causal — the cache is empty before
-    the single generate() prefill at position 0) and scatters only the last
-    ``window`` entries into the ring; decode steps write one slot and
-    attend against the ring with per-slot position masking."""
+    ``pos % capacity``. Multi-token writes at ANY position (initial prefill,
+    chunked prefill, speculative verification) attend the pre-write ring
+    contents concatenated with the chunk, masked by per-slot positions;
+    single-token decode writes one slot and attends the ring alone."""
     if "pos" not in cache:
         start = (0, cache_pos, 0, 0)
         new_cache = {
