@@ -839,16 +839,20 @@ class StreamedModel:
             self._jitted[key] = fn
         return fn(ptrees, args, cache, pos)
 
-    def _cached_pass(self, args: tuple, caches: list, pos: int, specs=None):
-        """One full pass (prefill or single-token decode) through the given
-        blocks (default: all), updating layer caches in place. Returns the
-        next greedy token.
+    def _cached_pass(self, args: tuple, caches: list, pos: int, specs=None,
+                     static_pos=None):
+        """One full pass (prefill, single-token decode, or a speculative
+        verification chunk) through the given blocks (default: all), updating
+        layer caches in place. Returns the greedy prediction at EVERY chunk
+        position, [B, chunk_len] (single-token callers take ``[:, -1]``).
 
-        The multi-token prefill keeps ``pos`` STATIC (a Python int) — its
-        executable is shape-distinct from the decode step anyway, so the
-        specialization is free and XLA sees the constant offset. Decode
-        passes a traced scalar so every token shares one executable."""
-        static_pos = args[0].shape[1] > 1
+        ``static_pos`` None infers: multi-token chunks keep ``pos`` STATIC
+        (a Python int) — the initial prefill's executable is shape-distinct
+        from decode anyway, so the specialization is free. Speculative
+        chunks pass ``static_pos=False``: their position changes every
+        iteration and must stay traced to share one executable."""
+        if static_pos is None:
+            static_pos = args[0].shape[1] > 1
         if static_pos:
             pos = int(pos)
         else:
@@ -866,10 +870,12 @@ class StreamedModel:
                 args, _ = self._apply_cached(spec, ptrees, args, None, pos,
                                              static_pos=static_pos)
         logits = args[0]
-        return jnp.argmax(logits[:, -1, :], axis=-1)
+        return jnp.argmax(logits, axis=-1)
 
     def generate(self, input_ids, max_new_tokens: int = 20,
-                 eos_token_id: Optional[int] = None, use_cache: bool = True):
+                 eos_token_id: Optional[int] = None, use_cache: bool = True,
+                 prompt_lookup_num_tokens: Optional[int] = None,
+                 lookup_ngram: int = 2):
         """Greedy decoding (reference capability: hook-streamed
         ``model.generate``; per-token latency table in
         benchmarks/big_model_inference/README.md:26-45).
@@ -880,7 +886,15 @@ class StreamedModel:
         cache — O(1) forward work per token instead of O(seq). Weights still
         stream per block with the same double-buffered prefetch. Without
         cache support (or ``use_cache=False``) falls back to full re-forward
-        per token."""
+        per token.
+
+        ``prompt_lookup_num_tokens=K`` turns on prompt-lookup speculation
+        (batch 1, greedy — see generation.prompt_lookup_generate): each pass
+        verifies K drafted tokens plus one bonus in a single streamed
+        forward, so the offloaded weights stream once per ACCEPTED RUN
+        instead of once per token — on the cpu/disk tiers, where weight
+        traffic dominates the per-token latency, acceptance translates
+        almost directly into speedup. Output equals plain greedy exactly."""
         if any(s.stage == "enc" for s in self.specs):
             raise TypeError(
                 "this is an encoder-decoder model; use seq2seq_generate")
@@ -902,22 +916,77 @@ class StreamedModel:
             return ids
 
         B, S = ids.shape
-        if self.position_bound is not None and S + max_new_tokens > self.position_bound:
+        slack = (prompt_lookup_num_tokens or 0) and (prompt_lookup_num_tokens + 1)
+        if self.position_bound is not None and S + max_new_tokens + slack > self.position_bound:
             raise ValueError(
-                f"prompt + max_new_tokens = {S + max_new_tokens} exceeds the model's "
-                f"position table ({self.position_bound}); learned-position lookups "
-                "would silently clamp."
+                f"prompt + max_new_tokens = {S + max_new_tokens + slack} exceeds the "
+                f"model's position table ({self.position_bound}); learned-position "
+                "lookups would silently clamp."
             )
+        if prompt_lookup_num_tokens:
+            return self._generate_prompt_lookup(
+                ids, max_new_tokens, eos_token_id,
+                int(prompt_lookup_num_tokens), int(lookup_ngram))
         caches = list(self.cache_factory(B, S + max_new_tokens))
         caches = [jax.device_put(c, self.device) for c in caches]
-        tok = self._cached_pass((jax.device_put(ids, self.device),), caches, 0)
+        tok = self._cached_pass((jax.device_put(ids, self.device),), caches, 0)[:, -1]
         pieces = [ids, tok[:, None].astype(ids.dtype)]
         for t in range(1, max_new_tokens):
             if eos_token_id is not None and bool((tok == eos_token_id).all()):
                 break
-            tok = self._cached_pass((tok[:, None].astype(ids.dtype),), caches, S + t - 1)
+            tok = self._cached_pass((tok[:, None].astype(ids.dtype),), caches,
+                                    S + t - 1)[:, -1]
             pieces.append(tok[:, None].astype(ids.dtype))
         return jnp.concatenate(pieces, axis=1)
+
+    def _generate_prompt_lookup(self, ids, max_new_tokens: int, eos_token_id,
+                                K: int, ngram: int):
+        """Speculative greedy decode: draft in Python (the committed ids are
+        host-side anyway), verify K+1 tokens per streamed pass. Rejected
+        positions leave stale KV that the next chunk overwrites before any
+        query attends it; ring caches get K+1 slots of eviction slack."""
+        import numpy as np
+
+        if ids.shape[0] != 1:
+            raise ValueError("prompt_lookup_num_tokens is batch-1 only")
+        if ngram < 1 or K < 1:
+            raise ValueError(f"lookup_ngram and prompt_lookup_num_tokens must be >= 1 "
+                             f"(got {ngram}, {K})")
+        S = ids.shape[1]
+        try:
+            caches = list(self.cache_factory(1, S + max_new_tokens + K + 1,
+                                             ring_slack=K + 1))
+        except TypeError:  # factories without ring caches (no slack concept)
+            caches = list(self.cache_factory(1, S + max_new_tokens + K + 1))
+        caches = [jax.device_put(c, self.device) for c in caches]
+        first = self._cached_pass((jax.device_put(ids, self.device),), caches, 0)[0, -1]
+        committed = np.asarray(ids[0]).tolist() + [int(first)]
+        eos_done = eos_token_id is not None and int(first) == eos_token_id
+        while len(committed) - S < max_new_tokens and not eos_done:
+            cur = len(committed)
+            # Draft: continuation of the most recent earlier occurrence of
+            # the last `ngram` committed tokens (pure host-side search).
+            draft: list = []
+            if cur > ngram:
+                pat = committed[-ngram:]
+                for i in range(cur - ngram - 1, -1, -1):
+                    if committed[i:i + ngram] == pat:
+                        draft = committed[i + ngram:i + ngram + K]
+                        break
+            draft += [committed[-1]] * (K - len(draft))   # pad: rejected cheaply
+            chunk = jnp.asarray([[committed[-1], *draft]], ids.dtype)   # [1, K+1]
+            preds = np.asarray(
+                self._cached_pass((chunk,), caches, cur - 1, static_pos=False)[0])
+            m = 0
+            while m < K and draft[m] == int(preds[m]):
+                m += 1
+            emit = [int(p) for p in preds[: m + 1]]
+            emit = emit[: max_new_tokens - (cur - S)]
+            if eos_token_id is not None and eos_token_id in emit:
+                emit = emit[: emit.index(eos_token_id) + 1]
+                eos_done = True
+            committed.extend(emit)
+        return jnp.asarray([committed], ids.dtype)
 
     def seq2seq_generate(self, input_ids, max_new_tokens: int = 20,
                          decoder_start_token_id: int = 0,
@@ -968,13 +1037,18 @@ class StreamedModel:
                                          dtype=cache_dtype or jnp.bfloat16,
                                          src_len=S_enc))
         caches = [jax.device_put(c, self.device) for c in caches]
-        tok = self._cached_pass((enc, start), caches, 0, specs=dec_specs)
+        # static_pos=False explicitly: args[0] here is the ENCODER tensor
+        # (its width would wrongly infer a static — per-token retraced —
+        # position for the decode loop).
+        tok = self._cached_pass((enc, start), caches, 0, specs=dec_specs,
+                                static_pos=False)[:, -1]
         pieces = [start, tok[:, None].astype(ids.dtype)]
         for t in range(1, max_new_tokens):
             if eos_token_id is not None and bool((tok == eos_token_id).all()):
                 break
             tok = self._cached_pass((enc, tok[:, None].astype(ids.dtype)),
-                                    caches, t, specs=dec_specs)
+                                    caches, t, specs=dec_specs,
+                                    static_pos=False)[:, -1]
             pieces.append(tok[:, None].astype(ids.dtype))
         return jnp.concatenate(pieces, axis=1)
 

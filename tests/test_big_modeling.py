@@ -317,3 +317,48 @@ class TestLoadCheckpoint:
         store = load_checkpoint_in_model(abstract, str(tmp_path / "ckpt"), {"": "cpu"},
                                          dtype=np.float16)
         assert all(v.dtype == np.float16 for v in store.entries.values())
+
+
+class TestStreamedPromptLookup:
+    """Streamed speculation must equal plain streamed greedy exactly —
+    weights stream once per accepted run instead of once per token."""
+
+    def _streamed(self, tmp_path, window=None):
+        from accelerate_tpu.big_modeling import load_checkpoint_and_dispatch
+        from accelerate_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+        cfg = LlamaConfig.tiny(use_flash_attention=False, sliding_window=window)
+        model = LlamaForCausalLM(cfg)
+        params = model.init_params(jax.random.PRNGKey(4), batch_size=1, seq_len=8)
+        from accelerate_tpu.checkpointing import save_model
+
+        class _Acc:  # save_model only touches is_main_process/wait
+            is_main_process = True
+
+            @staticmethod
+            def wait_for_everyone():
+                pass
+
+        d = str(tmp_path / "m")
+        save_model(_Acc, type("M", (), {"params": params})(), d)
+        return load_checkpoint_and_dispatch(model, d, device_map={"": "disk"},
+                                            dtype=jnp.float32)
+
+    @pytest.mark.parametrize("window", [None, 8])
+    def test_matches_plain_streamed_greedy(self, tmp_path, window):
+        streamed = self._streamed(tmp_path, window=window)
+        ids = np.tile(np.array([[3, 7, 12]], np.int32), (1, 4))
+        ref = np.asarray(streamed.generate(ids, max_new_tokens=14))
+        got = np.asarray(streamed.generate(ids, max_new_tokens=14,
+                                           prompt_lookup_num_tokens=4))
+        np.testing.assert_array_equal(got, ref)
+
+    def test_matches_with_eos(self, tmp_path):
+        streamed = self._streamed(tmp_path)
+        ids = (np.arange(9, dtype=np.int32)[None] * 5) % 64
+        free = np.asarray(streamed.generate(ids, max_new_tokens=12))
+        eos = int(free[0, -2])
+        ref = np.asarray(streamed.generate(ids, max_new_tokens=12, eos_token_id=eos))
+        got = np.asarray(streamed.generate(ids, max_new_tokens=12, eos_token_id=eos,
+                                           prompt_lookup_num_tokens=3))
+        np.testing.assert_array_equal(got, ref)
