@@ -103,7 +103,7 @@ def build_and_save(size: str, ckpt_dir: str, family: str = "llama"):
 
 
 def bench_tier(module, ckpt_dir: str, tier: str, prompt_len: int, tokens: int,
-               offload_folder=None):
+               offload_folder=None, prompt_lookup: int = 0):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -149,12 +149,26 @@ def bench_tier(module, ckpt_dir: str, tier: str, prompt_len: int, tokens: int,
         gen(n=2, use_cache=False)
         nocache_per_token = (time.perf_counter() - t0) / 2
 
+    lookup_per_token = None
+    if prompt_lookup and not is_t5:
+        # Prompt-lookup speculation: a REPETITIVE prompt so acceptance is
+        # realistic for the self-repetitive texts the technique targets.
+        rep = jnp.asarray(np.tile(rng.integers(0, module.config.vocab_size,
+                                               size=(1, 4)), (1, prompt_len // 4)),
+                          jnp.int32)
+        kw = dict(max_new_tokens=tokens, prompt_lookup_num_tokens=prompt_lookup)
+        streamed.generate(rep, **kw)  # compile warm-up
+        t0 = time.perf_counter()
+        streamed.generate(rep, **kw)
+        lookup_per_token = (time.perf_counter() - t0) / tokens
+
     result = {
         "tier": tier,
         "load_s": round(load_s, 2),
         "first_call_s": round(first_token_s, 2),
         "kv_s_per_token": round(kv_per_token, 4),
         "nocache_s_per_token": round(nocache_per_token, 4) if nocache_per_token else None,
+        "lookup_s_per_token": round(lookup_per_token, 4) if lookup_per_token else None,
         "hbm_resident_bytes": streamed.hbm_resident_bytes,
         "n_new_tokens": int(out.shape[1] - (1 if is_t5 else prompt_len)),
     }
@@ -170,6 +184,9 @@ def main() -> int:
     ap.add_argument("--tiers", default="device,cpu")
     ap.add_argument("--tokens", type=int, default=16)
     ap.add_argument("--prompt-len", type=int, default=64)
+    ap.add_argument("--prompt-lookup", type=int, default=0,
+                    help="also time prompt-lookup speculation with K drafts "
+                         "(decoder-only families)")
     args = ap.parse_args()
 
     from accelerate_tpu.utils.platforms import resolve_backend
@@ -185,7 +202,7 @@ def main() -> int:
             offload = f"{tmp}/offload_{tier}" if tier == "disk" else None
             rows.append(
                 bench_tier(module, ckpt, tier.strip(), args.prompt_len, args.tokens,
-                           offload_folder=offload)
+                           offload_folder=offload, prompt_lookup=args.prompt_lookup)
             )
 
     print(f"\n{args.family}-{args.size} ({n_params/1e6:.0f}M params), "
@@ -194,9 +211,11 @@ def main() -> int:
     print("|:---------:|:---------:|:-----------:|:----------------:|:---------------:|:------------:|")
     for r in rows:
         nc = f"{r['nocache_s_per_token']:.3f}s" if r["nocache_s_per_token"] else "-"
+        extra = (f" lookup {r['lookup_s_per_token']*1000:.1f}ms/tok"
+                 if r.get("lookup_s_per_token") else "")
         print(f"| {r['tier']} | {r['load_s']:.1f}s | {r['first_call_s']:.2f}s "
               f"| {r['kv_s_per_token']*1000:.1f}ms | {nc} "
-              f"| {r['hbm_resident_bytes']/2**30:.2f}GiB |")
+              f"| {r['hbm_resident_bytes']/2**30:.2f}GiB |{extra}")
     print()
     print(json.dumps({"metric": "big_model_kv_decode_s_per_token",
                       "size": args.size, "family": args.family,
