@@ -362,3 +362,32 @@ class TestStreamedPromptLookup:
         got = np.asarray(streamed.generate(ids, max_new_tokens=12, eos_token_id=eos,
                                            prompt_lookup_num_tokens=3))
         np.testing.assert_array_equal(got, ref)
+
+    def test_speculation_accepts_on_periodic_text(self, tmp_path):
+        """Equality alone can't catch a regression that rejects every draft
+        (it would still be correct, just slow) — on a periodic continuation
+        the verification passes must number fewer than one per token."""
+        streamed = self._streamed(tmp_path)
+        ids = (np.arange(8, dtype=np.int32)[None] * 11) % 64
+        # tiny random models fall into cycles; use the model's own greedy
+        # continuation as the prompt so lookup finds real patterns
+        warm = np.asarray(streamed.generate(ids, max_new_tokens=24))
+        prompt = warm[:, :20]
+        tail = warm[0, 20:].tolist()
+        if len(set(tail)) > len(tail) - 2:
+            pytest.skip("continuation not periodic for this seed; no pattern to accept")
+        calls = {"n": 0}
+        orig = streamed._cached_pass
+
+        def counting(*a, **k):
+            calls["n"] += 1
+            return orig(*a, **k)
+
+        streamed._cached_pass = counting
+        ref = np.asarray(streamed.generate(prompt, max_new_tokens=12))
+        plain_calls = calls["n"]
+        calls["n"] = 0
+        got = np.asarray(streamed.generate(prompt, max_new_tokens=12,
+                                           prompt_lookup_num_tokens=4))
+        np.testing.assert_array_equal(got, ref)
+        assert calls["n"] < plain_calls, (calls["n"], plain_calls)
