@@ -1,0 +1,76 @@
+"""Prompt-lookup speculative decoding (net-new vs the reference, whose
+users reach the same capability through transformers'
+``prompt_lookup_num_tokens``).
+
+Greedy decoding where each step drafts the continuation of the most recent
+earlier occurrence of the last n-gram and verifies the whole draft in ONE
+cached forward — the output is exactly the plain greedy output, reached in
+fewer, wider (MXU-friendlier) steps wherever the text repeats itself.
+Demonstrates both the fully-compiled path (`prompt_lookup_generate`) and
+the weight-streaming executor (`StreamedModel.generate(
+prompt_lookup_num_tokens=...)`), and checks the exact-equality contract.
+"""
+
+import sys
+import tempfile
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from accelerate_tpu import generate, prompt_lookup_generate
+from accelerate_tpu.utils import set_seed
+
+
+def main():
+    set_seed(0)
+    import jax
+
+    from accelerate_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig.tiny(use_flash_attention=False)
+    model = LlamaForCausalLM(cfg)
+    params = model.init_params(jax.random.PRNGKey(0), batch_size=1, seq_len=8)
+
+    # A self-repetitive prompt — the regime prompt lookup accelerates
+    # (code, quotes, retrieval contexts).
+    ids = jnp.asarray(np.tile(np.array([[7, 11, 13]], np.int32), (1, 4)))
+
+    ref = generate(model, params, ids, max_new_tokens=24, cache_dtype=jnp.float32)
+    spec = prompt_lookup_generate(model, params, ids, max_new_tokens=24,
+                                  num_draft=5, cache_dtype=jnp.float32)
+    assert np.array_equal(np.asarray(ref), np.asarray(spec)), "speculation must be greedy-exact"
+    print("compiled path: speculative output == greedy output "
+          f"({spec.shape[1] - ids.shape[1]} tokens)")
+
+    # Streamed executor: weights stream once per ACCEPTED RUN, not per
+    # token — the win scales with how much of the per-token latency is
+    # weight traffic (cpu/disk tiers).
+    from accelerate_tpu.big_modeling import load_checkpoint_and_dispatch
+    from accelerate_tpu.checkpointing import save_model
+
+    class _Acc:
+        is_main_process = True
+
+        @staticmethod
+        def wait_for_everyone():
+            pass
+
+    with tempfile.TemporaryDirectory() as d:
+        save_model(_Acc, type("M", (), {"params": params})(), d)
+        streamed = load_checkpoint_and_dispatch(model, d, device_map={"": "disk"},
+                                                dtype=jnp.float32)
+        plain = streamed.generate(np.asarray(ids), max_new_tokens=14)
+        spec = streamed.generate(np.asarray(ids), max_new_tokens=14,
+                                 prompt_lookup_num_tokens=4)
+        assert np.array_equal(np.asarray(plain), np.asarray(spec))
+        streamed.close()
+    print("streamed path: speculative output == greedy output (disk tier)")
+    print("speculative decoding example: OK")
+
+
+if __name__ == "__main__":
+    main()

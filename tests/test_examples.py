@@ -116,6 +116,16 @@ class TestInferenceExamples:
         assert res.returncode == 0, res.stdout[-2500:] + res.stderr[-2500:]
         assert "distributed inference example: OK" in res.stdout
 
+    def test_speculative_decoding(self):
+        env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+        res = subprocess.run(
+            [sys.executable, str(EXAMPLES / "inference" / "speculative_decoding.py")],
+            capture_output=True, text=True, timeout=420, cwd=str(REPO), env=env)
+        assert res.returncode == 0, res.stdout[-2500:] + res.stderr[-2500:]
+        assert "speculative decoding example: OK" in res.stdout
+
 
 class TestConfigTemplates:
     def test_every_template_resolves(self):
