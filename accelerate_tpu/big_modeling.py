@@ -906,6 +906,13 @@ class StreamedModel:
             and self.cache_factory is not None
             and all(s.cached_apply is not None for s in self.specs)
         )
+        if prompt_lookup_num_tokens and not cached:
+            # Never silently fall back to the slowest path when the caller
+            # explicitly asked for speculation (which presupposes a cache).
+            raise ValueError(
+                "prompt_lookup_num_tokens requires KV-cache support "
+                "(cached_apply on every block spec + a cache_factory) and "
+                "use_cache=True")
         if not cached:
             for _ in range(max_new_tokens):
                 logits = self(ids)
@@ -916,10 +923,12 @@ class StreamedModel:
             return ids
 
         B, S = ids.shape
-        slack = (prompt_lookup_num_tokens or 0) and (prompt_lookup_num_tokens + 1)
+        slack = (prompt_lookup_num_tokens + 1) if prompt_lookup_num_tokens else 0
         if self.position_bound is not None and S + max_new_tokens + slack > self.position_bound:
+            label = ("prompt + max_new_tokens + speculative slack" if slack
+                     else "prompt + max_new_tokens")
             raise ValueError(
-                f"prompt + max_new_tokens = {S + max_new_tokens + slack} exceeds the "
+                f"{label} = {S + max_new_tokens + slack} exceeds the "
                 f"model's position table ({self.position_bound}); learned-position "
                 "lookups would silently clamp."
             )
@@ -953,11 +962,23 @@ class StreamedModel:
             raise ValueError(f"lookup_ngram and prompt_lookup_num_tokens must be >= 1 "
                              f"(got {ngram}, {K})")
         S = ids.shape[1]
-        try:
+        import inspect
+
+        # Signature introspection, not try/except: a bare TypeError catch
+        # would silently drop the correctness-critical ring_slack (and mask
+        # real bugs inside a slack-aware factory).
+        takes_slack = "ring_slack" in inspect.signature(self.cache_factory).parameters
+        if takes_slack:
             caches = list(self.cache_factory(1, S + max_new_tokens + K + 1,
                                              ring_slack=K + 1))
-        except TypeError:  # factories without ring caches (no slack concept)
+        else:
             caches = list(self.cache_factory(1, S + max_new_tokens + K + 1))
+            if any("pos" in c for c in caches):
+                raise ValueError(
+                    "this model's cache_factory builds ring (sliding-window) "
+                    "caches but does not accept ring_slack — speculation "
+                    "would evict in-window keys; add ring_slack support "
+                    "(see big_modeling.cache_factory_for)")
         caches = [jax.device_put(c, self.device) for c in caches]
         first = self._cached_pass((jax.device_put(ids, self.device),), caches, 0)[0, -1]
         committed = np.asarray(ids[0]).tolist() + [int(first)]

@@ -207,15 +207,21 @@ def main() -> int:
 
     print(f"\n{args.family}-{args.size} ({n_params/1e6:.0f}M params), "
           f"prompt={args.prompt_len}, platform={platform}\n")
-    print("| Placement | Load time | First call (compile) | KV decode /token | No-cache /token | HBM resident |")
-    print("|:---------:|:---------:|:-----------:|:----------------:|:---------------:|:------------:|")
+    with_lookup = any(r.get("lookup_s_per_token") for r in rows)
+    lk_head = " Prompt-lookup /token |" if with_lookup else ""
+    lk_sep = ":---:|" if with_lookup else ""
+    print("| Placement | Load time | First call (compile) | KV decode /token "
+          f"| No-cache /token | HBM resident |{lk_head}")
+    print(f"|:---------:|:---------:|:-----------:|:----------------:|:---------------:|:------------:|{lk_sep}")
     for r in rows:
         nc = f"{r['nocache_s_per_token']:.3f}s" if r["nocache_s_per_token"] else "-"
-        extra = (f" lookup {r['lookup_s_per_token']*1000:.1f}ms/tok"
-                 if r.get("lookup_s_per_token") else "")
+        lk = ""
+        if with_lookup:
+            v = r.get("lookup_s_per_token")
+            lk = f" {v*1000:.1f}ms |" if v else " - |"
         print(f"| {r['tier']} | {r['load_s']:.1f}s | {r['first_call_s']:.2f}s "
               f"| {r['kv_s_per_token']*1000:.1f}ms | {nc} "
-              f"| {r['hbm_resident_bytes']/2**30:.2f}GiB |{extra}")
+              f"| {r['hbm_resident_bytes']/2**30:.2f}GiB |{lk}")
     print()
     print(json.dumps({"metric": "big_model_kv_decode_s_per_token",
                       "size": args.size, "family": args.family,
