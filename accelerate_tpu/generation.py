@@ -74,10 +74,23 @@ def _make_selector(sampling, repetition_penalty: float = 1.0):
 
     if sampling is None:
         return lambda logits, rng, seen: jnp.argmax(apply_penalty(logits, seen), axis=-1)
-    temperature, top_k, top_p = sampling
+    warp = _make_warper(sampling)
 
     def select(logits, rng, seen):
-        logits = apply_penalty(logits, seen).astype(jnp.float32) / max(temperature, 1e-6)
+        return jax.random.categorical(rng, warp(apply_penalty(logits, seen)), axis=-1)
+
+    return select
+
+
+def _make_warper(sampling):
+    """logits [B, V] -> warped fp32 logits (temperature / top-k / top-p;
+    excluded tokens at -inf). ``softmax(warped)`` IS the sampling target
+    distribution — shared by the selector and the speculative accept rule,
+    which must agree on it exactly."""
+    temperature, top_k, top_p = sampling
+
+    def warp(logits):
+        logits = logits.astype(jnp.float32) / max(temperature, 1e-6)
         if top_k is not None and top_k > 0:
             k = min(top_k, logits.shape[-1])
             kth = jax.lax.top_k(logits, k)[0][:, -1:]
@@ -93,9 +106,43 @@ def _make_selector(sampling, repetition_penalty: float = 1.0):
             )
             cutoff = jnp.min(jnp.where(keep, sorted_logits, jnp.inf), axis=-1, keepdims=True)
             logits = jnp.where(logits < cutoff, -jnp.inf, logits)
-        return jax.random.categorical(rng, logits, axis=-1)
+        return logits
 
-    return select
+    return warp
+
+
+def speculative_accept(warped_logits, draft, rng):
+    """Exact speculative sampling over one verification chunk (Leviathan/
+    Chen rejection rule with a deterministic — delta — proposal).
+
+    Args:
+      warped_logits: [K+1, V] fp32 — position j's TARGET distribution is
+        softmax(warped_logits[j]) (already temperature/top-k/top-p warped).
+      draft: [K] int — proposed tokens.
+      rng: PRNG key.
+
+    Returns ``(m, final)``: ``m`` draft tokens commit (their acceptance
+    tests passed) followed by ``final``, drawn from position ``m``'s
+    residual distribution max(p - delta_draft, 0)/Z when ``m < K`` (the
+    rejection-sampling correction) or from position K's full target when
+    every draft was accepted. Marginal law of the emitted tokens is exactly
+    the chain of target distributions — the speculative-sampling theorem.
+    """
+    K = draft.shape[0]
+    probs = jax.nn.softmax(warped_logits, axis=-1)               # [K+1, V]
+    u_rng, s_rng = jax.random.split(rng)
+    u = jax.random.uniform(u_rng, (K,))
+    p_draft = jnp.take_along_axis(probs[:K], draft[:, None], axis=1)[:, 0]
+    accept = u < p_draft                                         # delta proposal: q = 1
+    m = jnp.sum(jnp.cumprod(accept.astype(jnp.int32)))
+    # Resample row: position m's warped logits, with the rejected draft
+    # token excluded (residual distribution) when m < K.
+    row = warped_logits[jnp.minimum(m, K)]
+    rejected = draft[jnp.minimum(m, K - 1)]
+    masked = row.at[rejected].set(-jnp.inf)
+    row = jnp.where(m < K, masked, row)
+    final = jax.random.categorical(s_rng, row)
+    return m, final
 
 
 def _freeze(obj):
@@ -330,18 +377,22 @@ def greedy_generate(module, params, input_ids, max_new_tokens: int = 20,
 
 
 def _compiled_lookup_generate(module, max_new_tokens: int, eos_token_id, cache_dtype,
-                              ngram: int, num_draft: int, prompt_len: int):
+                              ngram: int, num_draft: int, prompt_len: int,
+                              sampling=None):
     """(prefill, speculate_loop) jitted pair for prompt-lookup decoding.
     Keyed per (module config, lengths, eos, dtype, ngram, K) like
     _compiled_generate; prompt_len is part of the key because the token
-    buffer and position arithmetic are shaped by it."""
+    buffer and position arithmetic are shaped by it. ``sampling`` non-None
+    switches the greedy accept rule to exact speculative sampling
+    (:func:`speculative_accept`)."""
     key = _cache_key(module, max_new_tokens, eos_token_id,
-                     jnp.dtype(cache_dtype).name, None, 1.0,
+                     jnp.dtype(cache_dtype).name, sampling, 1.0,
                      ("lookup", ngram, num_draft, prompt_len))
     hit = _generate_cache.get(key) if key is not None else None
     if hit is not None:
         return hit
 
+    warp = _make_warper(sampling) if sampling is not None else None
     K = num_draft
     S = prompt_len
     # Buffer slack: a verification chunk may scribble K + 1 tokens past the
@@ -351,21 +402,26 @@ def _compiled_lookup_generate(module, max_new_tokens: int, eos_token_id, cache_d
     eos = eos_token_id
 
     @jax.jit
-    def prefill(params, ids, cache):
+    def prefill(params, ids, cache, rng):
         logits, cache = module.apply({"params": params}, ids, cache=cache, cache_pos=0)
-        return jnp.argmax(logits[:, -1], axis=-1).astype(ids.dtype), cache
+        if sampling is None:
+            tok = jnp.argmax(logits[:, -1], axis=-1)
+        else:
+            tok = jax.random.categorical(rng, warp(logits[:, -1]), axis=-1)
+        return tok.astype(ids.dtype), cache
 
     @jax.jit
-    def speculate(params, buf, cache):
+    def speculate(params, buf, cache, rng):
         """buf: [1, L] with the prompt + first generated token committed
         (n_gen starts at 1). Returns the completed buf."""
 
         def cond(state):
-            _, n_gen, _, done = state
+            _, n_gen, _, done, _ = state
             return (n_gen < max_new_tokens) & ~done
 
         def body(state):
-            buf, n_gen, cache, done = state
+            buf, n_gen, cache, done, rng = state
+            rng, step_rng = jax.random.split(rng)
             cur = S + n_gen                       # committed length
             # --- draft: continuation of the most recent earlier match of
             # the last `ngram` committed tokens --------------------------
@@ -386,11 +442,18 @@ def _compiled_lookup_generate(module, max_new_tokens: int, eos_token_id, cache_d
             chunk = jnp.concatenate([last, draft[None, :]], axis=1)    # [1, K+1]
             logits, cache = module.apply({"params": params}, chunk,
                                          cache=cache, cache_pos=cur - 1)
-            preds = jnp.argmax(logits[0], axis=-1).astype(buf.dtype)   # [K+1]
-
-            matches = draft == preds[:K]
-            m = jnp.sum(jnp.cumprod(matches.astype(jnp.int32)))        # accepted drafts
-            emit = preds                                               # m drafts + bonus
+            if sampling is None:
+                preds = jnp.argmax(logits[0], axis=-1).astype(buf.dtype)   # [K+1]
+                matches = draft == preds[:K]
+                m = jnp.sum(jnp.cumprod(matches.astype(jnp.int32)))    # accepted drafts
+                emit = preds                                           # m drafts + bonus
+            else:
+                m, final = speculative_accept(warp(logits[0]), draft, step_rng)
+                # emit = draft[:m] + final at slot m; slots past m are
+                # never committed (n_emit caps at m + 1) — fill with final.
+                slots = jnp.arange(K + 1)
+                emit = jnp.where(slots < m, jnp.append(draft, 0)[slots],
+                                 final).astype(buf.dtype)
             if eos is not None:
                 # generate()'s ragged-stop contract: after EOS, keep
                 # emitting EOS.
@@ -401,13 +464,13 @@ def _compiled_lookup_generate(module, max_new_tokens: int, eos_token_id, cache_d
             buf = jax.lax.dynamic_update_slice(buf, emit[None, :], (0, cur))
             if eos is not None:
                 done = done | jnp.any((jnp.arange(K + 1) < n_emit) & (emit == eos))
-            return buf, n_gen + n_emit, cache, done
+            return buf, n_gen + n_emit, cache, done, rng
 
         # The first generated token may itself be EOS (ragged-stop from the
         # very first step, like generate()).
         done0 = (buf[0, S] == eos) if eos is not None else jnp.asarray(False)
-        buf, n_gen, _, _ = jax.lax.while_loop(
-            cond, body, (buf, jnp.asarray(1, jnp.int32), cache, done0))
+        buf, n_gen, _, _, _ = jax.lax.while_loop(
+            cond, body, (buf, jnp.asarray(1, jnp.int32), cache, done0, rng))
         if eos is not None:
             # Early EOS stop: the un-generated tail keeps emitting EOS.
             tail = jnp.arange(L) >= (S + n_gen)
@@ -427,6 +490,11 @@ def prompt_lookup_generate(
     cache_dtype=None,
     ngram: int = 2,
     num_draft: int = 5,
+    do_sample: bool = False,
+    temperature: float = 1.0,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
+    rng=None,
 ):
     """Greedy decoding accelerated by prompt-lookup speculation (assisted
     generation without a draft model — transformers'
@@ -444,6 +512,12 @@ def prompt_lookup_generate(
     overwrites before any query can attend them; ring caches mask them by
     stored position. Batch 1 only (per-row acceptance counts would
     desynchronize a batched scan).
+
+    ``do_sample=True`` switches the accept rule to EXACT speculative
+    sampling (:func:`speculative_accept` — rejection sampling against the
+    temperature/top-k/top-p-warped target): the emitted tokens are
+    distributed exactly as ``generate(do_sample=True)``'s, though the
+    draws differ (different rng consumption).
     """
     from .big_modeling import cache_factory_for
 
@@ -474,14 +548,18 @@ def prompt_lookup_generate(
     # from sliding-window layers' ring caches.
     cache = factory(B, S + max_new_tokens + K + 1, dtype, ring_slack=K + 1)
 
+    sampling = (float(temperature), top_k, top_p) if do_sample else None
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    rng, pre_rng = jax.random.split(rng)
     prefill, speculate = _compiled_lookup_generate(
-        module, max_new_tokens, eos_token_id, dtype, int(ngram), K, S)
-    first_tok, cache = prefill(params, ids, cache)
+        module, max_new_tokens, eos_token_id, dtype, int(ngram), K, S,
+        sampling=sampling)
+    first_tok, cache = prefill(params, ids, cache, pre_rng)
     L = S + max_new_tokens + K + 1
     buf = jnp.zeros((1, L), ids.dtype)
     buf = jax.lax.dynamic_update_slice(buf, ids, (0, 0))
     buf = buf.at[0, S].set(first_tok[0])
-    buf = speculate(params, buf, cache)
+    buf = speculate(params, buf, cache, rng)
     return buf[:, : S + max_new_tokens]
 
 

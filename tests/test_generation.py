@@ -357,3 +357,82 @@ class TestPromptLookupGenerate:
         model, params, cfg = self._model()
         with pytest.raises(ValueError, match="batch-1"):
             prompt_lookup_generate(model, params, jnp.zeros((2, 4), jnp.int32))
+
+
+class TestSpeculativeSampling:
+    """do_sample speculation must be DISTRIBUTION-exact (the speculative
+    sampling theorem), not just plausible."""
+
+    def test_accept_rule_preserves_target_distribution(self):
+        # K=1: whatever the draft, the law of the emitted token must be
+        # exactly softmax(warped_logits[0]).
+        from accelerate_tpu.generation import speculative_accept
+
+        V = 8
+        logits = jnp.asarray(np.array([
+            [2.0, 0.1, -1.0, 0.5, 1.5, -0.5, 0.0, 0.7],
+            [0.0] * V,
+        ], np.float32))
+        target = np.asarray(jax.nn.softmax(logits[0]))
+        draft = jnp.asarray([4])  # a likely (but not top) token
+
+        @jax.jit
+        def one(key):
+            m, final = speculative_accept(logits, draft, key)
+            return jnp.where(m >= 1, draft[0], final)
+
+        keys = jax.random.split(jax.random.PRNGKey(0), 20000)
+        toks = np.asarray(jax.vmap(one)(keys))
+        emp = np.bincount(toks, minlength=V) / len(toks)
+        np.testing.assert_allclose(emp, target, atol=0.015)
+
+    def test_full_acceptance_bonus_samples_target(self):
+        # Draft token has ~all the mass at position 0 -> m = 1 (almost)
+        # always; the bonus must then follow position 1's target.
+        from accelerate_tpu.generation import speculative_accept
+
+        V = 8
+        row0 = np.full(V, -30.0, np.float32); row0[3] = 10.0
+        row1 = np.array([1.0, 0.0, 2.0, -1.0, 0.5, 0.2, -0.3, 0.8], np.float32)
+        logits = jnp.asarray(np.stack([row0, row1]))
+        target1 = np.asarray(jax.nn.softmax(logits[1]))
+        draft = jnp.asarray([3])
+
+        @jax.jit
+        def one(key):
+            return speculative_accept(logits, draft, key)
+
+        keys = jax.random.split(jax.random.PRNGKey(1), 20000)
+        ms, finals = jax.vmap(one)(keys)
+        assert float(np.mean(np.asarray(ms))) > 0.999
+        emp = np.bincount(np.asarray(finals), minlength=V) / len(keys)
+        np.testing.assert_allclose(emp, target1, atol=0.015)
+
+    def test_tiny_temperature_degenerates_to_greedy(self):
+        from accelerate_tpu.generation import generate, prompt_lookup_generate
+        from accelerate_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+        cfg = LlamaConfig.tiny(use_flash_attention=False)
+        model = LlamaForCausalLM(cfg)
+        params = model.init_params(jax.random.PRNGKey(5), batch_size=1, seq_len=8)
+        ids = np.tile(np.array([[9, 4, 17]], np.int32), (1, 4))
+        ref = np.asarray(generate(model, params, jnp.asarray(ids), max_new_tokens=18,
+                                  cache_dtype=jnp.float32))
+        got = np.asarray(prompt_lookup_generate(
+            model, params, jnp.asarray(ids), max_new_tokens=18,
+            do_sample=True, temperature=1e-6, cache_dtype=jnp.float32))
+        np.testing.assert_array_equal(got, ref)
+
+    def test_seeded_determinism(self):
+        from accelerate_tpu.generation import prompt_lookup_generate
+        from accelerate_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+        cfg = LlamaConfig.tiny(use_flash_attention=False)
+        model = LlamaForCausalLM(cfg)
+        params = model.init_params(jax.random.PRNGKey(6), batch_size=1, seq_len=8)
+        ids = (np.arange(10, dtype=np.int32)[None] * 7) % cfg.vocab_size
+        kw = dict(max_new_tokens=12, do_sample=True, temperature=0.9, top_k=16,
+                  cache_dtype=jnp.float32, rng=jax.random.PRNGKey(42))
+        a = np.asarray(prompt_lookup_generate(model, params, jnp.asarray(ids), **kw))
+        b = np.asarray(prompt_lookup_generate(model, params, jnp.asarray(ids), **kw))
+        np.testing.assert_array_equal(a, b)
