@@ -840,11 +840,13 @@ class StreamedModel:
         return fn(ptrees, args, cache, pos)
 
     def _cached_pass(self, args: tuple, caches: list, pos: int, specs=None,
-                     static_pos=None):
+                     static_pos=None, return_logits: bool = False):
         """One full pass (prefill, single-token decode, or a speculative
         verification chunk) through the given blocks (default: all), updating
         layer caches in place. Returns the greedy prediction at EVERY chunk
-        position, [B, chunk_len] (single-token callers take ``[:, -1]``).
+        position, [B, chunk_len] (single-token callers take ``[:, -1]``) —
+        or the raw logits [B, chunk_len, V] with ``return_logits=True``
+        (sampling paths need the distribution, not the argmax).
 
         ``static_pos`` None infers: multi-token chunks keep ``pos`` STATIC
         (a Python int) — the initial prefill's executable is shape-distinct
@@ -870,12 +872,15 @@ class StreamedModel:
                 args, _ = self._apply_cached(spec, ptrees, args, None, pos,
                                              static_pos=static_pos)
         logits = args[0]
-        return jnp.argmax(logits, axis=-1)
+        return logits if return_logits else jnp.argmax(logits, axis=-1)
 
     def generate(self, input_ids, max_new_tokens: int = 20,
                  eos_token_id: Optional[int] = None, use_cache: bool = True,
                  prompt_lookup_num_tokens: Optional[int] = None,
-                 lookup_ngram: int = 2):
+                 lookup_ngram: int = 2,
+                 do_sample: bool = False, temperature: float = 1.0,
+                 top_k: Optional[int] = None, top_p: Optional[float] = None,
+                 rng=None):
         """Greedy decoding (reference capability: hook-streamed
         ``model.generate``; per-token latency table in
         benchmarks/big_model_inference/README.md:26-45).
@@ -913,10 +918,23 @@ class StreamedModel:
                 "prompt_lookup_num_tokens requires KV-cache support "
                 "(cached_apply on every block spec + a cache_factory) and "
                 "use_cache=True")
+        sampling = (float(temperature), top_k, top_p) if do_sample else None
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+
+        def pick(logits_row, key):
+            # logits_row [B, V] -> [B] next tokens (greedy or warped sample).
+            if sampling is None:
+                return jnp.argmax(logits_row, axis=-1)
+            from .generation import _make_warper
+
+            return jax.random.categorical(key, _make_warper(sampling)(logits_row),
+                                          axis=-1)
+
         if not cached:
             for _ in range(max_new_tokens):
                 logits = self(ids)
-                nxt = jnp.argmax(logits[:, -1, :], axis=-1)[:, None].astype(ids.dtype)
+                rng, key = jax.random.split(rng)
+                nxt = pick(logits[:, -1, :], key)[:, None].astype(ids.dtype)
                 ids = jnp.concatenate([ids, nxt], axis=1)
                 if eos_token_id is not None and bool((nxt == eos_token_id).all()):
                     break
@@ -935,25 +953,34 @@ class StreamedModel:
         if prompt_lookup_num_tokens:
             return self._generate_prompt_lookup(
                 ids, max_new_tokens, eos_token_id,
-                int(prompt_lookup_num_tokens), int(lookup_ngram))
+                int(prompt_lookup_num_tokens), int(lookup_ngram),
+                sampling=sampling, rng=rng)
         caches = list(self.cache_factory(B, S + max_new_tokens))
         caches = [jax.device_put(c, self.device) for c in caches]
-        tok = self._cached_pass((jax.device_put(ids, self.device),), caches, 0)[:, -1]
+        sample = sampling is not None
+        out = self._cached_pass((jax.device_put(ids, self.device),), caches, 0,
+                                return_logits=sample)
+        rng, key = jax.random.split(rng)
+        tok = pick(out[:, -1, :], key) if sample else out[:, -1]
         pieces = [ids, tok[:, None].astype(ids.dtype)]
         for t in range(1, max_new_tokens):
             if eos_token_id is not None and bool((tok == eos_token_id).all()):
                 break
-            tok = self._cached_pass((tok[:, None].astype(ids.dtype),), caches,
-                                    S + t - 1)[:, -1]
+            out = self._cached_pass((tok[:, None].astype(ids.dtype),), caches,
+                                    S + t - 1, return_logits=sample)
+            rng, key = jax.random.split(rng)
+            tok = pick(out[:, -1, :], key) if sample else out[:, -1]
             pieces.append(tok[:, None].astype(ids.dtype))
         return jnp.concatenate(pieces, axis=1)
 
     def _generate_prompt_lookup(self, ids, max_new_tokens: int, eos_token_id,
-                                K: int, ngram: int):
-        """Speculative greedy decode: draft in Python (the committed ids are
-        host-side anyway), verify K+1 tokens per streamed pass. Rejected
-        positions leave stale KV that the next chunk overwrites before any
-        query attends it; ring caches get K+1 slots of eviction slack."""
+                                K: int, ngram: int, sampling=None, rng=None):
+        """Speculative decode: draft in Python (the committed ids are
+        host-side anyway), verify K+1 tokens per streamed pass. Greedy by
+        default; ``sampling`` switches the accept rule to exact speculative
+        sampling (generation.speculative_accept). Rejected positions leave
+        stale KV that the next chunk overwrites before any query attends
+        it; ring caches get K+1 slots of eviction slack."""
         import numpy as np
 
         if ids.shape[0] != 1:
@@ -980,7 +1007,19 @@ class StreamedModel:
                     "would evict in-window keys; add ring_slack support "
                     "(see big_modeling.cache_factory_for)")
         caches = [jax.device_put(c, self.device) for c in caches]
-        first = self._cached_pass((jax.device_put(ids, self.device),), caches, 0)[0, -1]
+        sample = sampling is not None
+        if sample:
+            from .generation import _make_warper, speculative_accept
+
+            warp = _make_warper(sampling)
+            rng = rng if rng is not None else jax.random.PRNGKey(0)
+        out = self._cached_pass((jax.device_put(ids, self.device),), caches, 0,
+                                return_logits=sample)
+        if sample:
+            rng, key = jax.random.split(rng)
+            first = jax.random.categorical(key, warp(out[:, -1, :]), axis=-1)[0]
+        else:
+            first = out[0, -1]
         committed = np.asarray(ids[0]).tolist() + [int(first)]
         eos_done = eos_token_id is not None and int(first) == eos_token_id
         while len(committed) - S < max_new_tokens and not eos_done:
@@ -996,11 +1035,19 @@ class StreamedModel:
                         break
             draft += [committed[-1]] * (K - len(draft))   # pad: rejected cheaply
             chunk = jnp.asarray([[committed[-1], *draft]], ids.dtype)   # [1, K+1]
-            preds = np.asarray(
-                self._cached_pass((chunk,), caches, cur - 1, static_pos=False)[0])
-            m = 0
-            while m < K and draft[m] == int(preds[m]):
-                m += 1
+            out = self._cached_pass((chunk,), caches, cur - 1, static_pos=False,
+                                    return_logits=sample)
+            if sample:
+                rng, key = jax.random.split(rng)
+                m_arr, final = speculative_accept(
+                    warp(out[0]), jnp.asarray(draft), key)
+                m = int(m_arr)
+                preds = draft[:m] + [int(final)] + [0] * (K - m)  # emit shape [K+1]
+            else:
+                preds = np.asarray(out[0])
+                m = 0
+                while m < K and draft[m] == int(preds[m]):
+                    m += 1
             emit = [int(p) for p in preds[: m + 1]]
             emit = emit[: max_new_tokens - (cur - S)]
             if eos_token_id is not None and eos_token_id in emit:

@@ -391,3 +391,25 @@ class TestStreamedPromptLookup:
                                            prompt_lookup_num_tokens=4))
         np.testing.assert_array_equal(got, ref)
         assert calls["n"] < plain_calls, (calls["n"], plain_calls)
+
+    def test_sampled_decode_and_speculation(self, tmp_path):
+        """Streamed sampled decode (new) — tiny temperature must degenerate
+        to greedy on both the plain and speculative paths; fixed seeds are
+        deterministic."""
+        streamed = self._streamed(tmp_path)
+        ids = np.tile(np.array([[3, 7, 12]], np.int32), (1, 4))
+        ref = np.asarray(streamed.generate(ids, max_new_tokens=10))
+        cold = np.asarray(streamed.generate(ids, max_new_tokens=10, do_sample=True,
+                                            temperature=1e-6))
+        np.testing.assert_array_equal(cold, ref)
+        cold_spec = np.asarray(streamed.generate(
+            ids, max_new_tokens=10, do_sample=True, temperature=1e-6,
+            prompt_lookup_num_tokens=4))
+        np.testing.assert_array_equal(cold_spec, ref)
+        import jax as _jax
+
+        kw = dict(max_new_tokens=10, do_sample=True, temperature=0.9, top_k=16,
+                  rng=_jax.random.PRNGKey(7))
+        a = np.asarray(streamed.generate(ids, **kw))
+        b = np.asarray(streamed.generate(ids, **kw))
+        np.testing.assert_array_equal(a, b)
