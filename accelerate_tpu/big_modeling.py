@@ -881,9 +881,10 @@ class StreamedModel:
                  do_sample: bool = False, temperature: float = 1.0,
                  top_k: Optional[int] = None, top_p: Optional[float] = None,
                  rng=None):
-        """Greedy decoding (reference capability: hook-streamed
-        ``model.generate``; per-token latency table in
-        benchmarks/big_model_inference/README.md:26-45).
+        """Streamed decoding — greedy by default, sampled with
+        ``do_sample=True`` (temperature/top-k/top-p) — the reference
+        capability: hook-streamed ``model.generate``; per-token latency
+        table in benchmarks/big_model_inference/README.md:26-45.
 
         With cache support (``cached_apply`` on every spec + a
         ``cache_factory``) decoding is KV-cached: one prefill pass writes the
@@ -920,15 +921,16 @@ class StreamedModel:
                 "use_cache=True")
         sampling = (float(temperature), top_k, top_p) if do_sample else None
         rng = rng if rng is not None else jax.random.PRNGKey(0)
+        if sampling is not None:
+            from .generation import _make_warper
+
+            warp = _make_warper(sampling)  # built once, not per token
 
         def pick(logits_row, key):
             # logits_row [B, V] -> [B] next tokens (greedy or warped sample).
             if sampling is None:
                 return jnp.argmax(logits_row, axis=-1)
-            from .generation import _make_warper
-
-            return jax.random.categorical(key, _make_warper(sampling)(logits_row),
-                                          axis=-1)
+            return jax.random.categorical(key, warp(logits_row), axis=-1)
 
         if not cached:
             for _ in range(max_new_tokens):
@@ -1042,7 +1044,7 @@ class StreamedModel:
                 m_arr, final = speculative_accept(
                     warp(out[0]), jnp.asarray(draft), key)
                 m = int(m_arr)
-                preds = draft[:m] + [int(final)] + [0] * (K - m)  # emit shape [K+1]
+                preds = draft[:m] + [int(final)]  # truncated to [:m+1] below
             else:
                 preds = np.asarray(out[0])
                 m = 0

@@ -496,7 +496,8 @@ def prompt_lookup_generate(
     top_p: Optional[float] = None,
     rng=None,
 ):
-    """Greedy decoding accelerated by prompt-lookup speculation (assisted
+    """Decoding accelerated by prompt-lookup speculation — greedy by
+    default, distribution-exact sampling with ``do_sample=True`` (assisted
     generation without a draft model — transformers'
     ``prompt_lookup_num_tokens``, which the reference's users reach through
     ``model.generate``).
