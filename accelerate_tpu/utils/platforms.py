@@ -87,18 +87,33 @@ def enable_compilation_cache(path: str | None = None) -> str:
     relaunched trainer after preemption) skips straight to execution.
     Honors ``ACCELERATE_TPU_COMPILATION_CACHE`` when ``path`` is None;
     flag-style values ("1", "true", ...) select the default directory
-    ``~/.cache/accelerate_tpu/jax`` rather than becoming a literal path.
-    Returns the directory."""
+    ``~/.cache/accelerate_tpu/jax`` rather than becoming a literal path,
+    and disable-style values ("0", "false", "no", "off") leave the cache
+    off entirely. Returns the directory, or "" when disabled."""
     import jax
 
     default = os.path.join(os.path.expanduser("~"), ".cache", "accelerate_tpu", "jax")
     if path is None:
         env = os.environ.get("ACCELERATE_TPU_COMPILATION_CACHE", "")
+        if env.lower() in ("0", "false", "no", "off"):
+            return ""
         path = default if env.lower() in ("", "1", "true", "yes", "on") else env
     os.makedirs(path, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", path)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     return path
+
+
+def device_kind() -> str:
+    """Canonical chip-generation string of device 0 (e.g. "TPU v5 lite").
+
+    Bench evidence records and compares this string for chip-equality
+    (skip/merge gating across tunnel windows), so every producer must go
+    through this one helper.
+    """
+    import jax
+
+    return str(getattr(jax.devices()[0], "device_kind", "?"))
 
 
 def _probe_cache_path() -> str:

@@ -53,6 +53,7 @@ def run_bench(on_tpu: bool) -> dict:
     import optax
 
     from accelerate_tpu.utils.platforms import enable_compilation_cache
+    from accelerate_tpu.utils.platforms import device_kind as _device_kind
 
     # Persistent compile cache: a tier-1 attempt that got as far as
     # compiling pays the tunnel's ~25 s/program cost ONCE — later attempts
@@ -74,10 +75,18 @@ def run_bench(on_tpu: bool) -> dict:
             print(f"ATPU_BENCH_{stage}", flush=True)
 
     if on_tpu:
+        # The watcher sets ACCELERATE_TPU_BENCH_NO_FLASH when its quick flash
+        # check failed on this chip: an MFU datapoint on the XLA einsum
+        # attention path still beats no datapoint at all. Disable-style
+        # values ("0", "false", ...) mean flash stays ON.
+        import os
+
+        no_flash_env = os.environ.get("ACCELERATE_TPU_BENCH_NO_FLASH", "")
+        use_flash = no_flash_env.lower() in ("", "0", "false", "no", "off")
         cfg = LlamaConfig(
             vocab_size=32000, hidden_size=2048, intermediate_size=5632,
             num_hidden_layers=10, num_attention_heads=16, num_key_value_heads=8,
-            max_position_embeddings=2048, remat=False, use_flash_attention=True,
+            max_position_embeddings=2048, remat=False, use_flash_attention=use_flash,
         )
         batch, seq, iters, warmup = 8, 1024, 20, 3
     else:  # CPU smoke fallback so the bench always emits a line
@@ -151,7 +160,9 @@ def run_bench(on_tpu: bool) -> dict:
             "config": {
                 "hidden": cfg.hidden_size, "layers": cfg.num_hidden_layers,
                 "batch": batch, "seq": seq, "backend": jax.default_backend(),
+                "flash_attention": cfg.use_flash_attention,
             },
+            "device_kind": _device_kind(),
             "loss": float(metrics["loss"]),
         },
     }
@@ -165,19 +176,23 @@ def _tpu_run_main() -> int:
     return 0
 
 
-def _tpu_subprocess(timeout: float = 480.0) -> tuple[dict | None, str | None]:
+def _tpu_subprocess(
+    timeout: float = 480.0, env: dict | None = None
+) -> tuple[dict | None, str | None]:
     """Run the TPU benchmark in a fresh interpreter with a hard timeout.
 
     The parent never initializes a backend itself: backend init can hang
     irrecoverably in-process when the device tunnel is down, and only a
-    process boundary makes the timeout enforceable. Returns (result, error).
+    process boundary makes the timeout enforceable. ``env`` overrides the
+    child environment (default: inherit). Returns (result, error).
     """
     import os
 
     from accelerate_tpu.utils.platforms import run_with_group_timeout
 
     rc, stdout = run_with_group_timeout(
-        [sys.executable, os.path.abspath(__file__), "--tpu-run"], timeout=timeout
+        [sys.executable, os.path.abspath(__file__), "--tpu-run"],
+        timeout=timeout, env=env,
     )
     for line in reversed(stdout.splitlines()):
         line = line.strip()

@@ -12,8 +12,14 @@ subprocess with a hard group timeout:
 
 * **liveness** (120 s budget): device inventory + one jitted matmul — proves
   the tunnel end-to-end and records the chip generation.
+* **quickflash** (180 s): ONE Mosaic-compiled flash-attention forward at one
+  shape vs the einsum reference, persisted the instant it passes — the
+  cheapest possible "Pallas compiles and is correct on this chip" evidence,
+  captured before anything longer can eat the window. A *failed* (not
+  killed) quickflash also flips tier1 onto the einsum attention path, so a
+  broken kernel cannot cost the headline MFU number.
 * **tier1** (900 s): the full ``bench.py`` training-throughput/MFU run —
-  run FIRST after liveness because observed tunnel-up windows can be short
+  run FIRST after quickflash because observed tunnel-up windows can be short
   and this is the headline artifact.
 * **kernels** (1500 s): the Pallas flash-attention forward/backward, the
   sliding-window variant, and the fp8 delayed-scaling matmul, all
@@ -33,6 +39,7 @@ TPU, so the round artifact carries the best real number ever observed.
 Child modes (run in subprocesses by the loop; usable manually for debug):
 
     python bench_watch.py --liveness-run
+    python bench_watch.py --quickflash-run
     python bench_watch.py --kernels-run
     python bench_watch.py --sweep-run
 """
@@ -47,6 +54,7 @@ import time
 ARTIFACT_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "bench_artifacts")
 HISTORY = os.path.join(ARTIFACT_DIR, "history.jsonl")
 BEST = os.path.join(ARTIFACT_DIR, "best.json")
+QUICKFLASH = os.path.join(ARTIFACT_DIR, "quickflash.json")
 KERNELS = os.path.join(ARTIFACT_DIR, "kernels.json")
 KERNELS_PARTIAL = os.path.join(ARTIFACT_DIR, "kernels_partial.json")
 SWEEP = os.path.join(ARTIFACT_DIR, "sweep.json")
@@ -55,6 +63,7 @@ LOG = os.path.join(ARTIFACT_DIR, "watch.log")
 
 PROBE_TIMEOUT = 90.0
 LIVENESS_BUDGET = 120.0
+QUICKFLASH_BUDGET = 180.0  # backend init + 2 Mosaic/XLA compiles at ~25 s each
 KERNELS_BUDGET = 1500.0  # ~11 Mosaic compiles at ~25 s each over the tunnel
 TIER1_BUDGET = 900.0   # headroom over bench.py's own 480 s default
 SWEEP_BUDGET = 900.0
@@ -123,6 +132,8 @@ def run_liveness() -> dict:
     import jax
     import jax.numpy as jnp
 
+    from accelerate_tpu.utils.platforms import device_kind
+
     t0 = time.perf_counter()
     devs = jax.devices()
     x = jnp.ones((1024, 1024), jnp.bfloat16)
@@ -132,9 +143,74 @@ def run_liveness() -> dict:
         "ok": True,
         "backend": jax.default_backend(),
         "device_count": len(devs),
-        "device_kind": str(getattr(devs[0], "device_kind", "?")),
+        "device_kind": device_kind(),
         "first_matmul_s": round(time.perf_counter() - t0, 2),
     }
+
+
+# ---------------------------------------------------------------------------
+# Child: the single cheapest compiled-kernel proof
+# ---------------------------------------------------------------------------
+
+def _flash_bf16_fwd_parity(tiny: bool) -> dict:
+    """The canonical bf16 causal flash-forward parity check, shared by the
+    quickflash tier and the first check of the full kernel tier so the two
+    can never drift on shape/tolerance/meaning of "flash parity"."""
+    import jax
+    import jax.numpy as jnp
+
+    from accelerate_tpu.ops.attention import _einsum_attention
+    from accelerate_tpu.ops.flash_pallas import pallas_flash_attention
+
+    B, S, H, D = (1, 128, 1, 64) if tiny else (2, 512, 4, 128)
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (jax.random.normal(kk, (B, S, H, D), jnp.bfloat16) for kk in ks)
+    t0 = time.perf_counter()
+    got = jax.jit(lambda q, k, v: pallas_flash_attention(q, k, v, causal=True))(q, k, v)
+    jax.device_get(got[0, 0, 0, 0])
+    compile_s = round(time.perf_counter() - t0, 2)
+    want = jax.jit(lambda q, k, v: _einsum_attention(q, k, v, causal=True))(q, k, v)
+    err = _max_rel_err(got, want)
+    return {"max_rel_err": round(err, 6), "tol": 3e-2, "ok": err <= 3e-2,
+            "compile_s": compile_s}
+
+
+def run_quickflash() -> dict:
+    """ONE Mosaic-compiled flash forward vs the einsum reference.
+
+    A pass is persisted to ``QUICKFLASH`` the moment the numbers are in, so
+    even a window that closes seconds later keeps the "Pallas compiles on
+    this chip" evidence; a failure is reported (history event, tier1
+    fallback) but never overwrites previously captured passing evidence.
+    Everything else about kernels (backward, variants, timings) belongs to
+    the full ``run_kernels`` tier.
+    """
+    import jax
+
+    from accelerate_tpu.utils.platforms import device_kind, enable_compilation_cache
+
+    enable_compilation_cache()
+
+    from accelerate_tpu.ops import flash_pallas
+
+    tiny = bool(os.environ.get("ACCELERATE_TPU_BENCH_TINY"))
+    out: dict = {
+        "backend": jax.default_backend(),
+        "device_kind": device_kind(),
+        "interpret_mode": flash_pallas._interpret(),
+        "tiny_smoke": tiny,
+    }
+    assert tiny or not flash_pallas._interpret(), (
+        "quickflash would run interpreted, not compiled"
+    )
+    out.update(_flash_bf16_fwd_parity(tiny))
+    out["ts"] = _now()
+    # Same publish filter as the kernels salvage path (not just the assert,
+    # which python -O strips): only compiled-on-TPU passes become evidence.
+    if (out["ok"] and not tiny and not out["interpret_mode"]
+            and out["backend"] == "tpu"):
+        _save_json(QUICKFLASH, out)
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -145,6 +221,7 @@ def run_kernels() -> dict:
     import jax
     import jax.numpy as jnp
 
+    from accelerate_tpu.utils.platforms import device_kind as _device_kind
     from accelerate_tpu.utils.platforms import enable_compilation_cache
 
     enable_compilation_cache()
@@ -158,6 +235,7 @@ def run_kernels() -> dict:
     tiny = bool(os.environ.get("ACCELERATE_TPU_BENCH_TINY"))
     out: dict = {
         "backend": jax.default_backend(),
+        "device_kind": _device_kind(),
         "interpret_mode": flash_pallas._interpret(),
         "tiny_smoke": tiny,
         "checks": {},
@@ -182,14 +260,13 @@ def run_kernels() -> dict:
     # tunnel (seconds per op); one compile each is far cheaper.
     ref_fwd = jax.jit(lambda q, k, v: _einsum_attention(q, k, v, causal=True))
 
-    # -- forward parity, bf16 (training dtype) --------------------------------
-    q, k, v = qkv(*((1, 128, 1, 64) if tiny else (2, 512, 4, 128)), jnp.bfloat16)
-    t0 = time.perf_counter()
-    got = jax.jit(lambda q, k, v: pallas_flash_attention(q, k, v, causal=True))(q, k, v)
-    jax.device_get(got[0, 0, 0, 0])
-    out["compile_s_fwd"] = round(time.perf_counter() - t0, 2)
-    want = ref_fwd(q, k, v)
-    check("flash_fwd_bf16_causal", got, want, 3e-2)
+    # -- forward parity, bf16 (training dtype): the shared quickflash check ---
+    r = _flash_bf16_fwd_parity(tiny)
+    out["compile_s_fwd"] = r["compile_s"]
+    out["checks"]["flash_fwd_bf16_causal"] = {
+        k: r[k] for k in ("max_rel_err", "tol", "ok")
+    }
+    _save_json(KERNELS_PARTIAL, out)
 
     # -- forward parity, fp32 ------------------------------------------------
     qf, kf, vf = qkv(*((1, 128, 1, 32) if tiny else (1, 256, 2, 64)), jnp.float32, seed=1)
@@ -394,17 +471,21 @@ def run_sweep() -> dict:
 # Parent: subprocess plumbing
 # ---------------------------------------------------------------------------
 
-def _run_child(mode: str, budget: float) -> tuple[dict | None, str | None]:
+def _run_child(
+    mode: str, budget: float, extra_env: dict | None = None
+) -> tuple[dict | None, str | None]:
     """Run a child mode with a group timeout. Returns (result, error)."""
     if mode == "--tpu-run":
         # bench.py owns the tier-1 child protocol (incl. the compile-stage
         # disambiguation marker); reuse its parser instead of duplicating it.
         import bench
 
-        return bench._tpu_subprocess(timeout=budget)
+        env = {**os.environ, **(extra_env or {})} if extra_env else None
+        return bench._tpu_subprocess(timeout=budget, env=env)
     from accelerate_tpu.utils.platforms import run_with_group_timeout
 
     env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    env.update(extra_env or {})
     rc, stdout = run_with_group_timeout(
         [sys.executable, os.path.abspath(__file__), mode], timeout=budget, env=env
     )
@@ -418,6 +499,22 @@ def _run_child(mode: str, budget: float) -> tuple[dict | None, str | None]:
     if rc is None:
         return None, f"killed at {budget:.0f}s budget"
     return None, f"exited rc={rc} without a result"
+
+
+def _kernels_complete(device_kind: str | None = None) -> bool:
+    """Full compiled-on-TPU kernel evidence already captured (not partial,
+    not interpreted, not a tiny smoke, same chip generation)? Then later
+    cycles can skip past the kernel stages and spend the window on better
+    things. The flaky tunnel could in principle reconnect to a different
+    TPU generation, so evidence only counts for the chip it was captured
+    on (``device_kind`` from the cycle's liveness check)."""
+    kern = _load_json(KERNELS)
+    return bool(
+        kern and kern.get("ok") and not kern.get("partial")
+        and kern.get("backend") == "tpu" and not kern.get("interpret_mode")
+        and not kern.get("tiny_smoke")
+        and (device_kind is None or kern.get("device_kind") == device_kind)
+    )
 
 
 def _load_json(path: str) -> dict | None:
@@ -460,9 +557,27 @@ def persist_best_if_better(result: dict) -> bool:
 
 
 def merge_evidence(result: dict) -> dict:
-    """Attach the latest kernel/sweep evidence to a tier-1 result's extra."""
+    """Attach the latest kernel/sweep evidence to a tier-1 result's extra.
+
+    Evidence captured on a different chip generation than the tier-1 result
+    describes (possible in principle: the flaky tunnel could reconnect to
+    different hardware) is not attached — it would claim kernel behavior the
+    benched chip never exhibited. Legacy records without a ``device_kind``
+    are attached as before.
+    """
     extra = result.setdefault("extra", {})
+    chip = extra.get("device_kind")
+
+    def same_chip(ev: dict) -> bool:
+        kind = ev.get("device_kind")
+        return chip is None or kind is None or kind == chip
+
+    qf = _load_json(QUICKFLASH)
+    if qf and same_chip(qf):
+        extra["quick_flash_check"] = qf
     kern = _load_json(KERNELS)
+    if kern and not same_chip(kern):
+        kern = None
     if kern:
         extra["compiled_kernels"] = {
             "ok": kern.get("ok"),
@@ -512,10 +627,37 @@ def run_cycle() -> float:
         return PARTIAL_SLEEP
     _log(f"liveness ok: {live['device_kind']} matmul in {live['first_matmul_s']}s")
 
-    # Tier 1 FIRST: the tunnel has been observed up for windows as short as
+    # Quickflash: the cheapest compiled-Pallas evidence, persisted the
+    # moment it passes. Skipped once the full kernel suite has passed
+    # compiled on-chip. Any non-pass (parity failure OR a kill — a Mosaic
+    # hang would eat tier1's budget the same way) flips tier1 onto the
+    # einsum attention path so the headline MFU number survives a broken
+    # kernel; a kill from a dropped tunnel loses nothing, tier1 was dead
+    # anyway.
+    no_flash = False
+    if not _kernels_complete(live["device_kind"]):
+        qf, err = _run_child("--quickflash-run", QUICKFLASH_BUDGET)
+        _append_history({"event": "quickflash", "ok": bool(qf and qf.get("ok")),
+                         "error": err,
+                         **{k: v for k, v in (qf or {}).items() if k != "ts"}})
+        if qf is not None and qf.get("ok"):
+            _log(f"quickflash ok: rel_err={qf['max_rel_err']}, "
+                 f"compile {qf['compile_s']}s")
+        else:
+            no_flash = True
+            all_ok = False
+            _log(f"quickflash not ok ({err or qf}); tier1 falls back to "
+                 "einsum attention")
+
+    # Tier 1 next: the tunnel has been observed up for windows as short as
     # ~25 min, and the headline MFU number is the single most valuable
     # artifact — don't let a long kernels run eat the window before it.
-    t1, err = _run_child("--tpu-run", TIER1_BUDGET)
+    t1, err = _run_child(
+        "--tpu-run", TIER1_BUDGET,
+        # Always set explicitly: "0" (flash on) must override any stale
+        # NO_FLASH export sitting in the watcher's own environment.
+        extra_env={"ACCELERATE_TPU_BENCH_NO_FLASH": "1" if no_flash else "0"},
+    )
     if t1 is not None:
         t1_extra = t1.get("extra", {})
         _append_history({"event": "tier1", "ok": True, "value": t1.get("value"),
@@ -528,42 +670,47 @@ def run_cycle() -> float:
         _append_history({"event": "tier1", "ok": False, "error": err})
         _log(f"tier1 failed: {err}")
 
-    # Clear the partial checkpoint so a kill can't surface stale evidence.
-    try:
-        os.remove(KERNELS_PARTIAL)
-    except OSError:
-        pass
-    kern, err = _run_child("--kernels-run", KERNELS_BUDGET)
-    if kern is None:
-        # Budget kill: salvage whatever the child checkpointed. Partial
-        # evidence with all-passing checks is still compiled-parity proof.
-        partial = _load_json(KERNELS_PARTIAL)
-        # A concurrent debug/tiny run writes the same checkpoint path; never
-        # publish interpret-mode or non-TPU evidence as compiled-TPU proof.
-        if partial and (partial.get("tiny_smoke") or partial.get("interpret_mode")
-                        or partial.get("backend") != "tpu"):
-            partial = None
-        if partial and partial.get("checks"):
-            partial["partial"] = True
-            partial["ok"] = all(c["ok"] for c in partial["checks"].values())
-            kern = partial
-            err = f"{err} (salvaged {len(partial['checks'])} checks)"
-    if kern is not None and kern.get("ok"):
-        kern["ts"] = _now()
-        _save_json(KERNELS, kern)
-        _log(f"kernels: ok={kern['ok']} timings={kern['timings_ms']}")
+    if _kernels_complete(live["device_kind"]):
+        # Full compiled evidence already on disk from an earlier window —
+        # spend this one on the sweep instead.
+        _log("kernels: complete evidence already captured; skipping")
     else:
-        # A child that ran but failed a parity check is as bad as a dead
-        # child: don't persist failing evidence, retry on the short cadence.
-        all_ok = False
-        _log(f"kernels failed: {err or (kern or {}).get('checks')}")
-    _append_history({"event": "kernels", "ok": kern is not None and kern.get("ok"),
-                     "error": err, **({k: v for k, v in (kern or {}).items() if k != "ts"})})
-    if kern is not None and kern.get("ok"):
-        # Fresh kernel evidence after tier1 already persisted: re-merge.
-        best = _load_json(BEST)
-        if best:
-            _save_json(BEST, merge_evidence(best))
+        # Clear the partial checkpoint so a kill can't surface stale evidence.
+        try:
+            os.remove(KERNELS_PARTIAL)
+        except OSError:
+            pass
+        kern, err = _run_child("--kernels-run", KERNELS_BUDGET)
+        if kern is None:
+            # Budget kill: salvage whatever the child checkpointed. Partial
+            # evidence with all-passing checks is still compiled-parity proof.
+            partial = _load_json(KERNELS_PARTIAL)
+            # A concurrent debug/tiny run writes the same checkpoint path; never
+            # publish interpret-mode or non-TPU evidence as compiled-TPU proof.
+            if partial and (partial.get("tiny_smoke") or partial.get("interpret_mode")
+                            or partial.get("backend") != "tpu"):
+                partial = None
+            if partial and partial.get("checks"):
+                partial["partial"] = True
+                partial["ok"] = all(c["ok"] for c in partial["checks"].values())
+                kern = partial
+                err = f"{err} (salvaged {len(partial['checks'])} checks)"
+        if kern is not None and kern.get("ok"):
+            kern["ts"] = _now()
+            _save_json(KERNELS, kern)
+            _log(f"kernels: ok={kern['ok']} timings={kern['timings_ms']}")
+        else:
+            # A child that ran but failed a parity check is as bad as a dead
+            # child: don't persist failing evidence, retry on the short cadence.
+            all_ok = False
+            _log(f"kernels failed: {err or (kern or {}).get('checks')}")
+        _append_history({"event": "kernels", "ok": kern is not None and kern.get("ok"),
+                         "error": err, **({k: v for k, v in (kern or {}).items() if k != "ts"})})
+        if kern is not None and kern.get("ok"):
+            # Fresh kernel evidence after tier1 already persisted: re-merge.
+            best = _load_json(BEST)
+            if best:
+                _save_json(BEST, merge_evidence(best))
 
     prior_sweep = _load_json(SWEEP)
     # A salvaged partial sweep is better than nothing but must not stop a
@@ -636,6 +783,9 @@ def main() -> int:
         force_cpu_platform()
     if "--liveness-run" in sys.argv:
         _emit(run_liveness())
+        return 0
+    if "--quickflash-run" in sys.argv:
+        _emit(run_quickflash())
         return 0
     if "--kernels-run" in sys.argv:
         _emit(run_kernels())
