@@ -943,7 +943,12 @@ class StreamedModel:
             return ids
 
         B, S = ids.shape
-        slack = (prompt_lookup_num_tokens + 1) if prompt_lookup_num_tokens else 0
+        # Highest position a verification chunk can touch is
+        # S + max_new_tokens + K - 2 (the last chunk starts at
+        # S + max_new_tokens - 2 and spans K + 1), so the needed slack is
+        # K - 1 — keep in lockstep with generation._check_position_bound's
+        # speculative call site.
+        slack = (prompt_lookup_num_tokens - 1) if prompt_lookup_num_tokens else 0
         if self.position_bound is not None and S + max_new_tokens + slack > self.position_bound:
             label = ("prompt + max_new_tokens + speculative slack" if slack
                      else "prompt + max_new_tokens")

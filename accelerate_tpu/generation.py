@@ -377,28 +377,29 @@ def greedy_generate(module, params, input_ids, max_new_tokens: int = 20,
 
 
 def _compiled_lookup_generate(module, max_new_tokens: int, eos_token_id, cache_dtype,
-                              ngram: int, num_draft: int, prompt_len: int,
+                              ngram: int, num_draft: int, buf_len: int,
                               sampling=None):
     """(prefill, speculate_loop) jitted pair for prompt-lookup decoding.
     Keyed per (module config, lengths, eos, dtype, ngram, K) like
-    _compiled_generate; prompt_len is part of the key because the token
-    buffer and position arithmetic are shaped by it. ``sampling`` non-None
-    switches the greedy accept rule to exact speculative sampling
-    (:func:`speculative_accept`)."""
+    _compiled_generate. The prompt length is NOT part of the key: the
+    speculate loop takes it as a traced argument and is shaped only by the
+    bucketed ``buf_len``, so varied prompt lengths share one compiled loop
+    (prefill, like ``generate()``'s, still specializes per prompt shape
+    inside its own jit). ``sampling`` non-None switches the greedy accept
+    rule to exact speculative sampling (:func:`speculative_accept`)."""
     key = _cache_key(module, max_new_tokens, eos_token_id,
                      jnp.dtype(cache_dtype).name, sampling, 1.0,
-                     ("lookup", ngram, num_draft, prompt_len))
+                     ("lookup", ngram, num_draft, buf_len))
     hit = _generate_cache.get(key) if key is not None else None
     if hit is not None:
         return hit
 
     warp = _make_warper(sampling) if sampling is not None else None
     K = num_draft
-    S = prompt_len
     # Buffer slack: a verification chunk may scribble K + 1 tokens past the
     # last committed position; committed entries always overwrite before
     # they are read (or are sliced away at the end).
-    L = S + max_new_tokens + K + 1
+    L = buf_len
     eos = eos_token_id
 
     @jax.jit
@@ -411,9 +412,10 @@ def _compiled_lookup_generate(module, max_new_tokens: int, eos_token_id, cache_d
         return tok.astype(ids.dtype), cache
 
     @jax.jit
-    def speculate(params, buf, cache, rng):
-        """buf: [1, L] with the prompt + first generated token committed
-        (n_gen starts at 1). Returns the completed buf."""
+    def speculate(params, buf, cache, rng, S):
+        """buf: [1, L] with the prompt (length ``S``, traced) + first
+        generated token committed (n_gen starts at 1). Returns the
+        completed buf."""
 
         def cond(state):
             _, n_gen, _, done, _ = state
@@ -545,22 +547,26 @@ def prompt_lookup_generate(
     _check_position_bound(module, S + max_new_tokens + K - 1,
                           label="prompt + max_new_tokens + speculative slack")
     dtype = cache_dtype or jnp.bfloat16
+    # Bucket the buffer/cache length to a 128 multiple so interactive use
+    # with varied prompt lengths shares ONE compiled speculate loop per
+    # bucket instead of recompiling (and filling a generate-cache slot) per
+    # exact length; the prompt length rides in as a traced argument.
+    L = -(-(S + max_new_tokens + K + 1) // 128) * 128
     # ring_slack: rejected overshoot writes must not evict in-window keys
     # from sliding-window layers' ring caches.
-    cache = factory(B, S + max_new_tokens + K + 1, dtype, ring_slack=K + 1)
+    cache = factory(B, L, dtype, ring_slack=K + 1)
 
     sampling = (float(temperature), top_k, top_p) if do_sample else None
     rng = rng if rng is not None else jax.random.PRNGKey(0)
     rng, pre_rng = jax.random.split(rng)
     prefill, speculate = _compiled_lookup_generate(
-        module, max_new_tokens, eos_token_id, dtype, int(ngram), K, S,
+        module, max_new_tokens, eos_token_id, dtype, int(ngram), K, L,
         sampling=sampling)
     first_tok, cache = prefill(params, ids, cache, pre_rng)
-    L = S + max_new_tokens + K + 1
     buf = jnp.zeros((1, L), ids.dtype)
     buf = jax.lax.dynamic_update_slice(buf, ids, (0, 0))
     buf = buf.at[0, S].set(first_tok[0])
-    buf = speculate(params, buf, cache, rng)
+    buf = speculate(params, buf, cache, rng, jnp.asarray(S, jnp.int32))
     return buf[:, : S + max_new_tokens]
 
 

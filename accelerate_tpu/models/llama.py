@@ -663,8 +663,10 @@ class LlamaModel(nn.Module):
         embed = nn.Embed(cfg.vocab_size, cfg.hidden_size, name="embed_tokens", param_dtype=jnp.float32)
         x = embed(input_ids)
         if cfg.scale_embeddings:
-            # Gemma: activations enter the stack scaled by sqrt(hidden); the
-            # scalar is cast to the compute dtype first (HF rounds it to bf16).
+            # Gemma: activations enter the stack scaled by sqrt(hidden). HF
+            # rounds the scalar to the activations' dtype (bf16 under
+            # torch_dtype=bfloat16, fp32 here where embeddings run fp32), so
+            # casting to x.dtype reproduces HF exactly at matching dtypes.
             x = x * jnp.asarray(cfg.hidden_size ** 0.5, x.dtype)
         block_cls = LlamaBlock
         if cfg.remat:

@@ -358,6 +358,26 @@ class TestPromptLookupGenerate:
         with pytest.raises(ValueError, match="batch-1"):
             prompt_lookup_generate(model, params, jnp.zeros((2, 4), jnp.int32))
 
+    def test_prompt_lengths_share_one_speculate_compile(self):
+        """The speculate loop is keyed by the BUCKETED buffer length, not
+        the exact prompt length — interactive use with varied prompts must
+        not thrash the compile cache (one loop per 128-bucket)."""
+        from accelerate_tpu import generation
+        from accelerate_tpu.generation import generate, prompt_lookup_generate
+
+        model, params, cfg = self._model()
+        kw = dict(max_new_tokens=12, cache_dtype=jnp.float32)
+        before = set(generation._generate_cache)
+        for S in (6, 9, 14):  # all bucket to L=128
+            ids = (np.arange(S, dtype=np.int32)[None] * 37 + 5) % cfg.vocab_size
+            ref = np.asarray(generate(model, params, jnp.asarray(ids), **kw))
+            got = np.asarray(prompt_lookup_generate(model, params, jnp.asarray(ids), **kw))
+            np.testing.assert_array_equal(got, ref)
+        new_lookup = [k for k in set(generation._generate_cache) - before
+                      if any(isinstance(p, tuple) and p and p[0] == "lookup"
+                             for p in k if isinstance(p, tuple))]
+        assert len(new_lookup) == 1, new_lookup
+
 
 class TestSpeculativeSampling:
     """do_sample speculation must be DISTRIBUTION-exact (the speculative
