@@ -839,6 +839,8 @@ class PipelinedLlamaForCausalLM:
                 pos, seg = exs
                 return block.apply({"params": p_layer}, h, pos, segment_ids=seg)
 
+        from ..parallel.sharding import resolve_remat_policy
+
         x = pipeline_apply(
             block_fn,
             p["model"]["blocks"],
@@ -846,6 +848,7 @@ class PipelinedLlamaForCausalLM:
             extras=extras,
             num_microbatches=self.num_microbatches,
             remat=cfg.remat,
+            remat_policy=resolve_remat_policy(cfg.remat_policy) if cfg.remat else None,
         )
         x = RMSNorm(cfg.rms_norm_eps, unit_offset=cfg.rms_norm_unit_offset).apply(
             {"params": p["model"]["norm"]}, x)

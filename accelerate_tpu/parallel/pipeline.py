@@ -88,6 +88,7 @@ def pipeline_apply(
     mesh=None,
     num_microbatches: Optional[int] = None,
     remat: bool = False,
+    remat_policy=None,
 ):
     """Run ``x`` through ``L`` stacked layers with GPipe microbatch pipelining.
 
@@ -103,7 +104,13 @@ def pipeline_apply(
       num_microbatches: GPipe microbatch count ``M`` (default: ``pp``; more
         microbatches shrink the bubble at the cost of smaller per-stage
         matmuls). Must divide ``batch``.
-      remat: rematerialize each stage application in the backward pass.
+      remat: rematerialize each *layer* application in the backward pass.
+        The checkpoint wraps the block inside the scan body — one block's
+        residuals live at a time during backward. (Wrapping the whole layer
+        scan instead would save nothing at peak: its backward still
+        materializes every layer's residuals simultaneously.)
+      remat_policy: optional ``jax.checkpoint`` policy for ``remat`` (see
+        ``parallel.sharding.resolve_remat_policy``).
 
     Returns ``[batch, ...]`` activations after layer ``L-1``.
     """
@@ -112,9 +119,11 @@ def pipeline_apply(
     L = num_layers_of(stacked_params)
     extras = extras if extras is not None else ()
 
+    body_fn = jax.checkpoint(block_fn, policy=remat_policy) if remat else block_fn
+
     def _scan_layers(params, h, exs):
         def body(carry, p_layer):
-            return block_fn(p_layer, carry, exs), None
+            return body_fn(p_layer, carry, exs), None
 
         h, _ = jax.lax.scan(body, h, params)
         return h
@@ -122,8 +131,7 @@ def pipeline_apply(
     if pp <= 1:
         # No pipeline axis: plain scan over layers (still the memory-friendly
         # stacked form — one compiled block body for all L layers).
-        fn = jax.checkpoint(_scan_layers) if remat else _scan_layers
-        return fn(stacked_params, x, extras)
+        return _scan_layers(stacked_params, x, extras)
 
     if L % pp != 0:
         raise ValueError(f"num_layers={L} not divisible by pp={pp}")
@@ -164,7 +172,7 @@ def pipeline_apply(
     )
     outputs = constrain(jnp.zeros((M, mb) + x.shape[1:], x.dtype), mb_spec)
 
-    stage_fn = jax.checkpoint(_scan_layers) if remat else _scan_layers
+    stage_fn = _scan_layers
 
     def tick(carry, t):
         state, state_ex, outputs = carry

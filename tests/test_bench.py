@@ -24,6 +24,7 @@ def artifacts(tmp_path, monkeypatch):
     monkeypatch.setattr(bench_watch, "BEST", str(d / "best.json"))
     monkeypatch.setattr(bench_watch, "KERNELS", str(d / "kernels.json"))
     monkeypatch.setattr(bench_watch, "KERNELS_PARTIAL", str(d / "kernels_partial.json"))
+    monkeypatch.setattr(bench_watch, "QUICKFLASH", str(d / "quickflash.json"))
     monkeypatch.setattr(bench_watch, "SWEEP", str(d / "sweep.json"))
     monkeypatch.setattr(bench_watch, "LOG", str(d / "watch.log"))
     return d
@@ -160,15 +161,19 @@ class TestWatcherCycle:
         results = {
             "--liveness-run": {"ok": True, "backend": "tpu", "device_count": 1,
                                "device_kind": "TPU v5e", "first_matmul_s": 1.0},
+            "--quickflash-run": {"ok": True, "backend": "tpu", "device_kind": "TPU v5e",
+                                 "interpret_mode": False, "tiny_smoke": False,
+                                 "max_rel_err": 0.001, "tol": 0.03, "compile_s": 25.0},
             "--kernels-run": {"ok": True, "checks": {}, "timings_ms": {"k": 1.0},
-                              "backend": "tpu", "interpret_mode": False},
+                              "backend": "tpu", "device_kind": "TPU v5e",
+                              "interpret_mode": False},
             "--tpu-run": {"metric": bench.METRIC, "value": 9000.0, "unit": "tokens/s/chip",
                           "vs_baseline": 1.0, "extra": {"mfu": 0.45, "step_ms": 90.0}},
             "--sweep-run": {"ok": True, "rows": [], "best": {"block_q": 512, "block_k": 256},
                             "backend": "tpu"},
         }
         monkeypatch.setattr(bench_watch, "_run_child",
-                            lambda mode, budget: (dict(results[mode]), None))
+                            lambda mode, budget, extra_env=None: (dict(results[mode]), None))
         sleep = bench_watch.run_cycle()
         assert sleep == bench_watch.SUCCESS_SLEEP
         best = bench_watch._load_json(bench_watch.BEST)
@@ -177,15 +182,79 @@ class TestWatcherCycle:
         assert best["extra"]["flash_block_sweep"]["best"]["block_q"] == 512
         events = [json.loads(l) for l in open(bench_watch.HISTORY)]
         kinds = [e["event"] for e in events]
-        # tier1 runs right after liveness: tunnel-up windows can be short
-        # and the MFU number is the headline artifact.
-        assert kinds == ["probe", "liveness", "tier1", "kernels", "sweep"]
+        # quickflash (cheapest compiled-Pallas proof) then tier1 right after:
+        # tunnel-up windows can be short and MFU is the headline artifact.
+        assert kinds == ["probe", "liveness", "quickflash", "tier1", "kernels", "sweep"]
+
+    def test_failed_quickflash_flips_tier1_to_einsum(self, artifacts, monkeypatch):
+        """A quickflash parity failure must not cost the MFU run: tier1 is
+        re-pointed at the einsum attention path via an explicit child env."""
+        self._patch_probe(monkeypatch, {"platform": "tpu", "device_count": 1,
+                                        "devices": ["TPU:0"], "process_count": 1})
+        seen_env = {}
+
+        def child(mode, budget, extra_env=None):
+            if mode == "--liveness-run":
+                return {"ok": True, "backend": "tpu", "device_count": 1,
+                        "device_kind": "TPU v5e", "first_matmul_s": 1.0}, None
+            if mode == "--quickflash-run":
+                return {"ok": False, "backend": "tpu", "device_kind": "TPU v5e",
+                        "interpret_mode": False, "tiny_smoke": False,
+                        "max_rel_err": 0.9, "tol": 0.03, "compile_s": 25.0}, None
+            if mode == "--tpu-run":
+                seen_env.update(extra_env or {})
+                return {"metric": bench.METRIC, "value": 5000.0, "unit": "tokens/s/chip",
+                        "vs_baseline": 0.5, "extra": {"mfu": 0.2, "step_ms": 90.0}}, None
+            return None, "killed"
+
+        monkeypatch.setattr(bench_watch, "_run_child", child)
+        bench_watch.run_cycle()
+        assert seen_env.get("ACCELERATE_TPU_BENCH_NO_FLASH") == "1"
+        assert bench_watch._load_json(bench_watch.BEST)["value"] == 5000.0
+
+    def test_complete_kernels_skip_quickflash_and_kernels(self, artifacts, monkeypatch):
+        """Full same-chip compiled kernel evidence short-circuits both kernel
+        stages; a different chip generation re-runs them."""
+        self._patch_probe(monkeypatch, {"platform": "tpu", "device_count": 1,
+                                        "devices": ["TPU:0"], "process_count": 1})
+        bench_watch._save_json(bench_watch.KERNELS, {
+            "ok": True, "checks": {"x": {"ok": True}}, "timings_ms": {},
+            "backend": "tpu", "device_kind": "TPU v5e", "interpret_mode": False,
+            "tiny_smoke": False, "ts": "t"})
+        bench_watch._save_json(bench_watch.SWEEP, {"ok": True, "rows": [],
+                                                   "best": {}, "ts": "t"})
+        calls = []
+
+        def child(mode, budget, extra_env=None):
+            calls.append(mode)
+            if mode == "--liveness-run":
+                return {"ok": True, "backend": "tpu", "device_count": 1,
+                        "device_kind": "TPU v5e", "first_matmul_s": 1.0}, None
+            return {"metric": bench.METRIC, "value": 1.0, "unit": "tokens/s/chip",
+                    "vs_baseline": 0.0, "extra": {"mfu": 0.01}}, None
+
+        monkeypatch.setattr(bench_watch, "_run_child", child)
+        bench_watch.run_cycle()
+        assert calls == ["--liveness-run", "--tpu-run"]
+        # Same evidence, different chip: both kernel stages run again.
+        calls.clear()
+
+        def child2(mode, budget, extra_env=None):
+            calls.append(mode)
+            if mode == "--liveness-run":
+                return {"ok": True, "backend": "tpu", "device_count": 1,
+                        "device_kind": "TPU v4", "first_matmul_s": 1.0}, None
+            return None, "killed"
+
+        monkeypatch.setattr(bench_watch, "_run_child", child2)
+        bench_watch.run_cycle()
+        assert "--quickflash-run" in calls and "--kernels-run" in calls
 
     def test_tier_failure_retries_sooner(self, artifacts, monkeypatch):
         self._patch_probe(monkeypatch, {"platform": "tpu", "device_count": 1,
                                         "devices": ["TPU:0"], "process_count": 1})
 
-        def child(mode, budget):
+        def child(mode, budget, extra_env=None):
             if mode == "--liveness-run":
                 return {"ok": True, "backend": "tpu", "device_count": 1,
                         "device_kind": "TPU v5e", "first_matmul_s": 1.0}, None
