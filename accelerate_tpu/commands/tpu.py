@@ -10,6 +10,7 @@ it (the reference's behavior), which is also what the tests assert on.
 from __future__ import annotations
 
 import argparse
+import shlex
 import subprocess
 import sys
 
@@ -39,6 +40,13 @@ def tpu_command_parser(subparsers=None):
                                "(a version pin, wheel path, or VCS URL)")
     pod_args.add_argument("--use_alpha", action="store_true",
                           help="Use `gcloud alpha` instead of `gcloud`")
+    pod_args.add_argument("--use_sudo", action="store_true",
+                          help="Run the remote commands under sudo "
+                               "(reference: launch --tpu_use_sudo)")
+    pod_args.add_argument("--env", action="append", default=None,
+                          metavar="KEY=VALUE",
+                          help="Environment variable to export before the remote "
+                               "commands; repeatable (reference: launch --env)")
     pod_args.add_argument("--debug", action="store_true",
                           help="Print the gcloud command instead of running it")
     if subparsers is not None:
@@ -67,7 +75,16 @@ def tpu_command_launcher(args) -> int:
               "(or --install_accelerate)", file=sys.stderr)
         return 2
 
-    remote = "; ".join(commands)
+    if args.use_sudo:
+        commands = [f"sudo {c}" for c in commands]
+    exports = []
+    for kv in args.env or []:
+        if "=" not in kv:
+            print(f"--env expects KEY=VALUE, got {kv!r}", file=sys.stderr)
+            return 2
+        key, _, val = kv.partition("=")
+        exports.append(f"export {key}={shlex.quote(val)}")
+    remote = "; ".join(exports + commands)
     cmd = [
         "gcloud", *(["alpha"] if args.use_alpha else []),
         "compute", "tpus", "tpu-vm", "ssh", tpu_name,

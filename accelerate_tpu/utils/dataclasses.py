@@ -662,9 +662,54 @@ class MegatronLMPlugin(KwargsHandler):
         return tp, pp, fsdp
 
 
-def add_model_config_to_megatron_parser(*args, **kwargs):  # pragma: no cover - parity stub
-    """Reference has model-config→megatron-arg parsers (utils/dataclasses.py:1939-2068).
+def add_model_config_to_megatron_parser(model_config, plugin: Optional[MegatronLMPlugin] = None):
+    """Stamp a model config's dimensions into a Megatron-style arg dict.
 
-    Not needed: model configs talk to sharding rules directly.
+    The reference builds megatron argparse args from ``model.config`` per
+    family (reference: utils/dataclasses.py:1939-2068 — gpt/bert/t5 each
+    copy layers/hidden/heads/positions/vocab into megatron names). Here the
+    mesh translation consumes the same dimensions, so this returns them
+    under the reference's arg names and validates them against the
+    plugin's degrees — the checks Megatron would raise at engine setup
+    (hidden/heads divisible by tp, layers by pp) fail here, before any
+    compilation.
+
+    Args:
+      model_config: an HF-style config object or plain dict (``hidden_size``
+        / ``n_embd``, ``num_hidden_layers`` / ``n_layer``, ...).
+      plugin: degrees to validate against (default: an unsharded plan).
+
+    Returns ``(plugin, megatron_args dict)``.
     """
-    raise NotImplementedError("Megatron arg parsing is replaced by sharding rules; see parallel/sharding.py")
+    plugin = plugin or MegatronLMPlugin()
+    get = (model_config.get if isinstance(model_config, dict)
+           else lambda k, d=None: getattr(model_config, k, d))
+
+    def first(*names, required=True):
+        for n in names:
+            v = get(n)
+            if v is not None:
+                return v
+        if required:
+            raise ValueError(f"model config provides none of {names}")
+        return None
+
+    args = {
+        "num_layers": int(first("num_hidden_layers", "n_layer", "num_layers")),
+        "hidden_size": int(first("hidden_size", "n_embd", "d_model")),
+        "num_attention_heads": int(first("num_attention_heads", "n_head", "num_heads")),
+        "max_position_embeddings": int(first(
+            "max_position_embeddings", "n_positions", required=False) or 0) or None,
+        "orig_vocab_size": int(first("vocab_size")),
+    }
+    if args["hidden_size"] % plugin.tp_degree:
+        raise ValueError(
+            f"hidden_size {args['hidden_size']} not divisible by tp_degree {plugin.tp_degree}")
+    if args["num_attention_heads"] % plugin.tp_degree:
+        raise ValueError(
+            f"num_attention_heads {args['num_attention_heads']} not divisible by "
+            f"tp_degree {plugin.tp_degree}")
+    if args["num_layers"] % plugin.pp_degree:
+        raise ValueError(
+            f"num_layers {args['num_layers']} not divisible by pp_degree {plugin.pp_degree}")
+    return plugin, args

@@ -980,14 +980,17 @@ def convert_hf_state_dict(
 
 
 def export_hf_state_dict(params: dict, family: str, *, prefix: str = "",
-                         config=None) -> dict:
+                         config=None, dtype=None) -> dict:
     """Our param pytree -> flat HF-named state dict (numpy, torch layouts).
 
     Inverse of :func:`convert_hf_state_dict`; raises on any param with no
     rule so checkpoints cannot silently lose weights. ``prefix`` lets callers
     re-add a wrapper scope (e.g. ``"transformer."`` for GPT-2). ``config``
     is required for families whose export is shape-ambiguous (vit: the conv
-    kernel's (channels, patch, patch) factorization)."""
+    kernel's (channels, patch, patch) factorization). ``dtype`` downcasts
+    every floating tensor at export time (the reference's
+    ``zero3_save_16bit_model`` capability: train in full precision, publish
+    bf16/fp16 weights)."""
     if family not in _COMPILED:
         raise ValueError(f"unsupported family {family!r}; supported: {sorted(_COMPILED)}")
     rules = _COMPILED[family]
@@ -1026,6 +1029,14 @@ def export_hf_state_dict(params: dict, family: str, *, prefix: str = "",
                 break
         else:
             raise KeyError(f"no export rule for param {key!r} ({family})")
+    if dtype is not None:
+        dt = np.dtype(dtype)  # accepts "bfloat16" via ml_dtypes
+
+        def is_float(v):
+            return (np.issubdtype(v.dtype, np.floating)
+                    or v.dtype.name == "bfloat16")
+
+        out = {k: (v.astype(dt) if is_float(v) else v) for k, v in out.items()}
     return out
 
 

@@ -148,6 +148,19 @@ class TestCLISubprocess:
         assert "pip install" in out.stdout and "echo hi" in out.stdout
         assert "--worker all" in out.stdout
 
+    def test_tpu_config_sudo_and_env(self):
+        """launch --tpu_use_sudo / --env parity: sudo prefixes every remote
+        command, --env exports land before them (reference:
+        commands/launch.py --tpu_use_sudo/--env)."""
+        out = _run_cli("tpu-config", "--tpu_name", "pod1",
+                       "--command", "echo hi", "--use_sudo",
+                       "--env", "FOO=bar baz", "--env", "N=1", "--debug")
+        assert out.returncode == 0, out.stderr
+        assert "export FOO='bar baz'; export N=1; sudo echo hi" in out.stdout
+        out = _run_cli("tpu-config", "--tpu_name", "pod1",
+                       "--command", "echo hi", "--env", "MALFORMED")
+        assert out.returncode == 2
+
     def test_tpu_config_requires_name_and_commands(self, tmp_path):
         # Isolate the config dir: a developer's real default config could
         # name a live pod, and this test must never reach gcloud.

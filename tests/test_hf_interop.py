@@ -45,6 +45,16 @@ def _roundtrip(params, family, hf_sd, prefix=""):
     assert set(flat) == set(flat_back)
     for key in flat:
         np.testing.assert_array_equal(flat[key], flat_back[key], err_msg=key)
+    # dtype= publishes downcast weights (zero3_save_16bit_model parity):
+    # every float tensor converts, nothing else changes.
+    half = export_hf_state_dict(params, family, prefix=prefix, dtype="bfloat16")
+    assert set(half) == set(exported)
+    for key, v in half.items():
+        full = np.asarray(exported[key])
+        if np.issubdtype(full.dtype, np.floating) or full.dtype.name == "bfloat16":
+            assert np.asarray(v).dtype.name == "bfloat16", key
+        else:
+            assert np.asarray(v).dtype == full.dtype, key
 
 
 class TestLlama:

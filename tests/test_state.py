@@ -185,6 +185,33 @@ def test_megatron_plugin_translation():
     assert state.mesh.shape["tp"] == 2 and state.mesh.shape["pp"] == 2 and state.mesh.shape["dp"] == 2
 
 
+def test_megatron_model_config_args():
+    """Config dims translate into megatron arg names and are validated
+    against the plugin's degrees BEFORE any compile (the checks Megatron
+    raises at engine setup; reference: utils/dataclasses.py:1939-2068)."""
+    import pytest
+
+    from accelerate_tpu.utils import MegatronLMPlugin
+    from accelerate_tpu.utils.dataclasses import add_model_config_to_megatron_parser
+
+    cfg = {"num_hidden_layers": 4, "hidden_size": 64, "num_attention_heads": 8,
+           "max_position_embeddings": 128, "vocab_size": 1000}
+    plugin, args = add_model_config_to_megatron_parser(cfg, MegatronLMPlugin(tp_degree=2, pp_degree=2))
+    assert args == {"num_layers": 4, "hidden_size": 64, "num_attention_heads": 8,
+                    "max_position_embeddings": 128, "orig_vocab_size": 1000}
+    # gpt2-style aliases resolve too
+    class C:  # noqa: D401 - attr-style config
+        n_layer, n_embd, n_head, n_positions, vocab_size = 2, 32, 4, 64, 50257
+    _, args = add_model_config_to_megatron_parser(C())
+    assert args["num_layers"] == 2 and args["hidden_size"] == 32
+    with pytest.raises(ValueError, match="not divisible by tp_degree"):
+        add_model_config_to_megatron_parser(cfg, MegatronLMPlugin(tp_degree=3))
+    with pytest.raises(ValueError, match="not divisible by pp_degree"):
+        add_model_config_to_megatron_parser(cfg, MegatronLMPlugin(pp_degree=3))
+    with pytest.raises(ValueError, match="provides none of"):
+        add_model_config_to_megatron_parser({"vocab_size": 10})
+
+
 def test_main_process_first():
     s = PartialState()
     order = []
