@@ -29,9 +29,98 @@ def default_config_file() -> Path:
     return default_config_dir / "default_config.yaml"
 
 
+#: Keys that only a HuggingFace Accelerate (reference-schema) config file
+#: would contain — their presence routes the file through
+#: :func:`migrate_reference_config` so `accelerate-tpu config update` can
+#: upgrade a migrating user's existing config in place.
+_REFERENCE_MARKERS = frozenset({
+    "distributed_type", "use_cpu", "downcast_bf16", "deepspeed_config",
+    "fsdp_config", "megatron_lm_config", "dynamo_config", "fp8_config",
+    "gpu_ids", "tpu_use_cluster", "main_training_function", "fp16",
+})
+
+
+def migrate_reference_config(data: dict) -> tuple[dict, dict, list[str]]:
+    """Translate a reference-schema config dict into this schema.
+
+    Covers every schema generation the reference pins in its fixtures
+    (reference: tests/test_configs/*.yaml — from the 0.11 era's ``fp16:``
+    key through 0.34's ``fp8_config``). Returns ``(ours, dropped, notes)``:
+    translated known keys, untranslatable keys with their values, and
+    human-readable notes explaining non-obvious translations (printed by
+    ``config update``).
+
+    SageMaker configs are rejected outright — that compute environment is a
+    recorded non-goal (docs/migrating_from_accelerate.md).
+    """
+    ours: dict = {}
+    dropped: dict = {}
+    notes: list[str] = []
+    if str(data.get("compute_environment", "LOCAL_MACHINE")) == "AMAZON_SAGEMAKER":
+        raise ValueError(
+            "SageMaker configs are not supported: the SageMaker compute "
+            "environment is a recorded non-goal (see "
+            "docs/migrating_from_accelerate.md, launch flag parity)")
+    dist = str(data.get("distributed_type", "NO"))
+    copied = ("mixed_precision", "num_machines", "machine_rank",
+              "main_process_ip", "main_process_port", "debug")
+    for key in copied:
+        if data.get(key) is not None:
+            ours[key] = data[key]
+    if "fp16" in data:  # pre-0.12 schema: fp16: true|false
+        ours["mixed_precision"] = "fp16" if data["fp16"] else "no"
+        notes.append("legacy 'fp16' key -> mixed_precision")
+    if str(ours.get("mixed_precision", "no")) == "fp8":
+        ours["mixed_precision"] = "bf16"
+        notes.append(
+            "mixed_precision fp8 -> bf16 autocast; enable fp8 matmuls via "
+            "the model config's use_fp8 / FP8RecipeKwargs")
+    if data.get("use_cpu"):
+        ours["use_cpu_emulation"] = True
+        notes.append("use_cpu -> use_cpu_emulation (virtual CPU devices)")
+    if int(data.get("num_machines") or 1) > 1 and dist in ("TPU", "XLA"):
+        ours["compute_environment"] = "TPU_POD"
+    mega = data.get("megatron_lm_config") or {}
+    if mega:
+        tp = mega.get("megatron_lm_tp_degree", mega.get("tp_degree"))
+        pp = mega.get("megatron_lm_pp_degree", mega.get("pp_degree"))
+        if tp:
+            ours["mesh_tp"] = int(tp)
+        if pp:
+            ours["mesh_pp"] = int(pp)
+        notes.append("megatron_lm tp/pp degrees -> mesh_tp/mesh_pp; the "
+                     "remaining knobs are MegatronLMPlugin arguments")
+    ds = data.get("deepspeed_config") or {}
+    fsdp = data.get("fsdp_config") or {}
+    if fsdp or dist == "FSDP" or int(ds.get("zero_stage") or 0) >= 1:
+        ours["mesh_fsdp"] = -1
+        ours["mesh_dp"] = 1
+        notes.append(
+            "FSDP/ZeRO sharding -> the fsdp mesh axis fills all chips "
+            "(mesh_fsdp: -1); offload/activation-checkpointing knobs live "
+            "on FullyShardedDataParallelPlugin / DeepSpeedPlugin in code")
+    if data.get("num_processes") is not None:
+        notes.append(
+            "num_processes dropped: JAX runs one process per host — the "
+            "mesh covers all local chips (use --emulated_device_count for "
+            "CPU testing)")
+    handled = set(copied) | {
+        "fp16", "use_cpu", "compute_environment", "distributed_type",
+        "megatron_lm_config", "deepspeed_config", "fsdp_config",
+    }
+    for key, val in data.items():
+        if key not in handled:
+            dropped[key] = val
+    return ours, dropped, notes
+
+
 def load_config_from_file(config_file: Optional[str] = None) -> "ClusterConfig":
     """Load YAML/JSON config; returns defaults if no file exists (reference:
-    load_config_from_file, config_args.py:48)."""
+    load_config_from_file, config_args.py:48). Reference-schema files (a
+    migrating user's existing HF Accelerate config, any generation) are
+    translated via :func:`migrate_reference_config`; the translation notes
+    land on ``cfg.migration_notes`` and untranslated keys in ``cfg.extra``
+    (reported and dropped by ``config update``)."""
     path = Path(config_file) if config_file else default_config_file()
     if not path.exists():
         if config_file:
@@ -40,10 +129,15 @@ def load_config_from_file(config_file: Optional[str] = None) -> "ClusterConfig":
     text = path.read_text()
     data = json.loads(text) if path.suffix == ".json" else yaml.safe_load(text)
     data = data or {}
+    notes: list[str] = []
+    if _REFERENCE_MARKERS & set(data):
+        data, dropped, notes = migrate_reference_config(data)
+        data = {**data, **dropped}
     known = {f.name for f in dataclasses.fields(ClusterConfig)}
     extra = {k: v for k, v in data.items() if k not in known}
     cfg = ClusterConfig(**{k: v for k, v in data.items() if k in known})
     cfg.extra = extra
+    cfg.migration_notes = notes
     return cfg
 
 
@@ -81,6 +175,10 @@ class ClusterConfig:
     emulated_device_count: int = 8
 
     extra: dict = field(default_factory=dict, repr=False)
+
+    # Translation notes from migrate_reference_config (not a dataclass
+    # field: never serialized, defaults to empty for directly-built configs).
+    migration_notes = ()
 
     def to_dict(self) -> dict:
         d = dataclasses.asdict(self)

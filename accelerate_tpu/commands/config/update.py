@@ -24,6 +24,8 @@ def update_config(args) -> str:
     elif not Path(config_file).exists():
         raise FileNotFoundError(f"The config file {config_file} doesn't exist.")
     cfg = load_config_from_file(config_file)
+    for note in cfg.migration_notes:
+        print(f"note: {note}")
     if cfg.extra:
         print(f"Dropping unknown keys: {sorted(cfg.extra)}")
         cfg.extra = {}

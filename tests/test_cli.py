@@ -89,6 +89,74 @@ def _run_cli(*argv, env_extra=None, cwd=None):
         cwd=cwd or os.path.dirname(os.path.dirname(__file__)))
 
 
+FIXTURES = os.path.join(os.path.dirname(__file__), "test_configs")
+
+
+class TestConfigBackcompat:
+    """Pinned old-schema config files must load and upgrade forever
+    (reference pins its generations the same way: tests/test_configs/)."""
+
+    def _upgrade(self, tmp_path, name):
+        import shutil
+
+        path = tmp_path / name
+        shutil.copy(os.path.join(FIXTURES, name), path)
+        out = _run_cli("config", "update", "--config_file", str(path))
+        assert out.returncode == 0, out.stderr
+        return out, load_config_from_file(str(path))
+
+    def test_hf_legacy_fp16_schema(self, tmp_path):
+        cfg = load_config_from_file(os.path.join(FIXTURES, "hf_0_11_legacy.yaml"))
+        assert cfg.mixed_precision == "fp16"  # pre-0.12 'fp16: true' key
+        assert any("fp16" in n for n in cfg.migration_notes)
+        out, upgraded = self._upgrade(tmp_path, "hf_0_11_legacy.yaml")
+        assert upgraded.mixed_precision == "fp16"
+        assert upgraded.extra == {}  # rewritten in the current schema
+        assert not upgraded.migration_notes  # no longer a reference file
+
+    def test_hf_fsdp_multinode_schema(self, tmp_path):
+        cfg = load_config_from_file(os.path.join(FIXTURES, "hf_0_34_fsdp.yaml"))
+        assert cfg.mesh_fsdp == -1 and cfg.mesh_dp == 1  # FSDP -> fsdp axis
+        assert cfg.num_machines == 2 and cfg.machine_rank == 1
+        assert cfg.main_process_ip == "10.0.0.7" and cfg.main_process_port == 29500
+        assert cfg.mixed_precision == "bf16" and cfg.debug is True
+        assert "rdzv_backend" in cfg.extra  # untranslatable, kept for report
+        out, upgraded = self._upgrade(tmp_path, "hf_0_34_fsdp.yaml")
+        assert "Dropping unknown keys" in out.stdout
+        assert upgraded.mesh_fsdp == -1 and upgraded.num_machines == 2
+        assert upgraded.extra == {}
+
+    def test_hf_fp8_dynamo_schema(self, tmp_path):
+        cfg = load_config_from_file(os.path.join(FIXTURES, "hf_0_34_fp8.yaml"))
+        assert cfg.mixed_precision == "bf16"  # fp8 -> bf16 autocast
+        assert any("fp8" in n for n in cfg.migration_notes)
+        out, upgraded = self._upgrade(tmp_path, "hf_0_34_fp8.yaml")
+        assert "note:" in out.stdout
+        assert upgraded.mixed_precision == "bf16"
+
+    def test_own_minimal_v1_schema(self, tmp_path):
+        cfg = load_config_from_file(os.path.join(FIXTURES, "v1_minimal.yaml"))
+        assert cfg.mesh_fsdp == 2 and cfg.mixed_precision == "bf16"
+        assert cfg.mesh_cp == 1 and cfg.mesh_ep == 1  # later fields default
+        out, upgraded = self._upgrade(tmp_path, "v1_minimal.yaml")
+        assert upgraded.mesh_fsdp == 2
+
+    def test_invalid_keys_reported_and_dropped(self, tmp_path):
+        cfg = load_config_from_file(os.path.join(FIXTURES, "invalid_keys.yaml"))
+        assert set(cfg.extra) == {"another_invalid_key", "invalid_key"}
+        out, upgraded = self._upgrade(tmp_path, "invalid_keys.yaml")
+        assert "another_invalid_key" in out.stdout and "invalid_key" in out.stdout
+        assert upgraded.extra == {} and upgraded.mesh_tp == 2
+
+    def test_sagemaker_config_rejected(self, tmp_path):
+        p = tmp_path / "sm.yaml"
+        p.write_text(yaml.safe_dump({
+            "compute_environment": "AMAZON_SAGEMAKER", "distributed_type": "NO",
+            "ec2_instance_type": "ml.p3.2xlarge"}))
+        with pytest.raises(ValueError, match="SageMaker"):
+            load_config_from_file(str(p))
+
+
 class TestCLISubprocess:
     def test_help_lists_all_subcommands(self):
         out = _run_cli("--help")
