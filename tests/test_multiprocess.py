@@ -65,6 +65,76 @@ class TestMultiProcessLaunch:
         assert "gather_for_metrics over composed mesh ok" in res.stdout
 
 
+class TestMultiHostShape:
+    """2 hosts x 4 devices — the pod-launcher shape (one process per HOST,
+    several local devices), vs the other lane's one-device-per-process
+    worlds (VERDICT r3 item 8)."""
+
+    def test_two_machines_four_devices_each(self):
+        """Two concurrent `launch --num_machines 2 --machine_rank R` runs —
+        exactly how two pod hosts start — must rendezvous into one world
+        and pass the topology/global-array/reduction checks."""
+        import socket
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        import threading
+
+        results = {}
+
+        def host(rank):
+            results[rank] = _launch([
+                "--num_machines", "2", "--machine_rank", str(rank),
+                "--main_process_ip", "127.0.0.1", "--main_process_port", str(port),
+                "--use_cpu_emulation", "--emulated_device_count", "4",
+                "--module", "accelerate_tpu.test_utils.scripts.test_pod_shape",
+            ], env_extra={"ATPU_TEST_EXPECT_RANK": str(rank)})
+
+        threads = [threading.Thread(target=host, args=(r,)) for r in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for rank, res in results.items():
+            assert res.returncode == 0, (
+                f"rank {rank}: " + res.stdout[-3000:] + res.stderr[-3000:])
+            assert "All pod-shape checks passed" in res.stdout
+        assert "make_array_from_process_local_data ok" in results[0].stdout
+
+    def test_notebook_launcher_multihost(self):
+        """The same world assembled by notebook_launcher(num_nodes=2) — the
+        multi-host notebook coordinator plumbing (launchers.py)."""
+        import socket
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        import re
+
+        env = {**os.environ}
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        # The pytest conftest pins 8 virtual devices; the child wants 4 per
+        # host and the device-count flag is raise-only, so scrub it here.
+        env["XLA_FLAGS"] = re.sub(
+            r"--xla_force_host_platform_device_count=\d+", "",
+            env.get("XLA_FLAGS", "")).strip()
+        env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+        env["ATPU_TEST_NB_PORT"] = str(port)
+        procs = []
+        for rank in range(2):
+            e = {**env, "ATPU_TEST_NB_RANK": str(rank)}
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m",
+                 "accelerate_tpu.test_utils.scripts.test_pod_shape", "--notebook"],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+                cwd=str(REPO), env=e))
+        outs = [p.communicate(timeout=600) for p in procs]
+        for rank, (p, (out, err)) in enumerate(zip(procs, outs)):
+            assert p.returncode == 0, f"rank {rank}: {out[-3000:]}{err[-3000:]}"
+            assert "All pod-shape checks passed" in out
+
+
 class TestReshardCheckpoint:
     def test_save_2_processes_restore_4(self, tmp_path):
         """Elastic resume: checkpoint written by a 2-process fsdp=4 world
