@@ -55,6 +55,7 @@ ARTIFACT_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "bench_a
 HISTORY = os.path.join(ARTIFACT_DIR, "history.jsonl")
 BEST = os.path.join(ARTIFACT_DIR, "best.json")
 QUICKFLASH = os.path.join(ARTIFACT_DIR, "quickflash.json")
+BIGMODEL = os.path.join(ARTIFACT_DIR, "bigmodel.json")
 KERNELS = os.path.join(ARTIFACT_DIR, "kernels.json")
 KERNELS_PARTIAL = os.path.join(ARTIFACT_DIR, "kernels_partial.json")
 SWEEP = os.path.join(ARTIFACT_DIR, "sweep.json")
@@ -67,6 +68,7 @@ QUICKFLASH_BUDGET = 180.0  # backend init + 2 Mosaic/XLA compiles at ~25 s each
 KERNELS_BUDGET = 1500.0  # ~11 Mosaic compiles at ~25 s each over the tunnel
 TIER1_BUDGET = 900.0   # headroom over bench.py's own 480 s default
 SWEEP_BUDGET = 900.0
+BIGMODEL_BUDGET = 600.0  # per (size, tier) child: load + ~4-7 tunnel compiles
 DOWN_SLEEP = 240.0      # tunnel down: re-probe every ~5.5 min incl. probe
                         # (observed to flicker: probes can succeed minutes
                         # after a timeout, so a tight cadence catches windows)
@@ -468,6 +470,72 @@ def run_sweep() -> dict:
 
 
 # ---------------------------------------------------------------------------
+# Child: streamed big-model inference rows (the reference's benchmark format)
+# ---------------------------------------------------------------------------
+
+#: Ascending-cost (size, tier) rows for the streamed-inference benchmark —
+#: the reference's own headline table is measured load-time + s/token rows
+#: (reference: benchmarks/big_model_inference/README.md:26-37).
+BIGMODEL_ROWS = (("tiny", "device"), ("small", "device"), ("small", "cpu"))
+
+
+def run_bigmodel_row(size: str, tier: str, budget: float = BIGMODEL_BUDGET
+                     ) -> tuple[dict | None, str | None]:
+    """One (size, tier) row of benchmarks/big_model_inference.py on the live
+    backend, in its own budgeted child. Returns (row json, error)."""
+    from accelerate_tpu.utils.platforms import run_with_group_timeout
+
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "benchmarks", "big_model_inference.py")
+    env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    rc, stdout = run_with_group_timeout(
+        [sys.executable, script, "--size", size, "--tiers", tier,
+         "--tokens", "8", "--prompt-len", "64"],
+        timeout=budget, env=env,
+    )
+    for line in reversed(stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line), None
+            except ValueError:
+                continue
+    if rc is None:
+        return None, f"killed at {budget:.0f}s budget"
+    return None, f"exited rc={rc} without a result line"
+
+
+def run_bigmodel_stage(device_kind: str) -> None:
+    """Run any not-yet-captured BIGMODEL_ROWS, cheapest first, persisting
+    after every row (a window can close at any moment)."""
+    big = _load_json(BIGMODEL) or {}
+    if big.get("device_kind") != device_kind:
+        big = {"device_kind": device_kind, "rows": {}}
+    for size, tier in BIGMODEL_ROWS:
+        key = f"{size}/{tier}"
+        if key in big["rows"]:
+            continue
+        res, err = run_bigmodel_row(size, tier)
+        if res is not None and res.get("platform") in (None, "cpu"):
+            res, err = None, f"ran on {res.get('platform')}, not the live backend"
+        ok = res is not None and res.get("tiers")
+        _append_history({"event": "bigmodel", "ok": bool(ok), "row": key,
+                         "error": err,
+                         **({"result": res["tiers"][0]} if ok else {})})
+        if not ok:
+            _log(f"bigmodel {key} failed: {err}; stopping the stage")
+            return  # tunnel likely degraded — later rows cost more
+        big["rows"][key] = {**res["tiers"][0], "family": res.get("family"),
+                            "platform": res.get("platform"), "captured_at": _now()}
+        _save_json(BIGMODEL, big)
+        _log(f"bigmodel {key}: load={res['tiers'][0].get('load_s')}s "
+             f"kv={res['tiers'][0].get('kv_s_per_token')}s/token")
+        best = _load_json(BEST)
+        if best:
+            _save_json(BEST, merge_evidence(best))
+
+
+# ---------------------------------------------------------------------------
 # Parent: subprocess plumbing
 # ---------------------------------------------------------------------------
 
@@ -594,6 +662,9 @@ def merge_evidence(result: dict) -> dict:
             "rows": sweep.get("rows"),
             "captured_at": sweep.get("ts"),
         }
+    big = _load_json(BIGMODEL)
+    if big and big.get("rows") and same_chip(big):
+        extra["big_model_inference"] = big
     return result
 
 
@@ -740,6 +811,11 @@ def run_cycle() -> float:
             _log(f"sweep failed: {err or (sw or {}).get('rows')}")
         _append_history({"event": "sweep", "ok": sw is not None and sw.get("ok"),
                          "error": err, "best": (sw or {}).get("best")})
+
+    # Streamed big-model rows (the reference's own benchmark format) last:
+    # the most expensive evidence, only worth starting on a healthy window.
+    if all_ok:
+        run_bigmodel_stage(live["device_kind"])
 
     sleep = SUCCESS_SLEEP if all_ok else PARTIAL_SLEEP
     _log(f"cycle done (all_ok={all_ok}); sleeping {sleep:.0f}s")

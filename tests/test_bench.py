@@ -25,6 +25,7 @@ def artifacts(tmp_path, monkeypatch):
     monkeypatch.setattr(bench_watch, "KERNELS", str(d / "kernels.json"))
     monkeypatch.setattr(bench_watch, "KERNELS_PARTIAL", str(d / "kernels_partial.json"))
     monkeypatch.setattr(bench_watch, "QUICKFLASH", str(d / "quickflash.json"))
+    monkeypatch.setattr(bench_watch, "BIGMODEL", str(d / "bigmodel.json"))
     monkeypatch.setattr(bench_watch, "SWEEP", str(d / "sweep.json"))
     monkeypatch.setattr(bench_watch, "LOG", str(d / "watch.log"))
     return d
@@ -174,17 +175,63 @@ class TestWatcherCycle:
         }
         monkeypatch.setattr(bench_watch, "_run_child",
                             lambda mode, budget, extra_env=None: (dict(results[mode]), None))
+        big_calls = []
+
+        def fake_row(size, tier, budget=0):
+            big_calls.append((size, tier))
+            return {"metric": "big_model_kv_decode_s_per_token", "size": size,
+                    "family": "llama", "platform": "tpu",
+                    "tiers": [{"tier": tier, "load_s": 1.0,
+                               "kv_s_per_token": 0.01}]}, None
+
+        monkeypatch.setattr(bench_watch, "run_bigmodel_row", fake_row)
         sleep = bench_watch.run_cycle()
         assert sleep == bench_watch.SUCCESS_SLEEP
         best = bench_watch._load_json(bench_watch.BEST)
         assert best["value"] == 9000.0
         assert best["extra"]["compiled_kernels"]["ok"] is True
         assert best["extra"]["flash_block_sweep"]["best"]["block_q"] == 512
+        # Healthy cycle: every ascending-cost big-model row ran and the
+        # evidence merged onto the best artifact.
+        assert big_calls == list(bench_watch.BIGMODEL_ROWS)
+        assert best["extra"]["big_model_inference"]["rows"]["small/cpu"][
+            "kv_s_per_token"] == 0.01
         events = [json.loads(l) for l in open(bench_watch.HISTORY)]
         kinds = [e["event"] for e in events]
         # quickflash (cheapest compiled-Pallas proof) then tier1 right after:
         # tunnel-up windows can be short and MFU is the headline artifact.
-        assert kinds == ["probe", "liveness", "quickflash", "tier1", "kernels", "sweep"]
+        assert kinds == ["probe", "liveness", "quickflash", "tier1", "kernels",
+                         "sweep", "bigmodel", "bigmodel", "bigmodel"]
+        # Second cycle: rows already captured for this chip — none re-run.
+        big_calls.clear()
+        bench_watch.run_cycle()
+        assert big_calls == []
+
+    def test_bigmodel_stage_stops_on_failure_and_skips_cpu_result(self, artifacts, monkeypatch):
+        """A row that dies (or silently ran on CPU fallback) stops the
+        stage — later rows cost more — and persists nothing for it."""
+        bench_watch._save_json(bench_watch.BIGMODEL, {
+            "device_kind": "TPU v5e", "rows": {"tiny/device": {"load_s": 1}}})
+
+        calls = []
+
+        def fake_row(size, tier, budget=0):
+            calls.append((size, tier))
+            return {"platform": "cpu", "tiers": [{"tier": tier}]}, None
+
+        monkeypatch.setattr(bench_watch, "run_bigmodel_row", fake_row)
+        bench_watch.run_bigmodel_stage("TPU v5e")
+        assert calls == [("small", "device")]  # tiny/device kept, stage stopped
+        big = bench_watch._load_json(bench_watch.BIGMODEL)
+        assert list(big["rows"]) == ["tiny/device"]
+        # A different chip generation invalidates the captured rows.
+        calls.clear()
+        monkeypatch.setattr(bench_watch, "run_bigmodel_row",
+                            lambda size, tier, budget=0: (None, "killed"))
+        bench_watch.run_bigmodel_stage("TPU v4")
+        assert calls == []  # first row attempt happens via the stub above
+        big = bench_watch._load_json(bench_watch.BIGMODEL)
+        assert big["rows"] == {"tiny/device": {"load_s": 1}}  # untouched on failure
 
     def test_failed_quickflash_flips_tier1_to_einsum(self, artifacts, monkeypatch):
         """A quickflash parity failure must not cost the MFU run: tier1 is
