@@ -74,98 +74,148 @@ def run_bench(on_tpu: bool) -> dict:
         if on_tpu:
             print(f"ATPU_BENCH_{stage}", flush=True)
 
+    import os
+
     if on_tpu:
         # The watcher sets ACCELERATE_TPU_BENCH_NO_FLASH when its quick flash
         # check failed on this chip: an MFU datapoint on the XLA einsum
         # attention path still beats no datapoint at all. Disable-style
         # values ("0", "false", ...) mean flash stays ON.
-        import os
-
         no_flash_env = os.environ.get("ACCELERATE_TPU_BENCH_NO_FLASH", "")
         use_flash = no_flash_env.lower() in ("", "0", "false", "no", "off")
-        cfg = LlamaConfig(
-            vocab_size=32000, hidden_size=2048, intermediate_size=5632,
-            num_hidden_layers=10, num_attention_heads=16, num_key_value_heads=8,
-            max_position_embeddings=2048, remat=False, use_flash_attention=use_flash,
-        )
-        batch, seq, iters, warmup = 8, 1024, 20, 3
+        seq, iters, warmup = 1024, 20, 3
+        # Attempt ladder, best-MFU first. Lowered-step memory_analysis at
+        # this config (einsum attention, CPU estimate): no-remat needs
+        # ~39 GiB — over v5e's 16 GiB HBM — remat/"dots" ~19 GiB (falls to
+        # ~9 with flash's O(S) residuals), remat/"nothing" b8 ~13.5 GiB,
+        # b4 ~11.7 GiB. An OOM costs one on-chip recompile (~25 s), not
+        # the whole tunnel window.
+        ladder = [("dots", 8), ("nothing", 8), ("nothing", 4)]
+        if not use_flash:
+            # einsum attention materializes [B,H,S,S] scores; "dots" saves
+            # them — start straight at full recompute.
+            ladder = [("nothing", 8), ("nothing", 4)]
     else:  # CPU smoke fallback so the bench always emits a line
-        cfg = LlamaConfig.tiny(use_flash_attention=False)
-        batch, seq, iters, warmup = 4, 32, 3, 1
-    # Scan-over-layers layout for BOTH tiers: the decoder block is traced
-    # and compiled ONCE and lax.scan'd over the stacked [L, ...] params,
-    # instead of inlining N copies — over the tunnel the unrolled compile
-    # alone blew a 480 s budget (watch history 2026-07-31T04:05). Using the
-    # same model class + loss on CPU means every smoke run exercises the
-    # exact tier-1 code path.
-    model_def = PipelinedLlamaForCausalLM(cfg)
+        use_flash = False
+        seq, iters, warmup = 32, 3, 1
+        ladder = [("nothing", 4)]
+
+    def attempt(remat_policy, batch):
+        if on_tpu:
+            cfg = LlamaConfig(
+                vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+                num_hidden_layers=10, num_attention_heads=16, num_key_value_heads=8,
+                max_position_embeddings=2048, remat=True, remat_policy=remat_policy,
+                use_flash_attention=use_flash,
+            )
+        else:
+            cfg = LlamaConfig.tiny(use_flash_attention=False)
+        # Scan-over-layers layout for BOTH tiers: the decoder block is traced
+        # and compiled ONCE and lax.scan'd over the stacked [L, ...] params,
+        # instead of inlining N copies — over the tunnel the unrolled compile
+        # alone blew a 480 s budget (watch history 2026-07-31T04:05). Using
+        # the same model class + loss on CPU means every smoke run exercises
+        # the exact tier-1 code path.
+        model_def = PipelinedLlamaForCausalLM(cfg)
+        params = model_def.init_params(jax.random.PRNGKey(0))
+        mark("PARAMS_INIT")
+
+        acc = Accelerator(mixed_precision="bf16")
+        model, opt = acc.prepare(Model(model_def, params), optax.adamw(1e-4))
+        mark("PREPARED")
+        # Chunked LM-head loss: never materializes the [tokens, vocab]
+        # logits — at vocab 32k that's the train step's largest activation
+        # (~1 GB at this config) and pure HBM traffic saved.
+        step = acc.compile_train_step(fused_causal_lm_loss(model_def),
+                                      max_grad_norm=1.0)
+
+        rng = np.random.default_rng(0)
+        batches = [
+            make_global_batch(
+                {"input_ids": rng.integers(0, cfg.vocab_size, size=(batch, seq)).astype(np.int32)},
+                acc.mesh,
+            )
+            for _ in range(4)
+        ]
+
+        for i in range(warmup):
+            metrics = step(batches[i % 4])
+        # NB: device_get, not block_until_ready — the latter is a no-op on
+        # some experimental PJRT platforms (observed on the axon tunnel).
+        jax.device_get(metrics["loss"])
+        mark("COMPILED")
+
+        t0 = time.perf_counter()
+        for i in range(iters):
+            metrics = step(batches[i % 4])
+        jax.device_get(metrics["loss"])
+        dt = time.perf_counter() - t0
+
+        tokens = batch * seq * iters
+        tokens_per_sec = tokens / dt
+        n_chips = len(jax.devices())
+        tokens_per_sec_per_chip = tokens_per_sec / n_chips
+
+        n_params = sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(model.params))
+        # The input embedding is a gather, not a matmul — exclude it from 6N.
+        n_matmul_params = n_params - cfg.vocab_size * cfg.hidden_size
+        flops_per_tok = model_flops_per_token(n_matmul_params, cfg, seq)
+        achieved_tflops = tokens_per_sec_per_chip * flops_per_tok / 1e12
+        peak = detect_peak_tflops(jax.devices()[0])
+        mfu = achieved_tflops / peak
+
+        result = {
+            "metric": METRIC,
+            "value": round(tokens_per_sec_per_chip, 1),
+            "unit": "tokens/s/chip",
+            "vs_baseline": round(mfu / 0.45, 4),
+            "extra": {
+                "mfu": round(mfu, 4),
+                "achieved_tflops": round(achieved_tflops, 2),
+                "peak_tflops": peak,
+                "step_ms": round(1000 * dt / iters, 2),
+                "config": {
+                    "hidden": cfg.hidden_size, "layers": cfg.num_hidden_layers,
+                    "batch": batch, "seq": seq, "backend": jax.default_backend(),
+                    "flash_attention": cfg.use_flash_attention,
+                    "remat_policy": remat_policy if cfg.remat else None,
+                },
+                "device_kind": _device_kind(),
+                "loss": float(metrics["loss"]),
+            },
+        }
+        trace_dir = os.environ.get("ACCELERATE_TPU_BENCH_TRACE")
+        if trace_dir and on_tpu:
+            # A committed profiler trace is the MFU gap-analysis artifact;
+            # never let capture overhead or a tunnel hiccup kill the result.
+            try:
+                with jax.profiler.trace(trace_dir):
+                    for i in range(2):
+                        step(batches[i % 4])
+                    jax.device_get(metrics["loss"])
+                result["extra"]["profile_trace"] = trace_dir
+            except Exception as e:  # noqa: BLE001
+                result["extra"]["profile_trace_error"] = f"{type(e).__name__}: {e}"
+        return result
+
     if on_tpu:
         jax.devices()  # force backend init under its own marker
         mark("BACKEND_UP")
-    params = model_def.init_params(jax.random.PRNGKey(0))
-    mark("PARAMS_INIT")
-
-    acc = Accelerator(mixed_precision="bf16")
-    model, opt = acc.prepare(Model(model_def, params), optax.adamw(1e-4))
-    mark("PREPARED")
-    # Chunked LM-head loss: never materializes the [tokens, vocab] logits —
-    # at vocab 32k that's the train step's largest activation (~1 GB at
-    # this config) and pure HBM traffic saved.
-    step = acc.compile_train_step(fused_causal_lm_loss(model_def), max_grad_norm=1.0)
-
-    rng = np.random.default_rng(0)
-    batches = [
-        make_global_batch(
-            {"input_ids": rng.integers(0, cfg.vocab_size, size=(batch, seq)).astype(np.int32)}, acc.mesh
-        )
-        for _ in range(4)
-    ]
-
-    for i in range(warmup):
-        metrics = step(batches[i % 4])
-    # NB: device_get, not block_until_ready — the latter is a no-op on some
-    # experimental PJRT platforms (observed on the axon tunnel).
-    jax.device_get(metrics["loss"])
-    mark("COMPILED")
-
-    t0 = time.perf_counter()
-    for i in range(iters):
-        metrics = step(batches[i % 4])
-    jax.device_get(metrics["loss"])
-    dt = time.perf_counter() - t0
-
-    tokens = batch * seq * iters
-    tokens_per_sec = tokens / dt
-    n_chips = len(jax.devices())
-    tokens_per_sec_per_chip = tokens_per_sec / n_chips
-
-    n_params = sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(model.params))
-    # The input embedding is a gather, not a matmul — exclude it from 6N.
-    n_matmul_params = n_params - cfg.vocab_size * cfg.hidden_size
-    flops_per_tok = model_flops_per_token(n_matmul_params, cfg, seq)
-    achieved_tflops = tokens_per_sec_per_chip * flops_per_tok / 1e12
-    peak = detect_peak_tflops(jax.devices()[0])
-    mfu = achieved_tflops / peak
-
-    return {
-        "metric": METRIC,
-        "value": round(tokens_per_sec_per_chip, 1),
-        "unit": "tokens/s/chip",
-        "vs_baseline": round(mfu / 0.45, 4),
-        "extra": {
-            "mfu": round(mfu, 4),
-            "achieved_tflops": round(achieved_tflops, 2),
-            "peak_tflops": peak,
-            "step_ms": round(1000 * dt / iters, 2),
-            "config": {
-                "hidden": cfg.hidden_size, "layers": cfg.num_hidden_layers,
-                "batch": batch, "seq": seq, "backend": jax.default_backend(),
-                "flash_attention": cfg.use_flash_attention,
-            },
-            "device_kind": _device_kind(),
-            "loss": float(metrics["loss"]),
-        },
-    }
+    last_oom = None
+    for n, (remat_policy, batch) in enumerate(ladder):
+        try:
+            result = attempt(remat_policy, batch)
+            if last_oom:
+                result["extra"]["oom_fallbacks"] = last_oom
+            return result
+        except Exception as e:  # noqa: BLE001 - only OOM falls down the ladder
+            msg = str(e)
+            if not ("RESOURCE_EXHAUSTED" in msg or "out of memory" in msg.lower()):
+                raise
+            last_oom = f"{remat_policy}/b{batch} OOM"
+            mark(f"OOM_RETRY_{n + 1}")
+            jax.clear_caches()
+    raise RuntimeError(f"all tier-1 ladder attempts OOMed (last: {last_oom})")
 
 
 def _tpu_run_main() -> int:

@@ -726,8 +726,11 @@ def run_cycle() -> float:
     t1, err = _run_child(
         "--tpu-run", TIER1_BUDGET,
         # Always set explicitly: "0" (flash on) must override any stale
-        # NO_FLASH export sitting in the watcher's own environment.
-        extra_env={"ACCELERATE_TPU_BENCH_NO_FLASH": "1" if no_flash else "0"},
+        # NO_FLASH export sitting in the watcher's own environment. The
+        # trace dir makes a successful tier1 also commit a profiler trace
+        # (the MFU gap-analysis artifact).
+        extra_env={"ACCELERATE_TPU_BENCH_NO_FLASH": "1" if no_flash else "0",
+                   "ACCELERATE_TPU_BENCH_TRACE": os.path.join(ARTIFACT_DIR, "trace")},
     )
     if t1 is not None:
         t1_extra = t1.get("extra", {})
