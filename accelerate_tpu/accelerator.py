@@ -1138,9 +1138,15 @@ class Accelerator:
         yield self.policy
 
     def profile(self, profile_handler: Optional[ProfileKwargs] = None):
-        """Context manager capturing a jax.profiler trace (reference: :3423)."""
+        """Context manager capturing a jax.profiler trace (reference: :3423).
+
+        Trace directory precedence: the handler's ``output_trace_dir`` (the
+        user's explicit choice), then the project's ``logging_dir``, then
+        ``./jax_trace``.
+        """
         handler = profile_handler or self.profile_handler or ProfileKwargs()
-        log_dir = self.project_configuration.logging_dir or "./jax_trace"
+        log_dir = (handler.output_trace_dir
+                   or self.project_configuration.logging_dir or "./jax_trace")
         return handler.build(log_dir=log_dir)
 
     # ------------------------------------------------------------------

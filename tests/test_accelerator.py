@@ -302,6 +302,28 @@ class TestMisc:
             assert not acc.sync_gradients
         assert acc.sync_gradients
 
+    def test_profile_honors_handler_trace_dir(self, tmp_path):
+        """The handler's output_trace_dir must win over the default — a
+        regression here silently dumps xplane protos into ./jax_trace in
+        the caller's cwd (observed: 51 MB of strays from example runs)."""
+        import os
+
+        import jax.numpy as jnp
+
+        from accelerate_tpu.utils import ProfileKwargs
+
+        acc = Accelerator()
+        target = tmp_path / "trace_here"
+        stray = "./jax_trace/plugins/profile"
+        before = set(os.listdir(stray)) if os.path.isdir(stray) else set()
+        with acc.profile(ProfileKwargs(output_trace_dir=str(target))) as prof:
+            jnp.ones((8,)).sum().block_until_ready()
+            prof.step()
+        produced = list(target.rglob("*"))
+        assert any(p.is_file() for p in produced), produced
+        after = set(os.listdir(stray)) if os.path.isdir(stray) else set()
+        assert after == before, f"stray trace written to {stray}"
+
 
 class TestRematPolicy:
     def test_resolve_names(self):
