@@ -45,12 +45,24 @@ class Policy:
 
 
 def _cast_floating(tree, dtype):
-    def conv(x):
+    from .ops.quant import FP8_META_NAMES
+
+    def conv(path, x):
+        if path:
+            last = path[-1]
+            name = getattr(last, "key", None) or getattr(last, "name", None)
+            if name in FP8_META_NAMES:
+                # fp8 delayed-scaling statistics are fp32 by contract
+                # (scales/amax histories, TE semantics): rounding them to
+                # bf16 quantizes every scale and — since the amax-history
+                # ring update mixes the fp32 running amax into the cast
+                # history — trips jax's scatter dtype-mismatch error.
+                return x
         if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
             return x.astype(dtype)
         return x
 
-    return jax.tree_util.tree_map(conv, tree)
+    return jax.tree_util.tree_map_with_path(conv, tree)
 
 
 def policy_for(mixed_precision: str | PrecisionType) -> Policy:

@@ -188,6 +188,30 @@ class TestFp8LlamaTraining:
         assert all(s > 1e-4 for s in scales)
 
 
+class TestPolicyKeepsStatsFp32:
+    def test_cast_to_compute_exempts_fp8_meta(self):
+        """Delayed-scaling statistics are fp32 by contract (TE semantics):
+        the bf16 compute policy must cast weights but never the six meta
+        leaves — rounding them quantizes every scale and trips jax's
+        scatter dtype-mismatch (a FutureWarning today, an error soon)."""
+        import warnings
+
+        from accelerate_tpu.precision import policy_for
+
+        x = jnp.ones((4, 8), jnp.float32)
+        params = Fp8Dense(features=4).init(jax.random.PRNGKey(0), x)["params"]
+        cp = policy_for("fp8").cast_to_compute(params)
+        assert cp["kernel"].dtype == jnp.bfloat16
+        for name in FP8_META_NAMES:
+            assert cp[name].dtype == jnp.float32, name
+        # The full fwd+bwd under the cast params must be warning-clean.
+        with warnings.catch_warnings():
+            warnings.simplefilter("error", FutureWarning)
+            m = Fp8Dense(features=4)
+            jax.grad(lambda p: jnp.sum(m.apply(
+                {"params": p}, x.astype(jnp.bfloat16)) ** 2))(cp)
+
+
 class TestRecipeBridge:
     def test_recipe_to_config(self):
         recipe = FP8RecipeKwargs(margin=2, amax_history_len=32, fp8_format="E4M3")
