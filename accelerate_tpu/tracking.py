@@ -119,6 +119,12 @@ class JSONLTracker(GeneralTracker):
     def finish(self):
         self._fh.close()
 
+    def __del__(self):  # pragma: no cover - GC-timing dependent
+        # A run abandoned without end_training must not leak the fd.
+        fh = getattr(self, "_fh", None)
+        if fh is not None and not fh.closed:
+            fh.close()
+
 
 class TensorBoardTracker(GeneralTracker):
     """(reference: tracking.py:165)"""

@@ -281,6 +281,8 @@ class TestWatcherCycle:
                     "vs_baseline": 0.0, "extra": {"mfu": 0.01}}, None
 
         monkeypatch.setattr(bench_watch, "_run_child", child)
+        monkeypatch.setattr(bench_watch, "run_bigmodel_row",
+                            lambda size, tier, budget=0: (None, "stubbed"))
         bench_watch.run_cycle()
         assert calls == ["--liveness-run", "--tpu-run"]
         # Same evidence, different chip: both kernel stages run again.
