@@ -16,16 +16,20 @@ import numpy as np
 
 
 class SyntheticMRPC:
-    """Sentence pairs; label = whether the two halves are identical."""
+    """Sentence pairs; equivalent pairs share rare "anchor" tokens
+    (see examples/nlp_example.py for the task-design rationale — the
+    accuracy these examples print reflects real learning)."""
 
     def __init__(self, n=256, seq_len=64, vocab=1024, seed=0):
         rng = np.random.default_rng(seed)
         half = seq_len // 2
-        self.input_ids = rng.integers(4, vocab, (n, seq_len)).astype(np.int32)
+        self.input_ids = rng.integers(20, vocab, (n, seq_len)).astype(np.int32)
         same = rng.integers(0, 2, n).astype(np.int32)
-        for i in range(n):
-            if same[i]:
-                self.input_ids[i, half:] = self.input_ids[i, :half]
+        anchors = rng.integers(4, 20, n)
+        for i in np.nonzero(same)[0]:
+            for lo in (0, half):  # 3 anchor copies per half
+                pos = lo + rng.choice(half, 3, replace=False)
+                self.input_ids[i, pos] = anchors[i]
         self.token_type_ids = np.concatenate(
             [np.zeros((n, half), np.int32), np.ones((n, seq_len - half), np.int32)], axis=1
         )

@@ -27,16 +27,26 @@ from accelerate_tpu.utils import set_seed
 
 
 class SyntheticMRPC:
-    """Sentence pairs; label = whether the two halves share a majority token."""
+    """Sentence pairs; equivalent pairs share rare "anchor" tokens.
+
+    Paraphrase pairs (label 1) carry a few copies of one anchor token
+    (ids 4-19) in BOTH halves; non-pairs are pure filler (ids 20+). The
+    signal is token *presence*, so it generalizes to held-out pairs — a
+    learnable stand-in for MRPC's paraphrase signal at BertConfig.tiny
+    scale (real MRPC needs downloads; equality-style synthetic labels are
+    XOR-shaped and tiny models only memorize them), so the accuracy the
+    example prints reflects actual learning."""
 
     def __init__(self, n=512, seq_len=64, vocab=1024, seed=0):
         rng = np.random.default_rng(seed)
         half = seq_len // 2
-        self.input_ids = rng.integers(4, vocab, (n, seq_len)).astype(np.int32)
+        self.input_ids = rng.integers(20, vocab, (n, seq_len)).astype(np.int32)
         same = rng.integers(0, 2, n).astype(np.int32)
-        for i in range(n):
-            if same[i]:
-                self.input_ids[i, half:] = self.input_ids[i, :half]
+        anchors = rng.integers(4, 20, n)
+        for i in np.nonzero(same)[0]:
+            for lo in (0, half):  # 3 anchor copies per half
+                pos = lo + rng.choice(half, 3, replace=False)
+                self.input_ids[i, pos] = anchors[i]
         self.token_type_ids = np.concatenate(
             [np.zeros((n, half), np.int32), np.ones((n, seq_len - half), np.int32)], axis=1
         )
@@ -57,13 +67,16 @@ class SyntheticMRPC:
 def training_function(args):
     set_seed(args.seed)
     accelerator = Accelerator(mixed_precision=args.mixed_precision)
-    cfg = BertConfig.tiny(use_flash_attention=False)
+    # No dropout: at this tiny scale + ~100 optimizer steps it halves the
+    # learning signal (the from-scratch model never converges in-budget);
+    # real workloads re-enable it.
+    cfg = BertConfig.tiny(use_flash_attention=False, hidden_dropout_prob=0.0)
     model_def = BertForSequenceClassification(cfg)
     params = model_def.init(
         jax.random.PRNGKey(args.seed), jnp.zeros((1, 64), jnp.int32), deterministic=True
     )["params"]
 
-    train_dl = NumpyDataLoader(SyntheticMRPC(512), batch_size=args.batch_size, shuffle=True, drop_last=True)
+    train_dl = NumpyDataLoader(SyntheticMRPC(1024), batch_size=args.batch_size, shuffle=True, drop_last=True)
     eval_dl = NumpyDataLoader(SyntheticMRPC(100, seed=1), batch_size=args.batch_size)
 
     schedule = optax.warmup_cosine_decay_schedule(0.0, args.lr, 20, args.epochs * len(train_dl))
@@ -71,7 +84,11 @@ def training_function(args):
         Model(model_def, params), optax.adamw(schedule), train_dl, eval_dl,
         LRScheduler(schedule),
     )
-    step = accelerator.compile_train_step(classification_loss(model_def.apply), max_grad_norm=1.0)
+    # No grad clipping, matching the reference's nlp_example (clipping at
+    # this tiny scale + batch 16 interacts badly with Adam's variance
+    # adaptation; see by_feature/gradient_accumulation.py for the clipped
+    # variant).
+    step = accelerator.compile_train_step(classification_loss(model_def.apply))
 
     for epoch in range(args.epochs):
         losses = []
@@ -95,7 +112,7 @@ if __name__ == "__main__":
     parser = argparse.ArgumentParser()
     parser.add_argument("--mixed_precision", default=None, choices=[None, "no", "bf16", "fp16"])
     parser.add_argument("--batch_size", type=int, default=16)
-    parser.add_argument("--lr", type=float, default=3e-4)
-    parser.add_argument("--epochs", type=int, default=3)
+    parser.add_argument("--lr", type=float, default=1e-3)
+    parser.add_argument("--epochs", type=int, default=5)
     parser.add_argument("--seed", type=int, default=42)
     training_function(parser.parse_args())

@@ -78,9 +78,20 @@ class TestExampleInventory:
 
 
 class TestCanonicalExamples:
-    def test_nlp_example(self):
-        res = _run_example(EXAMPLES / "nlp_example.py", ["--epochs", "1"])
-        assert "eval_acc" in res.stdout
+    def test_nlp_example_learns(self):
+        """The reference's test_performance pattern: the printed metric must
+        clear a threshold, not just appear. At the defaults the synthetic
+        paraphrase task reaches eval_acc 1.00 by epoch 3 (seeds 42/7
+        measured); 0.8 leaves seed headroom while still proving the full
+        loop (optimizer, schedule, masking, gather_for_metrics) learns."""
+        import re
+
+        # extra args come after FAST_ARGS, so this --epochs wins (argparse
+        # keeps the last occurrence).
+        res = _run_example(EXAMPLES / "nlp_example.py", ["--epochs", "5"])
+        accs = [float(a) for a in re.findall(r"eval_acc (\d\.\d+)", res.stdout)]
+        assert accs, res.stdout[-2000:]
+        assert max(accs) >= 0.8, f"eval accuracy never reached 0.8: {accs}"
 
     def test_cv_example(self):
         _run_example(EXAMPLES / "cv_example.py", ["--epochs", "1"])
