@@ -374,6 +374,46 @@ def run_kernels() -> dict:
     want = (qx @ qk) * (meta["input_scale"] * meta["kernel_scale"])
     check("fp8_matmul_fwd", got, want, 2e-2)
 
+    # -- fused (chunked, online-softmax) LM-head loss fwd+bwd ----------------
+    from accelerate_tpu.ops.fused_loss import chunked_softmax_xent
+
+    Nf, Hf, Vf = (16, 32, 64) if tiny else (256, 256, 1024)
+    kh, kk3, kt = jax.random.split(jax.random.PRNGKey(5), 3)
+    hf = jax.random.normal(kh, (Nf, Hf), jnp.float32)
+    wf = jax.random.normal(kk3, (Hf, Vf), jnp.float32) * 0.05
+    tf = jax.random.randint(kt, (Nf,), 0, Vf)
+    maskf = (jnp.arange(Nf) % 5 != 0).astype(jnp.float32)  # some dropped tokens
+
+    def dense_xent(h, w):
+        logp = jax.nn.log_softmax((h @ w).astype(jnp.float32))
+        nll = -jnp.take_along_axis(logp, tf[:, None], -1)[:, 0]
+        return (nll * maskf).sum() / jnp.maximum(maskf.sum(), 1)
+
+    fused_vg = jax.jit(jax.value_and_grad(
+        lambda h, w: chunked_softmax_xent(h, w, tf, maskf, num_chunks=8), argnums=(0, 1)))
+    dense_vg = jax.jit(jax.value_and_grad(dense_xent, argnums=(0, 1)))
+    (lf, (dhf, dwf)) = fused_vg(hf, wf)
+    (ld, (dhd, dwd)) = dense_vg(hf, wf)
+    check("fused_lmhead_loss_value", lf, ld, 1e-3)
+    check("fused_lmhead_loss_dh", dhf, dhd, 1e-3)
+    check("fused_lmhead_loss_dkernel", dwf, dwd, 1e-3)
+
+    # -- int8 / int4 weight-only matmul (dequantize path) --------------------
+    from accelerate_tpu.utils.quantization import quantize_tensor
+
+    Mq, Kq, Nq = (8, 32, 16) if tiny else (128, 512, 256)
+    kxq, kwq = jax.random.split(jax.random.PRNGKey(6))
+    xq = jax.random.normal(kxq, (Mq, Kq), jnp.bfloat16)
+    wq = np.asarray(jax.random.normal(kwq, (Kq, Nq), jnp.float32))
+    for bits in (8, 4):
+        qt = quantize_tensor(jnp.asarray(wq), bits=bits, block_size=64 if not tiny else 16)
+        got = jax.jit(lambda x, q=qt: x @ q.dequantize(jnp.bfloat16))(xq)
+        # Exact reference: the same dequantized weights in fp32 on host —
+        # checks the compiled dequant+matmul, not quantization quality.
+        want = np.asarray(xq, np.float32) @ np.asarray(
+            qt.dequantize(jnp.float32), np.float32)
+        check(f"int{bits}_matmul_fwd", got, want, 3e-2)
+
     # -- timings at the training-bench shape ---------------------------------
     # bench.py tier1: hidden 2048 / 16 heads -> head_dim 128, seq 1024, batch 8.
     B, S, H, D = (1, 128, 1, 32) if tiny else (8, 1024, 16, 128)
