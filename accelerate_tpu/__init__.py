@@ -49,6 +49,7 @@ from .big_modeling import (
 )
 from .data_loader import NumpyDataLoader, prepare_data_loader, skip_first_batches
 from .generation import (
+    assisted_generate,
     beam_search_generate,
     generate,
     greedy_generate,

@@ -570,6 +570,196 @@ def prompt_lookup_generate(
     return buf[:, : S + max_new_tokens]
 
 
+def _compiled_assisted_generate(module, draft_module, max_new_tokens: int,
+                                eos_token_id, cache_dtype, num_draft: int,
+                                buf_len: int, sampling=None):
+    """(prefill_target, prefill_draft, speculate_loop) jitted triple for
+    draft-model speculation. Keyed like :func:`_compiled_lookup_generate`
+    (bucketed ``buf_len``, prompt length traced) plus the DRAFT module's
+    config — two target/draft pairings never share an executable."""
+    tkey = _cache_key(module, max_new_tokens, eos_token_id,
+                      jnp.dtype(cache_dtype).name, sampling, 1.0,
+                      ("assisted", num_draft, buf_len))
+    dkey = _cache_key(draft_module, 0)
+    key = (tkey, dkey) if tkey is not None and dkey is not None else None
+    hit = _generate_cache.get(key) if key is not None else None
+    if hit is not None:
+        return hit
+
+    warp = _make_warper(sampling) if sampling is not None else None
+    K = num_draft
+    L = buf_len
+    eos = eos_token_id
+
+    @jax.jit
+    def prefill_t(params, ids, cache, rng):
+        logits, cache = module.apply({"params": params}, ids, cache=cache, cache_pos=0)
+        if sampling is None:
+            tok = jnp.argmax(logits[:, -1], axis=-1)
+        else:
+            tok = jax.random.categorical(rng, warp(logits[:, -1]), axis=-1)
+        return tok.astype(ids.dtype), cache
+
+    @jax.jit
+    def prefill_d(draft_params, ids, dcache):
+        _, dcache = draft_module.apply(
+            {"params": draft_params}, ids, cache=dcache, cache_pos=0)
+        return dcache
+
+    @jax.jit
+    def speculate(params, draft_params, buf, cache, dcache, rng, S):
+        """buf: [1, L] with the prompt (length ``S``, traced) + first
+        generated token committed. The draft model proposes K tokens by
+        greedy cached decode (a delta proposal, so
+        :func:`speculative_accept` stays exact for sampled targets); the
+        target verifies the chunk in ONE forward. Rejected positions leave
+        stale KV entries in BOTH caches that the next round's writes cover
+        before any query can attend them (drafting restarts from the last
+        committed token, one position behind the target's chunk)."""
+
+        def cond(state):
+            _, n_gen, _, _, done, _ = state
+            return (n_gen < max_new_tokens) & ~done
+
+        def body(state):
+            buf, n_gen, cache, dcache, done, rng = state
+            rng, step_rng = jax.random.split(rng)
+            cur = S + n_gen                       # committed length
+
+            # --- draft: K greedy cached steps of the draft model ---------
+            last = jax.lax.dynamic_slice(buf, (0, cur - 1), (1, 1))
+
+            def dstep(carry, _):
+                tok, dcache, pos = carry
+                logits, dcache = draft_module.apply(
+                    {"params": draft_params}, tok, cache=dcache, cache_pos=pos)
+                nxt = jnp.argmax(logits[:, -1], axis=-1)[:, None].astype(tok.dtype)
+                return (nxt, dcache, pos + 1), nxt[0, 0]
+
+            (_, dcache, _), draft = jax.lax.scan(
+                dstep, (last, dcache, cur - 1), None, length=K)
+
+            # --- verify: one target forward over [last_committed, draft] --
+            chunk = jnp.concatenate([last, draft[None, :]], axis=1)    # [1, K+1]
+            logits, cache = module.apply({"params": params}, chunk,
+                                         cache=cache, cache_pos=cur - 1)
+            if sampling is None:
+                preds = jnp.argmax(logits[0], axis=-1).astype(buf.dtype)   # [K+1]
+                matches = draft == preds[:K]
+                m = jnp.sum(jnp.cumprod(matches.astype(jnp.int32)))
+                emit = preds
+            else:
+                m, final = speculative_accept(warp(logits[0]), draft, step_rng)
+                slots = jnp.arange(K + 1)
+                emit = jnp.where(slots < m, jnp.append(draft, 0)[slots],
+                                 final).astype(buf.dtype)
+            if eos is not None:
+                after = jnp.concatenate(
+                    [jnp.zeros((1,), bool), jnp.cumsum(emit == eos)[:-1] > 0])
+                emit = jnp.where(after, eos, emit)
+            n_emit = jnp.minimum(m + 1, max_new_tokens - n_gen)
+            buf = jax.lax.dynamic_update_slice(buf, emit[None, :], (0, cur))
+            if eos is not None:
+                done = done | jnp.any((jnp.arange(K + 1) < n_emit) & (emit == eos))
+            return buf, n_gen + n_emit, cache, dcache, done, rng
+
+        done0 = (buf[0, S] == eos) if eos is not None else jnp.asarray(False)
+        buf, n_gen, _, _, _, _ = jax.lax.while_loop(
+            cond, body, (buf, jnp.asarray(1, jnp.int32), cache, dcache, done0, rng))
+        if eos is not None:
+            tail = jnp.arange(L) >= (S + n_gen)
+            committed = jnp.arange(L) < S + max_new_tokens
+            buf = jnp.where((tail & committed)[None, :], eos, buf)
+        return buf
+
+    return _cache_put(key, (prefill_t, prefill_d, speculate))
+
+
+def assisted_generate(
+    module,
+    params,
+    draft_module,
+    draft_params,
+    input_ids,
+    max_new_tokens: int = 20,
+    num_draft: int = 5,
+    eos_token_id: Optional[int] = None,
+    cache_dtype=None,
+    do_sample: bool = False,
+    temperature: float = 1.0,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
+    rng=None,
+):
+    """Draft-model speculative decoding — transformers' assisted generation
+    (``model.generate(assistant_model=...)``), which the reference's users
+    reach through the big-model stack.
+
+    A small draft model proposes ``num_draft`` tokens by greedy cached
+    decode; the target verifies the whole chunk in ONE cached forward. The
+    output is EXACTLY ``generate``'s greedy output of the TARGET (the
+    target's predictions decide every commit); ``do_sample=True`` switches
+    to exact speculative sampling against the warped target — the greedy
+    draft is a delta proposal, so :func:`speculative_accept`'s rejection
+    rule keeps the emitted distribution exactly the target's.
+
+    Wall-clock wins when the draft agrees often and costs a small fraction
+    of the target per token: each round is K cheap draft steps + one wide
+    (MXU-friendly) K+1-token target forward instead of K+1 sequential
+    target steps. Complements :func:`prompt_lookup_generate`, which needs
+    self-repetitive text; a trained draft accelerates arbitrary text.
+
+    Both models must be decoder-only cache-threading families over the SAME
+    vocabulary. Batch 1 only (per-row acceptance would desynchronize).
+    """
+    from .big_modeling import cache_factory_for
+
+    for m, name in ((module, "target"), (draft_module, "draft")):
+        if hasattr(m, "init_decode_cache"):
+            raise TypeError(f"assisted_generate supports decoder-only models; "
+                            f"the {name} model is encoder-decoder")
+        if cache_factory_for(m) is None:
+            raise TypeError(f"{type(m).__name__} ({name}) does not thread a KV cache")
+    t_vocab = getattr(module.config, "vocab_size", None)
+    d_vocab = getattr(draft_module.config, "vocab_size", None)
+    if t_vocab != d_vocab:
+        raise ValueError(
+            f"target and draft must share a vocabulary (got {t_vocab} vs {d_vocab})")
+    ids = jnp.asarray(input_ids)
+    if ids.shape[0] != 1:
+        raise ValueError(f"assisted_generate is batch-1 only (got batch {ids.shape[0]})")
+    if num_draft < 1:
+        raise ValueError(f"num_draft must be >= 1 (got {num_draft})")
+    if max_new_tokens <= 0:
+        return ids
+    B, S = ids.shape
+    K = int(num_draft)
+    _check_position_bound(module, S + max_new_tokens + K - 1,
+                          label="prompt + max_new_tokens + speculative slack")
+    # The draft decodes at positions up to S + max_new_tokens + K - 3.
+    _check_position_bound(draft_module, S + max_new_tokens + K - 2,
+                          label="prompt + max_new_tokens + draft slack")
+    dtype = cache_dtype or jnp.bfloat16
+    L = -(-(S + max_new_tokens + K + 1) // 128) * 128
+    cache = cache_factory_for(module)(B, L, dtype, ring_slack=K + 1)
+    dcache = cache_factory_for(draft_module)(B, L, dtype, ring_slack=K + 1)
+
+    sampling = (float(temperature), top_k, top_p) if do_sample else None
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    rng, pre_rng = jax.random.split(rng)
+    prefill_t, prefill_d, speculate = _compiled_assisted_generate(
+        module, draft_module, max_new_tokens, eos_token_id, dtype, K, L,
+        sampling=sampling)
+    first_tok, cache = prefill_t(params, ids, cache, pre_rng)
+    dcache = prefill_d(draft_params, ids, dcache)
+    buf = jnp.zeros((1, L), ids.dtype)
+    buf = jax.lax.dynamic_update_slice(buf, ids, (0, 0))
+    buf = buf.at[0, S].set(first_tok[0])
+    buf = speculate(params, draft_params, buf, cache, dcache, rng,
+                    jnp.asarray(S, jnp.int32))
+    return buf[:, : S + max_new_tokens]
+
+
 def beam_search_generate(
     module,
     params,

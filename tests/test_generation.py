@@ -382,6 +382,82 @@ class TestPromptLookupGenerate:
         assert len(new_lookup) == 1, new_lookup
 
 
+class TestAssistedGenerate:
+    """Draft-model speculation must produce EXACTLY the target's generate
+    output — the target's predictions decide every commit, the draft only
+    proposes (transformers' assisted-generation contract)."""
+
+    def _pair(self, **cfg_overrides):
+        import dataclasses
+
+        from accelerate_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+        cfg = LlamaConfig.tiny(use_flash_attention=False, **cfg_overrides)
+        target = LlamaForCausalLM(cfg)
+        tp = target.init_params(jax.random.PRNGKey(3), batch_size=1, seq_len=8)
+        draft = LlamaForCausalLM(dataclasses.replace(cfg, num_hidden_layers=1))
+        dp = draft.init_params(jax.random.PRNGKey(9), batch_size=1, seq_len=8)
+        return target, tp, draft, dp, cfg
+
+    def test_matches_target_greedy(self):
+        from accelerate_tpu.generation import assisted_generate, generate
+
+        target, tp, draft, dp, cfg = self._pair()
+        ids = (np.arange(12, dtype=np.int32)[None] * 37 + 5) % cfg.vocab_size
+        ref = np.asarray(generate(target, tp, jnp.asarray(ids), max_new_tokens=24,
+                                  cache_dtype=jnp.float32))
+        got = np.asarray(assisted_generate(target, tp, draft, dp, jnp.asarray(ids),
+                                           max_new_tokens=24, cache_dtype=jnp.float32))
+        np.testing.assert_array_equal(got, ref)
+        # Self-speculation (draft == target): every draft accepted, same result.
+        got_self = np.asarray(assisted_generate(target, tp, target, tp,
+                                                jnp.asarray(ids), max_new_tokens=24,
+                                                cache_dtype=jnp.float32))
+        np.testing.assert_array_equal(got_self, ref)
+
+    def test_matches_with_eos_and_window_model(self):
+        from accelerate_tpu.generation import assisted_generate, generate
+
+        target, tp, draft, dp, cfg = self._pair(sliding_window=8)
+        ids = np.tile(np.array([[5, 9]], np.int32), (1, 5))
+        ref_free = np.asarray(generate(target, tp, jnp.asarray(ids),
+                                       max_new_tokens=20, cache_dtype=jnp.float32))
+        eos = int(ref_free[0, 16])
+        ref = np.asarray(generate(target, tp, jnp.asarray(ids), max_new_tokens=20,
+                                  eos_token_id=eos, cache_dtype=jnp.float32))
+        got = np.asarray(assisted_generate(target, tp, draft, dp, jnp.asarray(ids),
+                                           max_new_tokens=20, eos_token_id=eos,
+                                           cache_dtype=jnp.float32))
+        np.testing.assert_array_equal(got, ref)
+
+    def test_sampled_is_deterministic_per_seed(self):
+        from accelerate_tpu.generation import assisted_generate
+
+        target, tp, draft, dp, cfg = self._pair()
+        ids = (np.arange(8, dtype=np.int32)[None] * 11 + 3) % cfg.vocab_size
+        kw = dict(max_new_tokens=12, do_sample=True, top_k=8,
+                  cache_dtype=jnp.float32)
+        a = np.asarray(assisted_generate(target, tp, draft, dp, jnp.asarray(ids),
+                                         rng=jax.random.PRNGKey(1), **kw))
+        b = np.asarray(assisted_generate(target, tp, draft, dp, jnp.asarray(ids),
+                                         rng=jax.random.PRNGKey(1), **kw))
+        np.testing.assert_array_equal(a, b)
+
+    def test_input_validation(self):
+        import dataclasses
+
+        from accelerate_tpu.generation import assisted_generate
+        from accelerate_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+        target, tp, draft, dp, cfg = self._pair()
+        with pytest.raises(ValueError, match="batch-1"):
+            assisted_generate(target, tp, draft, dp, jnp.zeros((2, 4), jnp.int32))
+        other = LlamaForCausalLM(dataclasses.replace(cfg, vocab_size=cfg.vocab_size * 2))
+        op = other.init_params(jax.random.PRNGKey(0), batch_size=1, seq_len=8)
+        with pytest.raises(ValueError, match="share a vocabulary"):
+            assisted_generate(target, tp, other, op, jnp.zeros((1, 4), jnp.int32))
+
+
 class TestSpeculativeSampling:
     """do_sample speculation must be DISTRIBUTION-exact (the speculative
     sampling theorem), not just plausible."""
