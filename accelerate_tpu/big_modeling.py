@@ -878,6 +878,8 @@ class StreamedModel:
                  eos_token_id: Optional[int] = None, use_cache: bool = True,
                  prompt_lookup_num_tokens: Optional[int] = None,
                  lookup_ngram: int = 2,
+                 assistant_module=None, assistant_params=None,
+                 num_draft: int = 5,
                  do_sample: bool = False, temperature: float = 1.0,
                  top_k: Optional[int] = None, top_p: Optional[float] = None,
                  rng=None):
@@ -900,23 +902,34 @@ class StreamedModel:
         forward, so the offloaded weights stream once per ACCEPTED RUN
         instead of once per token — on the cpu/disk tiers, where weight
         traffic dominates the per-token latency, acceptance translates
-        almost directly into speedup. Output equals plain greedy exactly."""
+        almost directly into speedup. Output equals plain greedy exactly.
+
+        ``assistant_module``/``assistant_params`` (transformers'
+        ``assistant_model=``) switch the drafter to a small device-resident
+        draft model proposing ``num_draft`` tokens per round — the same
+        weights-stream-once-per-accepted-run economics on arbitrary text,
+        not just self-repetitive text. Mutually exclusive with
+        prompt-lookup; same exactness contract."""
         if any(s.stage == "enc" for s in self.specs):
             raise TypeError(
                 "this is an encoder-decoder model; use seq2seq_generate")
         ids = jnp.asarray(input_ids)
         if max_new_tokens <= 0:
             return ids
+        if assistant_module is not None and prompt_lookup_num_tokens:
+            raise ValueError(
+                "assistant_module and prompt_lookup_num_tokens are mutually "
+                "exclusive drafters")
         cached = (
             use_cache
             and self.cache_factory is not None
             and all(s.cached_apply is not None for s in self.specs)
         )
-        if prompt_lookup_num_tokens and not cached:
+        if (prompt_lookup_num_tokens or assistant_module is not None) and not cached:
             # Never silently fall back to the slowest path when the caller
             # explicitly asked for speculation (which presupposes a cache).
             raise ValueError(
-                "prompt_lookup_num_tokens requires KV-cache support "
+                "speculative decoding requires KV-cache support "
                 "(cached_apply on every block spec + a cache_factory) and "
                 "use_cache=True")
         sampling = (float(temperature), top_k, top_p) if do_sample else None
@@ -948,7 +961,9 @@ class StreamedModel:
         # S + max_new_tokens - 2 and spans K + 1), so the needed slack is
         # K - 1 — keep in lockstep with generation._check_position_bound's
         # speculative call site.
-        slack = (prompt_lookup_num_tokens - 1) if prompt_lookup_num_tokens else 0
+        spec_k = int(prompt_lookup_num_tokens or 0) or (
+            int(num_draft) if assistant_module is not None else 0)
+        slack = (spec_k - 1) if spec_k else 0
         if self.position_bound is not None and S + max_new_tokens + slack > self.position_bound:
             label = ("prompt + max_new_tokens + speculative slack" if slack
                      else "prompt + max_new_tokens")
@@ -957,6 +972,10 @@ class StreamedModel:
                 f"model's position table ({self.position_bound}); learned-position "
                 "lookups would silently clamp."
             )
+        if assistant_module is not None:
+            return self._generate_assisted(
+                ids, max_new_tokens, eos_token_id, int(num_draft),
+                assistant_module, assistant_params, sampling=sampling, rng=rng)
         if prompt_lookup_num_tokens:
             return self._generate_prompt_lookup(
                 ids, max_new_tokens, eos_token_id,
@@ -982,19 +1001,97 @@ class StreamedModel:
 
     def _generate_prompt_lookup(self, ids, max_new_tokens: int, eos_token_id,
                                 K: int, ngram: int, sampling=None, rng=None):
-        """Speculative decode: draft in Python (the committed ids are
-        host-side anyway), verify K+1 tokens per streamed pass. Greedy by
+        """Prompt-lookup speculation: draft in Python (the committed ids
+        are host-side anyway), verify through the shared streamed
+        speculative loop."""
+        if ids.shape[0] != 1:
+            raise ValueError("prompt_lookup_num_tokens is batch-1 only")
+        if ngram < 1 or K < 1:
+            raise ValueError(f"lookup_ngram and prompt_lookup_num_tokens must be >= 1 "
+                             f"(got {ngram}, {K})")
+
+        def drafter(committed, state):
+            cur = len(committed)
+            draft: list = []
+            if cur > ngram:
+                pat = committed[-ngram:]
+                for i in range(cur - ngram - 1, -1, -1):
+                    if committed[i:i + ngram] == pat:
+                        draft = committed[i + ngram:i + ngram + K]
+                        break
+            draft += [committed[-1]] * (K - len(draft))   # pad: rejected cheaply
+            return draft, state
+
+        return self._generate_speculative(ids, max_new_tokens, eos_token_id, K,
+                                          drafter, None, sampling=sampling, rng=rng)
+
+    def _generate_assisted(self, ids, max_new_tokens: int, eos_token_id,
+                           K: int, draft_module, draft_params,
+                           sampling=None, rng=None):
+        """Draft-model speculation for streamed weights: the (small,
+        device-resident) draft proposes K tokens by a compiled greedy
+        cached scan; the streamed target verifies the chunk in one pass,
+        so offloaded weights stream once per accepted run. The draft's KV
+        cache self-heals rejected positions exactly like the target's
+        (drafting restarts from the last committed token)."""
+        import numpy as np
+
+        from .generation import _check_position_bound
+
+        if ids.shape[0] != 1:
+            raise ValueError("assistant_module speculation is batch-1 only")
+        if K < 1:
+            raise ValueError(f"num_draft must be >= 1 (got {K})")
+        if hasattr(draft_module, "init_decode_cache"):
+            raise TypeError("the assistant model must be decoder-only")
+        dfactory = cache_factory_for(draft_module)
+        if dfactory is None:
+            raise TypeError(
+                f"{type(draft_module).__name__} (assistant) does not thread a KV cache")
+        S = ids.shape[1]
+        # The draft decodes at positions up to S + max_new_tokens + K - 3.
+        _check_position_bound(draft_module, S + max_new_tokens + K - 2,
+                              label="prompt + max_new_tokens + draft slack")
+        L = S + max_new_tokens + K + 1
+        dcache = dfactory(1, L, jnp.bfloat16, ring_slack=K + 1)
+        prefill_d = jax.jit(lambda dp, ids, c: draft_module.apply(
+            {"params": dp}, ids, cache=c, cache_pos=0)[1])
+        dcache = prefill_d(draft_params, jnp.asarray(ids), dcache)
+
+        @jax.jit
+        def draft_k(dp, tok, dcache, pos):
+            def dstep(carry, _):
+                tok, dcache, pos = carry
+                logits, dcache = draft_module.apply(
+                    {"params": dp}, tok, cache=dcache, cache_pos=pos)
+                nxt = jnp.argmax(logits[:, -1], axis=-1)[:, None].astype(tok.dtype)
+                return (nxt, dcache, pos + 1), nxt[0, 0]
+
+            (_, dcache, _), draft = jax.lax.scan(dstep, (tok, dcache, pos),
+                                                 None, length=K)
+            return draft, dcache
+
+        def drafter(committed, dcache):
+            tok = jnp.asarray([[committed[-1]]], jnp.asarray(ids).dtype)
+            draft, dcache = draft_k(draft_params, tok, dcache,
+                                    jnp.asarray(len(committed) - 1, jnp.int32))
+            return [int(t) for t in np.asarray(draft)], dcache
+
+        return self._generate_speculative(ids, max_new_tokens, eos_token_id, K,
+                                          drafter, dcache, sampling=sampling, rng=rng)
+
+    def _generate_speculative(self, ids, max_new_tokens: int, eos_token_id,
+                              K: int, drafter, drafter_state,
+                              sampling=None, rng=None):
+        """Shared verify/commit loop for streamed speculation: ``drafter``
+        maps (committed token list, state) -> (K proposed tokens, state);
+        each round verifies K+1 tokens in ONE streamed pass. Greedy by
         default; ``sampling`` switches the accept rule to exact speculative
         sampling (generation.speculative_accept). Rejected positions leave
         stale KV that the next chunk overwrites before any query attends
         it; ring caches get K+1 slots of eviction slack."""
         import numpy as np
 
-        if ids.shape[0] != 1:
-            raise ValueError("prompt_lookup_num_tokens is batch-1 only")
-        if ngram < 1 or K < 1:
-            raise ValueError(f"lookup_ngram and prompt_lookup_num_tokens must be >= 1 "
-                             f"(got {ngram}, {K})")
         S = ids.shape[1]
         import inspect
 
@@ -1031,16 +1128,7 @@ class StreamedModel:
         eos_done = eos_token_id is not None and int(first) == eos_token_id
         while len(committed) - S < max_new_tokens and not eos_done:
             cur = len(committed)
-            # Draft: continuation of the most recent earlier occurrence of
-            # the last `ngram` committed tokens (pure host-side search).
-            draft: list = []
-            if cur > ngram:
-                pat = committed[-ngram:]
-                for i in range(cur - ngram - 1, -1, -1):
-                    if committed[i:i + ngram] == pat:
-                        draft = committed[i + ngram:i + ngram + K]
-                        break
-            draft += [committed[-1]] * (K - len(draft))   # pad: rejected cheaply
+            draft, drafter_state = drafter(committed, drafter_state)
             chunk = jnp.asarray([[committed[-1], *draft]], ids.dtype)   # [1, K+1]
             out = self._cached_pass((chunk,), caches, cur - 1, static_pos=False,
                                     return_logits=sample)

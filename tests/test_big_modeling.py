@@ -413,3 +413,56 @@ class TestStreamedPromptLookup:
         a = np.asarray(streamed.generate(ids, **kw))
         b = np.asarray(streamed.generate(ids, **kw))
         np.testing.assert_array_equal(a, b)
+
+    def _draft(self, layers=1, seed=11, **overrides):
+        import dataclasses
+
+        from accelerate_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+        cfg = LlamaConfig.tiny(use_flash_attention=False, **overrides)
+        draft = LlamaForCausalLM(dataclasses.replace(cfg, num_hidden_layers=layers))
+        return draft, draft.init_params(jax.random.PRNGKey(seed), batch_size=1, seq_len=8)
+
+    @pytest.mark.parametrize("window", [None, 8])
+    def test_assistant_model_matches_streamed_greedy(self, tmp_path, window):
+        """Draft-MODEL speculation (transformers' assistant_model=) through
+        the streamed executor: target-exact on full and ring-cached
+        sliding-window targets; weights stream once per accepted run."""
+        streamed = self._streamed(tmp_path, window=window)
+        draft, dp = self._draft(sliding_window=window)
+        ids = np.tile(np.array([[3, 7, 12]], np.int32), (1, 4))
+        ref = np.asarray(streamed.generate(ids, max_new_tokens=14))
+        got = np.asarray(streamed.generate(
+            ids, max_new_tokens=14, assistant_module=draft, assistant_params=dp,
+            num_draft=4))
+        np.testing.assert_array_equal(got, ref)
+
+    def test_assistant_model_eos_and_sampling(self, tmp_path):
+        streamed = self._streamed(tmp_path)
+        draft, dp = self._draft()
+        ids = (np.arange(9, dtype=np.int32)[None] * 5) % 64
+        free = np.asarray(streamed.generate(ids, max_new_tokens=12))
+        eos = int(free[0, -2])
+        ref = np.asarray(streamed.generate(ids, max_new_tokens=12, eos_token_id=eos))
+        got = np.asarray(streamed.generate(
+            ids, max_new_tokens=12, eos_token_id=eos,
+            assistant_module=draft, assistant_params=dp, num_draft=3))
+        np.testing.assert_array_equal(got, ref)
+        kw = dict(max_new_tokens=10, do_sample=True, top_k=8,
+                  assistant_module=draft, assistant_params=dp, num_draft=3)
+        import jax as _jax
+
+        a = np.asarray(streamed.generate(ids, rng=_jax.random.PRNGKey(2), **kw))
+        b = np.asarray(streamed.generate(ids, rng=_jax.random.PRNGKey(2), **kw))
+        np.testing.assert_array_equal(a, b)
+
+    def test_assistant_model_validation(self, tmp_path):
+        streamed = self._streamed(tmp_path)
+        draft, dp = self._draft()
+        ids = np.zeros((1, 4), np.int32)
+        with pytest.raises(ValueError, match="mutually"):
+            streamed.generate(ids, max_new_tokens=4, assistant_module=draft,
+                              assistant_params=dp, prompt_lookup_num_tokens=3)
+        with pytest.raises(ValueError, match="batch-1"):
+            streamed.generate(np.zeros((2, 4), np.int32), max_new_tokens=4,
+                              assistant_module=draft, assistant_params=dp)
