@@ -47,6 +47,24 @@ def model_flops_per_token(n_params: int, cfg, seq: int) -> float:
     return 6.0 * n_params + attn
 
 
+def sweep_block_defaults() -> tuple:
+    """Close the sweep loop: once the watcher's on-chip flash block sweep
+    has picked a best (block_q, block_k), later tier-1 runs use it instead
+    of the static 128/128 default. Any problem reading the artifact keeps
+    the safe defaults."""
+    try:
+        import bench_watch
+
+        sweep = bench_watch._load_json(bench_watch.SWEEP) or {}
+        best = sweep.get("best") or {}
+        if (sweep.get("backend") == "tpu" and not sweep.get("tiny_smoke")
+                and best.get("block_q") and best.get("block_k")):
+            return int(best["block_q"]), int(best["block_k"])
+    except Exception:  # noqa: BLE001 - defaults are always safe
+        pass
+    return 128, 128
+
+
 def run_bench(on_tpu: bool) -> dict:
     import jax
     import numpy as np
@@ -102,11 +120,13 @@ def run_bench(on_tpu: bool) -> dict:
 
     def attempt(remat_policy, batch):
         if on_tpu:
+            bq, bk = sweep_block_defaults()
             cfg = LlamaConfig(
                 vocab_size=32000, hidden_size=2048, intermediate_size=5632,
                 num_hidden_layers=10, num_attention_heads=16, num_key_value_heads=8,
                 max_position_embeddings=2048, remat=True, remat_policy=remat_policy,
                 use_flash_attention=use_flash,
+                flash_block_q=bq, flash_block_k=bk,
             )
         else:
             cfg = LlamaConfig.tiny(use_flash_attention=False)
@@ -178,6 +198,7 @@ def run_bench(on_tpu: bool) -> dict:
                     "hidden": cfg.hidden_size, "layers": cfg.num_hidden_layers,
                     "batch": batch, "seq": seq, "backend": jax.default_backend(),
                     "flash_attention": cfg.use_flash_attention,
+                    "flash_blocks": [cfg.flash_block_q, cfg.flash_block_k],
                     "remat_policy": remat_policy if cfg.remat else None,
                 },
                 "device_kind": _device_kind(),

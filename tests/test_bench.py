@@ -142,6 +142,19 @@ def test_worse_live_result_does_not_clobber_best(artifacts, monkeypatch, capsys)
     assert stored["value"] == FAKE_BEST["value"]  # best survives
 
 
+def test_sweep_block_defaults(artifacts):
+    """Tier-1 picks up the on-chip sweep's best flash blocks; smoke/absent
+    artifacts keep the safe 128/128."""
+    assert bench.sweep_block_defaults() == (128, 128)  # no artifact
+    bench_watch._save_json(bench_watch.SWEEP, {
+        "backend": "tpu", "best": {"block_q": 512, "block_k": 256, "fwdbwd_ms": 1}})
+    assert bench.sweep_block_defaults() == (512, 256)
+    bench_watch._save_json(bench_watch.SWEEP, {
+        "backend": "cpu", "tiny_smoke": True,
+        "best": {"block_q": 512, "block_k": 256}})
+    assert bench.sweep_block_defaults() == (128, 128)  # smoke never counts
+
+
 class TestWatcherCycle:
     def _patch_probe(self, monkeypatch, info):
         from accelerate_tpu.utils import platforms
