@@ -739,6 +739,36 @@ def _t5_block_specs(cfg) -> list[BlockSpec]:
 # Streamed executor
 # ---------------------------------------------------------------------------
 
+def _compiled_drafter(draft_module, K: int):
+    """(prefill, K-step greedy decode) jitted pair for a draft model,
+    cached per (draft config, K) in generation's executable cache —
+    repeated streamed-assisted calls must not re-trace the drafter."""
+    from .generation import _cache_key, _cache_put, _generate_cache
+
+    key = _cache_key(draft_module, "streamed_drafter", K)
+    hit = _generate_cache.get(key) if key is not None else None
+    if hit is not None:
+        return hit
+
+    prefill_d = jax.jit(lambda dp, ids, c: draft_module.apply(
+        {"params": dp}, ids, cache=c, cache_pos=0)[1])
+
+    @jax.jit
+    def draft_k(dp, tok, dcache, pos):
+        def dstep(carry, _):
+            tok, dcache, pos = carry
+            logits, dcache = draft_module.apply(
+                {"params": dp}, tok, cache=dcache, cache_pos=pos)
+            nxt = jnp.argmax(logits[:, -1], axis=-1)[:, None].astype(tok.dtype)
+            return (nxt, dcache, pos + 1), nxt[0, 0]
+
+        (_, dcache, _), draft = jax.lax.scan(dstep, (tok, dcache, pos),
+                                             None, length=K)
+        return draft, dcache
+
+    return _cache_put(key, (prefill_d, draft_k))
+
+
 class StreamedModel:
     """Executes a block-split model whose weights live across HBM / host DRAM
     / disk, double-buffering host→HBM transfers (reference equivalent:
@@ -1054,22 +1084,8 @@ class StreamedModel:
                               label="prompt + max_new_tokens + draft slack")
         L = S + max_new_tokens + K + 1
         dcache = dfactory(1, L, jnp.bfloat16, ring_slack=K + 1)
-        prefill_d = jax.jit(lambda dp, ids, c: draft_module.apply(
-            {"params": dp}, ids, cache=c, cache_pos=0)[1])
+        prefill_d, draft_k = _compiled_drafter(draft_module, K)
         dcache = prefill_d(draft_params, jnp.asarray(ids), dcache)
-
-        @jax.jit
-        def draft_k(dp, tok, dcache, pos):
-            def dstep(carry, _):
-                tok, dcache, pos = carry
-                logits, dcache = draft_module.apply(
-                    {"params": dp}, tok, cache=dcache, cache_pos=pos)
-                nxt = jnp.argmax(logits[:, -1], axis=-1)[:, None].astype(tok.dtype)
-                return (nxt, dcache, pos + 1), nxt[0, 0]
-
-            (_, dcache, _), draft = jax.lax.scan(dstep, (tok, dcache, pos),
-                                                 None, length=K)
-            return draft, dcache
 
         def drafter(committed, dcache):
             tok = jnp.asarray([[committed[-1]]], jnp.asarray(ids).dtype)

@@ -103,7 +103,7 @@ def build_and_save(size: str, ckpt_dir: str, family: str = "llama"):
 
 
 def bench_tier(module, ckpt_dir: str, tier: str, prompt_len: int, tokens: int,
-               offload_folder=None, prompt_lookup: int = 0):
+               offload_folder=None, prompt_lookup: int = 0, assisted: int = 0):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -162,6 +162,24 @@ def bench_tier(module, ckpt_dir: str, tier: str, prompt_len: int, tokens: int,
         streamed.generate(rep, **kw)
         lookup_per_token = (time.perf_counter() - t0) / tokens
 
+    assisted_per_token = None
+    if assisted and not is_t5:
+        # Self-speculation upper bound: the draft is the SAME weights
+        # rebuilt device-resident (the checkpoint came from this seed), so
+        # acceptance is 1.0 and the row shows the ceiling of what a good
+        # draft buys — streamed passes divided by the full run length.
+        try:
+            draft_params = module.init_params(jax.random.PRNGKey(0),
+                                              batch_size=1, seq_len=8)
+        except TypeError:
+            draft_params = module.init_params(jax.random.PRNGKey(0))
+        kw = dict(max_new_tokens=tokens, assistant_module=module,
+                  assistant_params=draft_params, num_draft=assisted)
+        streamed.generate(ids, **kw)  # compile warm-up
+        t0 = time.perf_counter()
+        streamed.generate(ids, **kw)
+        assisted_per_token = (time.perf_counter() - t0) / tokens
+
     result = {
         "tier": tier,
         "load_s": round(load_s, 2),
@@ -169,6 +187,7 @@ def bench_tier(module, ckpt_dir: str, tier: str, prompt_len: int, tokens: int,
         "kv_s_per_token": round(kv_per_token, 4),
         "nocache_s_per_token": round(nocache_per_token, 4) if nocache_per_token else None,
         "lookup_s_per_token": round(lookup_per_token, 4) if lookup_per_token else None,
+        "assisted_s_per_token": round(assisted_per_token, 4) if assisted_per_token else None,
         "hbm_resident_bytes": streamed.hbm_resident_bytes,
         "n_new_tokens": int(out.shape[1] - (1 if is_t5 else prompt_len)),
     }
@@ -187,6 +206,10 @@ def main() -> int:
     ap.add_argument("--prompt-lookup", type=int, default=0,
                     help="also time prompt-lookup speculation with K drafts "
                          "(decoder-only families)")
+    ap.add_argument("--assisted", type=int, default=0,
+                    help="also time draft-model speculation with K drafts; "
+                         "the draft is the same weights device-resident "
+                         "(acceptance-1.0 upper bound; decoder-only)")
     args = ap.parse_args()
 
     from accelerate_tpu.utils.platforms import resolve_backend
@@ -202,26 +225,35 @@ def main() -> int:
             offload = f"{tmp}/offload_{tier}" if tier == "disk" else None
             rows.append(
                 bench_tier(module, ckpt, tier.strip(), args.prompt_len, args.tokens,
-                           offload_folder=offload, prompt_lookup=args.prompt_lookup)
+                           offload_folder=offload, prompt_lookup=args.prompt_lookup,
+                           assisted=args.assisted)
             )
 
     print(f"\n{args.family}-{args.size} ({n_params/1e6:.0f}M params), "
           f"prompt={args.prompt_len}, platform={platform}\n")
     with_lookup = any(r.get("lookup_s_per_token") for r in rows)
+    with_assist = any(r.get("assisted_s_per_token") for r in rows)
     lk_head = " Prompt-lookup /token |" if with_lookup else ""
     lk_sep = ":---:|" if with_lookup else ""
+    as_head = " Assisted /token |" if with_assist else ""
+    as_sep = ":---:|" if with_assist else ""
     print("| Placement | Load time | First call (compile) | KV decode /token "
-          f"| No-cache /token | HBM resident |{lk_head}")
-    print(f"|:---------:|:---------:|:-----------:|:----------------:|:---------------:|:------------:|{lk_sep}")
+          f"| No-cache /token | HBM resident |{lk_head}{as_head}")
+    print(f"|:---------:|:---------:|:-----------:|:----------------:|:---------------:|:------------:|{lk_sep}{as_sep}")
     for r in rows:
         nc = f"{r['nocache_s_per_token']:.3f}s" if r["nocache_s_per_token"] else "-"
-        lk = ""
-        if with_lookup:
-            v = r.get("lookup_s_per_token")
-            lk = f" {v*1000:.1f}ms |" if v else " - |"
+
+        def spec_cell(key, on):
+            if not on:
+                return ""
+            v = r.get(key)
+            return f" {v*1000:.1f}ms |" if v else " - |"
+
+        lk = spec_cell("lookup_s_per_token", with_lookup)
+        asst = spec_cell("assisted_s_per_token", with_assist)
         print(f"| {r['tier']} | {r['load_s']:.1f}s | {r['first_call_s']:.2f}s "
               f"| {r['kv_s_per_token']*1000:.1f}ms | {nc} "
-              f"| {r['hbm_resident_bytes']/2**30:.2f}GiB |{lk}")
+              f"| {r['hbm_resident_bytes']/2**30:.2f}GiB |{lk}{asst}")
     print()
     print(json.dumps({"metric": "big_model_kv_decode_s_per_token",
                       "size": args.size, "family": args.family,
