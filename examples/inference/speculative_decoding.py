@@ -1,14 +1,15 @@
-"""Prompt-lookup speculative decoding (net-new vs the reference, whose
-users reach the same capability through transformers'
-``prompt_lookup_num_tokens``).
+"""Speculative decoding, both flavors (net-new vs the reference, whose
+users reach the same capabilities through transformers'
+``prompt_lookup_num_tokens`` / ``assistant_model=``).
 
-Greedy decoding where each step drafts the continuation of the most recent
-earlier occurrence of the last n-gram and verifies the whole draft in ONE
-cached forward — the output is exactly the plain greedy output, reached in
-fewer, wider (MXU-friendlier) steps wherever the text repeats itself.
-Demonstrates both the fully-compiled path (`prompt_lookup_generate`) and
-the weight-streaming executor (`StreamedModel.generate(
-prompt_lookup_num_tokens=...)`), and checks the exact-equality contract.
+Prompt-lookup drafts the continuation of the most recent earlier
+occurrence of the last n-gram; draft-model speculation asks a small
+same-vocabulary model instead. Either way the target verifies the whole
+draft in ONE cached forward, so the output is exactly the plain greedy
+output, reached in fewer, wider (MXU-friendlier) steps. Demonstrates the
+fully-compiled paths (`prompt_lookup_generate`, `assisted_generate`) and
+the weight-streaming executor (both drafters), and checks the
+exact-equality contract everywhere.
 """
 
 import sys
@@ -22,7 +23,7 @@ import numpy as np
 
 import jax.numpy as jnp
 
-from accelerate_tpu import generate, prompt_lookup_generate
+from accelerate_tpu import assisted_generate, generate, prompt_lookup_generate
 from accelerate_tpu.utils import set_seed
 
 
@@ -47,6 +48,17 @@ def main():
     print("compiled path: speculative output == greedy output "
           f"({spec.shape[1] - ids.shape[1]} tokens)")
 
+    # Draft-model speculation: a smaller same-vocabulary model proposes the
+    # chunks (here a 1-layer sibling — in practice a distilled draft).
+    import dataclasses
+
+    draft = LlamaForCausalLM(dataclasses.replace(cfg, num_hidden_layers=1))
+    draft_params = draft.init_params(jax.random.PRNGKey(7), batch_size=1, seq_len=8)
+    spec = assisted_generate(model, params, draft, draft_params, ids,
+                             max_new_tokens=24, num_draft=5, cache_dtype=jnp.float32)
+    assert np.array_equal(np.asarray(ref), np.asarray(spec)), "assisted must be target-exact"
+    print("compiled path: assisted (draft-model) output == greedy output")
+
     # Streamed executor: weights stream once per ACCEPTED RUN, not per
     # token — the win scales with how much of the per-token latency is
     # weight traffic (cpu/disk tiers).
@@ -68,8 +80,12 @@ def main():
         spec = streamed.generate(np.asarray(ids), max_new_tokens=14,
                                  prompt_lookup_num_tokens=4)
         assert np.array_equal(np.asarray(plain), np.asarray(spec))
+        assisted = streamed.generate(np.asarray(ids), max_new_tokens=14,
+                                     assistant_module=draft,
+                                     assistant_params=draft_params, num_draft=4)
+        assert np.array_equal(np.asarray(plain), np.asarray(assisted))
         streamed.close()
-    print("streamed path: speculative output == greedy output (disk tier)")
+    print("streamed path: both drafters == greedy output (disk tier)")
     print("speculative decoding example: OK")
 
 
