@@ -1,0 +1,72 @@
+"""Gradient-communication precision (reference:
+examples/by_feature/ddp_comm_hook.py — DDP's fp16/bf16 compress hooks).
+
+The reference registers a DDP communication hook that compresses gradient
+buckets to bf16 before the NCCL all-reduce. There is no hook to register
+here — gradients cross the dp axis through the all-reduce GSPMD inserts in
+the fused step — so the same capability is a compile-time choice:
+``compile_train_step(grad_reduce_dtype=jnp.bfloat16)`` differentiates with
+respect to the compute-cast parameters, keeping cotangents (and therefore
+the inserted collective) in bf16 and upcasting to fp32 only after the
+reduction, for clipping and the optimizer. Same accuracy trade as the
+torch hook: the cross-replica sum runs narrow, master weights stay fp32.
+
+This example trains the shared classifier twice — fp32 vs bf16 gradient
+reductions — and shows the loss trajectories track.
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from accelerate_tpu import Accelerator, Model
+from accelerate_tpu.data_loader import make_global_batch
+from accelerate_tpu.models.bert import classification_loss
+from accelerate_tpu.utils import set_seed
+from example_lib import build_model, common_parser, get_dataloaders
+
+
+def train_once(args, grad_reduce_dtype):
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    for cls in (AcceleratorState, GradientState, PartialState):
+        cls._reset_state()
+    set_seed(args.seed)
+    accelerator = Accelerator(mixed_precision=args.mixed_precision or "bf16")
+    model_def, params = build_model(args.seed)
+    train_dl, _ = get_dataloaders(args.batch_size)
+    model, optimizer, train_dl = accelerator.prepare(
+        Model(model_def, params), optax.adamw(args.lr), train_dl
+    )
+    step = accelerator.compile_train_step(
+        classification_loss(model_def.apply), grad_reduce_dtype=grad_reduce_dtype
+    )
+    losses = []
+    for epoch in range(args.epochs):
+        for batch in train_dl:
+            losses.append(float(step(make_global_batch(batch, accelerator.mesh))["loss"]))
+    return accelerator, losses
+
+
+def training_function(args):
+    acc, base = train_once(args, None)
+    _, narrow = train_once(args, jnp.bfloat16)
+    acc.print(f"fp32 reductions:  first {base[0]:.4f}  last {base[-1]:.4f}")
+    acc.print(f"bf16 reductions:  first {narrow[0]:.4f}  last {narrow[-1]:.4f}")
+    drift = max(abs(a - b) for a, b in zip(base, narrow))
+    acc.print(f"max per-step loss drift: {drift:.5f} (gradient wire traffic halved)")
+    assert drift < 0.1, "bf16 gradient reductions must track fp32 closely"
+
+
+def main():
+    parser = common_parser(__doc__)
+    training_function(parser.parse_args())
+
+
+if __name__ == "__main__":
+    main()

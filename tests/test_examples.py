@@ -33,6 +33,7 @@ SCRIPTS = {
     "tracking.py": [],            # project_dir injected per-test
     "fsdp_with_peak_mem_tracking.py": ["--cpu_offload", "--activation_checkpointing"],
     "cross_validation.py": ["--num_folds", "2"],
+    "ddp_comm_hook.py": [],
     "schedule_free.py": [],
     "deepspeed_with_config_support.py": [],
     "megatron_lm_gpt_pretraining.py": ["--tp", "2", "--pp", "2", "--steps", "4"],
