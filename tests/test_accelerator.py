@@ -368,6 +368,28 @@ class TestGradReduceDtype:
         for a, b in zip(base, narrow):
             assert abs(a - b) < 0.05 * max(abs(a), 1e-3), (base, narrow)
 
+    def test_composes_with_accumulation_and_clip(self):
+        """Narrow reductions must survive the in-executable accumulation
+        scan (bf16 microbatch grads, fp32 accumulator) and grad clipping."""
+        from accelerate_tpu import MeshConfig
+        from accelerate_tpu.data_loader import make_global_batch
+        from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+        for cls in (AcceleratorState, GradientState, PartialState):
+            cls._reset_state()
+        acc = Accelerator(mixed_precision="bf16",
+                          mesh_config=MeshConfig(dp=jax.device_count()))
+        model, opt = acc.prepare(Model(mlp_apply, init_mlp()), optax.adamw(1e-2))
+        step = acc.compile_train_step(mse_loss, accumulation_steps=2,
+                                      max_grad_norm=1.0,
+                                      grad_reduce_dtype=jnp.bfloat16)
+        data = make_regression_data(n=jax.device_count() * 8)
+        x = np.stack([d["x"] for d in data]).reshape(2, -1, 4)
+        y = np.stack([d["y"] for d in data]).reshape(2, -1, 1)
+        batch = make_global_batch({"x": x, "y": y}, acc.mesh)
+        losses = [float(step(batch)["loss"]) for _ in range(5)]
+        assert losses[-1] < losses[0], losses
+
 
 class TestRematPolicy:
     def test_resolve_names(self):
