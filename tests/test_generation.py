@@ -443,6 +443,21 @@ class TestAssistedGenerate:
                                          rng=jax.random.PRNGKey(1), **kw))
         np.testing.assert_array_equal(a, b)
 
+    @pytest.mark.parametrize("S,mnt,K", [(1, 8, 5), (3, 1, 5), (2, 2, 7), (5, 3, 1)])
+    def test_edge_lengths_stay_exact(self, S, mnt, K):
+        """One-token prompts, single-token generations, K > max_new_tokens
+        (overshoot commits capped) — every corner stays target-exact."""
+        from accelerate_tpu.generation import assisted_generate, generate
+
+        target, tp, draft, dp, cfg = self._pair()
+        ids = (np.arange(S, dtype=np.int32)[None] * 13 + 2) % cfg.vocab_size
+        ref = np.asarray(generate(target, tp, jnp.asarray(ids), max_new_tokens=mnt,
+                                  cache_dtype=jnp.float32))
+        got = np.asarray(assisted_generate(target, tp, draft, dp, jnp.asarray(ids),
+                                           max_new_tokens=mnt, num_draft=K,
+                                           cache_dtype=jnp.float32))
+        np.testing.assert_array_equal(got, ref)
+
     def test_input_validation(self):
         import dataclasses
 
