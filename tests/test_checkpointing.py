@@ -235,7 +235,8 @@ class TestTracking:
         acc.log({"loss": 1.0}, step=1)
         tracker = acc.get_tracker("jsonl")
         acc.end_training()
-        lines = [json.loads(l) for l in open(tracker.path)]
+        with open(tracker.path) as fh:
+            lines = [json.loads(l) for l in fh]
         assert lines[0]["_type"] == "config" and lines[0]["config"]["lr"] == 0.05
         assert lines[2]["loss"] == 1.0 and lines[2]["step"] == 1
 

@@ -112,7 +112,8 @@ def test_save_pickle_and_safetensors(tmp_path):
     obj = {"x": [1, 2, 3]}
     p = tmp_path / "obj.pkl"
     save(obj, p)
-    assert pickle.load(open(p, "rb")) == obj
+    with open(p, "rb") as fh:
+        assert pickle.load(fh) == obj
 
     sd = {"w": jnp.arange(4.0)}
     sp = tmp_path / "sd.safetensors"
@@ -128,7 +129,8 @@ def test_save_accepts_file_objects(tmp_path):
     obj = {"x": 1}
     with open(tmp_path / "o.pkl", "wb") as fh:
         save(obj, fh)
-    assert pickle.load(open(tmp_path / "o.pkl", "rb")) == obj
+    with open(tmp_path / "o.pkl", "rb") as fh2:
+        assert pickle.load(fh2) == obj
 
     buf = io.BytesIO()
     save({"w": jnp.ones((2,))}, buf, safe_serialization=True)
