@@ -277,6 +277,10 @@ def block_specs_for(module) -> Optional[list[BlockSpec]]:
         return _opt_block_specs(module.config)
     if isinstance(module, PhiForCausalLM):
         return _phi_block_specs(module.config)
+    from .models.bloom import BloomForCausalLM
+
+    if isinstance(module, BloomForCausalLM):
+        return _bloom_block_specs(module.config)
     if isinstance(module, T5ForConditionalGeneration):
         return _t5_block_specs(module.config)
     return None
@@ -401,6 +405,7 @@ def cache_factory_for(module) -> Optional[Callable]:
     families with cache threading; None otherwise. Layer caches pair, in
     order, with the specs marked ``cache_slot=True`` (``kind == "layer"`` is
     honored as a legacy alias for externally-built spec lists)."""
+    from .models.bloom import BloomForCausalLM
     from .models.gpt2 import GPT2LMHeadModel
     from .models.gpt_neox import GPTNeoXForCausalLM
     from .models.gptj import GPTJForCausalLM
@@ -411,7 +416,7 @@ def cache_factory_for(module) -> Optional[Callable]:
 
     if isinstance(module, (LlamaForCausalLM, GPT2LMHeadModel, MixtralForCausalLM,
                            GPTJForCausalLM, GPTNeoXForCausalLM, OPTForCausalLM,
-                           PhiForCausalLM)):
+                           PhiForCausalLM, BloomForCausalLM)):
         cfg = module.config  # non-Llama configs duck-type the kv-cache fields
 
         def factory(batch, max_len, dtype=jnp.bfloat16, ring_slack=0):
@@ -586,6 +591,24 @@ def _phi_block_specs(cfg) -> list[BlockSpec]:
 
     return _gptlike_block_specs(cfg, PhiBlock(cfg), "layers_{i}", ("embed_tokens",), embed,
                                 ("final_layernorm", "lm_head"), head)
+
+
+def _bloom_block_specs(cfg) -> list[BlockSpec]:
+    import flax.linen as nn
+    from .models.bloom import BloomBlock
+
+    def embed(ptrees, input_ids, pos):
+        x = ptrees[0]["embedding"][input_ids]
+        return nn.LayerNorm(epsilon=cfg.layer_norm_epsilon).apply(
+            {"params": ptrees[1]}, x)
+
+    def head(ptrees, x):
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon).apply({"params": ptrees[0]}, x)
+        return h @ ptrees[1]["embedding"].T.astype(h.dtype)  # tied
+
+    return _gptlike_block_specs(cfg, BloomBlock(cfg), "layers_{i}",
+                                ("word_embeddings", "word_embeddings_layernorm"),
+                                embed, ("ln_f", "word_embeddings"), head)
 
 
 def _mixtral_block_specs(cfg) -> list[BlockSpec]:
@@ -1502,7 +1525,8 @@ def load_hf_checkpoint_and_dispatch(
 
     family, config, module = open_hf_checkpoint(checkpoint_dir, config)
     streamable = ("llama", "mistral", "qwen2", "qwen2_moe", "gemma", "gemma2",
-                  "gpt2", "gptj", "gpt_neox", "opt", "phi", "t5", "mixtral")
+                  "gpt2", "gptj", "gpt_neox", "bloom", "opt", "phi", "t5",
+                  "mixtral")
     if family not in streamable:
         raise ValueError(
             f"streamed dispatch supports {'/'.join(streamable)} (got "

@@ -1,3 +1,4 @@
+from .bloom import BloomConfig, BloomForCausalLM
 from .bert import BertConfig, BertForSequenceClassification, classification_loss
 from .gpt2 import GPT2Config, GPT2LMHeadModel
 from .llama import LlamaConfig, LlamaForCausalLM, LlamaModel, PipelinedLlamaForCausalLM, causal_lm_loss

@@ -1,0 +1,149 @@
+"""BLOOM decoder (BigScience) — ALiBi position bias instead of rotary
+(reference's big-model stack loads any HF family via hooks; this adds the
+ALiBi architecture class to the bridge: utils/hf_interop.py).
+
+Architecture (HF ``BloomForCausalLM`` parity): word embeddings followed by
+an embedding LayerNorm, pre-LN blocks with a per-head fused ``[q|k|v]``
+projection (bias=True throughout), ALiBi attention bias
+``slope_h * key_position`` (no position embeddings of any kind), tanh-gelu
+MLP (h → 4h → h), tied LM head.
+
+ALiBi rides the shared cached-attention core (models/llama.py
+``alibi_slopes``): the bias depends only on the ABSOLUTE key position, so
+KV-cached decode adds it from the cache's stored positions — ring caches
+included — and softmax's per-row shift-invariance makes it equal to the
+relative ``slope * (j - i)`` form. Flash attention is not wired for this
+family (the Pallas kernel has no bias input); attention runs on the
+grouped-einsum path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from .llama import _grouped_cached_attention, update_kv_cache_and_attend
+
+
+@dataclasses.dataclass
+class BloomConfig:
+    vocab_size: int = 250880
+    hidden_size: int = 1024
+    num_hidden_layers: int = 24
+    num_attention_heads: int = 16
+    layer_norm_epsilon: float = 1e-5
+    # ALiBi needs no position table; bound is "unlimited" for bookkeeping.
+    max_position_embeddings: int | None = None
+    sliding_window: int | None = None  # duck-types init_kv_cache (full caches)
+
+    @classmethod
+    def tiny(cls, **overrides):
+        cfg = cls(vocab_size=256, hidden_size=64, num_hidden_layers=2,
+                  num_attention_heads=4)
+        return dataclasses.replace(cfg, **overrides)
+
+    @property
+    def head_dim(self):
+        """Per-head width: hidden_size // num_attention_heads."""
+        return self.hidden_size // self.num_attention_heads
+
+    @property
+    def intermediate_size(self):
+        """BLOOM's MLP is a fixed 4x expansion."""
+        return 4 * self.hidden_size
+
+    @property
+    def num_key_value_heads(self):
+        """KV head count (no GQA); duck-types llama.init_kv_cache."""
+        return self.num_attention_heads
+
+
+def alibi_slopes(n_heads: int) -> jnp.ndarray:
+    """Per-head ALiBi slopes, HF/paper formula incl. the non-power-of-two
+    interleave: the closest power of two gets the geometric ladder
+    2^(-8/n), extra heads take the odd steps of the 2n ladder."""
+    closest = 2 ** math.floor(math.log2(n_heads))
+    base = 2.0 ** (-(2.0 ** -(math.log2(closest) - 3)))
+    slopes = [base ** (i + 1) for i in range(closest)]
+    if closest < n_heads:
+        extra_base = 2.0 ** (-(2.0 ** -(math.log2(2 * closest) - 3)))
+        slopes += [extra_base ** (2 * i + 1) for i in range(n_heads - closest)]
+    return jnp.asarray(slopes, jnp.float32)
+
+
+class BloomBlock(nn.Module):
+    """BLOOM layer; ``cache``/``cache_pos`` switch to KV-cached decode (same
+    threading contract as LlamaBlock)."""
+
+    config: BloomConfig
+
+    @nn.compact
+    def __call__(self, x, cache=None, cache_pos=None):
+        cfg = self.config
+        B, S, _ = x.shape
+        H, D = cfg.num_attention_heads, cfg.head_dim
+        dense = lambda n, name: nn.Dense(n, name=name, dtype=x.dtype,
+                                         param_dtype=jnp.float32)
+        slopes = alibi_slopes(H)
+
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, name="input_layernorm",
+                         param_dtype=jnp.float32)(x)
+        # HF fuses QKV per head: view(B, S, H, 3, D).
+        qkv = dense(3 * H * D, "query_key_value")(h).reshape(B, S, H, 3, D)
+        q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
+
+        new_cache = None
+        if cache is not None:
+            attn, new_cache = update_kv_cache_and_attend(
+                cache, q, k, v, cache_pos, 1, alibi_slopes=slopes)
+        else:
+            pos = jnp.arange(S, dtype=jnp.int32)
+            mask = pos[None, :] <= pos[:, None]                    # causal [S, S]
+            attn = _grouped_cached_attention(
+                q, k, v, mask[None], 1, alibi_slopes=slopes, k_positions=pos)
+        attn = dense(cfg.hidden_size, "dense")(attn.reshape(B, S, H * D))
+        x = x + attn
+
+        h2 = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon,
+                          name="post_attention_layernorm", param_dtype=jnp.float32)(x)
+        # BloomGelu is the tanh approximation.
+        mlp = dense(cfg.hidden_size, "dense_4h_to_h")(
+            jax.nn.gelu(dense(cfg.intermediate_size, "dense_h_to_4h")(h2),
+                        approximate=True)
+        )
+        out = x + mlp
+        return out if cache is None else (out, new_cache)
+
+
+class BloomForCausalLM(nn.Module):
+    config: BloomConfig
+
+    @nn.compact
+    def __call__(self, input_ids, cache=None, cache_pos=None):
+        cfg = self.config
+        embed = nn.Embed(cfg.vocab_size, cfg.hidden_size, name="word_embeddings",
+                         param_dtype=jnp.float32)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon,
+                         name="word_embeddings_layernorm",
+                         param_dtype=jnp.float32)(embed(input_ids))
+        new_caches = []
+        for i in range(cfg.num_hidden_layers):
+            if cache is None:
+                x = BloomBlock(cfg, name=f"layers_{i}")(x)
+            else:
+                x, layer_cache = BloomBlock(cfg, name=f"layers_{i}")(
+                    x, cache=cache[i], cache_pos=cache_pos)
+                new_caches.append(layer_cache)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, name="ln_f",
+                         param_dtype=jnp.float32)(x)
+        logits = x @ embed.embedding.T.astype(x.dtype)  # tied head
+        return logits if cache is None else (logits, tuple(new_caches))
+
+    def init_params(self, rng, batch_size=1, seq_len=8):
+        """Initialize a parameter pytree from a PRNG key (shape-driving args are traced-free)."""
+        dummy = jnp.zeros((batch_size, seq_len), jnp.int32)
+        return self.init(rng, dummy)["params"]

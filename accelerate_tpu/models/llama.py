@@ -402,7 +402,7 @@ def init_kv_cache(config: "LlamaConfig", batch_size: int, max_len: int, dtype=jn
 
 
 def _cached_attention(q, k_all, v_all, cache_pos, n_rep: int, sliding_window=None,
-                      sm_scale=None, logit_softcap=None):
+                      sm_scale=None, logit_softcap=None, alibi_slopes=None):
     """Attention of q [B, S, H, hd] against the full cache [B, L, n_kv, hd].
 
     Valid keys are those at global index <= cache_pos + (local query index):
@@ -422,11 +422,12 @@ def _cached_attention(q, k_all, v_all, cache_pos, n_rep: int, sliding_window=Non
     if sliding_window is not None:
         mask &= k_pos > q_pos[:, None] - sliding_window
     return _grouped_cached_attention(q, k_all, v_all, mask[None], n_rep,
-                                     sm_scale=sm_scale, logit_softcap=logit_softcap)
+                                     sm_scale=sm_scale, logit_softcap=logit_softcap,
+                                     alibi_slopes=alibi_slopes, k_positions=k_pos[0])
 
 
 def _ring_cached_attention(q, cache, cache_pos, n_rep: int, window: int,
-                           sm_scale=None, logit_softcap=None):
+                           sm_scale=None, logit_softcap=None, alibi_slopes=None):
     """Ring-cache decode: validity comes from the per-slot ``pos`` buffer —
     a slot is visible iff it has been written (pos >= 0), is not in the
     query's future, and lies inside the window."""
@@ -439,16 +440,23 @@ def _ring_cached_attention(q, cache, cache_pos, n_rep: int, window: int,
         & (slot_pos[:, None, :] > q_pos[None, :, None] - window)
     )  # [B, S, W]
     return _grouped_cached_attention(q, cache["k"], cache["v"], mask, n_rep,
-                                     sm_scale=sm_scale, logit_softcap=logit_softcap)
+                                     sm_scale=sm_scale, logit_softcap=logit_softcap,
+                                     alibi_slopes=alibi_slopes, k_positions=slot_pos)
 
 
 def _grouped_cached_attention(q, k_all, v_all, mask, n_rep: int,
-                              sm_scale=None, logit_softcap=None):
+                              sm_scale=None, logit_softcap=None,
+                              alibi_slopes=None, k_positions=None):
     """Shared cached-attention core: q [B, S, H, hd] against [B, L, n_kv, hd]
     with a caller-built validity mask [B or 1, S, L]. GQA is a *grouped*
     einsum — queries reshape to [B, S, n_kv, rep, hd] and contract directly
     against the unrepeated cache, so per-token HBM traffic scales with n_kv,
-    never with a materialized n_q-wide K/V copy."""
+    never with a materialized n_q-wide K/V copy.
+
+    ``alibi_slopes`` [H] adds BLOOM-style position bias slope_h * key_pos
+    (``k_positions`` [L] or [B, L] — absolute stored positions; softmax is
+    per-row shift-invariant, so this equals the relative slope*(j-i) form).
+    """
     from ..ops.attention import softcap_logits
 
     B, S, H, hd = q.shape
@@ -456,6 +464,11 @@ def _grouped_cached_attention(q, k_all, v_all, mask, n_rep: int,
     qg = (q * scale).astype(jnp.float32).reshape(B, S, H // n_rep, n_rep, hd)
     logits = jnp.einsum("bqgrd,bkgd->bgrqk", qg, k_all.astype(jnp.float32))
     logits = softcap_logits(logits, logit_softcap)
+    if alibi_slopes is not None:
+        sl = alibi_slopes.astype(jnp.float32).reshape(H // n_rep, n_rep)
+        kp = k_positions.astype(jnp.float32)
+        kp = kp[None, None, None, None, :] if kp.ndim == 1 else kp[:, None, None, None, :]
+        logits = logits + sl[None, :, :, None, None] * kp
     # logits: [B, G, rep, S, L] <- mask broadcast over the two head dims.
     logits = jnp.where(mask[:, None, None, :, :], logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1)
@@ -464,7 +477,7 @@ def _grouped_cached_attention(q, k_all, v_all, mask, n_rep: int,
 
 
 def update_kv_cache_and_attend(cache, q, k, v, cache_pos, n_rep: int, sliding_window=None,
-                               sm_scale=None, logit_softcap=None):
+                               sm_scale=None, logit_softcap=None, alibi_slopes=None):
     """Write this call's K/V into the cache at ``cache_pos`` and attend q
     against the whole buffer. Shared by every cached attention (Llama, GPT-2).
     Returns (out [B,S,H,hd], new_cache).
@@ -482,7 +495,7 @@ def update_kv_cache_and_attend(cache, q, k, v, cache_pos, n_rep: int, sliding_wi
         }
         out = _cached_attention(q, new_cache["k"], new_cache["v"], cache_pos, n_rep,
                                 sliding_window=sliding_window, sm_scale=sm_scale,
-                                logit_softcap=logit_softcap)
+                                logit_softcap=logit_softcap, alibi_slopes=alibi_slopes)
         return out, new_cache
 
     window = cache["k"].shape[1]
@@ -517,7 +530,8 @@ def update_kv_cache_and_attend(cache, q, k, v, cache_pos, n_rep: int, sliding_wi
             & (pos_comb[:, None, :] > q_pos[None, :, None] - eff_window)
         )  # [B, S, W+S]
         out = _grouped_cached_attention(q, k_comb, v_comb, mask, n_rep,
-                                        sm_scale=sm_scale, logit_softcap=logit_softcap)
+                                        sm_scale=sm_scale, logit_softcap=logit_softcap,
+                                        alibi_slopes=alibi_slopes, k_positions=pos_comb)
         # Scatter the last `window` entries (unique slots) into the ring.
         take = min(S, window)
         idx = cache_pos + jnp.arange(S - take, S, dtype=jnp.int32)   # global positions
@@ -540,7 +554,8 @@ def update_kv_cache_and_attend(cache, q, k, v, cache_pos, n_rep: int, sliding_wi
     }
     out = _ring_cached_attention(q, new_cache, cache_pos, n_rep,
                                  window=min(sliding_window or window, window),
-                                 sm_scale=sm_scale, logit_softcap=logit_softcap)
+                                 sm_scale=sm_scale, logit_softcap=logit_softcap,
+                                 alibi_slopes=alibi_slopes)
     return out, new_cache
 
 

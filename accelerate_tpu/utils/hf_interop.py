@@ -9,7 +9,7 @@ name/layout translation between HF state dicts (torch conventions:
 ``Linear.weight`` is ``(out, in)``, dot-separated names) and our param
 pytrees (flax: ``kernel`` is ``(in, out)``, nested dicts).
 
-Supported families mirror ``accelerate_tpu.models``: llama, mixtral, gpt2,
+Supported families mirror ``accelerate_tpu.models``: llama, mixtral, bloom, gpt2,
 bert, t5. Each family is a table of bidirectional rules; conversion is pure
 numpy (no torch import needed when reading safetensors).
 
@@ -116,6 +116,32 @@ _GPT2_RULES = [
     ("h.{i}.mlp.c_fc.bias", "h_{i}/fc1/bias", "copy", None),
     ("h.{i}.mlp.c_proj.weight", "h_{i}/fc2/kernel", "copy", None),
     ("h.{i}.mlp.c_proj.bias", "h_{i}/fc2/bias", "copy", None),
+    ("ln_f.weight", "ln_f/scale", "copy", None),
+    ("ln_f.bias", "ln_f/bias", "copy", None),
+]
+
+_BLOOM_RULES = [
+    ("word_embeddings.weight", "word_embeddings/embedding", "copy", None),
+    ("word_embeddings_layernorm.weight", "word_embeddings_layernorm/scale", "copy", None),
+    ("word_embeddings_layernorm.bias", "word_embeddings_layernorm/bias", "copy", None),
+    ("h.{i}.input_layernorm.weight", "layers_{i}/input_layernorm/scale", "copy", None),
+    ("h.{i}.input_layernorm.bias", "layers_{i}/input_layernorm/bias", "copy", None),
+    # Fused per-head QKV: output dim is H blocks of [q|k|v] (3D) — the
+    # HF view(B, S, H, 3, D) layout survives a plain transpose.
+    ("h.{i}.self_attention.query_key_value.weight",
+     "layers_{i}/query_key_value/kernel", "t", None),
+    ("h.{i}.self_attention.query_key_value.bias",
+     "layers_{i}/query_key_value/bias", "copy", None),
+    ("h.{i}.self_attention.dense.weight", "layers_{i}/dense/kernel", "t", None),
+    ("h.{i}.self_attention.dense.bias", "layers_{i}/dense/bias", "copy", None),
+    ("h.{i}.post_attention_layernorm.weight",
+     "layers_{i}/post_attention_layernorm/scale", "copy", None),
+    ("h.{i}.post_attention_layernorm.bias",
+     "layers_{i}/post_attention_layernorm/bias", "copy", None),
+    ("h.{i}.mlp.dense_h_to_4h.weight", "layers_{i}/dense_h_to_4h/kernel", "t", None),
+    ("h.{i}.mlp.dense_h_to_4h.bias", "layers_{i}/dense_h_to_4h/bias", "copy", None),
+    ("h.{i}.mlp.dense_4h_to_h.weight", "layers_{i}/dense_4h_to_h/kernel", "t", None),
+    ("h.{i}.mlp.dense_4h_to_h.bias", "layers_{i}/dense_4h_to_h/bias", "copy", None),
     ("ln_f.weight", "ln_f/scale", "copy", None),
     ("ln_f.bias", "ln_f/bias", "copy", None),
 ]
@@ -395,6 +421,7 @@ _FAMILY_RULES = {
     "gpt2": _GPT2_RULES,
     "gptj": _GPTJ_RULES,
     "gpt_neox": _GPT_NEOX_RULES,
+    "bloom": _BLOOM_RULES,
     "opt": _OPT_RULES,
     "phi": _PHI_RULES,
     "bert": _BERT_RULES,
@@ -407,6 +434,7 @@ _STRIP_PREFIXES = {
     "gpt2": ("transformer.",),
     "gptj": ("transformer.",),
     "gpt_neox": ("gpt_neox.",),
+    "bloom": ("transformer.",),
     "opt": ("model.decoder.", "decoder."),
     "phi": ("model.",),
     "bert": ("bert.",),
@@ -716,6 +744,20 @@ def config_from_hf(hf_config: dict, family: Optional[str] = None):
             hidden_act=act,
             layer_norm_eps=get("layer_norm_eps", 1e-5),
         )
+    if family == "bloom":
+        from ..models.bloom import BloomConfig
+
+        if get("slow_but_exact"):
+            raise NotImplementedError(
+                "slow_but_exact BLOOM inference reorders the matmul "
+                "accumulation; the flax forward is the standard path")
+        return BloomConfig(
+            vocab_size=get("vocab_size", 250880),
+            hidden_size=get("hidden_size", get("n_embed", 1024)),
+            num_hidden_layers=get("n_layer", get("num_hidden_layers", 24)),
+            num_attention_heads=get("n_head", get("num_attention_heads", 16)),
+            layer_norm_epsilon=get("layer_norm_epsilon", 1e-5),
+        )
     if family == "gpt_neox":
         from ..models.gpt_neox import GPTNeoXConfig
 
@@ -843,6 +885,10 @@ def model_from_config(config, family: str):
         from ..models.gpt_neox import GPTNeoXForCausalLM
 
         return GPTNeoXForCausalLM(config)
+    if family == "bloom":
+        from ..models.bloom import BloomForCausalLM
+
+        return BloomForCausalLM(config)
     if family == "opt":
         from ..models.opt import OPTForCausalLM
 

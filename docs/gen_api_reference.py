@@ -58,7 +58,8 @@ GROUPS = [
     ("models", "Model zoo",
      ["accelerate_tpu.models.llama", "accelerate_tpu.models.mixtral",
       "accelerate_tpu.models.gpt2", "accelerate_tpu.models.gptj",
-      "accelerate_tpu.models.gpt_neox", "accelerate_tpu.models.opt",
+      "accelerate_tpu.models.gpt_neox", "accelerate_tpu.models.bloom",
+      "accelerate_tpu.models.opt",
       "accelerate_tpu.models.phi",
       "accelerate_tpu.models.bert", "accelerate_tpu.models.t5",
       "accelerate_tpu.models.vit", "accelerate_tpu.models.resnet"],

@@ -244,6 +244,63 @@ class TestGPTJ:
         _roundtrip(params, "gptj", hf.state_dict(), prefix="transformer.")
 
 
+class TestBloom:
+    """BLOOM: ALiBi position bias (no position embeddings at all), fused
+    per-head QKV with biases, embedding LayerNorm, tanh-gelu MLP, tied head
+    — the ALiBi architecture class of the HF bridge."""
+
+    def _pair(self):
+        hf_cfg = transformers.BloomConfig(
+            vocab_size=96, hidden_size=32, n_layer=2, n_head=4,
+            hidden_dropout=0.0, attention_dropout=0.0)
+        torch.manual_seed(0)
+        with torch.no_grad():
+            hf = transformers.BloomForCausalLM(hf_cfg).eval()
+        assert detect_family(hf_cfg.to_dict()) == "bloom"
+        cfg = config_from_hf(hf_cfg.to_dict())
+        assert cfg.num_attention_heads == 4 and cfg.hidden_size == 32
+        from accelerate_tpu.models.bloom import BloomForCausalLM
+
+        params = convert_hf_state_dict(hf.state_dict(), "bloom", strict=True)
+        return hf, BloomForCausalLM(cfg), params
+
+    def test_forward_parity(self):
+        hf, model, params = self._pair()
+        ids = (np.arange(20, dtype=np.int64).reshape(2, 10) * 3) % 96
+        ours = model.apply({"params": params}, jnp.asarray(ids, jnp.int32))
+        with torch.no_grad():
+            theirs = hf(torch.from_numpy(ids)).logits
+        _logits_close(ours, theirs)
+
+    def test_greedy_decode_parity(self):
+        hf, model, params = self._pair()
+        from accelerate_tpu.generation import generate
+
+        ids = np.array([[5, 17, 3, 29, 11]], dtype=np.int64)
+        ours = generate(model, params, jnp.asarray(ids, jnp.int32), max_new_tokens=8,
+                        cache_dtype=jnp.float32)
+        with torch.no_grad():
+            theirs = hf.generate(torch.from_numpy(ids), max_new_tokens=8,
+                                 do_sample=False)
+        np.testing.assert_array_equal(np.asarray(ours), theirs.numpy())
+
+    def test_alibi_slopes_match_hf(self):
+        from transformers.models.bloom.modeling_bloom import build_alibi_tensor
+
+        from accelerate_tpu.models.bloom import alibi_slopes
+
+        for n in (4, 6, 16):  # incl. a non-power-of-two head count
+            mask = torch.ones((1, 5))
+            hf_alibi = build_alibi_tensor(mask, n, torch.float32)  # [n, 1, 5]
+            # HF's tensor is slopes x position; position 1 column = slopes.
+            np.testing.assert_allclose(
+                np.asarray(alibi_slopes(n)), hf_alibi[:, 0, 1].numpy(), rtol=1e-6)
+
+    def test_roundtrip(self):
+        hf, _, params = self._pair()
+        _roundtrip(params, "bloom", hf.state_dict(), prefix="transformer.")
+
+
 class TestGPTNeoX:
     """GPT-NeoX: fused per-head QKV + partial split-half rope + parallel
     residual + untied head (one of the reference's benchmark families)."""
@@ -895,7 +952,7 @@ class TestStreamedDispatch:
             theirs = hf(torch.from_numpy(ids)).logits
         _logits_close(ours, theirs)
 
-    @pytest.mark.parametrize("family", ["gptj", "gpt_neox", "opt", "phi"])
+    @pytest.mark.parametrize("family", ["gptj", "gpt_neox", "opt", "phi", "bloom"])
     def test_benchmark_families_stream_and_decode(self, tmp_path, family):
         """The reference's benchmark families (GPT-J / GPT-NeoX / OPT) run
         through the block-streaming executor off a raw HF dir: forward
@@ -926,6 +983,9 @@ class TestStreamedDispatch:
                 num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
                 max_position_embeddings=64, partial_rotary_factor=0.5,
                 resid_pdrop=0.0, embd_pdrop=0.0, attention_dropout=0.0)),
+            "bloom": lambda: transformers.BloomForCausalLM(transformers.BloomConfig(
+                vocab_size=96, hidden_size=32, n_layer=2, n_head=4,
+                hidden_dropout=0.0, attention_dropout=0.0)),
         }
         torch.manual_seed(0)
         with torch.no_grad():
