@@ -75,6 +75,14 @@ def build_and_save(size: str, ckpt_dir: str, family: str = "llama"):
                             max_position_embeddings=2048, use_flash_attention=False)
         module = GPTNeoXForCausalLM(cfg)
         params = module.init_params(jax.random.PRNGKey(0), batch_size=1, seq_len=8)
+    elif family == "bloom":
+        # ALiBi family: no position table at all.
+        from accelerate_tpu.models.bloom import BloomConfig, BloomForCausalLM
+
+        cfg = BloomConfig(vocab_size=vocab, hidden_size=h,
+                          num_hidden_layers=layers, num_attention_heads=heads)
+        module = BloomForCausalLM(cfg)
+        params = module.init_params(jax.random.PRNGKey(0), batch_size=1, seq_len=8)
     elif family == "opt":
         # Reference table rows :36-37 (OPT-30B, cpu/disk offload).
         from accelerate_tpu.models.opt import OPTConfig, OPTForCausalLM
@@ -199,7 +207,7 @@ def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--size", default="tiny", choices=sorted(SIZES))
     ap.add_argument("--family", default="llama",
-                choices=["llama", "t5", "gptj", "gpt_neox", "opt"])
+                choices=["llama", "t5", "gptj", "gpt_neox", "bloom", "opt"])
     ap.add_argument("--tiers", default="device,cpu")
     ap.add_argument("--tokens", type=int, default=16)
     ap.add_argument("--prompt-len", type=int, default=64)
