@@ -47,6 +47,32 @@ def model_flops_per_token(n_params: int, cfg, seq: int) -> float:
     return 6.0 * n_params + attn
 
 
+def _probe_summary() -> dict:
+    """Condense the watcher's probe history: how often the tunnel was
+    checked, when it was last up, and what ran in the up-windows."""
+    import bench_watch
+
+    probes = ups = 0
+    last_up = None
+    tiers: dict = {}
+    with open(bench_watch.HISTORY) as f:
+        for line in f:
+            try:
+                ev = json.loads(line)
+            except ValueError:
+                continue
+            kind = ev.get("event")
+            if kind == "probe":
+                probes += 1
+                if ev.get("up"):
+                    ups += 1
+                    last_up = ev.get("ts")
+            elif kind:
+                tiers[kind] = {"ok": ev.get("ok"), "ts": ev.get("ts")}
+    return {"probes": probes, "up_probes": ups, "last_up": last_up,
+            "latest_tier_outcomes": tiers}
+
+
 def sweep_block_defaults() -> tuple:
     """Close the sweep loop: once the watcher's on-chip flash block sweep
     has picked a best (block_q, block_k), later tier-1 runs use it instead
@@ -364,6 +390,13 @@ def main() -> int:
             traceback.print_exc(file=sys.stderr)
             errors.append(f"cpu smoke: {type(e).__name__}: {e}")
             result = {"metric": METRIC, "value": 0.0, "unit": "tokens/s/chip", "vs_baseline": 0.0}
+        # Attach the watcher's availability record: a CPU-smoke round
+        # artifact should say HOW unreachable the chip was, not just that
+        # one probe failed at capture time.
+        try:
+            result.setdefault("extra", {})["tunnel_availability"] = _probe_summary()
+        except Exception:  # noqa: BLE001 - context must never kill the bench
+            pass
     if errors:
         result["error"] = "; ".join(errors)
     print(json.dumps(result))
