@@ -368,6 +368,27 @@ class TestGradReduceDtype:
         for a, b in zip(base, narrow):
             assert abs(a - b) < 0.05 * max(abs(a), 1e-3), (base, narrow)
 
+    def test_composes_with_fp16_loss_scaling(self):
+        """fp16 policy + fp16 reductions: the scaler's early skip-steps
+        (backing off from the 2^16 init while fp16 grads overflow) must
+        resolve into real training."""
+        from accelerate_tpu import MeshConfig
+        from accelerate_tpu.data_loader import make_global_batch
+        from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+        for cls in (AcceleratorState, GradientState, PartialState):
+            cls._reset_state()
+        acc = Accelerator(mixed_precision="fp16",
+                          mesh_config=MeshConfig(dp=jax.device_count()))
+        model, opt = acc.prepare(Model(mlp_apply, init_mlp()), optax.adamw(1e-2))
+        step = acc.compile_train_step(mse_loss, grad_reduce_dtype=jnp.float16)
+        data = make_regression_data(n=32)
+        batch = make_global_batch(
+            {"x": np.stack([d["x"] for d in data]),
+             "y": np.stack([d["y"] for d in data])}, acc.mesh)
+        losses = [float(step(batch)["loss"]) for _ in range(8)]
+        assert losses[-1] < losses[0], losses
+
     def test_composes_with_accumulation_and_clip(self):
         """Narrow reductions must survive the in-executable accumulation
         scan (bf16 microbatch grads, fp32 accumulator) and grad clipping."""
