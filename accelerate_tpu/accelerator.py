@@ -814,6 +814,15 @@ class Accelerator:
         cross-replica sum runs in the narrow dtype — the same accuracy
         trade the torch hook makes; leave None for fp32 reductions.
 
+        Because the step differentiates with respect to the cast params,
+        ``grad_reduce_dtype`` is also the FORWARD compute dtype when it
+        differs from the mixed-precision policy's (e.g.
+        ``mixed_precision='no'`` with ``grad_reduce_dtype=bf16`` runs the
+        forward in bf16, a wider accuracy change than the torch hook's
+        communication-only compression) — a warning is emitted for such
+        mismatches. With matching dtypes (bf16/bf16, fp16/fp16) it is
+        communication-narrowing only.
+
         With ``fsdp_plugin.activation_checkpointing=True`` the whole loss
         computation is rematerialized (``jax.checkpoint`` with the
         dots-saveable policy) regardless of any model-level remat config
@@ -832,6 +841,16 @@ class Accelerator:
             optimizer.init_state(model.params)
         accum = accumulation_steps if accumulation_steps is not None else self.gradient_state.num_steps
         policy = self.policy
+        if (grad_reduce_dtype is not None
+                and jnp.dtype(grad_reduce_dtype) != jnp.dtype(policy.compute_dtype)):
+            warnings.warn(
+                f"grad_reduce_dtype={jnp.dtype(grad_reduce_dtype).name} differs from the "
+                f"mixed-precision compute dtype {jnp.dtype(policy.compute_dtype).name}: the "
+                "forward will also run in the reduce dtype (the step differentiates w.r.t. "
+                "the cast params), which changes accuracy beyond communication narrowing. "
+                "Match the dtypes to narrow only the gradient all-reduce.",
+                stacklevel=2,
+            )
         accepts_rng = self._loss_fn_accepts_rng(loss_fn)
         tx = optimizer.tx
         has_scale = optimizer.loss_scale is not None

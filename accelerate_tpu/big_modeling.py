@@ -935,7 +935,7 @@ class StreamedModel:
                  num_draft: int = 5,
                  do_sample: bool = False, temperature: float = 1.0,
                  top_k: Optional[int] = None, top_p: Optional[float] = None,
-                 rng=None):
+                 rng=None, cache_dtype=None):
         """Streamed decoding — greedy by default, sampled with
         ``do_sample=True`` (temperature/top-k/top-p) — the reference
         capability: hook-streamed ``model.generate``; per-token latency
@@ -962,7 +962,12 @@ class StreamedModel:
         draft model proposing ``num_draft`` tokens per round — the same
         weights-stream-once-per-accepted-run economics on arbitrary text,
         not just self-repetitive text. Mutually exclusive with
-        prompt-lookup; same exactness contract."""
+        prompt-lookup; same exactness contract.
+
+        ``cache_dtype`` sets the KV-cache element dtype for every cache
+        this call builds — the target's and, under assisted generation,
+        the draft's (matching generation.assisted_generate). None keeps
+        each factory's own default (bf16 for registry factories)."""
         if any(s.stage == "enc" for s in self.specs):
             raise TypeError(
                 "this is an encoder-decoder model; use seq2seq_generate")
@@ -1028,13 +1033,18 @@ class StreamedModel:
         if assistant_module is not None:
             return self._generate_assisted(
                 ids, max_new_tokens, eos_token_id, int(num_draft),
-                assistant_module, assistant_params, sampling=sampling, rng=rng)
+                assistant_module, assistant_params, sampling=sampling, rng=rng,
+                cache_dtype=cache_dtype)
         if prompt_lookup_num_tokens:
             return self._generate_prompt_lookup(
                 ids, max_new_tokens, eos_token_id,
                 int(prompt_lookup_num_tokens), int(lookup_ngram),
-                sampling=sampling, rng=rng)
-        caches = list(self.cache_factory(B, S + max_new_tokens))
+                sampling=sampling, rng=rng, cache_dtype=cache_dtype)
+        # Only pass dtype when the caller asked for one: a user-supplied
+        # factory may not take it (cf. the ring_slack introspection below),
+        # and an unconditional dtype= would also clobber its own default.
+        dt = {"dtype": cache_dtype} if cache_dtype is not None else {}
+        caches = list(self.cache_factory(B, S + max_new_tokens, **dt))
         caches = [jax.device_put(c, self.device) for c in caches]
         sample = sampling is not None
         out = self._cached_pass((jax.device_put(ids, self.device),), caches, 0,
@@ -1053,7 +1063,8 @@ class StreamedModel:
         return jnp.concatenate(pieces, axis=1)
 
     def _generate_prompt_lookup(self, ids, max_new_tokens: int, eos_token_id,
-                                K: int, ngram: int, sampling=None, rng=None):
+                                K: int, ngram: int, sampling=None, rng=None,
+                                cache_dtype=None):
         """Prompt-lookup speculation: draft in Python (the committed ids
         are host-side anyway), verify through the shared streamed
         speculative loop."""
@@ -1076,11 +1087,12 @@ class StreamedModel:
             return draft, state
 
         return self._generate_speculative(ids, max_new_tokens, eos_token_id, K,
-                                          drafter, None, sampling=sampling, rng=rng)
+                                          drafter, None, sampling=sampling, rng=rng,
+                                          cache_dtype=cache_dtype)
 
     def _generate_assisted(self, ids, max_new_tokens: int, eos_token_id,
                            K: int, draft_module, draft_params,
-                           sampling=None, rng=None):
+                           sampling=None, rng=None, cache_dtype=None):
         """Draft-model speculation for streamed weights: the (small,
         device-resident) draft proposes K tokens by a compiled greedy
         cached scan; the streamed target verifies the chunk in one pass,
@@ -1106,7 +1118,10 @@ class StreamedModel:
         _check_position_bound(draft_module, S + max_new_tokens + K - 2,
                               label="prompt + max_new_tokens + draft slack")
         L = S + max_new_tokens + K + 1
-        dcache = dfactory(1, L, jnp.bfloat16, ring_slack=K + 1)
+        # The draft cache follows the caller's cache dtype (matching
+        # generation.assisted_generate): a bf16-forced cache on an fp32
+        # draft can lower acceptance rate, costing target passes.
+        dcache = dfactory(1, L, cache_dtype or jnp.bfloat16, ring_slack=K + 1)
         prefill_d, draft_k = _compiled_drafter(draft_module, K)
         dcache = prefill_d(draft_params, jnp.asarray(ids), dcache)
 
@@ -1117,11 +1132,12 @@ class StreamedModel:
             return [int(t) for t in np.asarray(draft)], dcache
 
         return self._generate_speculative(ids, max_new_tokens, eos_token_id, K,
-                                          drafter, dcache, sampling=sampling, rng=rng)
+                                          drafter, dcache, sampling=sampling, rng=rng,
+                                          cache_dtype=cache_dtype)
 
     def _generate_speculative(self, ids, max_new_tokens: int, eos_token_id,
                               K: int, drafter, drafter_state,
-                              sampling=None, rng=None):
+                              sampling=None, rng=None, cache_dtype=None):
         """Shared verify/commit loop for streamed speculation: ``drafter``
         maps (committed token list, state) -> (K proposed tokens, state);
         each round verifies K+1 tokens in ONE streamed pass. Greedy by
@@ -1138,11 +1154,12 @@ class StreamedModel:
         # would silently drop the correctness-critical ring_slack (and mask
         # real bugs inside a slack-aware factory).
         takes_slack = "ring_slack" in inspect.signature(self.cache_factory).parameters
+        dt = {"dtype": cache_dtype} if cache_dtype is not None else {}
         if takes_slack:
             caches = list(self.cache_factory(1, S + max_new_tokens + K + 1,
-                                             ring_slack=K + 1))
+                                             ring_slack=K + 1, **dt))
         else:
-            caches = list(self.cache_factory(1, S + max_new_tokens + K + 1))
+            caches = list(self.cache_factory(1, S + max_new_tokens + K + 1, **dt))
             if any("pos" in c for c in caches):
                 raise ValueError(
                     "this model's cache_factory builds ring (sliding-window) "

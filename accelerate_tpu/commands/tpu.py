@@ -75,15 +75,23 @@ def tpu_command_launcher(args) -> int:
               "(or --install_accelerate)", file=sys.stderr)
         return 2
 
-    if args.use_sudo:
-        commands = [f"sudo {c}" for c in commands]
     exports = []
+    env_assigns = []
     for kv in args.env or []:
         if "=" not in kv:
             print(f"--env expects KEY=VALUE, got {kv!r}", file=sys.stderr)
             return 2
         key, _, val = kv.partition("=")
         exports.append(f"export {key}={shlex.quote(val)}")
+        env_assigns.append(f"{key}={shlex.quote(val)}")
+    if args.use_sudo:
+        # sudo's default env_reset strips shell-exported variables, so plain
+        # `export K=V; sudo cmd` silently drops every --env var. Inline them
+        # via `sudo env K=V cmd`: unlike `sudo -E` this needs no SETENV
+        # sudoers tag and passes ONLY the requested vars, not the whole
+        # invoking environment.
+        sudo = f"sudo env {' '.join(env_assigns)}" if env_assigns else "sudo"
+        commands = [f"{sudo} {c}" for c in commands]
     remote = "; ".join(exports + commands)
     cmd = [
         "gcloud", *(["alpha"] if args.use_alpha else []),

@@ -116,6 +116,13 @@ def device_kind() -> str:
     return str(getattr(jax.devices()[0], "device_kind", "?"))
 
 
+def same_chip(a: str | None, b: str | None) -> bool:
+    """Chip-equality rule for bench evidence records: the ONE place that
+    decides whether two :func:`device_kind` strings are comparable.
+    ``None`` (legacy records predating the field) matches anything."""
+    return a is None or b is None or a == b
+
+
 def _probe_cache_path() -> str:
     import tempfile
 

@@ -73,17 +73,22 @@ def _probe_summary() -> dict:
             "latest_tier_outcomes": tiers}
 
 
-def sweep_block_defaults() -> tuple:
+def sweep_block_defaults(chip: str | None = None) -> tuple:
     """Close the sweep loop: once the watcher's on-chip flash block sweep
     has picked a best (block_q, block_k), later tier-1 runs use it instead
-    of the static 128/128 default. Any problem reading the artifact keeps
-    the safe defaults."""
+    of the static 128/128 default. A sweep captured on a different chip
+    generation than ``chip`` (the flaky tunnel can reconnect to different
+    hardware) is ignored: its best blocks could fail to Mosaic-compile
+    there, and a non-OOM compile failure aborts the tier-1 ladder. Any
+    problem reading the artifact keeps the safe defaults."""
     try:
         import bench_watch
+        from accelerate_tpu.utils.platforms import same_chip
 
         sweep = bench_watch._load_json(bench_watch.SWEEP) or {}
         best = sweep.get("best") or {}
         if (sweep.get("backend") == "tpu" and not sweep.get("tiny_smoke")
+                and same_chip(chip, sweep.get("device_kind"))
                 and best.get("block_q") and best.get("block_k")):
             return int(best["block_q"]), int(best["block_k"])
     except Exception:  # noqa: BLE001 - defaults are always safe
@@ -146,7 +151,7 @@ def run_bench(on_tpu: bool) -> dict:
 
     def attempt(remat_policy, batch):
         if on_tpu:
-            bq, bk = sweep_block_defaults()
+            bq, bk = sweep_block_defaults(_device_kind())
             cfg = LlamaConfig(
                 vocab_size=32000, hidden_size=2048, intermediate_size=5632,
                 num_hidden_layers=10, num_attention_heads=16, num_key_value_heads=8,

@@ -460,7 +460,7 @@ def run_sweep() -> dict:
     import jax
     import jax.numpy as jnp
 
-    from accelerate_tpu.utils.platforms import enable_compilation_cache
+    from accelerate_tpu.utils.platforms import device_kind, enable_compilation_cache
 
     enable_compilation_cache()
 
@@ -483,6 +483,7 @@ def run_sweep() -> dict:
         "rows": rows,
         "best": None,
         "backend": jax.default_backend(),
+        "device_kind": device_kind(),
         "tiny_smoke": tiny,
         "interpret_mode": flash_pallas._interpret(),
     }
@@ -673,12 +674,13 @@ def merge_evidence(result: dict) -> dict:
     benched chip never exhibited. Legacy records without a ``device_kind``
     are attached as before.
     """
+    from accelerate_tpu.utils.platforms import same_chip as _same_kind
+
     extra = result.setdefault("extra", {})
     chip = extra.get("device_kind")
 
     def same_chip(ev: dict) -> bool:
-        kind = ev.get("device_kind")
-        return chip is None or kind is None or kind == chip
+        return _same_kind(chip, ev.get("device_kind"))
 
     qf = _load_json(QUICKFLASH)
     if qf and same_chip(qf):
@@ -695,6 +697,8 @@ def merge_evidence(result: dict) -> dict:
             "captured_at": kern.get("ts"),
         }
     sweep = _load_json(SWEEP)
+    if sweep and not same_chip(sweep):
+        sweep = None
     if sweep:
         extra["flash_block_sweep"] = {
             "best": sweep.get("best"),
@@ -826,10 +830,15 @@ def run_cycle() -> float:
             if best:
                 _save_json(BEST, merge_evidence(best))
 
+    from accelerate_tpu.utils.platforms import same_chip as _same_kind
+
     prior_sweep = _load_json(SWEEP)
     # A salvaged partial sweep is better than nothing but must not stop a
-    # healthy cycle from completing the full grid.
-    if prior_sweep is None or not prior_sweep.get("ok") or prior_sweep.get("partial"):
+    # healthy cycle from completing the full grid. A sweep captured on a
+    # different chip generation is dead evidence (consumers chip-gate it
+    # away), so it must not block re-capturing on the chip we are on now.
+    if (prior_sweep is None or not prior_sweep.get("ok") or prior_sweep.get("partial")
+            or not _same_kind(live["device_kind"], prior_sweep.get("device_kind"))):
         try:
             os.remove(SWEEP_PARTIAL)
         except OSError:

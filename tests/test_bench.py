@@ -155,6 +155,39 @@ def test_sweep_block_defaults(artifacts):
     assert bench.sweep_block_defaults() == (128, 128)  # smoke never counts
 
 
+def test_sweep_block_defaults_chip_gated(artifacts):
+    """A sweep best captured on one TPU generation must not configure
+    tier-1 flash blocks on another: its blocks could fail to Mosaic-compile
+    there, and a non-OOM compile failure aborts the whole tier-1 ladder
+    (bench.py only descends the ladder on RESOURCE_EXHAUSTED)."""
+    bench_watch._save_json(bench_watch.SWEEP, {
+        "backend": "tpu", "device_kind": "TPU v5 lite",
+        "best": {"block_q": 512, "block_k": 256, "fwdbwd_ms": 1}})
+    assert bench.sweep_block_defaults("TPU v5 lite") == (512, 256)  # same chip
+    assert bench.sweep_block_defaults("TPU v4") == (128, 128)       # cross-chip
+    assert bench.sweep_block_defaults(None) == (512, 256)           # unknown caller
+    # Legacy sweep records (no device_kind) keep working on any chip.
+    bench_watch._save_json(bench_watch.SWEEP, {
+        "backend": "tpu", "best": {"block_q": 256, "block_k": 128, "fwdbwd_ms": 1}})
+    assert bench.sweep_block_defaults("TPU v4") == (256, 128)
+
+
+def test_merge_evidence_drops_cross_chip_sweep(artifacts):
+    """merge_evidence must not attach sweep (or kernel) evidence captured
+    on a different chip generation than the tier-1 result describes."""
+    bench_watch._save_json(bench_watch.SWEEP, {
+        "backend": "tpu", "device_kind": "TPU v4",
+        "best": {"block_q": 512, "block_k": 256, "fwdbwd_ms": 1}, "rows": []})
+    result = {"extra": {"mfu": 0.5, "device_kind": "TPU v5 lite"}}
+    merged = bench_watch.merge_evidence(dict(result))
+    assert "flash_block_sweep" not in merged["extra"]
+    bench_watch._save_json(bench_watch.SWEEP, {
+        "backend": "tpu", "device_kind": "TPU v5 lite",
+        "best": {"block_q": 512, "block_k": 256, "fwdbwd_ms": 1}, "rows": []})
+    merged = bench_watch.merge_evidence(dict(result))
+    assert merged["extra"]["flash_block_sweep"]["best"]["block_q"] == 512
+
+
 class TestWatcherCycle:
     def _patch_probe(self, monkeypatch, info):
         from accelerate_tpu.utils import platforms
@@ -311,6 +344,45 @@ class TestWatcherCycle:
         monkeypatch.setattr(bench_watch, "_run_child", child2)
         bench_watch.run_cycle()
         assert "--quickflash-run" in calls and "--kernels-run" in calls
+
+    def test_cross_chip_sweep_recaptured(self, artifacts, monkeypatch):
+        """An ok sweep from a DIFFERENT chip generation is dead evidence
+        (every consumer chip-gates it away) — it must not block the sweep
+        stage from re-running on the chip the tunnel connects to now,
+        or block defaults would stay 128/128 forever after a chip swap."""
+        self._patch_probe(monkeypatch, {"platform": "tpu", "device_count": 1,
+                                        "devices": ["TPU:0"], "process_count": 1})
+        bench_watch._save_json(bench_watch.KERNELS, {
+            "ok": True, "checks": {"x": {"ok": True}}, "timings_ms": {},
+            "backend": "tpu", "device_kind": "TPU v5e", "interpret_mode": False,
+            "tiny_smoke": False, "ts": "t"})
+        bench_watch._save_json(bench_watch.SWEEP, {
+            "ok": True, "rows": [], "device_kind": "TPU v4",
+            "best": {"block_q": 512, "block_k": 256, "fwdbwd_ms": 1}, "ts": "t"})
+        calls = []
+
+        def child(mode, budget, extra_env=None):
+            calls.append(mode)
+            if mode == "--liveness-run":
+                return {"ok": True, "backend": "tpu", "device_count": 1,
+                        "device_kind": "TPU v5e", "first_matmul_s": 1.0}, None
+            if mode == "--sweep-run":
+                return {"ok": True, "rows": [], "backend": "tpu",
+                        "device_kind": "TPU v5e",
+                        "best": {"block_q": 256, "block_k": 256, "fwdbwd_ms": 1}}, None
+            return {"metric": bench.METRIC, "value": 1.0, "unit": "tokens/s/chip",
+                    "vs_baseline": 0.0, "extra": {"mfu": 0.01}}, None
+
+        monkeypatch.setattr(bench_watch, "_run_child", child)
+        monkeypatch.setattr(bench_watch, "run_bigmodel_row",
+                            lambda size, tier, budget=0: (None, "stubbed"))
+        bench_watch.run_cycle()
+        assert "--sweep-run" in calls
+        assert bench_watch._load_json(bench_watch.SWEEP)["device_kind"] == "TPU v5e"
+        # Same-chip ok sweep: stage skipped as before.
+        calls.clear()
+        bench_watch.run_cycle()
+        assert "--sweep-run" not in calls
 
     def test_tier_failure_retries_sooner(self, artifacts, monkeypatch):
         self._patch_probe(monkeypatch, {"platform": "tpu", "device_count": 1,

@@ -219,12 +219,19 @@ class TestCLISubprocess:
     def test_tpu_config_sudo_and_env(self):
         """launch --tpu_use_sudo / --env parity: sudo prefixes every remote
         command, --env exports land before them (reference:
-        commands/launch.py --tpu_use_sudo/--env)."""
+        commands/launch.py --tpu_use_sudo/--env). With --env present the
+        vars must be inlined per command (`sudo env K=V cmd`): sudo's
+        default env_reset strips shell-exported vars, and `sudo -E` would
+        both need the SETENV sudoers tag and leak the whole invoking
+        environment."""
         out = _run_cli("tpu-config", "--tpu_name", "pod1",
                        "--command", "echo hi", "--use_sudo",
                        "--env", "FOO=bar baz", "--env", "N=1", "--debug")
         assert out.returncode == 0, out.stderr
-        assert "export FOO='bar baz'; export N=1; sudo echo hi" in out.stdout
+        assert "export FOO='bar baz'; export N=1; sudo env FOO='bar baz' N=1 echo hi" in out.stdout
+        out = _run_cli("tpu-config", "--tpu_name", "pod1",
+                       "--command", "echo hi", "--use_sudo", "--debug")
+        assert "sudo echo hi" in out.stdout and "sudo env" not in out.stdout
         out = _run_cli("tpu-config", "--tpu_name", "pod1",
                        "--command", "echo hi", "--env", "MALFORMED")
         assert out.returncode == 2
