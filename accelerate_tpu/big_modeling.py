@@ -400,6 +400,27 @@ def _llama_block_specs(cfg) -> list[BlockSpec]:
     return _decoder_block_specs(cfg, LlamaBlock, "model.", has_aux=False)
 
 
+def _cache_dtype_kwargs(factory: Callable, cache_dtype) -> dict:
+    """kwargs to forward a caller's ``cache_dtype`` to a cache factory.
+
+    Only passes dtype when the caller asked for one — a user-supplied
+    factory may not take it, and an unconditional ``dtype=`` would clobber
+    its own default. When the caller DID ask and the factory can't honor
+    it, raise descriptively instead of a bare TypeError deep inside
+    generate (mirrors the ring_slack introspection in
+    StreamedModel._generate_speculative)."""
+    if cache_dtype is None:
+        return {}
+    import inspect
+
+    if "dtype" not in inspect.signature(factory).parameters:
+        raise TypeError(
+            "cache_dtype was passed but this model's cache_factory does not "
+            "accept a 'dtype' parameter; add one (registry factories from "
+            "cache_factory_for all do) or drop cache_dtype")
+    return {"dtype": cache_dtype}
+
+
 def cache_factory_for(module) -> Optional[Callable]:
     """``(batch, max_len, dtype=bf16) -> per-layer KV cache tuple`` for model
     families with cache threading; None otherwise. Layer caches pair, in
@@ -1040,10 +1061,7 @@ class StreamedModel:
                 ids, max_new_tokens, eos_token_id,
                 int(prompt_lookup_num_tokens), int(lookup_ngram),
                 sampling=sampling, rng=rng, cache_dtype=cache_dtype)
-        # Only pass dtype when the caller asked for one: a user-supplied
-        # factory may not take it (cf. the ring_slack introspection below),
-        # and an unconditional dtype= would also clobber its own default.
-        dt = {"dtype": cache_dtype} if cache_dtype is not None else {}
+        dt = _cache_dtype_kwargs(self.cache_factory, cache_dtype)
         caches = list(self.cache_factory(B, S + max_new_tokens, **dt))
         caches = [jax.device_put(c, self.device) for c in caches]
         sample = sampling is not None
@@ -1154,7 +1172,7 @@ class StreamedModel:
         # would silently drop the correctness-critical ring_slack (and mask
         # real bugs inside a slack-aware factory).
         takes_slack = "ring_slack" in inspect.signature(self.cache_factory).parameters
-        dt = {"dtype": cache_dtype} if cache_dtype is not None else {}
+        dt = _cache_dtype_kwargs(self.cache_factory, cache_dtype)
         if takes_slack:
             caches = list(self.cache_factory(1, S + max_new_tokens + K + 1,
                                              ring_slack=K + 1, **dt))

@@ -381,12 +381,21 @@ def _compiled_lookup_generate(module, max_new_tokens: int, eos_token_id, cache_d
                               sampling=None):
     """(prefill, speculate_loop) jitted pair for prompt-lookup decoding.
     Keyed per (module config, lengths, eos, dtype, ngram, K) like
-    _compiled_generate. The prompt length is NOT part of the key: the
-    speculate loop takes it as a traced argument and is shaped only by the
-    bucketed ``buf_len``, so varied prompt lengths share one compiled loop
-    (prefill, like ``generate()``'s, still specializes per prompt shape
-    inside its own jit). ``sampling`` non-None switches the greedy accept
-    rule to exact speculative sampling (:func:`speculative_accept`)."""
+    _compiled_generate. The prompt length is NOT part of the key: BOTH
+    halves take it as a traced argument — the speculate loop is shaped
+    only by the bucketed ``buf_len``, and prefill sees the prompt padded
+    to a 128-multiple with the true length traced (it reads the logits at
+    ``true_len - 1``) — so varied prompt lengths share one compiled
+    (prefill, loop) pair per bucket instead of recompiling prefill per
+    exact length. Pad positions write garbage KV the masks provably never
+    expose: full caches mask ``k_pos <= q_pos`` and every pad slot stays
+    ahead of the committed frontier until the contiguous verification
+    chunks overwrite it; ring caches mask by stored position, with the
+    cache built with ``ring_slack`` covering the pad so prefill's pad
+    writes cannot evict in-window prompt keys (see
+    :func:`prompt_lookup_generate`). ``sampling`` non-None switches the
+    greedy accept rule to exact speculative sampling
+    (:func:`speculative_accept`)."""
     key = _cache_key(module, max_new_tokens, eos_token_id,
                      jnp.dtype(cache_dtype).name, sampling, 1.0,
                      ("lookup", ngram, num_draft, buf_len))
@@ -403,12 +412,13 @@ def _compiled_lookup_generate(module, max_new_tokens: int, eos_token_id, cache_d
     eos = eos_token_id
 
     @jax.jit
-    def prefill(params, ids, cache, rng):
+    def prefill(params, ids, cache, rng, true_len):
         logits, cache = module.apply({"params": params}, ids, cache=cache, cache_pos=0)
+        last = jax.lax.dynamic_slice_in_dim(logits, true_len - 1, 1, axis=1)[:, 0]
         if sampling is None:
-            tok = jnp.argmax(logits[:, -1], axis=-1)
+            tok = jnp.argmax(last, axis=-1)
         else:
-            tok = jax.random.categorical(rng, warp(logits[:, -1]), axis=-1)
+            tok = jax.random.categorical(rng, warp(last), axis=-1)
         return tok.astype(ids.dtype), cache
 
     @jax.jit
@@ -552,9 +562,17 @@ def prompt_lookup_generate(
     # bucket instead of recompiling (and filling a generate-cache slot) per
     # exact length; the prompt length rides in as a traced argument.
     L = -(-(S + max_new_tokens + K + 1) // 128) * 128
-    # ring_slack: rejected overshoot writes must not evict in-window keys
-    # from sliding-window layers' ring caches.
-    cache = factory(B, L, dtype, ring_slack=K + 1)
+    # Bucket the PROMPT too: prefill runs on ids right-padded to a
+    # 128-multiple with the true length traced, so nearby prompt lengths
+    # share one compiled prefill (the pad KV is never attended — see
+    # _compiled_lookup_generate).
+    P = -(-S // 128) * 128
+    ids_padded = jnp.pad(ids, ((0, 0), (0, P - S))) if P > S else ids
+    # ring_slack: rejected overshoot writes (K + 1) plus prefill's pad
+    # writes (< 128, held STATIC at the bucket width so the cache shape —
+    # and thus the compiled pair — stays per-bucket) must not evict
+    # in-window keys from sliding-window layers' ring caches.
+    cache = factory(B, L, dtype, ring_slack=K + 1 + 128)
 
     sampling = (float(temperature), top_k, top_p) if do_sample else None
     rng = rng if rng is not None else jax.random.PRNGKey(0)
@@ -562,7 +580,8 @@ def prompt_lookup_generate(
     prefill, speculate = _compiled_lookup_generate(
         module, max_new_tokens, eos_token_id, dtype, int(ngram), K, L,
         sampling=sampling)
-    first_tok, cache = prefill(params, ids, cache, pre_rng)
+    first_tok, cache = prefill(params, ids_padded, cache, pre_rng,
+                               jnp.asarray(S, jnp.int32))
     buf = jnp.zeros((1, L), ids.dtype)
     buf = jax.lax.dynamic_update_slice(buf, ids, (0, 0))
     buf = buf.at[0, S].set(first_tok[0])
@@ -592,12 +611,15 @@ def _compiled_assisted_generate(module, draft_module, max_new_tokens: int,
     eos = eos_token_id
 
     @jax.jit
-    def prefill_t(params, ids, cache, rng):
+    def prefill_t(params, ids, cache, rng, true_len):
+        # ids arrive right-padded to the prompt bucket; the pad KV is never
+        # attended (same masking argument as _compiled_lookup_generate).
         logits, cache = module.apply({"params": params}, ids, cache=cache, cache_pos=0)
+        last = jax.lax.dynamic_slice_in_dim(logits, true_len - 1, 1, axis=1)[:, 0]
         if sampling is None:
-            tok = jnp.argmax(logits[:, -1], axis=-1)
+            tok = jnp.argmax(last, axis=-1)
         else:
-            tok = jax.random.categorical(rng, warp(logits[:, -1]), axis=-1)
+            tok = jax.random.categorical(rng, warp(last), axis=-1)
         return tok.astype(ids.dtype), cache
 
     @jax.jit
@@ -741,8 +763,13 @@ def assisted_generate(
                           label="prompt + max_new_tokens + draft slack")
     dtype = cache_dtype or jnp.bfloat16
     L = -(-(S + max_new_tokens + K + 1) // 128) * 128
-    cache = cache_factory_for(module)(B, L, dtype, ring_slack=K + 1)
-    dcache = cache_factory_for(draft_module)(B, L, dtype, ring_slack=K + 1)
+    # Prompt bucketed like prompt_lookup_generate: both prefills run on the
+    # right-padded ids (pad KV never attended), and both caches carry the
+    # static 128 extra ring slack so pad writes can't evict in-window keys.
+    P = -(-S // 128) * 128
+    ids_padded = jnp.pad(ids, ((0, 0), (0, P - S))) if P > S else ids
+    cache = cache_factory_for(module)(B, L, dtype, ring_slack=K + 1 + 128)
+    dcache = cache_factory_for(draft_module)(B, L, dtype, ring_slack=K + 1 + 128)
 
     sampling = (float(temperature), top_k, top_p) if do_sample else None
     rng = rng if rng is not None else jax.random.PRNGKey(0)
@@ -750,8 +777,9 @@ def assisted_generate(
     prefill_t, prefill_d, speculate = _compiled_assisted_generate(
         module, draft_module, max_new_tokens, eos_token_id, dtype, K, L,
         sampling=sampling)
-    first_tok, cache = prefill_t(params, ids, cache, pre_rng)
-    dcache = prefill_d(draft_params, ids, dcache)
+    first_tok, cache = prefill_t(params, ids_padded, cache, pre_rng,
+                                 jnp.asarray(S, jnp.int32))
+    dcache = prefill_d(draft_params, ids_padded, dcache)
     buf = jnp.zeros((1, L), ids.dtype)
     buf = jax.lax.dynamic_update_slice(buf, ids, (0, 0))
     buf = buf.at[0, S].set(first_tok[0])

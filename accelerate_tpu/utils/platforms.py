@@ -117,9 +117,13 @@ def device_kind() -> str:
 
 
 def same_chip(a: str | None, b: str | None) -> bool:
-    """Chip-equality rule for bench evidence records: the ONE place that
-    decides whether two :func:`device_kind` strings are comparable.
-    ``None`` (legacy records predating the field) matches anything."""
+    """Chip-equality rule shared by bench evidence CONSUMERS (evidence
+    attachment in merge_evidence, block-default selection, the sweep
+    re-run gate): ``None`` (legacy records predating the field) matches
+    anything, so old evidence keeps flowing. Completion checks that decide
+    whether to SKIP re-capturing (bench_watch._kernels_complete) are
+    deliberately stricter — there an untagged record is treated as
+    incomplete and re-captured."""
     return a is None or b is None or a == b
 
 

@@ -616,7 +616,10 @@ def _kernels_complete(device_kind: str | None = None) -> bool:
     cycles can skip past the kernel stages and spend the window on better
     things. The flaky tunnel could in principle reconnect to a different
     TPU generation, so evidence only counts for the chip it was captured
-    on (``device_kind`` from the cycle's liveness check)."""
+    on (``device_kind`` from the cycle's liveness check). Deliberately
+    STRICTER than platforms.same_chip: an untagged legacy record is
+    incomplete here (re-capture, tagging it), while consumers still
+    attach/apply legacy evidence permissively."""
     kern = _load_json(KERNELS)
     return bool(
         kern and kern.get("ok") and not kern.get("partial")

@@ -392,6 +392,75 @@ class TestStreamedPromptLookup:
         np.testing.assert_array_equal(got, ref)
         assert calls["n"] < plain_calls, (calls["n"], plain_calls)
 
+    def test_cache_dtype_reaches_every_cache(self, tmp_path):
+        """generate(cache_dtype=...) must reach the caches of the plain,
+        prompt-lookup, and assisted paths (incl. the draft cache that used
+        to be hardcoded bf16) without changing greedy output; a factory
+        that can't honor an explicit cache_dtype raises descriptively."""
+        import dataclasses
+
+        from accelerate_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+        streamed = self._streamed(tmp_path)
+        ids = np.tile(np.array([[3, 7, 12]], np.int32), (1, 4))
+        ref = np.asarray(streamed.generate(ids, max_new_tokens=8))
+        seen = []
+        orig = streamed.cache_factory
+
+        def recording(batch, max_len, dtype=jnp.bfloat16, ring_slack=0):
+            seen.append(jnp.dtype(dtype))
+            return orig(batch, max_len, dtype=dtype, ring_slack=ring_slack)
+
+        streamed.cache_factory = recording
+        got = np.asarray(streamed.generate(ids, max_new_tokens=8,
+                                           cache_dtype=jnp.float32))
+        np.testing.assert_array_equal(got, ref)
+        got = np.asarray(streamed.generate(ids, max_new_tokens=8,
+                                           prompt_lookup_num_tokens=3,
+                                           cache_dtype=jnp.float32))
+        np.testing.assert_array_equal(got, ref)
+        assert seen and all(d == jnp.float32 for d in seen), seen
+
+        cfg = LlamaConfig.tiny(use_flash_attention=False)
+        draft = LlamaForCausalLM(dataclasses.replace(cfg, num_hidden_layers=1))
+        dp = draft.init_params(jax.random.PRNGKey(11), batch_size=1, seq_len=8)
+        from accelerate_tpu import big_modeling as bm
+
+        drafts = []
+        orig_for = bm.cache_factory_for
+
+        def spying_for(module):
+            f = orig_for(module)
+            if f is None or module is not draft:
+                return f
+
+            def spy(batch, max_len, dtype=jnp.bfloat16, ring_slack=0):
+                drafts.append(jnp.dtype(dtype))
+                return f(batch, max_len, dtype=dtype, ring_slack=ring_slack)
+
+            return spy
+
+        bm.cache_factory_for = spying_for
+        try:
+            got = np.asarray(streamed.generate(
+                ids, max_new_tokens=8, assistant_module=draft,
+                assistant_params=dp, num_draft=3, cache_dtype=jnp.float32))
+        finally:
+            bm.cache_factory_for = orig_for
+        np.testing.assert_array_equal(got, ref)
+        assert drafts == [jnp.dtype(jnp.float32)], drafts
+
+        # Explicit cache_dtype + a factory without a dtype param: loud,
+        # descriptive failure instead of a bare TypeError.
+        streamed.cache_factory = lambda batch, max_len, ring_slack=0: orig(
+            batch, max_len, ring_slack=ring_slack)
+        with pytest.raises(TypeError, match="cache_factory does not accept"):
+            streamed.generate(ids, max_new_tokens=4, cache_dtype=jnp.float32)
+        # ...while None keeps such factories working (default dtype).
+        nd = np.asarray(streamed.generate(ids, max_new_tokens=8))
+        np.testing.assert_array_equal(nd, ref)
+        streamed.cache_factory = orig
+
     def test_sampled_decode_and_speculation(self, tmp_path):
         """Streamed sampled decode (new) — tiny temperature must degenerate
         to greedy on both the plain and speculative paths; fixed seeds are

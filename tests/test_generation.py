@@ -325,6 +325,31 @@ class TestPromptLookupGenerate:
                                                 cache_dtype=jnp.float32))
         np.testing.assert_array_equal(got, ref)
 
+    def test_prompt_bucket_shares_one_prefill_compile(self):
+        """Nearby prompt lengths must reuse ONE compiled (prefill, loop)
+        pair: prefill runs on the 128-bucketed padded prompt with the true
+        length traced, so interactive use doesn't recompile per exact
+        length — while outputs stay exactly plain greedy for every length."""
+        from accelerate_tpu.generation import (_compiled_lookup_generate,
+                                               generate, prompt_lookup_generate)
+
+        model, params, cfg = self._model()
+        outs = {}
+        for S in (5, 9, 12):
+            ids = (np.arange(S, dtype=np.int32)[None] * 29 + 3) % cfg.vocab_size
+            ref = np.asarray(generate(model, params, jnp.asarray(ids),
+                                      max_new_tokens=10, cache_dtype=jnp.float32))
+            got = np.asarray(prompt_lookup_generate(
+                model, params, jnp.asarray(ids), max_new_tokens=10,
+                cache_dtype=jnp.float32))
+            np.testing.assert_array_equal(got, ref)
+            outs[S] = got
+        # All three lengths share a bucket (L and P identical), so the
+        # cached prefill must hold exactly ONE jit trace.
+        prefill, _ = _compiled_lookup_generate(
+            model, 10, None, jnp.float32, 2, 5, 128)
+        assert prefill._cache_size() == 1, prefill._cache_size()
+
     def test_matches_with_eos(self):
         from accelerate_tpu.generation import generate, prompt_lookup_generate
 
