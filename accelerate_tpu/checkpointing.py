@@ -182,7 +182,23 @@ def load_array_tree(path: str | Path, target=None, shardings=None, via_host: boo
                     abstract = jax.tree_util.tree_map(_abstract, target, shardings)
                 else:
                     abstract = jax.tree_util.tree_map(_abstract, target)
-                return ckptr.restore(path, abstract)
+                # Explicit ArrayRestoreArgs, not just the abstract template:
+                # this orbax ignores ShapeDtypeStruct.sharding on a bare
+                # restore and silently rebuilds the SAVING mesh's shardings
+                # from the checkpoint's sharding file — wrong whenever the
+                # restoring mesh differs within an unchanged world size
+                # (same-process elastic reshard, e.g. fsdp=2 -> fsdp=8).
+                # Single-device/None-sharded leaves (optimizer scalars)
+                # restore as numpy — uncommitted, like the via_host path —
+                # so they can't pin the next jitted step to one device.
+                def _rarg(t):
+                    sh = getattr(t, "sharding", None)
+                    if sh is None or len(getattr(sh, "device_set", ())) <= 1:
+                        return ocp.RestoreArgs(restore_type=np.ndarray)
+                    return ocp.ArrayRestoreArgs(sharding=sh, global_shape=t.shape)
+
+                restore_args = jax.tree_util.tree_map(_rarg, abstract)
+                return ckptr.restore(path, item=abstract, restore_args=restore_args)
             return ckptr.restore(path)
     else:  # pragma: no cover
         from flax import serialization

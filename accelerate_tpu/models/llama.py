@@ -765,12 +765,15 @@ class PipelinedLlamaForCausalLM:
 
     # -- parameter init / layout ------------------------------------------
 
-    def init_params(self, rng, seq_len: int = 8):
-        """Initialize a parameter pytree from a PRNG key (shape-driving args are traced-free)."""
+    def init_params(self, rng, seq_len: int = 8, batch_size: int = 1):
+        """Initialize a parameter pytree from a PRNG key (shape-driving args
+        are traced-free). ``batch_size`` only matters when a context-parallel
+        plugin is active: the cp attention shard_map traced during init needs
+        the dummy batch divisible by the data mesh axes (dp x fsdp)."""
         cfg = self.config
         r_embed, r_blocks, r_head = jax.random.split(rng, 3)
-        dummy_x = jnp.zeros((1, seq_len, cfg.hidden_size), jnp.float32)
-        dummy_pos = jnp.zeros((1, seq_len), jnp.int32)
+        dummy_x = jnp.zeros((batch_size, seq_len, cfg.hidden_size), jnp.float32)
+        dummy_pos = jnp.zeros((batch_size, seq_len), jnp.int32)
         block = LlamaBlock(cfg)
         layer_rngs = jax.random.split(r_blocks, cfg.num_hidden_layers)
         blocks = jax.vmap(lambda r: block.init(r, dummy_x, dummy_pos)["params"])(layer_rngs)

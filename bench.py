@@ -31,6 +31,11 @@ PEAK_TFLOPS = {
 
 METRIC = "llama_train_tokens_per_sec_per_chip"
 
+#: BASELINE.json's north-star: FSDP fine-tuning at >=45% MFU (the "≥45% MFU"
+#: clause in its north_star field). vs_baseline = measured_mfu / TARGET_MFU on
+#: a TPU backend and null otherwise — a CPU smoke has no meaningful MFU.
+TARGET_MFU = 0.45
+
 
 def detect_peak_tflops(device) -> float:
     kind = str(getattr(device, "device_kind", "")).lower()
@@ -96,6 +101,60 @@ def sweep_block_defaults(chip: str | None = None) -> tuple:
     return 128, 128
 
 
+#: Tier-1 attempt ladder, best-MFU first (remat_policy, per-chip batch).
+#: Lowered-step memory_analysis at the tier-1 config (einsum attention, CPU
+#: estimate): no-remat needs ~39 GiB — over v5e's 16 GiB HBM — remat/"dots"
+#: ~19 GiB (falls to ~9 with flash's O(S) residuals), remat/"nothing" b8
+#: ~13.5 GiB, b4 ~11.7 GiB. An OOM costs one on-chip recompile (~25 s), not
+#: the whole tunnel window.
+TIER1_LADDER = [("dots", 8), ("nothing", 8), ("nothing", 4)]
+TIER1_LADDER_NO_FLASH = [("nothing", 8), ("nothing", 4)]
+
+
+def _use_flash() -> bool:
+    """The watcher sets ACCELERATE_TPU_BENCH_NO_FLASH when its quick flash
+    check failed on this chip: an MFU datapoint on the XLA einsum attention
+    path still beats no datapoint at all. Disable-style values ("0",
+    "false", ...) mean flash stays ON."""
+    import os
+
+    return os.environ.get(
+        "ACCELERATE_TPU_BENCH_NO_FLASH", "").lower() in ("", "0", "false", "no", "off")
+
+
+def tier1_llama_config(on_tpu: bool, remat_policy: str = "nothing"):
+    """The ONE model config both benches measure — run_bench (single chip)
+    and run_mesh_bench (explicit mesh) must stay cross-comparable, so the
+    config lives here, not copy-pasted per bench. TPU: the tier-1 2B-class
+    Llama with the sweep's best flash blocks; CPU: the tiny smoke config
+    exercising the same code path."""
+    from accelerate_tpu.models.llama import LlamaConfig
+    from accelerate_tpu.utils.platforms import device_kind as _device_kind
+
+    if not on_tpu:
+        return LlamaConfig.tiny(use_flash_attention=False)
+    bq, bk = sweep_block_defaults(_device_kind())
+    return LlamaConfig(
+        vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+        num_hidden_layers=10, num_attention_heads=16, num_key_value_heads=8,
+        max_position_embeddings=2048, remat=True, remat_policy=remat_policy,
+        use_flash_attention=_use_flash(), flash_block_q=bq, flash_block_k=bk,
+    )
+
+
+def mfu_fields(tokens_per_sec_per_chip: float, cfg, seq: int, n_params: int) -> dict:
+    """Shared MFU arithmetic: 6N (matmul params only — the input embedding
+    is a gather) + attention FLOPs vs the chip generation's peak."""
+    import jax
+
+    n_matmul_params = n_params - cfg.vocab_size * cfg.hidden_size
+    flops_per_tok = model_flops_per_token(n_matmul_params, cfg, seq)
+    achieved_tflops = tokens_per_sec_per_chip * flops_per_tok / 1e12
+    peak = detect_peak_tflops(jax.devices()[0])
+    return {"mfu": achieved_tflops / peak, "achieved_tflops": achieved_tflops,
+            "peak_tflops": peak}
+
+
 def run_bench(on_tpu: bool) -> dict:
     import jax
     import numpy as np
@@ -111,11 +170,7 @@ def run_bench(on_tpu: bool) -> dict:
 
     from accelerate_tpu import Accelerator, Model
     from accelerate_tpu.data_loader import make_global_batch
-    from accelerate_tpu.models.llama import (
-        LlamaConfig,
-        PipelinedLlamaForCausalLM,
-        fused_causal_lm_loss,
-    )
+    from accelerate_tpu.models.llama import PipelinedLlamaForCausalLM, fused_causal_lm_loss
 
     def mark(stage):
         # Progress markers: let the parent pinpoint which stage ate a killed
@@ -126,41 +181,16 @@ def run_bench(on_tpu: bool) -> dict:
     import os
 
     if on_tpu:
-        # The watcher sets ACCELERATE_TPU_BENCH_NO_FLASH when its quick flash
-        # check failed on this chip: an MFU datapoint on the XLA einsum
-        # attention path still beats no datapoint at all. Disable-style
-        # values ("0", "false", ...) mean flash stays ON.
-        no_flash_env = os.environ.get("ACCELERATE_TPU_BENCH_NO_FLASH", "")
-        use_flash = no_flash_env.lower() in ("", "0", "false", "no", "off")
         seq, iters, warmup = 1024, 20, 3
-        # Attempt ladder, best-MFU first. Lowered-step memory_analysis at
-        # this config (einsum attention, CPU estimate): no-remat needs
-        # ~39 GiB — over v5e's 16 GiB HBM — remat/"dots" ~19 GiB (falls to
-        # ~9 with flash's O(S) residuals), remat/"nothing" b8 ~13.5 GiB,
-        # b4 ~11.7 GiB. An OOM costs one on-chip recompile (~25 s), not
-        # the whole tunnel window.
-        ladder = [("dots", 8), ("nothing", 8), ("nothing", 4)]
-        if not use_flash:
-            # einsum attention materializes [B,H,S,S] scores; "dots" saves
-            # them — start straight at full recompute.
-            ladder = [("nothing", 8), ("nothing", 4)]
+        # einsum attention materializes [B,H,S,S] scores; "dots" saves
+        # them — without flash, start straight at full recompute.
+        ladder = TIER1_LADDER if _use_flash() else TIER1_LADDER_NO_FLASH
     else:  # CPU smoke fallback so the bench always emits a line
-        use_flash = False
         seq, iters, warmup = 32, 3, 1
         ladder = [("nothing", 4)]
 
     def attempt(remat_policy, batch):
-        if on_tpu:
-            bq, bk = sweep_block_defaults(_device_kind())
-            cfg = LlamaConfig(
-                vocab_size=32000, hidden_size=2048, intermediate_size=5632,
-                num_hidden_layers=10, num_attention_heads=16, num_key_value_heads=8,
-                max_position_embeddings=2048, remat=True, remat_policy=remat_policy,
-                use_flash_attention=use_flash,
-                flash_block_q=bq, flash_block_k=bk,
-            )
-        else:
-            cfg = LlamaConfig.tiny(use_flash_attention=False)
+        cfg = tier1_llama_config(on_tpu, remat_policy)
         # Scan-over-layers layout for BOTH tiers: the decoder block is traced
         # and compiled ONCE and lax.scan'd over the stacked [L, ...] params,
         # instead of inlining N copies — over the tunnel the unrolled compile
@@ -208,22 +238,19 @@ def run_bench(on_tpu: bool) -> dict:
         tokens_per_sec_per_chip = tokens_per_sec / n_chips
 
         n_params = sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(model.params))
-        # The input embedding is a gather, not a matmul — exclude it from 6N.
-        n_matmul_params = n_params - cfg.vocab_size * cfg.hidden_size
-        flops_per_tok = model_flops_per_token(n_matmul_params, cfg, seq)
-        achieved_tflops = tokens_per_sec_per_chip * flops_per_tok / 1e12
-        peak = detect_peak_tflops(jax.devices()[0])
-        mfu = achieved_tflops / peak
+        flops = mfu_fields(tokens_per_sec_per_chip, cfg, seq, n_params)
+        mfu = flops["mfu"]
 
         result = {
             "metric": METRIC,
             "value": round(tokens_per_sec_per_chip, 1),
             "unit": "tokens/s/chip",
-            "vs_baseline": round(mfu / 0.45, 4),
+            "vs_baseline": round(mfu / TARGET_MFU, 4) if on_tpu else None,
             "extra": {
-                "mfu": round(mfu, 4),
-                "achieved_tflops": round(achieved_tflops, 2),
-                "peak_tflops": peak,
+                "baseline_target_mfu": TARGET_MFU,
+                "mfu": round(mfu, 4) if on_tpu else None,
+                "achieved_tflops": round(flops["achieved_tflops"], 2),
+                "peak_tflops": flops["peak_tflops"],
                 "step_ms": round(1000 * dt / iters, 2),
                 "config": {
                     "hidden": cfg.hidden_size, "layers": cfg.num_hidden_layers,
@@ -268,6 +295,250 @@ def run_bench(on_tpu: bool) -> dict:
             mark(f"OOM_RETRY_{n + 1}")
             jax.clear_caches()
     raise RuntimeError(f"all tier-1 ladder attempts OOMed (last: {last_oom})")
+
+
+#: Axes the mesh perf harness accepts (pp/ep have their own schedules and are
+#: dry-run-validated in __graft_entry__; the perf story is dp/fsdp/tp/cp).
+PERF_MESH_AXES = ("dp", "fsdp", "tp", "cp")
+
+
+def parse_mesh_spec(spec: str) -> dict:
+    """'dp=4,fsdp=2' -> {'dp': 4, 'fsdp': 2} (axes validated, sizes >= 1)."""
+    out = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        ax, _, val = part.partition("=")
+        if ax not in PERF_MESH_AXES:
+            raise ValueError(
+                f"unknown mesh axis {ax!r} (choose from {', '.join(PERF_MESH_AXES)})")
+        if not val.isdigit() or int(val) < 1:
+            raise ValueError(f"mesh axis {ax} needs a positive size, got {val!r}")
+        out[ax] = int(val)
+    if not out:
+        raise ValueError("empty --mesh spec; expected e.g. dp=8 or fsdp=4,tp=2")
+    return out
+
+
+def run_mesh_bench(mesh_spec: dict, on_tpu: bool, quick: bool = False) -> dict:
+    """Multi-chip perf: per-chip tokens/s (+ MFU on TPU) and scaling
+    efficiency of the SAME fused train step run_bench times, over an
+    explicit dp/fsdp/tp/cp mesh (BASELINE.md's 8->256-chip scaling axis;
+    reference equivalent: its multi-GPU benchmark configs,
+    /root/reference/benchmarks/fp8/{ddp,fsdp,distrib_deepspeed}.py).
+
+    Scaling efficiency = per-chip tokens/s on the N-device mesh divided by
+    per-chip tokens/s of an identical 1-device run measured in the same
+    process — the number that tells you what the mesh costs you, not just
+    what it gives you. On an emulated CPU mesh the absolute numbers are
+    meaningless but every sharding/collective in the step is real; the
+    harness is pod-ready by construction (``quick`` trims iters for the
+    dryrun stage).
+    """
+    import math
+
+    import jax
+    import numpy as np
+    import optax
+
+    from accelerate_tpu import Accelerator, MeshConfig, Model
+    from accelerate_tpu.data_loader import make_global_batch
+    from accelerate_tpu.models.llama import (
+        LlamaConfig,
+        PipelinedLlamaForCausalLM,
+        fused_causal_lm_loss,
+    )
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+    from accelerate_tpu.utils import (
+        ContextParallelPlugin,
+        FullyShardedDataParallelPlugin,
+        TensorParallelPlugin,
+    )
+    from accelerate_tpu.utils.platforms import device_kind as _device_kind
+    from accelerate_tpu.utils.platforms import enable_compilation_cache
+
+    if on_tpu:
+        # Persistent-cache reuse only matters over the ~25 s/program tunnel;
+        # on emulated CPU meshes it just spews cross-machine AOT warnings.
+        enable_compilation_cache()
+    n_chips = math.prod(mesh_spec.values())
+    if len(jax.devices()) < n_chips:
+        raise RuntimeError(
+            f"mesh {mesh_spec} needs {n_chips} devices, have {len(jax.devices())}")
+
+    if on_tpu:
+        seq, per_chip_batch, iters, warmup = 1024, 4, 10, 2
+        ladder = TIER1_LADDER if _use_flash() else TIER1_LADDER_NO_FLASH
+    else:
+        seq, per_chip_batch = 32, 2
+        iters, warmup = (2, 1) if quick else (3, 1)
+        ladder = [("nothing", per_chip_batch)]
+
+    def timed(spec: dict, cfg, pcb: int) -> dict:
+        for cls in (AcceleratorState, GradientState, PartialState):
+            cls._reset_state()
+        n = math.prod(spec.values())
+        full = {ax: spec.get(ax, 1) for ax in PERF_MESH_AXES}
+        acc = Accelerator(
+            mixed_precision="bf16",
+            mesh_config=MeshConfig(**full, devices=jax.devices()[:n]),
+            fsdp_plugin=(FullyShardedDataParallelPlugin(min_weight_size_to_shard=1)
+                         if full["fsdp"] > 1 else None),
+            tp_plugin=(TensorParallelPlugin(tp_size=full["tp"])
+                       if full["tp"] > 1 else None),
+            cp_plugin=(ContextParallelPlugin(cp_size=full["cp"])
+                       if full["cp"] > 1 else None),
+        )
+        model_def = PipelinedLlamaForCausalLM(cfg)
+        # Batch rides the data axes (dp x fsdp); cp shards seq instead. The
+        # init dummy must already respect the data axes: a cp plugin's
+        # attention shard_map is traced during init too.
+        data_ways = full["dp"] * full["fsdp"]
+        batch_rows = pcb * data_ways
+        params = model_def.init_params(jax.random.PRNGKey(0), batch_size=data_ways)
+        model, opt = acc.prepare(Model(model_def, params), optax.adamw(1e-4))
+        step = acc.compile_train_step(fused_causal_lm_loss(model_def),
+                                      max_grad_norm=1.0)
+        rng = np.random.default_rng(0)
+        batches = [
+            make_global_batch(
+                {"input_ids": rng.integers(
+                    0, cfg.vocab_size, size=(batch_rows, seq)).astype(np.int32)},
+                acc.mesh,
+            )
+            for _ in range(2)
+        ]
+        for i in range(warmup):
+            metrics = step(batches[i % 2])
+        jax.device_get(metrics["loss"])
+        t0 = time.perf_counter()
+        for i in range(iters):
+            metrics = step(batches[i % 2])
+        loss = float(jax.device_get(metrics["loss"]))
+        dt = time.perf_counter() - t0
+        assert np.isfinite(loss), f"non-finite loss {loss} on mesh {spec}"
+        tokens_per_sec = batch_rows * seq * iters / dt
+        n_params = sum(int(np.prod(p.shape))
+                       for p in jax.tree_util.tree_leaves(model.params))
+        return {
+            "mesh": {ax: sz for ax, sz in full.items() if sz > 1} or {"dp": 1},
+            "n_chips": n,
+            "tokens_per_sec": tokens_per_sec,
+            "tokens_per_sec_per_chip": tokens_per_sec / n,
+            "step_ms": 1000 * dt / iters,
+            "loss": loss,
+            "n_params": n_params,
+        }
+
+    def attempt_ladder(spec: dict) -> tuple[dict, object, int, str | None]:
+        """Same OOM ladder as run_bench: fall to cheaper remat/batch on
+        RESOURCE_EXHAUSTED instead of wasting a tunnel window."""
+        last_oom = None
+        for remat_policy, pcb in ladder:
+            cfg = tier1_llama_config(on_tpu, remat_policy)
+            try:
+                return timed(spec, cfg, pcb), cfg, pcb, last_oom
+            except Exception as e:  # noqa: BLE001 - only OOM descends
+                msg = str(e)
+                if not ("RESOURCE_EXHAUSTED" in msg or "out of memory" in msg.lower()):
+                    raise
+                last_oom = f"{remat_policy}/b{pcb} OOM"
+                jax.clear_caches()
+        raise RuntimeError(f"all mesh ladder attempts OOMed (last: {last_oom})")
+
+    mesh_run, cfg, per_chip_batch, oom = attempt_ladder(mesh_spec)
+    # The 1-chip reference must run the exact surviving config/batch or the
+    # efficiency ratio compares different programs.
+    single = timed({"dp": 1}, cfg, per_chip_batch)
+    eff = (mesh_run["tokens_per_sec_per_chip"] / single["tokens_per_sec_per_chip"]
+           if single["tokens_per_sec_per_chip"] else 0.0)
+
+    flops = mfu_fields(mesh_run["tokens_per_sec_per_chip"], cfg, seq,
+                       mesh_run["n_params"])
+    mfu = flops["mfu"]
+    achieved_tflops, peak = flops["achieved_tflops"], flops["peak_tflops"]
+
+    return {
+        "metric": METRIC,
+        "value": round(mesh_run["tokens_per_sec_per_chip"], 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / TARGET_MFU, 4) if on_tpu else None,
+        "extra": {
+            "baseline_target_mfu": TARGET_MFU,
+            "mesh": mesh_run["mesh"],
+            "n_chips": mesh_run["n_chips"],
+            "scaling_efficiency": round(eff, 4),
+            "single_chip_tokens_per_sec": round(single["tokens_per_sec_per_chip"], 1),
+            "step_ms": round(mesh_run["step_ms"], 2),
+            "single_chip_step_ms": round(single["step_ms"], 2),
+            "mfu": round(mfu, 4) if on_tpu else None,
+            "achieved_tflops": round(achieved_tflops, 2),
+            "peak_tflops": peak,
+            "config": {
+                "hidden": cfg.hidden_size, "layers": cfg.num_hidden_layers,
+                "per_chip_batch": per_chip_batch, "seq": seq,
+                "backend": jax.default_backend(),
+            },
+            "device_kind": _device_kind(),
+            "loss": round(mesh_run["loss"], 4),
+            **({"oom_fallbacks": oom} if oom else {}),
+        },
+    }
+
+
+def _mesh_run_main(spec: str) -> int:
+    """Child mode: mesh perf on the live (TPU) backend, one JSON line."""
+    result = run_mesh_bench(parse_mesh_spec(spec), on_tpu=True)
+    print(json.dumps(result))
+    return 0
+
+
+def main_mesh(spec: str) -> int:
+    """Parent for --mesh: real TPU pod when it has enough chips (in a
+    budgeted child, like --tpu-run), else an emulated CPU mesh in-process
+    (the backend probe result decides; a JAX_PLATFORMS=cpu pin always
+    emulates). Always emits ONE JSON line."""
+    import os
+
+    from accelerate_tpu.utils.platforms import (
+        force_cpu_platform,
+        probe_backend_info,
+        run_with_group_timeout,
+    )
+
+    mesh_spec = parse_mesh_spec(spec)
+    import math
+
+    n_chips = math.prod(mesh_spec.values())
+    pin = (
+        os.environ.get("ACCELERATE_TPU_PLATFORM") or os.environ.get("JAX_PLATFORMS") or ""
+    ).split(",")[0].strip().lower()
+    info = None if pin == "cpu" else probe_backend_info(timeout=90.0, fresh=True)
+    errors = []
+    if info and info.get("platform") not in (None, "cpu") and \
+            int(info.get("device_count") or 0) >= n_chips:
+        rc, stdout = run_with_group_timeout(
+            [sys.executable, os.path.abspath(__file__), "--mesh-run", spec],
+            timeout=900.0,
+        )
+        for line in reversed(stdout.splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                print(line)
+                return 0
+        errors.append(f"tpu mesh child rc={rc} without a result line")
+    elif info and info.get("platform") not in (None, "cpu"):
+        errors.append(
+            f"tpu backend has {info.get('device_count')} chip(s); mesh needs "
+            f"{n_chips} — falling back to emulation")
+    force_cpu_platform(num_virtual_devices=n_chips)
+    result = run_mesh_bench(mesh_spec, on_tpu=False)
+    result["extra"]["emulated"] = True
+    if errors:
+        result["error"] = "; ".join(errors)
+    print(json.dumps(result))
+    return 0
 
 
 def _tpu_run_main() -> int:
@@ -394,7 +665,9 @@ def main() -> int:
         except Exception as e:  # noqa: BLE001 - must emit JSON no matter what
             traceback.print_exc(file=sys.stderr)
             errors.append(f"cpu smoke: {type(e).__name__}: {e}")
-            result = {"metric": METRIC, "value": 0.0, "unit": "tokens/s/chip", "vs_baseline": 0.0}
+            result = {"metric": METRIC, "value": 0.0, "unit": "tokens/s/chip",
+                      "vs_baseline": None,
+                      "extra": {"baseline_target_mfu": TARGET_MFU}}
         # Attach the watcher's availability record: a CPU-smoke round
         # artifact should say HOW unreachable the chip was, not just that
         # one probe failed at capture time.
@@ -408,5 +681,30 @@ def main() -> int:
     return 0
 
 
+def _arg_value(flag: str) -> str | None:
+    idx = sys.argv.index(flag)
+    return sys.argv[idx + 1] if idx + 1 < len(sys.argv) else None
+
+
+def _cli() -> int:
+    if "--tpu-run" in sys.argv:
+        return _tpu_run_main()
+    for flag, runner in (("--mesh-run", _mesh_run_main), ("--mesh", main_mesh)):
+        if flag in sys.argv:
+            spec = _arg_value(flag)
+            try:
+                if spec is None:
+                    raise ValueError(f"{flag} needs a spec, e.g. {flag} dp=8")
+                return runner(spec)
+            except ValueError as e:
+                # The bench contract: every failure path still emits ONE
+                # JSON line (a driver parses stdout for it).
+                print(json.dumps({"metric": METRIC, "value": 0.0,
+                                  "unit": "tokens/s/chip", "vs_baseline": None,
+                                  "error": str(e)}))
+                return 2
+    return main()
+
+
 if __name__ == "__main__":
-    sys.exit(_tpu_run_main() if "--tpu-run" in sys.argv else main())
+    sys.exit(_cli())
