@@ -51,7 +51,13 @@ import os
 import sys
 import time
 
-ARTIFACT_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "bench_artifacts")
+# Overridable for the rehearsal lane (tests/test_watch_rehearsal.py): real
+# child processes must checkpoint into the test's sandbox, never the live
+# artifact dir a concurrently armed watcher is writing.
+ARTIFACT_DIR = os.environ.get(
+    "ACCELERATE_TPU_BENCH_ARTIFACT_DIR",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), "bench_artifacts"),
+)
 HISTORY = os.path.join(ARTIFACT_DIR, "history.jsonl")
 BEST = os.path.join(ARTIFACT_DIR, "best.json")
 QUICKFLASH = os.path.join(ARTIFACT_DIR, "quickflash.json")
@@ -100,6 +106,17 @@ def _append_history(event: dict) -> None:
 def _emit(result: dict) -> None:
     """Child mode: print the marked result line for the parent."""
     print(RESULT_MARK + json.dumps(result), flush=True)
+
+
+def _fault_delay() -> None:
+    """Rehearsal hook: simulate the tunnel's ~25 s/compile latency so the
+    CPU fault-injection lane (tests/test_watch_rehearsal.py) can land
+    budget kills mid-stage and assert each stage persisted its evidence
+    first. No-op unless ACCELERATE_TPU_BENCH_FAULT_DELAY_S is set — never
+    set in production."""
+    d = float(os.environ.get("ACCELERATE_TPU_BENCH_FAULT_DELAY_S", "0") or 0)
+    if d:
+        time.sleep(d)
 
 
 def _timeit_ms(fn, *args, iters: int = 10, warmup: int = 2) -> float:
@@ -205,6 +222,7 @@ def run_quickflash() -> dict:
     assert tiny or not flash_pallas._interpret(), (
         "quickflash would run interpreted, not compiled"
     )
+    _fault_delay()  # rehearsal: the one flash compile
     out.update(_flash_bf16_fwd_parity(tiny))
     out["ts"] = _now()
     # Same publish filter as the kernels salvage path (not just the assert,
@@ -252,6 +270,7 @@ def run_kernels() -> dict:
         return tuple(jax.random.normal(k, (B, S, H, D), dtype) for k in ks)
 
     def check(name, got, want, tol):
+        _fault_delay()  # rehearsal: each check "costs a tunnel compile"
         err = _max_rel_err(got, want)
         out["checks"][name] = {"max_rel_err": round(err, 6), "tol": tol, "ok": err <= tol}
         # Checkpoint after every check: the tunnel makes each Mosaic compile
@@ -488,6 +507,7 @@ def run_sweep() -> dict:
         "interpret_mode": flash_pallas._interpret(),
     }
     for bq, bk in combos:
+        _fault_delay()  # rehearsal: each combo "costs a tunnel compile"
         fn = jax.jit(
             jax.grad(
                 lambda q, k, v, bq=bq, bk=bk: pallas_flash_attention(
@@ -608,6 +628,38 @@ def _run_child(
     if rc is None:
         return None, f"killed at {budget:.0f}s budget"
     return None, f"exited rc={rc} without a result"
+
+
+def _salvage_kernels_partial(err: str | None) -> tuple[dict | None, str | None]:
+    """Budget kill: salvage whatever the kernels child checkpointed.
+    Partial evidence with all-passing checks is still compiled-parity
+    proof. A concurrent debug/tiny run writes the same checkpoint path;
+    never publish interpret-mode or non-TPU evidence as compiled-TPU
+    proof."""
+    partial = _load_json(KERNELS_PARTIAL)
+    if partial and (partial.get("tiny_smoke") or partial.get("interpret_mode")
+                    or partial.get("backend") != "tpu"):
+        partial = None
+    if partial and partial.get("checks"):
+        partial["partial"] = True
+        partial["ok"] = all(c["ok"] for c in partial["checks"].values())
+        return partial, f"{err} (salvaged {len(partial['checks'])} checks)"
+    return None, err
+
+
+def _salvage_sweep_partial(err: str | None) -> tuple[dict | None, str | None]:
+    """Sweep analogue of :func:`_salvage_kernels_partial`: same
+    compiled-on-TPU publish gate (the two must not drift), but the sweep's
+    ``ok`` means "at least one combo timed" and is already maintained by
+    the child's per-combo checkpoints."""
+    partial = _load_json(SWEEP_PARTIAL)
+    if partial and (partial.get("tiny_smoke") or partial.get("interpret_mode")
+                    or partial.get("backend") != "tpu"):
+        partial = None
+    if partial and partial.get("ok"):
+        partial["partial"] = True
+        return partial, f"{err} (salvaged {len(partial['rows'])} rows)"
+    return None, err
 
 
 def _kernels_complete(device_kind: str | None = None) -> bool:
@@ -803,19 +855,7 @@ def run_cycle() -> float:
             pass
         kern, err = _run_child("--kernels-run", KERNELS_BUDGET)
         if kern is None:
-            # Budget kill: salvage whatever the child checkpointed. Partial
-            # evidence with all-passing checks is still compiled-parity proof.
-            partial = _load_json(KERNELS_PARTIAL)
-            # A concurrent debug/tiny run writes the same checkpoint path; never
-            # publish interpret-mode or non-TPU evidence as compiled-TPU proof.
-            if partial and (partial.get("tiny_smoke") or partial.get("interpret_mode")
-                            or partial.get("backend") != "tpu"):
-                partial = None
-            if partial and partial.get("checks"):
-                partial["partial"] = True
-                partial["ok"] = all(c["ok"] for c in partial["checks"].values())
-                kern = partial
-                err = f"{err} (salvaged {len(partial['checks'])} checks)"
+            kern, err = _salvage_kernels_partial(err)
         if kern is not None and kern.get("ok"):
             kern["ts"] = _now()
             _save_json(KERNELS, kern)
@@ -848,12 +888,7 @@ def run_cycle() -> float:
             pass
         sw, err = _run_child("--sweep-run", SWEEP_BUDGET)
         if sw is None:
-            partial = _load_json(SWEEP_PARTIAL)
-            if partial and not partial.get("tiny_smoke") and not partial.get(
-                    "interpret_mode") and partial.get("backend") == "tpu" and partial.get("ok"):
-                partial["partial"] = True
-                sw = partial
-                err = f"{err} (salvaged {len(partial['rows'])} rows)"
+            sw, err = _salvage_sweep_partial(err)
         if sw is not None and sw.get("ok"):
             sw["ts"] = _now()
             _save_json(SWEEP, sw)

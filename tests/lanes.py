@@ -58,6 +58,7 @@ LANES: dict[str, tuple[int, list[str]]] = {
     "subproc": (12, [
         "test_cli.py",
         "test_cli_deadbackend.py",
+        "test_watch_rehearsal.py",
         "test_examples.py",
     ]),
     "multiprocess": (8, [

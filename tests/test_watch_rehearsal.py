@@ -1,0 +1,260 @@
+"""CPU fault-injection rehearsal of the bench watcher's tunnel window.
+
+The live path has historically executed against a real tunnel window at most
+once per round, so every property it depends on is rehearsed here with REAL
+child processes (tiny smoke mode), a simulated per-compile latency
+(ACCELERATE_TPU_BENCH_FAULT_DELAY_S — stands in for the tunnel's ~25 s
+Mosaic compiles), and budget kills landed mid-stage (VERDICT r4 #2):
+
+* quickflash completes well inside its wall budget and the cheapest-first
+  stage order is pinned,
+* the kernels child checkpoints per check, so a kill at ANY point leaves a
+  valid partial JSON whose checks are each complete,
+* the sweep child checkpoints per block combo the same way,
+* the salvage gate only publishes compiled-on-TPU partials,
+* stage budgets stay above their expected tunnel compile costs.
+
+Every child is pinned to CPU explicitly: _run_child strips JAX_PLATFORMS so
+real watcher children probe the default backend — the rehearsal must never
+dial a live tunnel from CI.
+"""
+
+import json
+import os
+import sys
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench_watch  # noqa: E402
+
+TINY_CPU_ENV = {
+    "JAX_PLATFORMS": "cpu",
+    "ACCELERATE_TPU_PLATFORM": "cpu",
+    "ACCELERATE_TPU_BENCH_TINY": "1",
+}
+
+
+@pytest.fixture
+def artifacts(tmp_path, monkeypatch):
+    d = tmp_path / "bench_artifacts"
+    for name, path in (
+        ("ARTIFACT_DIR", d),
+        ("HISTORY", d / "history.jsonl"),
+        ("BEST", d / "best.json"),
+        ("KERNELS", d / "kernels.json"),
+        ("KERNELS_PARTIAL", d / "kernels_partial.json"),
+        ("QUICKFLASH", d / "quickflash.json"),
+        ("BIGMODEL", d / "bigmodel.json"),
+        ("SWEEP", d / "sweep.json"),
+        ("SWEEP_PARTIAL", d / "sweep_partial.json"),
+        ("LOG", d / "watch.log"),
+    ):
+        monkeypatch.setattr(bench_watch, name, str(path))
+    return d
+
+
+def _child(mode: str, budget: float, artifacts, extra_env=None):
+    """A REAL watcher child (fresh interpreter), artifact paths redirected
+    into the test dir via env so its checkpoints land where we can read
+    them."""
+    env = {
+        **TINY_CPU_ENV,
+        "ACCELERATE_TPU_BENCH_ARTIFACT_DIR": str(artifacts),
+        **(extra_env or {}),
+    }
+    t0 = time.perf_counter()
+    result, err = bench_watch._run_child(mode, budget, extra_env=env)
+    return result, err, time.perf_counter() - t0
+
+
+class TestQuickflash:
+    def test_completes_inside_wall_budget(self, artifacts):
+        """The cheapest compiled evidence must land fast: even with the
+        injected per-compile delay the child finishes far inside its
+        budget (the real contract: 2 compiles x ~25 s < 180 s budget)."""
+        result, err, wall = _child(
+            "--quickflash-run", bench_watch.QUICKFLASH_BUDGET, artifacts,
+            extra_env={"ACCELERATE_TPU_BENCH_FAULT_DELAY_S": "1"})
+        assert err is None and result is not None, err
+        assert result["ok"] is True, result
+        assert wall < 90, f"quickflash took {wall:.0f}s wall"
+        # Tiny/CPU evidence is NEVER published as compiled proof.
+        assert bench_watch._load_json(bench_watch.QUICKFLASH) is None
+
+    def test_kill_returns_no_result(self, artifacts):
+        """A budget kill mid-compile yields (None, killed-at) — the signal
+        run_cycle uses to flip tier1 onto the einsum path."""
+        result, err, wall = _child(
+            "--quickflash-run", 3.0, artifacts,
+            extra_env={"ACCELERATE_TPU_BENCH_FAULT_DELAY_S": "30"})
+        assert result is None and "killed at 3s budget" in err
+
+
+class TestKernelsCheckpointing:
+    # Scaled-down analogue of the VERDICT's random-kill points T in
+    # {60, 120, 300}s: with a 1 s/check injected compile cost these land
+    # the kill after ~backend-init, mid-run, and near the end.
+    @pytest.mark.parametrize("budget", [6.0, 12.0, 20.0])
+    def test_partial_valid_after_any_kill_point(self, artifacts, budget):
+        result, err, wall = _child(
+            "--kernels-run", budget, artifacts,
+            extra_env={"ACCELERATE_TPU_BENCH_FAULT_DELAY_S": "1"})
+        partial_path = os.path.join(str(artifacts), "kernels_partial.json")
+        if result is not None:
+            # Budget generous enough for the whole tiny suite on this box:
+            # nothing to salvage, the full result stands.
+            assert result["checks"], result
+            return
+        assert "killed at" in err, err
+        # The partial checkpoint must be valid JSON (atomic per-check
+        # writes) and every recorded check complete — a kill mid-write or
+        # mid-check must never surface a torn artifact.
+        raw = open(partial_path).read() if os.path.exists(partial_path) else None
+        if raw is None:
+            # Killed before the first check completed — acceptable, that's
+            # what the quickflash stage exists to cover.
+            return
+        partial = json.loads(raw)
+        assert partial["checks"], partial
+        for name, c in partial["checks"].items():
+            assert set(c) >= {"ok", "max_rel_err", "tol"}, (name, c)
+
+    def test_guaranteed_midrun_kill_leaves_complete_checks(self, artifacts):
+        """A kill that PROVABLY lands mid-run (8 s/check vs a 20 s budget:
+        the first check finishes, the full ~18-check suite cannot) leaves a
+        partial with >= 1 complete check — the property that makes a burned
+        window still produce evidence. Unlike the parametrized cases above,
+        this one fails if the kill path stops being exercised."""
+        result, err, wall = _child(
+            "--kernels-run", 20.0, artifacts,
+            extra_env={"ACCELERATE_TPU_BENCH_FAULT_DELAY_S": "8"})
+        assert result is None and "killed at" in err, (result, err)
+        partial = json.loads(
+            open(os.path.join(str(artifacts), "kernels_partial.json")).read())
+        assert partial["checks"], "first check must checkpoint before the kill"
+        for name, c in partial["checks"].items():
+            assert set(c) >= {"ok", "max_rel_err", "tol"}, (name, c)
+
+    def test_salvage_gate_rejects_noncompiled_and_accepts_tpu(self, artifacts):
+        """The salvage path publishes ONLY compiled-on-TPU partials: a
+        tiny/CPU checkpoint (what this rehearsal produces) must be
+        rejected; a same-shape TPU record salvages with partial=True and
+        recomputed ok."""
+        bench_watch._save_json(bench_watch.KERNELS_PARTIAL, {
+            "backend": "cpu", "tiny_smoke": True, "interpret_mode": True,
+            "checks": {"flash_fwd_bf16_causal": {"ok": True, "max_rel_err": 0, "tol": 1}},
+        })
+        kern, err = bench_watch._salvage_kernels_partial("killed at 60s budget")
+        assert kern is None and err == "killed at 60s budget"
+
+        bench_watch._save_json(bench_watch.KERNELS_PARTIAL, {
+            "backend": "tpu", "tiny_smoke": False, "interpret_mode": False,
+            "device_kind": "TPU v5e",
+            "checks": {"flash_fwd_bf16_causal": {"ok": True, "max_rel_err": 0, "tol": 1},
+                       "flash_bwd_fp32": {"ok": True, "max_rel_err": 0, "tol": 1}},
+        })
+        kern, err = bench_watch._salvage_kernels_partial("killed at 60s budget")
+        assert kern is not None and kern["partial"] is True and kern["ok"] is True
+        assert "salvaged 2 checks" in err
+        # One failing check poisons ok — failing evidence is never "proof".
+        bench_watch._save_json(bench_watch.KERNELS_PARTIAL, {
+            "backend": "tpu", "tiny_smoke": False, "interpret_mode": False,
+            "checks": {"a": {"ok": True, "max_rel_err": 0, "tol": 1},
+                       "b": {"ok": False, "max_rel_err": 9, "tol": 1}},
+        })
+        kern, _ = bench_watch._salvage_kernels_partial("killed")
+        assert kern is not None and kern["ok"] is False
+
+
+class TestSweepCheckpointing:
+    def test_kill_keeps_timed_rows(self, artifacts):
+        """Each block combo checkpoints before the next starts: a mid-grid
+        kill leaves SWEEP_PARTIAL with the rows already timed and a best
+        consistent with them."""
+        result, err, wall = _child(
+            "--sweep-run", 14.0, artifacts,
+            extra_env={"ACCELERATE_TPU_BENCH_FAULT_DELAY_S": "3"})
+        partial_path = os.path.join(str(artifacts), "sweep_partial.json")
+        if result is not None:
+            assert result["rows"], result
+            return
+        assert "killed at" in err, err
+        if not os.path.exists(partial_path):
+            return  # killed before the first combo — valid, nothing torn
+        partial = json.loads(open(partial_path).read())
+        timed = [r for r in partial["rows"] if "fwdbwd_ms" in r]
+        if timed:
+            assert partial["ok"] is True
+            assert partial["best"] == min(timed, key=lambda r: r["fwdbwd_ms"])
+        assert partial["tiny_smoke"] is True  # never publishable as TPU proof
+
+    def test_guaranteed_midgrid_kill(self, artifacts):
+        """6 s/combo vs a 20 s budget: the 4-combo tiny grid cannot finish,
+        so the kill path is provably exercised; whatever was checkpointed
+        must be internally consistent."""
+        result, err, wall = _child(
+            "--sweep-run", 20.0, artifacts,
+            extra_env={"ACCELERATE_TPU_BENCH_FAULT_DELAY_S": "6"})
+        assert result is None and "killed at" in err, (result, err)
+        partial_path = os.path.join(str(artifacts), "sweep_partial.json")
+        if os.path.exists(partial_path):
+            partial = json.loads(open(partial_path).read())
+            timed = [r for r in partial["rows"] if "fwdbwd_ms" in r]
+            if timed:
+                assert partial["best"] == min(timed, key=lambda r: r["fwdbwd_ms"])
+
+    def test_salvage_gate_mirrors_kernels(self, artifacts):
+        """The sweep salvage gate must match _salvage_kernels_partial's
+        compiled-on-TPU filter: tiny/interpreted/CPU partials are rejected,
+        TPU partials with timed rows salvage with partial=True."""
+        bench_watch._save_json(bench_watch.SWEEP_PARTIAL, {
+            "backend": "cpu", "tiny_smoke": True, "interpret_mode": True,
+            "ok": True, "rows": [{"block_q": 128, "block_k": 128, "fwdbwd_ms": 1}],
+        })
+        sw, err = bench_watch._salvage_sweep_partial("killed at 60s budget")
+        assert sw is None and err == "killed at 60s budget"
+
+        bench_watch._save_json(bench_watch.SWEEP_PARTIAL, {
+            "backend": "tpu", "tiny_smoke": False, "interpret_mode": False,
+            "ok": True, "device_kind": "TPU v5e",
+            "rows": [{"block_q": 128, "block_k": 128, "fwdbwd_ms": 1}],
+            "best": {"block_q": 128, "block_k": 128, "fwdbwd_ms": 1},
+        })
+        sw, err = bench_watch._salvage_sweep_partial("killed at 60s budget")
+        assert sw is not None and sw["partial"] is True
+        assert "salvaged 1 rows" in err
+        # No timed rows (ok False): nothing to salvage.
+        bench_watch._save_json(bench_watch.SWEEP_PARTIAL, {
+            "backend": "tpu", "tiny_smoke": False, "interpret_mode": False,
+            "ok": False, "rows": [{"block_q": 128, "block_k": 128, "error": "x"}],
+        })
+        sw, _ = bench_watch._salvage_sweep_partial("killed")
+        assert sw is None
+
+
+class TestBudgetSanity:
+    """Budgets vs the tunnel's observed ~25 s/compile: a future edit that
+    shrinks a stage budget below its expected compile cost would burn a
+    window exactly like round 4's monolithic child did — pin the floor."""
+
+    COMPILE_S = 25.0
+
+    def test_stage_budgets_cover_expected_compiles(self):
+        # quickflash: backend init + ~2 compiles (flash + einsum ref).
+        assert bench_watch.QUICKFLASH_BUDGET >= 2 * self.COMPILE_S + 60
+        # kernels: ~11 Mosaic compiles + references.
+        assert bench_watch.KERNELS_BUDGET >= 11 * self.COMPILE_S + 120
+        # sweep: up to 9 combos, each fwd+bwd.
+        assert bench_watch.SWEEP_BUDGET >= 9 * self.COMPILE_S + 120
+        # tier1 must out-budget bench.py's own child default (480 s).
+        assert bench_watch.TIER1_BUDGET > 480
+
+    def test_cheapest_first_order(self):
+        """Ascending cost protects short windows: liveness < quickflash <
+        bigmodel-row < tier1 <= sweep < kernels."""
+        assert (bench_watch.LIVENESS_BUDGET < bench_watch.QUICKFLASH_BUDGET
+                < bench_watch.BIGMODEL_BUDGET < bench_watch.TIER1_BUDGET
+                <= bench_watch.SWEEP_BUDGET < bench_watch.KERNELS_BUDGET)
