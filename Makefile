@@ -5,14 +5,16 @@
 #   make test-models        model zoo + HF parity (~12 min)
 #   make test-subproc       CLI + example scripts (~12 min)
 #   make test-multiprocess  real jax.distributed  (~8 min)
-#   make test-all           full suite, no -x (one flake can't hide the rest)
+#   make test-all           default suite, no -x (one flake can't hide the rest)
+#   make test-nightly       + exhaustive nightly variants (-m "")
 #
 # Dev loop: run test-fast after every change; the others before a commit
-# that touches their area; test-all before shipping.
+# that touches their area; test-all before shipping. Exhaustive
+# parametrizations are @pytest.mark.nightly (excluded by pyproject addopts).
 
 PYTHON ?= python
 
-.PHONY: test-fast test-models test-subproc test-multiprocess test-all quality
+.PHONY: test-fast test-models test-subproc test-multiprocess test-all test-nightly quality
 
 test-fast:
 	$(PYTHON) -m pytest -q $$($(PYTHON) tests/lanes.py fast)
@@ -28,6 +30,9 @@ test-multiprocess:
 
 test-all:
 	$(PYTHON) -m pytest -q tests/
+
+test-nightly:
+	$(PYTHON) -m pytest -q -m "" tests/
 
 quality:
 	$(PYTHON) -m compileall -q accelerate_tpu bench.py bench_watch.py __graft_entry__.py

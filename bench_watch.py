@@ -108,6 +108,15 @@ def _emit(result: dict) -> None:
     print(RESULT_MARK + json.dumps(result), flush=True)
 
 
+def _is_compiled_tpu(record: dict | None) -> bool:
+    """THE compiled-on-TPU evidence predicate — every publish/salvage/skip
+    gate goes through this one function so the filters cannot drift: a
+    tiny smoke, an interpret-mode run, or a non-TPU backend is plumbing
+    output, never hardware evidence."""
+    return bool(record) and not record.get("tiny_smoke") and not record.get(
+        "interpret_mode") and record.get("backend") == "tpu"
+
+
 def _fault_delay() -> None:
     """Rehearsal hook: simulate the tunnel's ~25 s/compile latency so the
     CPU fault-injection lane (tests/test_watch_rehearsal.py) can land
@@ -227,8 +236,7 @@ def run_quickflash() -> dict:
     out["ts"] = _now()
     # Same publish filter as the kernels salvage path (not just the assert,
     # which python -O strips): only compiled-on-TPU passes become evidence.
-    if (out["ok"] and not tiny and not out["interpret_mode"]
-            and out["backend"] == "tpu"):
+    if out["ok"] and _is_compiled_tpu(out):
         _save_json(QUICKFLASH, out)
     return out
 
@@ -637,8 +645,7 @@ def _salvage_kernels_partial(err: str | None) -> tuple[dict | None, str | None]:
     never publish interpret-mode or non-TPU evidence as compiled-TPU
     proof."""
     partial = _load_json(KERNELS_PARTIAL)
-    if partial and (partial.get("tiny_smoke") or partial.get("interpret_mode")
-                    or partial.get("backend") != "tpu"):
+    if not _is_compiled_tpu(partial):
         partial = None
     if partial and partial.get("checks"):
         partial["partial"] = True
@@ -653,8 +660,7 @@ def _salvage_sweep_partial(err: str | None) -> tuple[dict | None, str | None]:
     ``ok`` means "at least one combo timed" and is already maintained by
     the child's per-combo checkpoints."""
     partial = _load_json(SWEEP_PARTIAL)
-    if partial and (partial.get("tiny_smoke") or partial.get("interpret_mode")
-                    or partial.get("backend") != "tpu"):
+    if not _is_compiled_tpu(partial):
         partial = None
     if partial and partial.get("ok"):
         partial["partial"] = True
@@ -675,8 +681,7 @@ def _kernels_complete(device_kind: str | None = None) -> bool:
     kern = _load_json(KERNELS)
     return bool(
         kern and kern.get("ok") and not kern.get("partial")
-        and kern.get("backend") == "tpu" and not kern.get("interpret_mode")
-        and not kern.get("tiny_smoke")
+        and _is_compiled_tpu(kern)
         and (device_kind is None or kern.get("device_kind") == device_kind)
     )
 

@@ -218,6 +218,12 @@ def main() -> int:
                     help="also time draft-model speculation with K drafts; "
                          "the draft is the same weights device-resident "
                          "(acceptance-1.0 upper bound; decoder-only)")
+    ap.add_argument("--emit-markdown", action="store_true",
+                    help="also print rows in EXACTLY the reference table's "
+                         "column shape (reference: benchmarks/"
+                         "big_model_inference/README.md:26-37) plus a "
+                         "Backend column, ready to append to "
+                         "benchmarks/README.md")
     args = ap.parse_args()
 
     from accelerate_tpu.utils.platforms import resolve_backend
@@ -263,6 +269,28 @@ def main() -> int:
               f"| {r['kv_s_per_token']*1000:.1f}ms | {nc} "
               f"| {r['hbm_resident_bytes']/2**30:.2f}GiB |{lk}{asst}")
     print()
+    if args.emit_markdown:
+        # The reference's own column shape (Model | load | s-per-token |
+        # dtype | memory placement | disk), plus Backend so TPU rows can be
+        # appended next to CPU rows without a new table. save_model writes
+        # the fp32 init params and load_checkpoint_and_dispatch applies no
+        # cast here, so dtype is float32 throughout.
+        from accelerate_tpu.utils.platforms import device_kind
+
+        backend = platform if platform == "cpu" else f"{platform} ({device_kind()})"
+        total_gib = n_params * 4 / 2**30
+        name = f"{args.family}-{args.size} ({n_params/1e6:.0f}M)"
+        print("| Model | Backend | Model load time | Generation time | dtype "
+              "| HBM use | Host RAM use | Disk offload |")
+        print("|:-----:|:-------:|:---------------:|:---------------:|:-----:"
+              "|:-------:|:------------:|:------------:|")
+        for r in rows:
+            host = total_gib if r["tier"] == "cpu" else 0.0
+            print(f"| {name} | {backend} | {r['load_s']:.1f}s "
+                  f"| {r['kv_s_per_token']:.2f}s per token | float32 "
+                  f"| {r['hbm_resident_bytes']/2**30:.2f}GB | {host:.2f}GB "
+                  f"| {'yes' if r['tier'] == 'disk' else 'no'} |")
+        print()
     print(json.dumps({"metric": "big_model_kv_decode_s_per_token",
                       "size": args.size, "family": args.family,
                       "platform": platform, "tiers": rows}))

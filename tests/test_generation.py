@@ -133,6 +133,7 @@ class TestGPT2Generate:
         params = m.init_params(jax.random.PRNGKey(0), batch_size=2, seq_len=8)
         return cfg, m, params
 
+    @pytest.mark.nightly  # llama's TestGreedyGenerate covers default runs
     def test_fused_matches_naive(self, gpt2):
         cfg, m, params = gpt2
         ref = naive_greedy(m, params, PROMPT, 6)
@@ -195,6 +196,7 @@ class TestMixtralGenerate:
     # faithful inference setting); the uncached reference forward drops past
     # capacity, so exact equality holds only while the router stays under
     # capacity — true for the random-init tiny config used here.
+    @pytest.mark.nightly  # llama's TestGreedyGenerate covers default runs
     def test_fused_matches_naive(self):
         from accelerate_tpu.models.mixtral import MixtralConfig, MixtralForCausalLM
         from accelerate_tpu.generation import generate

@@ -324,7 +324,9 @@ class TestGPTNeoX:
         params = convert_hf_state_dict(hf.state_dict(), "gpt_neox", strict=True)
         return hf, GPTNeoXForCausalLM(cfg), params
 
-    @pytest.mark.parametrize("parallel", [True, False])
+    @pytest.mark.parametrize("parallel", [
+        pytest.param(True, marks=pytest.mark.nightly), False,
+    ])
     def test_forward_parity(self, parallel):
         hf, model, params = self._pair(parallel)
         ids = (np.arange(20, dtype=np.int64).reshape(2, 10) * 3) % 96
@@ -726,7 +728,9 @@ class TestT5Generate:
         params = convert_hf_state_dict(hf.state_dict(), "t5", strict=True)
         return hf, T5ForConditionalGeneration(cfg), params
 
-    @pytest.mark.parametrize("variant", ["tied-relu", "flan"])
+    @pytest.mark.parametrize("variant", [
+        pytest.param("tied-relu", marks=pytest.mark.nightly), "flan",
+    ])
     def test_cached_generate_matches_hf(self, variant):
         from accelerate_tpu.generation import seq2seq_generate
 
@@ -937,7 +941,11 @@ class TestStreamedDispatch:
         (tmp_path / "config.json").write_text(json.dumps(hf_cfg.to_dict()))
         return hf
 
-    @pytest.mark.parametrize("tier", ["device", "cpu", "disk"])
+    @pytest.mark.parametrize("tier", [
+        pytest.param("device", marks=pytest.mark.nightly),
+        pytest.param("cpu", marks=pytest.mark.nightly),
+        "disk",  # hardest tier (offload folder + reload) stays default
+    ])
     def test_llama_parity_per_tier(self, tmp_path, tier):
         from accelerate_tpu.big_modeling import load_hf_checkpoint_and_dispatch
 
@@ -952,7 +960,13 @@ class TestStreamedDispatch:
             theirs = hf(torch.from_numpy(ids)).logits
         _logits_close(ours, theirs)
 
-    @pytest.mark.parametrize("family", ["gptj", "gpt_neox", "opt", "phi", "bloom"])
+    @pytest.mark.parametrize("family", [
+        "gptj",  # representative; the full family sweep runs nightly
+        pytest.param("gpt_neox", marks=pytest.mark.nightly),
+        pytest.param("opt", marks=pytest.mark.nightly),
+        pytest.param("phi", marks=pytest.mark.nightly),
+        pytest.param("bloom", marks=pytest.mark.nightly),
+    ])
     def test_benchmark_families_stream_and_decode(self, tmp_path, family):
         """The reference's benchmark families (GPT-J / GPT-NeoX / OPT) run
         through the block-streaming executor off a raw HF dir: forward
@@ -1114,7 +1128,9 @@ class TestStreamedMixtral:
         (tmp_path / "config.json").write_text(json.dumps(hf_cfg.to_dict()))
         return hf
 
-    @pytest.mark.parametrize("tier", ["cpu", "disk"])
+    @pytest.mark.parametrize("tier", [
+        pytest.param("cpu", marks=pytest.mark.nightly), "disk",
+    ])
     def test_streamed_forward_parity(self, tmp_path, tier):
         from accelerate_tpu.big_modeling import load_hf_checkpoint_and_dispatch
 
@@ -1183,7 +1199,9 @@ class TestStreamedT5:
         (tmp_path / "config.json").write_text(json.dumps(hf_cfg.to_dict()))
         return hf
 
-    @pytest.mark.parametrize("tier", ["cpu", "disk"])
+    @pytest.mark.parametrize("tier", [
+        pytest.param("cpu", marks=pytest.mark.nightly), "disk",
+    ])
     def test_streamed_forward_parity(self, tmp_path, tier):
         from accelerate_tpu.big_modeling import load_hf_checkpoint_and_dispatch
 
