@@ -424,7 +424,13 @@ class TestRematPolicy:
         with pytest.raises(ValueError, match="unknown remat_policy"):
             resolve_remat_policy("some")
 
-    @pytest.mark.parametrize("policy_name", ["dots", "nothing", "everything"])
+    @pytest.mark.parametrize("policy_name", [
+        # "nothing" is the tier-1 ladder's base policy; the other two run
+        # nightly (the resolve/rejection unit tests stay default).
+        "nothing",
+        pytest.param("dots", marks=pytest.mark.nightly),
+        pytest.param("everything", marks=pytest.mark.nightly),
+    ])
     def test_train_step_runs_under_each_policy(self, policy_name):
         import optax
 

@@ -205,6 +205,8 @@ class TestMeshBench:
         with pytest.raises(ValueError, match="empty"):
             bench.parse_mesh_spec("")
 
+    @pytest.mark.nightly  # the driver's dryrun_multichip perf stage runs
+    # this harness every round; the default suite keeps the parse test.
     def test_emulated_mesh_run_schema_and_scaling(self):
         """The dp x fsdp composed run must emit the driver JSON schema with
         real scaling fields; numbers are meaningless on CPU but every

@@ -194,6 +194,7 @@ class TestGPT2:
             theirs = hf(torch.from_numpy(ids)).logits
         _logits_close(ours, theirs)
 
+    @pytest.mark.nightly  # llama/t5 roundtrips stay default
     def test_roundtrip(self):
         hf, _, params = self._pair()
         _roundtrip(params, "gpt2", hf.state_dict(), prefix="transformer.")
@@ -239,6 +240,7 @@ class TestGPTJ:
                                  do_sample=False)
         np.testing.assert_array_equal(np.asarray(ours), theirs.numpy())
 
+    @pytest.mark.nightly  # llama/t5 roundtrips stay default
     def test_roundtrip(self):
         hf, _, params = self._pair()
         _roundtrip(params, "gptj", hf.state_dict(), prefix="transformer.")
@@ -296,6 +298,7 @@ class TestBloom:
             np.testing.assert_allclose(
                 np.asarray(alibi_slopes(n)), hf_alibi[:, 0, 1].numpy(), rtol=1e-6)
 
+    @pytest.mark.nightly  # llama/t5 roundtrips stay default
     def test_roundtrip(self):
         hf, _, params = self._pair()
         _roundtrip(params, "bloom", hf.state_dict(), prefix="transformer.")
@@ -347,6 +350,7 @@ class TestGPTNeoX:
                                  do_sample=False)
         np.testing.assert_array_equal(np.asarray(ours), theirs.numpy())
 
+    @pytest.mark.nightly  # llama/t5 roundtrips stay default
     def test_roundtrip(self):
         hf, _, params = self._pair()
         _roundtrip(params, "gpt_neox", hf.state_dict(), prefix="gpt_neox.")
@@ -402,6 +406,7 @@ class TestOPT:
             if hf_eos.size:
                 assert (row_ours[hf_eos[0]:] == 2).all()
 
+    @pytest.mark.nightly  # llama/t5 roundtrips stay default
     def test_roundtrip(self):
         hf, _, params = self._pair()
         _roundtrip(params, "opt", hf.state_dict(), prefix="model.decoder.")
@@ -454,6 +459,7 @@ class TestPhi:
                                  do_sample=False)
         np.testing.assert_array_equal(np.asarray(ours), theirs.numpy())
 
+    @pytest.mark.nightly  # llama/t5 roundtrips stay default
     def test_roundtrip(self):
         hf, _, params = self._pair()
         _roundtrip(params, "phi", hf.state_dict(), prefix="model.")
@@ -494,6 +500,7 @@ class TestBert:
             theirs = hf(torch.from_numpy(ids), attention_mask=torch.from_numpy(mask)).logits
         _logits_close(ours, theirs)
 
+    @pytest.mark.nightly  # llama/t5 roundtrips stay default
     def test_roundtrip(self):
         hf, _, params = self._pair()
         _roundtrip(params, "bert", hf.state_dict(), prefix="bert.")
@@ -592,6 +599,7 @@ class TestMixtral:
             theirs = hf(torch.from_numpy(ids)).logits
         _logits_close(ours, theirs, atol=5e-4)
 
+    @pytest.mark.nightly  # llama/t5 roundtrips stay default
     def test_roundtrip(self):
         hf, _, params = self._pair()
         _roundtrip(params, "mixtral", hf.state_dict())
@@ -625,6 +633,8 @@ class TestViT:
         _logits_close(ours, theirs)
 
     def test_roundtrip(self):
+        # Stays DEFAULT (unlike the other family roundtrips): the only
+        # test of export_hf_state_dict's config= success path.
         hf, _, params, cfg = self._pair()
         exported = export_hf_state_dict(params, "vit", prefix="", config=cfg)
         back = convert_hf_state_dict(exported, "vit")
@@ -1365,6 +1375,7 @@ class TestQwen2:
             theirs = hf(torch.from_numpy(ids)).logits
         _logits_close(ours, theirs)
 
+    @pytest.mark.nightly  # llama/t5 roundtrips stay default
     def test_roundtrip(self):
         hf, _, params = self._pair()
         _roundtrip(params, "qwen2", hf.state_dict())
@@ -1414,6 +1425,7 @@ class TestGemma:
                                  do_sample=False)
         np.testing.assert_array_equal(ours, theirs.numpy())
 
+    @pytest.mark.nightly  # llama/t5 roundtrips stay default
     def test_roundtrip(self):
         hf, _, params = self._pair()
         _roundtrip(params, "gemma", hf.state_dict())
@@ -1559,6 +1571,7 @@ class TestGemma2:
                                  do_sample=False)
         np.testing.assert_array_equal(ours, theirs.numpy())
 
+    @pytest.mark.nightly  # llama/t5 roundtrips stay default
     def test_roundtrip(self):
         hf, _, params = self._pair()
         _roundtrip(params, "gemma2", hf.state_dict())
@@ -1682,6 +1695,7 @@ class TestQwen2Moe:
                                  do_sample=False)
         np.testing.assert_array_equal(ours, theirs.numpy())
 
+    @pytest.mark.nightly  # llama/t5 roundtrips stay default
     def test_roundtrip(self):
         hf, _, params = self._pair()
         _roundtrip(params, "qwen2_moe", hf.state_dict())

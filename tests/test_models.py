@@ -198,6 +198,8 @@ class TestResNet:
 
 
 class TestViT:
+    @pytest.mark.nightly  # T5's fsdp+tp train covers the default mesh-train
+    # proof; ViT forward parity stays default in test_hf_interop.
     def test_trains_under_fsdp_tp_mesh(self):
         """ViT trains with the fused step on a composed mesh — the vision
         counterpart of the transformer families' sharding tests."""

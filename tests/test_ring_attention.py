@@ -57,7 +57,10 @@ def test_cp_attention_grads_match(fn):
 
 
 @pytest.mark.parametrize("causal", [True, False])
-@pytest.mark.parametrize("inner_chunk", [4, 8, 16])
+@pytest.mark.parametrize("inner_chunk", [
+    pytest.param(4, marks=pytest.mark.nightly), 8,
+    pytest.param(16, marks=pytest.mark.nightly),
+])
 def test_ring_attention_sub_chunked_inner_matches_full(causal, inner_chunk):
     """The inner sub-chunking (logits tile bounded at [.., S_local, inner])
     must stay exact for every tile/boundary alignment, incl. grads."""
