@@ -948,6 +948,53 @@ class StreamedModel:
         logits = args[0]
         return logits if return_logits else jnp.argmax(logits, axis=-1)
 
+    def _bucketed_caches(self, batch: int, cache_len: int, extra_slack: int,
+                         cache_dtype) -> tuple[list, bool]:
+        """Build KV caches with the length bucketed to a 128-multiple and
+        decide whether the prompt may be right-padded for prefill reuse.
+
+        Without bucketing, every distinct (prompt length, max_new_tokens)
+        pair gives new cache shapes and a new prompt shape — re-jitting
+        every block kind's prefill AND decode executables per call in
+        interactive use. Bucketing shares them per 128-bucket; the pad KV
+        is provably never attended (full caches mask ``k_pos <= q_pos``
+        and pad slots stay ahead of the committed frontier until decode
+        overwrites them; ring caches mask by stored position — see
+        generation._compiled_lookup_generate for the full argument).
+
+        Ring (sliding-window) caches additionally need ``ring_slack``
+        covering the pad (< 128) plus the caller's ``extra_slack`` so pad
+        writes can't evict in-window prompt keys. A user-supplied factory
+        without a ring_slack parameter that builds ring caches gets NO
+        padding (correctness first — the caller keeps exact-length
+        prefill); the speculative paths separately reject that factory
+        shape as before. Returns (device-placed caches, pad_ok).
+        """
+        import inspect
+
+        L = -(-cache_len // 128) * 128
+        dt = _cache_dtype_kwargs(self.cache_factory, cache_dtype)
+        takes_slack = "ring_slack" in inspect.signature(self.cache_factory).parameters
+        if takes_slack:
+            caches = list(self.cache_factory(batch, L,
+                                             ring_slack=extra_slack + 128, **dt))
+            pad_ok = True
+        else:
+            caches = list(self.cache_factory(batch, L, **dt))
+            pad_ok = not any("pos" in c for c in caches)
+        return [jax.device_put(c, self.device) for c in caches], pad_ok
+
+    @staticmethod
+    def _pad_prompt(ids, pad_ok: bool):
+        """Right-pad the prompt to its 128-bucket (id value irrelevant —
+        the pad KV is masked); the caller reads predictions at the true
+        last position. No-op when padding is unsafe or already aligned."""
+        S = ids.shape[1]
+        P = -(-S // 128) * 128
+        if not pad_ok or P == S:
+            return ids
+        return jnp.pad(ids, ((0, 0), (0, P - S)))
+
     def generate(self, input_ids, max_new_tokens: int = 20,
                  eos_token_id: Optional[int] = None, use_cache: bool = True,
                  prompt_lookup_num_tokens: Optional[int] = None,
@@ -988,7 +1035,12 @@ class StreamedModel:
         ``cache_dtype`` sets the KV-cache element dtype for every cache
         this call builds — the target's and, under assisted generation,
         the draft's (matching generation.assisted_generate). None keeps
-        each factory's own default (bf16 for registry factories)."""
+        each factory's own default (bf16 for registry factories).
+
+        Cache lengths and the prompt are bucketed to 128-multiples
+        (:meth:`_bucketed_caches`), so interactive use with varied prompt
+        lengths re-jits each block kind once per bucket, not once per
+        exact (prompt, max_new_tokens) pair."""
         if any(s.stage == "enc" for s in self.specs):
             raise TypeError(
                 "this is an encoder-decoder model; use seq2seq_generate")
@@ -1061,14 +1113,13 @@ class StreamedModel:
                 ids, max_new_tokens, eos_token_id,
                 int(prompt_lookup_num_tokens), int(lookup_ngram),
                 sampling=sampling, rng=rng, cache_dtype=cache_dtype)
-        dt = _cache_dtype_kwargs(self.cache_factory, cache_dtype)
-        caches = list(self.cache_factory(B, S + max_new_tokens, **dt))
-        caches = [jax.device_put(c, self.device) for c in caches]
+        caches, pad_ok = self._bucketed_caches(B, S + max_new_tokens, 0, cache_dtype)
+        ids_p = self._pad_prompt(ids, pad_ok)
         sample = sampling is not None
-        out = self._cached_pass((jax.device_put(ids, self.device),), caches, 0,
+        out = self._cached_pass((jax.device_put(ids_p, self.device),), caches, 0,
                                 return_logits=sample)
         rng, key = jax.random.split(rng)
-        tok = pick(out[:, -1, :], key) if sample else out[:, -1]
+        tok = pick(out[:, S - 1, :], key) if sample else out[:, S - 1]
         pieces = [ids, tok[:, None].astype(ids.dtype)]
         for t in range(1, max_new_tokens):
             if eos_token_id is not None and bool((tok == eos_token_id).all()):
@@ -1135,13 +1186,16 @@ class StreamedModel:
         # The draft decodes at positions up to S + max_new_tokens + K - 3.
         _check_position_bound(draft_module, S + max_new_tokens + K - 2,
                               label="prompt + max_new_tokens + draft slack")
-        L = S + max_new_tokens + K + 1
+        # Cache length and prompt bucketed like the target's (registry
+        # factories always take ring_slack; +128 covers the pad writes).
+        L = -(-(S + max_new_tokens + K + 1) // 128) * 128
         # The draft cache follows the caller's cache dtype (matching
         # generation.assisted_generate): a bf16-forced cache on an fp32
         # draft can lower acceptance rate, costing target passes.
-        dcache = dfactory(1, L, cache_dtype or jnp.bfloat16, ring_slack=K + 1)
+        dcache = dfactory(1, L, cache_dtype or jnp.bfloat16, ring_slack=K + 1 + 128)
         prefill_d, draft_k = _compiled_drafter(draft_module, K)
-        dcache = prefill_d(draft_params, jnp.asarray(ids), dcache)
+        dcache = prefill_d(draft_params, self._pad_prompt(jnp.asarray(ids), True),
+                           dcache)
 
         def drafter(committed, dcache):
             tok = jnp.asarray([[committed[-1]]], jnp.asarray(ids).dtype)
@@ -1166,38 +1220,31 @@ class StreamedModel:
         import numpy as np
 
         S = ids.shape[1]
-        import inspect
-
-        # Signature introspection, not try/except: a bare TypeError catch
-        # would silently drop the correctness-critical ring_slack (and mask
-        # real bugs inside a slack-aware factory).
-        takes_slack = "ring_slack" in inspect.signature(self.cache_factory).parameters
-        dt = _cache_dtype_kwargs(self.cache_factory, cache_dtype)
-        if takes_slack:
-            caches = list(self.cache_factory(1, S + max_new_tokens + K + 1,
-                                             ring_slack=K + 1, **dt))
-        else:
-            caches = list(self.cache_factory(1, S + max_new_tokens + K + 1, **dt))
-            if any("pos" in c for c in caches):
-                raise ValueError(
-                    "this model's cache_factory builds ring (sliding-window) "
-                    "caches but does not accept ring_slack — speculation "
-                    "would evict in-window keys; add ring_slack support "
-                    "(see big_modeling.cache_factory_for)")
-        caches = [jax.device_put(c, self.device) for c in caches]
+        caches, pad_ok = self._bucketed_caches(1, S + max_new_tokens + K + 1,
+                                               K + 1, cache_dtype)
+        if not pad_ok:
+            # _bucketed_caches only reports pad_ok False for ring caches
+            # from a factory without ring_slack support: there the
+            # overshooting verification chunks would evict in-window keys.
+            raise ValueError(
+                "this model's cache_factory builds ring (sliding-window) "
+                "caches but does not accept ring_slack — speculation "
+                "would evict in-window keys; add ring_slack support "
+                "(see big_modeling.cache_factory_for)")
+        ids_p = self._pad_prompt(ids, pad_ok)
         sample = sampling is not None
         if sample:
             from .generation import _make_warper, speculative_accept
 
             warp = _make_warper(sampling)
             rng = rng if rng is not None else jax.random.PRNGKey(0)
-        out = self._cached_pass((jax.device_put(ids, self.device),), caches, 0,
+        out = self._cached_pass((jax.device_put(ids_p, self.device),), caches, 0,
                                 return_logits=sample)
         if sample:
             rng, key = jax.random.split(rng)
-            first = jax.random.categorical(key, warp(out[:, -1, :]), axis=-1)[0]
+            first = jax.random.categorical(key, warp(out[:, S - 1, :]), axis=-1)[0]
         else:
-            first = out[0, -1]
+            first = out[0, S - 1]
         committed = np.asarray(ids[0]).tolist() + [int(first)]
         eos_done = eos_token_id is not None and int(first) == eos_token_id
         while len(committed) - S < max_new_tokens and not eos_done:
