@@ -40,6 +40,7 @@ LANES: dict[str, tuple[int, list[str]]] = {
         "test_other_utils.py",
         "test_packing.py",
         "test_perf_guards.py",
+        "test_precision.py",
         "test_ring_attention.py",
         "test_state.py",
         "test_tracking.py",

@@ -1,0 +1,149 @@
+"""Direct unit tests of precision.py — the fp16 GradScaler state machine
+and dtype policies (reference: torch.cuda.amp.GradScaler semantics,
+accelerator.py:466-494; previously covered only indirectly through fp16
+end-to-end training, which can't distinguish growth/backoff boundary bugs
+from plain convergence)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from accelerate_tpu.precision import (
+    LossScaleState,
+    grads_finite,
+    make_loss_scale,
+    policy_for,
+    scale_loss,
+    unscale_grads,
+    update_loss_scale,
+)
+from accelerate_tpu.utils.dataclasses import GradScalerKwargs
+
+
+class TestPolicy:
+    @pytest.mark.parametrize("mp,compute", [
+        ("no", jnp.float32), ("fp32", jnp.float32),
+        ("bf16", jnp.bfloat16), ("fp16", jnp.float16),
+        ("fp8", jnp.bfloat16),  # fp8 matmuls are per-op; policy is bf16
+    ])
+    def test_policy_for_mapping(self, mp, compute):
+        p = policy_for(mp)
+        assert p.compute_dtype == compute
+        assert p.param_dtype == jnp.float32 and p.output_dtype == jnp.float32
+
+    def test_unknown_mode_raises(self):
+        with pytest.raises(ValueError, match="Unknown mixed precision"):
+            policy_for("tf32")
+
+    def test_cast_skips_non_float_and_fp8_meta(self):
+        """Int leaves pass through untouched and fp8 delayed-scaling
+        statistics stay fp32 by contract (casting them quantizes every
+        scale and breaks the amax-history scatter)."""
+        p = policy_for("bf16")
+        tree = {
+            "w": jnp.ones((2,), jnp.float32),
+            "ids": jnp.ones((2,), jnp.int32),
+            "kernel_amax_history": jnp.ones((4,), jnp.float32),
+            "kernel_scale": jnp.ones((), jnp.float32),
+        }
+        out = p.cast_to_compute(tree)
+        assert out["w"].dtype == jnp.bfloat16
+        assert out["ids"].dtype == jnp.int32
+        assert out["kernel_amax_history"].dtype == jnp.float32
+        assert out["kernel_scale"].dtype == jnp.float32
+
+
+class TestLossScaleState:
+    def test_disabled_returns_none(self):
+        assert make_loss_scale(GradScalerKwargs(enabled=False)) is None
+        assert make_loss_scale(enabled=False) is None
+
+    def test_scale_and_unscale_round_trip(self):
+        st = make_loss_scale(GradScalerKwargs(init_scale=2.0**10))
+        loss = jnp.asarray(3.0, jnp.float16)
+        scaled = scale_loss(loss, st)
+        assert float(scaled) == pytest.approx(3.0 * 2**10)
+        grads = {"w": jnp.asarray([2.0**11], jnp.float16)}
+        un = unscale_grads(grads, st)
+        assert float(un["w"][0]) == pytest.approx(2.0)
+        assert un["w"].dtype == jnp.float16  # dtype preserved
+        # None state: both are identity.
+        assert scale_loss(loss, None) is loss
+        assert unscale_grads(grads, None) is grads
+
+    def test_growth_exactly_at_interval(self):
+        """The scale doubles after growth_interval CONSECUTIVE finite
+        steps — not before — and the tracker resets after growing."""
+        kw = GradScalerKwargs(init_scale=4.0, growth_factor=2.0,
+                              growth_interval=3)
+        st = make_loss_scale(kw)
+        finite = jnp.asarray(True)
+        for i in range(2):
+            st = update_loss_scale(st, finite, kw)
+            assert float(st.scale) == 4.0, i  # not yet
+        st = update_loss_scale(st, finite, kw)
+        assert float(st.scale) == 8.0
+        assert int(st.growth_tracker) == 0  # reset after growth
+        assert int(st.fin_steps) == 3
+
+    def test_overflow_backs_off_and_resets_tracker(self):
+        kw = GradScalerKwargs(init_scale=1024.0, backoff_factor=0.5,
+                              growth_interval=4)
+        st = make_loss_scale(kw)
+        st = update_loss_scale(st, jnp.asarray(True), kw)
+        assert int(st.growth_tracker) == 1
+        st = update_loss_scale(st, jnp.asarray(False), kw)
+        assert float(st.scale) == 512.0
+        assert int(st.growth_tracker) == 0   # overflow breaks the streak
+        assert int(st.fin_steps) == 1        # skipped steps don't count
+        # A fresh streak must need the FULL interval again.
+        for _ in range(3):
+            st = update_loss_scale(st, jnp.asarray(True), kw)
+        assert float(st.scale) == 512.0
+        st = update_loss_scale(st, jnp.asarray(True), kw)
+        assert float(st.scale) == 1024.0
+
+    def test_update_is_jittable(self):
+        """The step threads this state through jit — the update must be
+        trace-compatible (no Python branching on traced values)."""
+        kw = GradScalerKwargs(init_scale=8.0, growth_interval=1,
+                              growth_factor=2.0, backoff_factor=0.5)
+        st = make_loss_scale(kw)
+        upd = jax.jit(lambda s, f: update_loss_scale(s, f, kw))
+        grown = upd(st, jnp.asarray(True))
+        shrunk = upd(st, jnp.asarray(False))
+        assert float(grown.scale) == 16.0 and float(shrunk.scale) == 4.0
+
+
+class TestGradsFinite:
+    def test_detects_inf_nan_anywhere(self):
+        good = {"a": jnp.ones((2, 2)), "b": jnp.zeros((3,))}
+        assert bool(grads_finite(good))
+        for bad_val in (jnp.inf, -jnp.inf, jnp.nan):
+            bad = {"a": jnp.ones((2, 2)),
+                   "b": jnp.asarray([0.0, bad_val, 1.0])}
+            assert not bool(grads_finite(bad)), bad_val
+
+    def test_empty_tree_is_finite(self):
+        assert bool(grads_finite({}))
+
+    def test_fp16_overflow_grads_flag(self):
+        """The real fp16 failure mode: an overflowing product becomes inf
+        in fp16 and must flip the flag (driving the scaler's backoff)."""
+        g = jnp.asarray([6.0e4], jnp.float16) * jnp.asarray([2.0], jnp.float16)
+        assert not bool(grads_finite({"g": g}))
+
+
+class TestStatePytree:
+    def test_loss_scale_state_is_a_pytree_leaf_tuple(self):
+        """LossScaleState must flatten cleanly (it rides through jitted
+        train steps and checkpointing's optimizer_meta)."""
+        st = make_loss_scale()
+        leaves, treedef = jax.tree_util.tree_flatten(st)
+        assert len(leaves) == 3
+        back = jax.tree_util.tree_unflatten(treedef, leaves)
+        assert isinstance(back, LossScaleState)
+        assert float(back.scale) == float(st.scale)
+        np.testing.assert_array_equal(np.asarray(back.growth_tracker),
+                                      np.asarray(st.growth_tracker))
