@@ -123,6 +123,31 @@ def main():
         np.testing.assert_allclose(a, b, rtol=1e-6)
     print(f"  [p{i}] checkpoint round-trip ok")
 
+    # Debug-mode shape sanitizer (reference: verify_operation :368): with
+    # debug on, a rank-dependent gather shape must raise the per-rank
+    # table on EVERY rank (the sanitizer's own collectives are symmetric
+    # even when the payload shapes differ), and matched shapes must pass.
+    from accelerate_tpu.utils.operations import DistributedOperationException
+
+    prev_debug = PartialState._shared_state.get("debug", False)
+    PartialState._shared_state["debug"] = True
+    try:
+        ok_val = np.ones((2, 2), np.float32)
+        np.asarray(gather(ok_val))  # matched shapes sail through
+        ragged = np.ones((i + 1, 2), np.float32)  # shape differs per rank
+        try:
+            gather(ragged)
+        except DistributedOperationException as e:
+            assert "shapes differ across processes" in str(e)
+            assert f"Process {n - 1}" in str(e)  # per-rank table present
+        else:
+            raise AssertionError("debug sanitizer let mismatched shapes through")
+    finally:
+        # Restore, don't clobber: an operator-enabled debug mode
+        # (ACCELERATE_TPU_DEBUG=1) must survive this check.
+        PartialState._shared_state["debug"] = prev_debug
+    print(f"  [p{i}] debug shape sanitizer ok")
+
     acc.wait_for_everyone()
     if i == 0:
         print("All multi-process ops checks passed.")

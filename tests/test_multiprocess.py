@@ -47,7 +47,8 @@ class TestMultiProcessLaunch:
         assert "All multi-process ops checks passed" in res.stdout
         for check in ("gather ok", "gather(global array) ok", "gather_object ok",
                       "broadcast ok", "reduce ok", "pad_across_processes ok",
-                      "checkpoint round-trip ok"):
+                      "broadcast_object_list ok", "split_between_processes ok",
+                      "checkpoint round-trip ok", "debug shape sanitizer ok"):
             assert check in res.stdout, f"missing: {check}"
 
     def test_composed_mesh_four_processes(self):
