@@ -91,7 +91,7 @@ def find_executable_batch_size(
                 f"batch_size itself — call it without one: `{function.__name__}({shown})`"
             )
         while True:
-            if batch_size == 0:
+            if batch_size <= 0:
                 raise RuntimeError(
                     "OOM retries exhausted: the batch size reached 0 and the step still "
                     "does not fit. The model/activations alone exceed device memory."
@@ -101,7 +101,16 @@ def find_executable_batch_size(
             except Exception as e:
                 if _is_oom_error(e):
                     clear_device_cache(garbage_collection=True)
-                    batch_size = reduce_batch_size_fn(batch_size)
+                    reduced = reduce_batch_size_fn(batch_size)
+                    if reduced >= batch_size:
+                        # A non-decreasing reducer would retry the same OOM
+                        # forever; fail loudly instead of hanging training.
+                        raise RuntimeError(
+                            f"reduce_batch_size_fn must strictly decrease the batch "
+                            f"size (got {batch_size} -> {reduced}) — OOM retry would "
+                            "loop forever"
+                        ) from e
+                    batch_size = reduced
                 else:
                     raise
 

@@ -29,6 +29,7 @@ LANES: dict[str, tuple[int, list[str]]] = {
         "test_bench.py",
         "test_checkpointing.py",
         "test_data_loader.py",
+        "test_env_memory_utils.py",
         "test_flash_attention.py",
         "test_fused_loss.py",
         "test_lanes.py",

@@ -1,0 +1,197 @@
+"""Direct unit tests for the environment / memory / RNG reference-parity
+helpers (reference: utils/environment.py, utils/memory.py,
+utils/random.py) — user-facing utilities previously exercised only as
+side effects of larger flows."""
+
+import os
+import random
+
+import numpy as np
+import pytest
+
+from accelerate_tpu.utils.environment import (
+    get_int_from_env,
+    parse_choice_from_env,
+    parse_flag_from_env,
+    patch_environment,
+    str_to_bool,
+)
+from accelerate_tpu.utils.memory import (
+    _is_oom_error,
+    clear_device_cache,
+    find_executable_batch_size,
+    get_device_memory_stats,
+    release_memory,
+)
+
+
+class TestEnvHelpers:
+    @pytest.mark.parametrize("val", ["y", "YES", "t", "True", "on", "1"])
+    def test_str_to_bool_true(self, val):
+        assert str_to_bool(val) == 1
+
+    @pytest.mark.parametrize("val", ["n", "NO", "f", "False", "off", "0"])
+    def test_str_to_bool_false(self, val):
+        assert str_to_bool(val) == 0
+
+    def test_str_to_bool_rejects_garbage(self):
+        with pytest.raises(ValueError, match="invalid truth value"):
+            str_to_bool("maybe")
+
+    def test_get_int_from_env_first_match_and_default(self, monkeypatch):
+        monkeypatch.delenv("ATPU_A", raising=False)
+        monkeypatch.setenv("ATPU_B", "3")
+        assert get_int_from_env(["ATPU_A", "ATPU_B"], default=7) == 3
+        monkeypatch.delenv("ATPU_B")
+        assert get_int_from_env(["ATPU_A", "ATPU_B"], default=7) == 7
+        # Zero is a real value, not "unset" (world sizes, ranks).
+        monkeypatch.setenv("ATPU_A", "0")
+        assert get_int_from_env(["ATPU_A", "ATPU_B"], default=7) == 0
+
+    def test_parse_flag_and_choice(self, monkeypatch):
+        monkeypatch.setenv("ATPU_FLAG", "true")
+        assert parse_flag_from_env("ATPU_FLAG") is True
+        monkeypatch.delenv("ATPU_FLAG")
+        assert parse_flag_from_env("ATPU_FLAG", default=False) is False
+        monkeypatch.setenv("ATPU_CHOICE", "bf16")
+        assert parse_choice_from_env("ATPU_CHOICE") == "bf16"
+        monkeypatch.delenv("ATPU_CHOICE")
+        assert parse_choice_from_env("ATPU_CHOICE", default="no") == "no"
+
+    def test_patch_environment_sets_and_restores(self, monkeypatch):
+        monkeypatch.setenv("ATPU_KEEP", "orig")
+        monkeypatch.delenv("ATPU_NEW", raising=False)
+        with patch_environment(ATPU_KEEP="patched", ATPU_NEW="1"):
+            assert os.environ["ATPU_KEEP"] == "patched"
+            assert os.environ["ATPU_NEW"] == "1"
+        assert os.environ["ATPU_KEEP"] == "orig"
+        assert "ATPU_NEW" not in os.environ
+
+
+class TestMemoryHelpers:
+    def test_is_oom_error_matches_every_marker(self):
+        for msg in ("RESOURCE_EXHAUSTED: alloc", "Out of memory", "xyz out of memory",
+                    "Resource exhausted: hbm", "Attempting to allocate 3G",
+                    "total size exceeds the limit"):
+            assert _is_oom_error(RuntimeError(msg)), msg
+        assert _is_oom_error(MemoryError())
+        assert not _is_oom_error(ValueError("shape mismatch"))
+
+    def test_release_memory_returns_nones_for_unpacking(self):
+        a, b = object(), object()
+        a, b = release_memory(a, b)
+        assert a is None and b is None
+        assert release_memory() == []
+
+    def test_clear_device_cache_runs(self):
+        clear_device_cache(garbage_collection=True)  # must never raise
+
+    def test_get_device_memory_stats_shape(self):
+        stats = get_device_memory_stats()
+        assert set(stats) == {"bytes_in_use", "bytes_limit", "peak_bytes_in_use"}
+        assert all(isinstance(v, int) for v in stats.values())
+
+    def test_find_executable_batch_size_custom_reduce(self):
+        attempts = []
+
+        @find_executable_batch_size(starting_batch_size=10,
+                                    reduce_batch_size_fn=lambda b: b - 3)
+        def train(batch_size):
+            attempts.append(batch_size)
+            if batch_size > 5:
+                raise RuntimeError("Out of memory")
+            return batch_size
+
+        assert train() == 4
+        assert attempts == [10, 7, 4]
+
+    def test_find_executable_batch_size_exhaustion(self):
+        @find_executable_batch_size(starting_batch_size=2)
+        def train(batch_size):
+            raise RuntimeError("RESOURCE_EXHAUSTED")
+
+        with pytest.raises(RuntimeError, match="retries exhausted"):
+            train()
+
+    def test_find_executable_batch_size_overshooting_reducer(self):
+        """A custom reducer that steps PAST zero must still terminate in
+        the exhaustion error, never loop at negative batch sizes."""
+
+        @find_executable_batch_size(starting_batch_size=5,
+                                    reduce_batch_size_fn=lambda b: b - 3)
+        def train(batch_size):
+            raise RuntimeError("Out of memory")
+
+        with pytest.raises(RuntimeError, match="retries exhausted"):
+            train()  # 5 -> 2 -> -1 <= 0 stops the loop
+
+    def test_find_executable_batch_size_nondecreasing_reducer_raises(self):
+        """A non-decreasing reducer would retry the same OOM forever —
+        fail loudly instead of hanging training."""
+
+        @find_executable_batch_size(starting_batch_size=4,
+                                    reduce_batch_size_fn=lambda b: b)
+        def train(batch_size):
+            raise RuntimeError("RESOURCE_EXHAUSTED")
+
+        with pytest.raises(RuntimeError, match="strictly decrease"):
+            train()
+
+    def test_find_executable_batch_size_rejects_caller_batch(self):
+        """The decorator owns the batch_size slot; a caller-supplied value
+        would silently shift every other argument (reference: memory.py
+        guard)."""
+
+        @find_executable_batch_size(starting_batch_size=4)
+        def train(batch_size, data):
+            return batch_size
+
+        with pytest.raises(TypeError, match="batch_size itself"):
+            train(8, "data")
+        assert train("data") == 4
+
+
+class TestRNGHelpers:
+    def test_set_seed_reproduces_and_offsets(self):
+        from accelerate_tpu.utils.random import set_seed
+
+        used = set_seed(123)
+        a = (random.random(), np.random.rand())
+        assert used == 123
+        set_seed(123)
+        b = (random.random(), np.random.rand())
+        assert a == b
+        # Single process: device_specific offsets by process_index (0).
+        assert set_seed(123, device_specific=True) == 123
+
+    def test_synchronize_rng_states_single_process_noop(self):
+        from accelerate_tpu.utils.random import synchronize_rng_states
+
+        state = np.random.get_state()
+        synchronize_rng_states(["numpy", "python", "jax"])
+        after = np.random.get_state()
+        assert state[0] == after[0]
+        np.testing.assert_array_equal(state[1], after[1])
+
+    def test_rng_state_checkpoint_roundtrip(self):
+        """checkpointing.get_rng_state/set_rng_state must restore python +
+        numpy streams exactly (the per-process rng_state_{i}.json cycle)."""
+        import json
+
+        from accelerate_tpu.checkpointing import get_rng_state, set_rng_state
+        from accelerate_tpu.utils.random import set_seed
+
+        set_seed(99)
+        rng = get_rng_state()
+        # Serialize the way save_accelerator_state does (checkpointing.py
+        # rng_ser) and round-trip through JSON, as on disk.
+        snap = json.loads(json.dumps({
+            "python": [rng["python"][0], list(rng["python"][1]), rng["python"][2]],
+            "numpy": [rng["numpy"][0], np.asarray(rng["numpy"][1]).tolist(),
+                      *rng["numpy"][2:]],
+        }))
+        want = (random.random(), float(np.random.rand()))
+        set_seed(7)  # diverge
+        set_rng_state(snap, accelerator=None)
+        got = (random.random(), float(np.random.rand()))
+        assert got == want
