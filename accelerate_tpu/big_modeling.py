@@ -984,16 +984,18 @@ class StreamedModel:
             pad_ok = not any("pos" in c for c in caches)
         return [jax.device_put(c, self.device) for c in caches], pad_ok
 
-    @staticmethod
-    def _pad_prompt(ids, pad_ok: bool):
-        """Right-pad the prompt to its 128-bucket (id value irrelevant —
-        the pad KV is masked); the caller reads predictions at the true
-        last position. No-op when padding is unsafe or already aligned."""
-        S = ids.shape[1]
-        P = -(-S // 128) * 128
-        if not pad_ok or P == S:
+    def _pad_prompt(self, ids, pad_ok: bool, extra=None):
+        """Edge-pad the prompt to its 128-bucket via generation's ONE
+        bucketing rule (capped at this model's position table and
+        ``extra`` — an assistant draft module or raw bound). The pad KV is
+        masked; the caller reads predictions at the true last position.
+        No-op when padding is unsafe or already aligned."""
+        if not pad_ok:
             return ids
-        return jnp.pad(ids, ((0, 0), (0, P - S)))
+        from .generation import _bucket_and_pad
+
+        caps = [b for b in (self.position_bound, extra) if b is not None]
+        return _bucket_and_pad(ids, *caps)[0]
 
     def generate(self, input_ids, max_new_tokens: int = 20,
                  eos_token_id: Optional[int] = None, use_cache: bool = True,
@@ -1194,8 +1196,10 @@ class StreamedModel:
         # draft can lower acceptance rate, costing target passes.
         dcache = dfactory(1, L, cache_dtype or jnp.bfloat16, ring_slack=K + 1 + 128)
         prefill_d, draft_k = _compiled_drafter(draft_module, K)
-        dcache = prefill_d(draft_params, self._pad_prompt(jnp.asarray(ids), True),
-                           dcache)
+        dcache = prefill_d(
+            draft_params,
+            self._pad_prompt(jnp.asarray(ids), True, extra=draft_module),
+            dcache)
 
         def drafter(committed, dcache):
             tok = jnp.asarray([[committed[-1]]], jnp.asarray(ids).dtype)

@@ -236,7 +236,15 @@ def _compiled_generate(module, max_new_tokens: int, eos_token_id, cache_dtype,
     """(prefill, decode) jitted pair for this (model config, length, eos,
     dtype) — cached so repeat generate calls reuse the same jitted function
     objects (and therefore jax.jit's executable cache) instead of retracing
-    fresh closures every call."""
+    fresh closures every call.
+
+    The prompt length is NOT part of any executable's shape: the caller
+    buckets the cache length to a 128-multiple and EDGE-pads the prompt to
+    its own 128-bucket (repeating each row's last token, so the
+    repetition-penalty seen-set is unchanged — zero-padding would poison
+    it), and prefill reads the logits at the traced ``true_len - 1``. One
+    compiled (prefill, decode) pair per bucket; the pad KV is never
+    attended (the masking argument in :func:`_compiled_lookup_generate`)."""
     key = _cache_key(module, max_new_tokens, eos_token_id,
                      jnp.dtype(cache_dtype).name, sampling, repetition_penalty,
                      min_new_tokens)
@@ -249,16 +257,19 @@ def _compiled_generate(module, max_new_tokens: int, eos_token_id, cache_dtype,
     track_seen = repetition_penalty != 1.0
 
     @jax.jit
-    def prefill(params, ids, cache, rng):
+    def prefill(params, ids, cache, rng, true_len):
         logits, cache = module.apply({"params": params}, ids, cache=cache, cache_pos=0)
         if track_seen:
             # Repetition penalty counts the prompt too (transformers
             # semantics); off the penalty path the tracking (a [B, V] bool
             # per call) is skipped entirely — a (B, 1) dummy rides the carry.
+            # ids arrive edge-padded, so marking the pad positions re-marks
+            # each row's last real token: the seen-set is exact.
             seen = _mark_seen(jnp.zeros((ids.shape[0], logits.shape[-1]), bool), ids)
         else:
             seen = jnp.zeros((ids.shape[0], 1), bool)
-        last = _suppress_eos(logits[:, -1], 1, eos_token_id, min_new_tokens)
+        last_row = jax.lax.dynamic_slice_in_dim(logits, true_len - 1, 1, axis=1)[:, 0]
+        last = _suppress_eos(last_row, 1, eos_token_id, min_new_tokens)
         tok = select(last, rng, seen).astype(ids.dtype)
         return tok, cache, (_mark_seen(seen, tok) if track_seen else seen)
 
@@ -275,6 +286,27 @@ def _compiled_generate(module, max_new_tokens: int, eos_token_id, cache_dtype,
                             track_seen=track_seen, min_new_tokens=min_new_tokens)
 
     return _cache_put(key, (prefill, decode))
+
+
+def _bucket_and_pad(ids, *modules_or_bounds):
+    """THE prompt-bucketing rule (compiled AND streamed paths import it):
+    EDGE-pad ``ids`` to the 128-bucket of its length — repeating each
+    row's last token, so a repetition-penalty seen-set is unchanged —
+    CAPPED at every given module's (or raw int bound's) learned-position
+    table. Padding past the table is not merely wasteful: OOB
+    learned-position lookups can go non-finite and NaN poisons the whole
+    forward (observed on OPT), so the cap is a correctness requirement.
+    Returns (padded_ids, true_len)."""
+    S = ids.shape[1]
+    P = -(-S // 128) * 128
+    for mb in modules_or_bounds:
+        bound = mb if isinstance(mb, int) else getattr(
+            getattr(mb, "config", None), "max_position_embeddings", None)
+        if bound is not None:
+            P = min(P, int(bound))
+    if P <= S:
+        return ids, S
+    return jnp.pad(ids, ((0, 0), (0, P - S)), mode="edge"), S
 
 
 def _check_position_bound(module, total_len: int, label: str = "prompt + max_new_tokens"):
@@ -355,7 +387,13 @@ def generate(
     B, S = ids.shape
     _check_position_bound(module, S + max_new_tokens)
     dtype = cache_dtype or jnp.bfloat16
-    cache = factory(B, S + max_new_tokens, dtype)
+    # Bucket the cache length and EDGE-pad the prompt to a 128-multiple so
+    # nearby prompt lengths share one compiled (prefill, decode) pair —
+    # see _compiled_generate. ring_slack=128 keeps sliding-window ring
+    # caches safe from the pad writes (registry factories all take it).
+    L = -(-(S + max_new_tokens) // 128) * 128
+    cache = factory(B, L, dtype, ring_slack=128)
+    ids_p, _ = _bucket_and_pad(ids, module)
 
     sampling = (float(temperature), top_k, top_p) if do_sample else None
     rng = rng if rng is not None else jax.random.PRNGKey(0)
@@ -364,7 +402,8 @@ def generate(
                                          repetition_penalty=float(repetition_penalty),
                                          min_new_tokens=int(min_new_tokens))
     rng, pre_rng = jax.random.split(rng)
-    first_tok, cache, seen = prefill(params, ids, cache, pre_rng)
+    first_tok, cache, seen = prefill(params, ids_p, cache, pre_rng,
+                                     jnp.asarray(S, jnp.int32))
     new_toks = decode(params, first_tok, cache, jnp.asarray(S, jnp.int32), rng, seen)
     return jnp.concatenate([ids, new_toks], axis=1)
 
@@ -563,11 +602,10 @@ def prompt_lookup_generate(
     # exact length; the prompt length rides in as a traced argument.
     L = -(-(S + max_new_tokens + K + 1) // 128) * 128
     # Bucket the PROMPT too: prefill runs on ids right-padded to a
-    # 128-multiple with the true length traced, so nearby prompt lengths
-    # share one compiled prefill (the pad KV is never attended — see
-    # _compiled_lookup_generate).
-    P = -(-S // 128) * 128
-    ids_padded = jnp.pad(ids, ((0, 0), (0, P - S))) if P > S else ids
+    # 128-multiple (capped at the position table) with the true length
+    # traced, so nearby prompt lengths share one compiled prefill (the pad
+    # KV is never attended — see _compiled_lookup_generate).
+    ids_padded, _ = _bucket_and_pad(ids, module)
     # ring_slack: rejected overshoot writes (K + 1) plus prefill's pad
     # writes (< 128, held STATIC at the bucket width so the cache shape —
     # and thus the compiled pair — stays per-bucket) must not evict
@@ -766,8 +804,8 @@ def assisted_generate(
     # Prompt bucketed like prompt_lookup_generate: both prefills run on the
     # right-padded ids (pad KV never attended), and both caches carry the
     # static 128 extra ring slack so pad writes can't evict in-window keys.
-    P = -(-S // 128) * 128
-    ids_padded = jnp.pad(ids, ((0, 0), (0, P - S))) if P > S else ids
+    # The bucket caps at BOTH models' position tables.
+    ids_padded, _ = _bucket_and_pad(ids, module, draft_module)
     cache = cache_factory_for(module)(B, L, dtype, ring_slack=K + 1 + 128)
     dcache = cache_factory_for(draft_module)(B, L, dtype, ring_slack=K + 1 + 128)
 

@@ -147,6 +147,33 @@ class TestGPT2Generate:
         kv = np.asarray(streamed.generate(jnp.asarray(PROMPT), 5))
         np.testing.assert_array_equal(kv, full)
 
+    def test_learned_positions_cap_the_prompt_bucket(self):
+        """Bucketed-prefill padding must cap at the learned-position table:
+        a wpe model with n_positions=32 would otherwise see pad positions
+        past its table, whose OOB lookups go non-finite and NaN-poison the
+        whole forward (caught live on OPT). Exactness across lengths +
+        repetition penalty pins both the cap and the edge-pad seen-set."""
+        from accelerate_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+
+        cfg = GPT2Config.tiny(use_flash_attention=False,
+                              max_position_embeddings=32)
+        m = GPT2LMHeadModel(cfg)
+        params = m.init_params(jax.random.PRNGKey(0), batch_size=1, seq_len=8)
+        for S in (3, 7, 12):
+            ids = (np.arange(S, dtype=np.int32)[None] * 11 + 4) % cfg.vocab_size
+            ref = naive_greedy(m, params, ids, 6)
+            out = greedy_generate(m, params, ids, max_new_tokens=6,
+                                  cache_dtype=jnp.float32)
+            np.testing.assert_array_equal(np.asarray(out), ref)
+            assert np.isfinite(np.asarray(
+                m.apply({"params": params}, jnp.asarray(ids)))).all()
+            from accelerate_tpu.generation import generate
+
+            rep = generate(m, params, ids, max_new_tokens=6,
+                           cache_dtype=jnp.float32, repetition_penalty=1.3)
+            assert np.asarray(rep).shape == (1, S + 6)
+            assert (np.asarray(rep) < cfg.vocab_size).all()
+
 
 class TestSampling:
     def test_temperature_zero_ish_matches_greedy(self, tiny):
