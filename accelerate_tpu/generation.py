@@ -992,6 +992,23 @@ def seq2seq_generate(
     sampling = (float(temperature), top_k, top_p) if do_sample else None
     rng = rng if rng is not None else jax.random.PRNGKey(0)
 
+    # Bucket the ENCODER length to a 128-multiple: unlike the decoder-only
+    # paths (where pad KV hides behind the causal mask), encoder pads are
+    # attended by cross-attention — so they are masked EXPLICITLY via
+    # attention_mask zeros. One compiled (encode, prefill, decode) triple
+    # then serves a whole source-length bucket. Relative-position models
+    # (T5) have no absolute position table to cap at.
+    S_enc = ids.shape[1]
+    P = -(-S_enc // 128) * 128
+    # Always materialize the mask: a bucket-boundary length (P == S_enc)
+    # with mask=None would otherwise trace a SECOND executable set for the
+    # same bucket (None vs array are distinct trace signatures).
+    attention_mask = (jnp.ones((B, S_enc), jnp.int32) if attention_mask is None
+                      else jnp.asarray(attention_mask))
+    if P > S_enc:
+        ids = jnp.pad(ids, ((0, 0), (0, P - S_enc)))
+        attention_mask = jnp.pad(attention_mask, ((0, 0), (0, P - S_enc)))
+
     encode, prefill, decode = _compiled_seq2seq(module, max_new_tokens, eos_token_id,
                                                 dtype, sampling,
                                                 float(repetition_penalty),

@@ -738,6 +738,34 @@ class TestT5Generate:
         params = convert_hf_state_dict(hf.state_dict(), "t5", strict=True)
         return hf, T5ForConditionalGeneration(cfg), params
 
+    def test_encoder_bucket_shares_executables_across_src_lengths(self):
+        """Nearby ENCODER lengths share one compiled (encode, prefill,
+        decode) triple — the source is padded to its 128-bucket with the
+        pads masked via attention_mask (cross-attention would otherwise
+        attend them) — while staying token-identical to HF per length."""
+        from accelerate_tpu.generation import _compiled_seq2seq, seq2seq_generate
+
+        hf, model, params = self._make()
+        sizes = None
+        for S in (3, 8, 13):
+            src = (np.arange(2 * S, dtype=np.int64).reshape(2, S) * 7) % 100
+            ours = np.asarray(seq2seq_generate(
+                model, params, jnp.asarray(src, jnp.int32), max_new_tokens=5,
+                decoder_start_token_id=0, eos_token_id=1, min_new_tokens=5,
+                cache_dtype=jnp.float32))
+            with torch.no_grad():
+                theirs = hf.generate(
+                    torch.from_numpy(src), max_new_tokens=5, min_new_tokens=5,
+                    do_sample=False, num_beams=1,
+                    attention_mask=torch.ones_like(torch.from_numpy(src))).numpy()
+            np.testing.assert_array_equal(ours, theirs)
+            triple = _compiled_seq2seq(model, 5, 1, jnp.float32, None, 1.0, 5)
+            now = tuple(f._cache_size() for f in triple)
+            if sizes is None:
+                sizes = now
+            else:
+                assert now == sizes, f"seq2seq retraced across src lengths: {sizes} -> {now}"
+
     @pytest.mark.parametrize("variant", [
         pytest.param("tied-relu", marks=pytest.mark.nightly), "flan",
     ])
