@@ -288,6 +288,12 @@ def _compiled_generate(module, max_new_tokens: int, eos_token_id, cache_dtype,
     return _cache_put(key, (prefill, decode))
 
 
+def _bucket128(n: int) -> int:
+    """Ceil to the 128 bucket — THE granularity every generate path uses
+    for cache lengths and padded prompts (one compiled set per bucket)."""
+    return -(-n // 128) * 128
+
+
 def _bucket_and_pad(ids, *modules_or_bounds):
     """THE prompt-bucketing rule (compiled AND streamed paths import it):
     EDGE-pad ``ids`` to the 128-bucket of its length — repeating each
@@ -298,7 +304,7 @@ def _bucket_and_pad(ids, *modules_or_bounds):
     forward (observed on OPT), so the cap is a correctness requirement.
     Returns (padded_ids, true_len)."""
     S = ids.shape[1]
-    P = -(-S // 128) * 128
+    P = _bucket128(S)
     for mb in modules_or_bounds:
         bound = mb if isinstance(mb, int) else getattr(
             getattr(mb, "config", None), "max_position_embeddings", None)
@@ -391,7 +397,7 @@ def generate(
     # nearby prompt lengths share one compiled (prefill, decode) pair —
     # see _compiled_generate. ring_slack=128 keeps sliding-window ring
     # caches safe from the pad writes (registry factories all take it).
-    L = -(-(S + max_new_tokens) // 128) * 128
+    L = _bucket128(S + max_new_tokens)
     cache = factory(B, L, dtype, ring_slack=128)
     ids_p, _ = _bucket_and_pad(ids, module)
 
@@ -600,7 +606,7 @@ def prompt_lookup_generate(
     # with varied prompt lengths shares ONE compiled speculate loop per
     # bucket instead of recompiling (and filling a generate-cache slot) per
     # exact length; the prompt length rides in as a traced argument.
-    L = -(-(S + max_new_tokens + K + 1) // 128) * 128
+    L = _bucket128(S + max_new_tokens + K + 1)
     # Bucket the PROMPT too: prefill runs on ids right-padded to a
     # 128-multiple (capped at the position table) with the true length
     # traced, so nearby prompt lengths share one compiled prefill (the pad
@@ -800,7 +806,7 @@ def assisted_generate(
     _check_position_bound(draft_module, S + max_new_tokens + K - 2,
                           label="prompt + max_new_tokens + draft slack")
     dtype = cache_dtype or jnp.bfloat16
-    L = -(-(S + max_new_tokens + K + 1) // 128) * 128
+    L = _bucket128(S + max_new_tokens + K + 1)
     # Prompt bucketed like prompt_lookup_generate: both prefills run on the
     # right-padded ids (pad KV never attended), and both caches carry the
     # static 128 extra ring slack so pad writes can't evict in-window keys.
@@ -862,11 +868,16 @@ def beam_search_generate(
     dtype = cache_dtype or jnp.bfloat16
     # Prefill runs on [B] rows (all K beams of a row are identical until the
     # first selection); the compiled fn repeats the cache to [B*K] after.
-    cache = factory(B, S + max_new_tokens, dtype)
+    # Cache length and prompt are 128-bucketed like every other decode path
+    # (edge-pad, true length traced, pad KV never attended).
+    L = _bucket128(S + max_new_tokens)
+    cache = factory(B, L, dtype, ring_slack=128)
+    ids_p, _ = _bucket_and_pad(ids, module)
 
     jitted = _compiled_beam(module, max_new_tokens, K, eos_token_id,
                             length_penalty, dtype)
-    return jitted(params, ids, cache)
+    best_toks = jitted(params, ids_p, cache, jnp.asarray(S, jnp.int32))
+    return jnp.concatenate([ids, best_toks], axis=1)
 
 
 def _compiled_beam(module, max_new_tokens, K, eos_token_id, length_penalty,
@@ -880,16 +891,18 @@ def _compiled_beam(module, max_new_tokens, K, eos_token_id, length_penalty,
     NEG = jnp.float32(-1e9)
 
     @jax.jit
-    def run(params, ids, cache):
-        B, S = ids.shape
+    def run(params, ids, cache, true_len):
+        B = ids.shape[0]
 
         # Prefill once per batch row; all K beams share it, so the cache is
         # repeated to [B*K] rows only afterwards ((K-1)/K of the prefill
-        # FLOPs and activation memory saved).
+        # FLOPs and activation memory saved). ids arrive bucket-padded; the
+        # seed distribution reads at the traced true last position.
         logits, cache = module.apply({"params": params}, ids, cache=cache,
                                      cache_pos=0)
         cache = jax.tree_util.tree_map(lambda buf: jnp.repeat(buf, K, axis=0), cache)
-        logp = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32), axis=-1)
+        last = jax.lax.dynamic_slice_in_dim(logits, true_len - 1, 1, axis=1)[:, 0]
+        logp = jax.nn.log_softmax(last.astype(jnp.float32), axis=-1)
         V = logp.shape[-1]
         # The first top-k picks K *distinct* tokens of the single prefill
         # distribution (equivalent to the usual seed-beams-1..K-1-with--inf
@@ -935,7 +948,7 @@ def _compiled_beam(module, max_new_tokens, K, eos_token_id, length_penalty,
             return (tok_hist, top_scores, new_cache, done, pos + 1), None
 
         (tok_hist, beam_scores, _, done, _), _ = jax.lax.scan(
-            body, (toks0, beam_scores, cache, done, jnp.asarray(S, jnp.int32)),
+            body, (toks0, beam_scores, cache, done, true_len),
             jnp.arange(max_new_tokens - 1))
 
         # Length-normalized selection (finished beams use their eos-frozen
@@ -950,8 +963,9 @@ def _compiled_beam(module, max_new_tokens, K, eos_token_id, length_penalty,
             lengths = jnp.full((B, K), max_new_tokens)
         norm = beam_scores / (lengths.astype(jnp.float32) ** length_penalty)
         best = jnp.argmax(norm, axis=-1)                          # [B]
-        best_toks = tok_hist[jnp.arange(B), best]                 # [B, L]
-        return jnp.concatenate([ids, best_toks], axis=1)
+        # Generated tokens only: the caller concatenates the ORIGINAL
+        # (unpadded) prompt.
+        return tok_hist[jnp.arange(B), best]                      # [B, L]
 
     return _cache_put(key, run)
 
@@ -999,7 +1013,7 @@ def seq2seq_generate(
     # then serves a whole source-length bucket. Relative-position models
     # (T5) have no absolute position table to cap at.
     S_enc = ids.shape[1]
-    P = -(-S_enc // 128) * 128
+    P = _bucket128(S_enc)
     # Always materialize the mask: a bucket-boundary length (P == S_enc)
     # with mask=None would otherwise trace a SECOND executable set for the
     # same bucket (None vs array are distinct trace signatures).

@@ -681,6 +681,30 @@ class TestBeamSearch:
                                  min_new_tokens=6, length_penalty=1.0)
         np.testing.assert_array_equal(np.asarray(ours), theirs.numpy())
 
+    def test_beam_bucket_shares_one_executable_across_lengths(self):
+        """Beam search shares its ONE compiled run per 128-bucket: nearby
+        prompt lengths must not retrace, and each stays HF-identical."""
+        from accelerate_tpu.generation import _compiled_beam, beam_search_generate
+
+        hf, model, params = self._pair()
+        sizes = None
+        for S in (3, 6, 10):
+            ids = (np.arange(2 * S, dtype=np.int64).reshape(2, S) * 11 + 2) % 128
+            ours = beam_search_generate(model, params, jnp.asarray(ids, jnp.int32),
+                                        max_new_tokens=5, num_beams=3,
+                                        cache_dtype=jnp.float32)
+            with torch.no_grad():
+                theirs = hf.generate(torch.from_numpy(ids), max_new_tokens=5,
+                                     num_beams=3, do_sample=False,
+                                     min_new_tokens=5, length_penalty=1.0)
+            np.testing.assert_array_equal(np.asarray(ours), theirs.numpy())
+            run = _compiled_beam(model, 5, 3, None, 1.0, jnp.float32)
+            now = run._cache_size()
+            if sizes is None:
+                sizes = now
+            else:
+                assert now == sizes, f"beam retraced across lengths: {sizes} -> {now}"
+
     def test_single_beam_equals_greedy(self):
         from accelerate_tpu.generation import beam_search_generate, generate
 
