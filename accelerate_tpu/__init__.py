@@ -93,5 +93,11 @@ from .utils.modeling import (
     get_max_memory,
     infer_auto_device_map,
 )
+from .utils.imports import is_rich_available
 from .utils.memory import find_executable_batch_size
 from .utils.random import set_seed, synchronize_rng_states
+
+if is_rich_available():
+    # Exact reference-surface parity: `from accelerate import rich` works
+    # when rich is installed (reference: __init__.py:49-50).
+    from .utils import rich  # noqa: F401
