@@ -281,7 +281,15 @@ class Accelerator:
         self._custom_objects: list = []
         self.step = 0  # accumulation step counter (reference: accelerator.py:1020)
         self._rng_key = jax.random.PRNGKey(seed)
-        self._backward_cache: dict = {}
+        from collections import OrderedDict
+
+        from .utils.profiling import PipelineStats
+
+        # Shared across every prepared loader so step-time breakdowns
+        # (data_wait_ms/stage_ms/queue depth) aggregate in one place.
+        self.pipeline_stats = PipelineStats()
+        self._backward_cache: OrderedDict = OrderedDict()
+        self._backward_cache_size = 16
         self._fused_cache: dict = {}
         self.flag_tensor = None
         self._log_with = log_with
@@ -545,9 +553,19 @@ class Accelerator:
             non_blocking=cfg.non_blocking,
             use_stateful_dataloader=cfg.use_stateful_dataloader,
             prefetch_size=cfg.prefetch_size,
+            async_prefetch=cfg.async_prefetch,
+            num_workers=cfg.num_workers,
         )
+        dl.pipeline_stats = self.pipeline_stats
         self._dataloaders.append(dl)
         return dl
+
+    def input_pipeline_metrics(self) -> dict:
+        """Aggregated input-pipeline breakdown over every prepared loader:
+        ``data_wait_ms`` (step loop blocked on data), ``stage_ms`` (collate +
+        host→device), ``queue_depth``. Log it alongside loss — a rising
+        ``data_wait_ms`` is MFU leaking to the host input path."""
+        return self.pipeline_stats.summary()
 
     # ------------------------------------------------------------------
     # Gradient accumulation (reference: accelerator.py:1020-1090)
@@ -696,8 +714,6 @@ class Accelerator:
         # is capped: passing a fresh lambda every step recompiles each time —
         # reuse one loss_fn object in hot loops.
         key = (loss_fn, self.gradient_state.num_steps)
-        if key not in self._backward_cache and len(self._backward_cache) >= 16:
-            self._backward_cache.pop(next(iter(self._backward_cache)))
         if key not in self._backward_cache:
             policy = self.policy
             accepts_rng = self._loss_fn_accepts_rng(loss_fn)
@@ -721,13 +737,25 @@ class Accelerator:
                 (_, (raw_loss, aux)), grads = grad_fn(params, batch, rng, scale)
                 return raw_loss, aux, grads
 
-            self._backward_cache[key] = backward_step
+            self._backward_cache_put(key, backward_step)
 
         scale = optimizer.loss_scale.scale if optimizer.loss_scale is not None else None
-        raw_loss, aux, grads = self._backward_cache[key](model.params, batch, self.next_rng_key(), scale)
+        raw_loss, aux, grads = self._backward_cache_get(key)(model.params, batch, self.next_rng_key(), scale)
         optimizer.accumulate_grads(grads)
         self._last_aux = aux
         return raw_loss
+
+    def _backward_cache_put(self, key, step):
+        """Insert a compiled backward step, evicting the LEAST RECENTLY USED
+        entry at capacity (hits refresh recency via ``move_to_end``, so a hot
+        loss_fn is never evicted by churn in rarely-used ones)."""
+        if len(self._backward_cache) >= self._backward_cache_size:
+            self._backward_cache.popitem(last=False)
+        self._backward_cache[key] = step
+
+    def _backward_cache_get(self, key):
+        self._backward_cache.move_to_end(key)
+        return self._backward_cache[key]
 
     # ------------------------------------------------------------------
     # Gradient clipping (reference: accelerator.py:2292)
@@ -1191,7 +1219,9 @@ class Accelerator:
         handler = profile_handler or self.profile_handler or ProfileKwargs()
         log_dir = (handler.output_trace_dir
                    or self.project_configuration.logging_dir or "./jax_trace")
-        return handler.build(log_dir=log_dir)
+        # The device trace and the host input-pipeline breakdown tell one
+        # story; sessions built here snapshot data_wait/stage per step().
+        return handler.build(log_dir=log_dir).attach_pipeline_stats(self.pipeline_stats)
 
     # ------------------------------------------------------------------
     # Memory / lifecycle (reference: accelerator.py:3219-3270)
@@ -1270,8 +1300,16 @@ class Accelerator:
             config=config, init_kwargs=init_kwargs or {},
         )
 
-    def log(self, values: dict, step: Optional[int] = None, log_kwargs: Optional[dict] = None):
-        """Log scalars to every active tracker, main process only (reference: :2625)."""
+    def log(self, values: dict, step: Optional[int] = None, log_kwargs: Optional[dict] = None,
+            include_input_pipeline: bool = False):
+        """Log scalars to every active tracker, main process only (reference: :2625).
+
+        ``include_input_pipeline=True`` merges the aggregated loader
+        breakdown (``input_pipeline/data_wait_ms`` etc.) into the payload."""
+        if include_input_pipeline:
+            from .tracking import with_input_pipeline_metrics
+
+            values = with_input_pipeline_metrics(values, self.pipeline_stats)
         for tracker in self.trackers:
             tracker.log(values, step=step, **((log_kwargs or {}).get(tracker.name, {})))
 

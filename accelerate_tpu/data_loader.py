@@ -27,6 +27,10 @@ TPU-native redesign:
 from __future__ import annotations
 
 import math
+import queue as queue_lib
+import threading
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Iterable, Iterator, Optional
 
 import numpy as np
@@ -34,6 +38,7 @@ import numpy as np
 from .state import GradientState, PartialState
 from .utils.dataclasses import DataLoaderConfiguration
 from .utils.operations import find_batch_size, recursively_apply, send_to_device
+from .utils.profiling import PipelineStats
 
 
 # ---------------------------------------------------------------------------
@@ -61,7 +66,10 @@ class SeedableRandomSampler:
         self.epoch = epoch
 
     def __iter__(self) -> Iterator[int]:
-        rng = np.random.default_rng(self.seed + self.epoch)
+        # Seed the generator on the (seed, epoch) *pair*, not their sum:
+        # seed+epoch collides ((1, 0) == (0, 1)), replaying epoch orders
+        # across runs that differ only in seed.
+        rng = np.random.default_rng([self.seed, self.epoch])
         yield from rng.permutation(self.data_source_len).tolist()
 
 
@@ -328,6 +336,164 @@ def make_global_batch(local_batch, mesh, sharding=None):
 
 
 # ---------------------------------------------------------------------------
+# Asynchronous prefetch pipeline
+# ---------------------------------------------------------------------------
+
+class _EndOfStream:
+    """Queue sentinel: the producer exhausted its source."""
+
+
+_END = _EndOfStream()
+
+
+class _PipelineError:
+    """Queue envelope carrying a producer-side exception to the consumer."""
+
+    __slots__ = ("exc",)
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
+class _Ready:
+    """Future-alike for already-staged batches (single-worker path)."""
+
+    __slots__ = ("_value",)
+
+    def __init__(self, value):
+        self._value = value
+
+    def result(self):
+        return self._value
+
+
+class AsyncPrefetcher:
+    """Background input pipeline: a puller thread drains ``produce`` (fetch +
+    collate on the training data source), stages each batch (host→device),
+    and parks up to ``prefetch_size`` staged batches in a bounded queue.
+
+    This is what actually overlaps host input work with device compute:
+    JAX's async dispatch lets the device run ahead of the host, but only if
+    the host thread isn't busy collating the next batch — here that work
+    happens on the worker while the training thread is inside the step.
+
+    * ``produce`` is a zero-arg callable returning the next raw host batch
+      and raising ``StopIteration`` when the source is exhausted. Pulling is
+      inherently serial (it's an iterator), so there is exactly one puller
+      thread regardless of ``num_workers``.
+    * ``num_workers > 1`` parallelizes the *staging* (collate pytrees +
+      ``jax.make_array_from_process_local_data``) across a thread pool; the
+      bounded queue holds futures in pull order, so batch order is always
+      preserved and backpressure still applies.
+    * Producer exceptions are forwarded and re-raised in the consumer.
+    * ``close()`` is idempotent and safe mid-epoch: it wakes a blocked
+      puller, joins the thread, and tears down the pool, so abandoning an
+      iterator (``break`` mid-epoch, GC) never leaks a worker.
+    """
+
+    def __init__(
+        self,
+        produce: Callable[[], Any],
+        stage: Callable[[Any], Any],
+        prefetch_size: int = 2,
+        num_workers: int = 1,
+        stats: Optional[PipelineStats] = None,
+    ):
+        self._produce = produce
+        self._stage = stage
+        self._stats = stats
+        self._queue: queue_lib.Queue = queue_lib.Queue(maxsize=max(1, prefetch_size))
+        self._stop = threading.Event()
+        self._closed = False
+        self._executor = ThreadPoolExecutor(
+            max_workers=num_workers, thread_name_prefix="atpu-stage"
+        ) if num_workers > 1 else None
+        self._thread = threading.Thread(
+            target=self._run, name="atpu-prefetch", daemon=True
+        )
+        self._thread.start()
+
+    # -- producer side ------------------------------------------------------
+
+    def _timed_stage(self, raw):
+        import time
+
+        t0 = time.perf_counter()
+        out = self._stage(raw)
+        if self._stats is not None:
+            self._stats.record_stage((time.perf_counter() - t0) * 1e3)
+        return out
+
+    def _put(self, item) -> bool:
+        # Bounded-blocking put that stays responsive to close(): a plain
+        # Queue.put would deadlock the worker against a consumer that left.
+        while not self._stop.is_set():
+            try:
+                self._queue.put(item, timeout=0.05)
+                return True
+            except queue_lib.Full:
+                continue
+        return False
+
+    def _run(self):
+        try:
+            while not self._stop.is_set():
+                try:
+                    raw = self._produce()
+                except StopIteration:
+                    break
+                if self._executor is not None:
+                    item = self._executor.submit(self._timed_stage, raw)
+                else:
+                    item = _Ready(self._timed_stage(raw))
+                if not self._put(item):
+                    return
+        except BaseException as exc:  # noqa: BLE001 - forwarded, not swallowed
+            self._put(_PipelineError(exc))
+            return
+        self._put(_END)
+
+    # -- consumer side ------------------------------------------------------
+
+    def get(self):
+        """Next staged batch in source order. Raises ``StopIteration`` at end
+        of stream and re-raises any producer-side exception."""
+        import time
+
+        t0 = time.perf_counter()
+        item = self._queue.get()
+        if isinstance(item, _PipelineError):
+            self._stop.set()
+            raise item.exc
+        if item is _END:
+            raise StopIteration
+        batch = item.result()  # blocks iff staging (num_workers>1) lags
+        if self._stats is not None:
+            self._stats.record_wait((time.perf_counter() - t0) * 1e3)
+            self._stats.record_depth(self._queue.qsize())
+        return batch
+
+    def close(self):
+        """Stop the worker and release every pipeline resource (idempotent)."""
+        if self._closed:
+            return
+        self._closed = True
+        self._stop.set()
+        # Drain so a put()-blocked worker wakes immediately.
+        try:
+            while True:
+                self._queue.get_nowait()
+        except queue_lib.Empty:
+            pass
+        self._thread.join(timeout=5.0)
+        if self._executor is not None:
+            self._executor.shutdown(wait=False)
+
+    def __del__(self):  # pragma: no cover - GC-timing dependent
+        self.close()
+
+
+# ---------------------------------------------------------------------------
 # DataLoader wrappers
 # ---------------------------------------------------------------------------
 
@@ -374,7 +540,14 @@ class DataLoaderShard(DataLoaderStateMixin):
     * synchronizes host RNG streams once per epoch (reference :549)
     * iterates one batch ahead to set ``end_of_dataloader`` on the last one
     * assembles global jax.Arrays sharded over the mesh batch axes
-    * keeps up to ``prefetch_size`` batches in flight (async device_put)
+    * with ``async_prefetch`` (the default) a background worker pulls,
+      collates, and stages up to ``prefetch_size`` batches ahead of the
+      training thread (:class:`AsyncPrefetcher`), overlapping host input
+      work with device compute; ``async_prefetch=False`` falls back to
+      inline staging with the same prefetch-depth lookahead
+    * records ``data_wait_ms``/``stage_ms``/queue-depth into
+      :attr:`pipeline_stats` either way, so step-time breakdowns are
+      comparable across modes
     """
 
     def __init__(
@@ -389,6 +562,8 @@ class DataLoaderShard(DataLoaderStateMixin):
         total_batch_size: Optional[int] = None,
         dataset_length: Optional[int] = None,
         stage_to_device: bool = True,
+        async_prefetch: bool = True,
+        num_workers: int = 1,
         _non_blocking: bool = True,
         **kwargs,
     ):
@@ -399,8 +574,11 @@ class DataLoaderShard(DataLoaderStateMixin):
         self.synchronized_generator = synchronized_generator
         self.skip_batches = skip_batches
         self.prefetch_size = max(1, prefetch_size)
+        self.async_prefetch = async_prefetch
+        self.num_workers = max(1, num_workers)
         self.stage_to_device = stage_to_device and mesh is not None
         self.gradient_state = GradientState()
+        self.pipeline_stats = PipelineStats()
         self._total_batch_size = total_batch_size
         self._dataset_length = dataset_length
         self.iteration = 0  # epoch counter
@@ -462,7 +640,96 @@ class DataLoaderShard(DataLoaderStateMixin):
     def _stage(self, batch):
         if not self.stage_to_device:
             return batch
-        return make_global_batch(batch, self.mesh, self.device_sharding)
+        from .utils.profiling import annotate
+
+        with annotate("atpu:stage_batch"):
+            return make_global_batch(batch, self.mesh, self.device_sharding)
+
+    def _produce_fn(self) -> Callable[[], Any]:
+        """Zero-arg producer for this epoch: fetch-only skip on resume, then
+        raw host batches. Skipped batches are never staged — and resume
+        counting (``batches_consumed``) only ever counts *yielded* batches,
+        so prefetched-but-unconsumed batches don't poison ``state_dict``."""
+        raw_iter = iter(self.base_dataloader)
+        for _ in range(self.skip_batches):
+            try:
+                next(raw_iter)
+            except StopIteration:
+                break
+        return lambda: next(raw_iter)
+
+    def _sync_staged_stream(self, produce):
+        """Inline fallback: same prefetch-depth lookahead as before, staged on
+        the training thread (reference :548-581 + MpDeviceLoader double
+        buffering). Wait time here IS produce+stage time — the serialized
+        cost the async path removes — so the metric stays comparable."""
+        def pull():
+            with self.pipeline_stats.time_wait():
+                raw = produce()
+                with self.pipeline_stats.time_stage():
+                    return self._stage(raw)
+
+        staged: deque = deque()
+        exhausted = False
+        while not exhausted and len(staged) < self.prefetch_size:
+            try:
+                staged.append(pull())
+            except StopIteration:
+                exhausted = True
+        while staged:
+            if not exhausted:
+                try:
+                    staged.append(pull())
+                except StopIteration:
+                    exhausted = True
+            yield staged.popleft()
+
+    def _async_staged_stream(self, produce):
+        """Staged batches from the background pipeline, in source order."""
+        prefetcher = AsyncPrefetcher(
+            produce,
+            self._stage,
+            prefetch_size=self.prefetch_size,
+            num_workers=self.num_workers,
+            stats=self.pipeline_stats,
+        )
+        try:
+            while True:
+                try:
+                    batch = prefetcher.get()
+                except StopIteration:
+                    return
+                yield batch
+        finally:
+            prefetcher.close()
+
+    def _iterate(self, produce):
+        """One-ahead loop shared by Shard and Dispatcher: the GradientState
+        flags flip on the final batch *before* it is yielded, identically in
+        sync and async modes."""
+        stream = (
+            self._async_staged_stream(produce)
+            if self.async_prefetch
+            else self._sync_staged_stream(produce)
+        )
+        try:
+            current = next(stream, _END)
+            while current is not _END:
+                nxt = next(stream, _END)
+                if nxt is _END:
+                    self.end_of_dataloader = True
+                    self.gradient_state._set_sync_gradients(True)
+                self.batches_consumed += 1
+                yield current
+                current = nxt
+        finally:
+            stream.close()  # tears down the worker even on abandoned iterators
+            if self.end_of_dataloader:
+                # Epoch completed: resume starts the next epoch from batch 0.
+                self.batches_consumed = 0
+            self.iteration += 1
+            self.skip_batches = 0
+            self.end()
 
     def __iter__(self):
         from .utils.random import synchronize_rng_states
@@ -471,48 +738,13 @@ class DataLoaderShard(DataLoaderStateMixin):
             synchronize_rng_states(self.rng_types, self.synchronized_generator)
         self.begin()
         self.set_epoch(self.iteration)
-
-        raw_iter = iter(self.base_dataloader)
-        # Skip batches on resume (reference: SkipDataLoader :1187).
-        for _ in range(self.skip_batches):
-            try:
-                next(raw_iter)
-            except StopIteration:
-                break
         self.batches_consumed = self.skip_batches
-
-        # One-ahead iteration with device prefetch (reference :548-581 +
-        # MpDeviceLoader double buffering).
-        staged: list = []
-        exhausted = False
-        try:
-            while not exhausted and len(staged) < self.prefetch_size:
-                try:
-                    staged.append(self._stage(next(raw_iter)))
-                except StopIteration:
-                    exhausted = True
-            while staged:
-                if not exhausted:
-                    try:
-                        staged.append(self._stage(next(raw_iter)))
-                    except StopIteration:
-                        exhausted = True
-                current = staged.pop(0)
-                if exhausted and not staged:
-                    self.end_of_dataloader = True
-                    self.gradient_state._set_sync_gradients(True)
-                self.batches_consumed += 1
-                yield current
-        finally:
-            if self.end_of_dataloader:
-                # Epoch completed: resume starts the next epoch from batch 0.
-                self.batches_consumed = 0
-            self.iteration += 1
-            self.skip_batches = 0
-            self.end()
+        yield from self._iterate(self._produce_fn())
 
     def __len__(self):
-        return len(self.base_dataloader) - (self.skip_batches if self.skip_batches else 0)
+        # Clamped: skip_batches beyond the epoch must read as empty, not a
+        # negative length.
+        return max(0, len(self.base_dataloader) - (self.skip_batches or 0))
 
     # -- resume support (reference: DataLoaderAdapter.state_dict :448) -------
     def state_dict(self) -> dict:
@@ -583,39 +815,25 @@ class DataLoaderDispatcher(DataLoaderShard):
             batch = recursively_apply(lambda t: t[lo:hi], batch)
         return batch
 
-    def __iter__(self):
-        self.begin()
-        self.set_epoch(self.iteration)
+    def _produce_fn(self) -> Callable[[], Any]:
+        """Producer = fetch-on-rank-0 + broadcast. Every process's worker
+        issues the same broadcast sequence in the same order, so running it
+        on the prefetch thread is safe — but it must stay serial, which the
+        single-puller design guarantees (num_workers only parallelizes
+        staging)."""
         raw_iter = iter(self.base_dataloader) if PartialState().is_main_process else iter(())
         for _ in range(self.skip_batches):
             try:
                 self._fetch_and_broadcast(raw_iter)
             except StopIteration:
                 break
-        self.batches_consumed = self.skip_batches
+        return lambda: self._fetch_and_broadcast(raw_iter)
 
-        nxt = None
-        try:
-            try:
-                nxt = self._stage(self._fetch_and_broadcast(raw_iter))
-            except StopIteration:
-                nxt = None
-            while nxt is not None:
-                current = nxt
-                try:
-                    nxt = self._stage(self._fetch_and_broadcast(raw_iter))
-                except StopIteration:
-                    nxt = None
-                    self.end_of_dataloader = True
-                    self.gradient_state._set_sync_gradients(True)
-                self.batches_consumed += 1
-                yield current
-        finally:
-            if self.end_of_dataloader:
-                self.batches_consumed = 0
-            self.iteration += 1
-            self.skip_batches = 0
-            self.end()
+    def __iter__(self):
+        self.begin()
+        self.set_epoch(self.iteration)
+        self.batches_consumed = self.skip_batches
+        yield from self._iterate(self._produce_fn())
 
 
 # ---------------------------------------------------------------------------
@@ -738,6 +956,8 @@ def prepare_data_loader(
     use_stateful_dataloader: bool = True,
     prefetch_size: int = 2,
     skip_batches: int = 0,
+    async_prefetch: bool = True,
+    num_workers: int = 1,
 ) -> DataLoaderShard:
     """Shard any dataloader across processes and stage batches to the mesh
     (reference: data_loader.py:917-1161).
@@ -763,6 +983,8 @@ def prepare_data_loader(
             prefetch_size=prefetch_size,
             skip_batches=skip_batches,
             stage_to_device=put_on_device,
+            async_prefetch=async_prefetch,
+            num_workers=num_workers,
         )
 
     new_loader = dataloader
@@ -798,6 +1020,8 @@ def prepare_data_loader(
         synchronized_generator=synchronized_generator,
         skip_batches=skip_batches,
         prefetch_size=prefetch_size,
+        async_prefetch=async_prefetch,
+        num_workers=num_workers,
         stage_to_device=put_on_device,
         total_batch_size=(
             getattr(dataloader, "batch_size", None)

@@ -410,6 +410,17 @@ _AVAILABILITY = {
 }
 
 
+def with_input_pipeline_metrics(values: dict, pipeline_stats, prefix: str = "input_pipeline/") -> dict:
+    """Merge an input-pipeline breakdown (``data_wait_ms``/``stage_ms``/
+    ``queue_depth``, see ``utils.profiling.PipelineStats``) into a tracker
+    payload under ``prefix``. User-provided keys always win on collision."""
+    if pipeline_stats is None:
+        return values
+    merged = {f"{prefix}{k}": v for k, v in pipeline_stats.summary().items()}
+    merged.update(values)
+    return merged
+
+
 def filter_trackers(log_with, logging_dir: Optional[str] = None):
     """Resolve requested tracker names to available ones (reference:
     tracking.py:971)."""

@@ -308,7 +308,15 @@ class DataLoaderConfiguration(KwargsHandler):
     non_blocking: bool = True        # async host->device transfer (always async in jax)
     use_stateful_dataloader: bool = True
     data_seed: Optional[int] = None
-    prefetch_size: int = 2           # device prefetch depth (double buffering)
+    prefetch_size: int = 2           # staged batches the pipeline keeps ahead
+    async_prefetch: bool = True      # background worker pulls/collates/stages
+    num_workers: int = 1             # staging threads (pulling is always serial)
+
+    def __post_init__(self):
+        if self.prefetch_size < 1:
+            raise ValueError(f"prefetch_size must be >= 1, got {self.prefetch_size}")
+        if self.num_workers < 1:
+            raise ValueError(f"num_workers must be >= 1, got {self.num_workers}")
 
 
 @dataclass

@@ -10,10 +10,126 @@ TensorBoard/Perfetto, capturing XLA ops, HBM usage, and ICI traffic.
 from __future__ import annotations
 
 import os
+import threading
+import time
 from typing import TYPE_CHECKING, Optional
 
 if TYPE_CHECKING:  # pragma: no cover
     from .dataclasses import ProfileKwargs
+
+
+class PipelineStats:
+    """Step-time breakdown counters for the host input pipeline.
+
+    Thread-safe: the prefetch worker records ``stage_ms`` (collate +
+    host→device staging) while the training thread records ``data_wait_ms``
+    (time the step loop blocked waiting for a batch) and the queue depth it
+    observed. Near-zero ``data_wait_ms`` with a busy device means the
+    pipeline is hidden behind compute; sustained waits mean the host is the
+    bottleneck (raise ``prefetch_size``/``num_workers`` or speed up the
+    producer).
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self):
+        """Zero every counter (e.g. between measurement windows)."""
+        with self._lock:
+            self._wait_ms_sum = 0.0
+            self._wait_ms_max = 0.0
+            self._wait_ms_last = 0.0
+            self._wait_count = 0
+            self._stage_ms_sum = 0.0
+            self._stage_ms_max = 0.0
+            self._stage_ms_last = 0.0
+            self._stage_count = 0
+            self._depth_sum = 0
+            self._depth_count = 0
+
+    def record_wait(self, ms: float):
+        """One consumer-side blocking wait for the next staged batch."""
+        with self._lock:
+            self._wait_ms_sum += ms
+            self._wait_ms_max = max(self._wait_ms_max, ms)
+            self._wait_ms_last = ms
+            self._wait_count += 1
+
+    def record_stage(self, ms: float):
+        """One producer-side collate+stage of a batch."""
+        with self._lock:
+            self._stage_ms_sum += ms
+            self._stage_ms_max = max(self._stage_ms_max, ms)
+            self._stage_ms_last = ms
+            self._stage_count += 1
+
+    def record_depth(self, depth: int):
+        """Queue depth observed by the consumer right after a get."""
+        with self._lock:
+            self._depth_sum += int(depth)
+            self._depth_count += 1
+
+    def summary(self) -> dict:
+        """Scalar snapshot suitable for ``Accelerator.log``/tracking payloads."""
+        with self._lock:
+            waits = max(1, self._wait_count)
+            stages = max(1, self._stage_count)
+            depths = max(1, self._depth_count)
+            return {
+                "data_wait_ms": round(self._wait_ms_sum / waits, 3),
+                "data_wait_ms_last": round(self._wait_ms_last, 3),
+                "data_wait_ms_max": round(self._wait_ms_max, 3),
+                "stage_ms": round(self._stage_ms_sum / stages, 3),
+                "stage_ms_last": round(self._stage_ms_last, 3),
+                "stage_ms_max": round(self._stage_ms_max, 3),
+                "queue_depth": round(self._depth_sum / depths, 3),
+                "batches_waited": self._wait_count,
+                "batches_staged": self._stage_count,
+            }
+
+    def merge(self, other: "PipelineStats") -> "PipelineStats":
+        """Fold another stats object into this one (multi-loader aggregation)."""
+        with other._lock:
+            o = (other._wait_ms_sum, other._wait_ms_max, other._wait_ms_last, other._wait_count,
+                 other._stage_ms_sum, other._stage_ms_max, other._stage_ms_last, other._stage_count,
+                 other._depth_sum, other._depth_count)
+        with self._lock:
+            self._wait_ms_sum += o[0]
+            self._wait_ms_max = max(self._wait_ms_max, o[1])
+            self._wait_ms_last = o[2] or self._wait_ms_last
+            self._wait_count += o[3]
+            self._stage_ms_sum += o[4]
+            self._stage_ms_max = max(self._stage_ms_max, o[5])
+            self._stage_ms_last = o[6] or self._stage_ms_last
+            self._stage_count += o[7]
+            self._depth_sum += o[8]
+            self._depth_count += o[9]
+        return self
+
+    class _Timer:
+        __slots__ = ("_record", "_t0")
+
+        def __init__(self, record):
+            self._record = record
+
+        def __enter__(self):
+            self._t0 = time.perf_counter()
+            return self
+
+        def __exit__(self, exc_type, *exc):
+            # An exhausted/failed pull is not a batch wait — don't count it.
+            if exc_type is None:
+                self._record((time.perf_counter() - self._t0) * 1e3)
+            return False
+
+    def time_wait(self):
+        """Context manager timing a consumer wait into ``data_wait_ms``."""
+        return self._Timer(self.record_wait)
+
+    def time_stage(self):
+        """Context manager timing a producer stage into ``stage_ms``."""
+        return self._Timer(self.record_stage)
 
 
 class ProfileSession:
@@ -28,7 +144,8 @@ class ProfileSession:
                 prof.step()
     """
 
-    def __init__(self, kwargs: "ProfileKwargs", log_dir: Optional[str] = None):
+    def __init__(self, kwargs: "ProfileKwargs", log_dir: Optional[str] = None,
+                 pipeline_stats: Optional[PipelineStats] = None):
         self.kwargs = kwargs
         self.log_dir = log_dir or kwargs.output_trace_dir or "./jax_trace"
         sched = kwargs.schedule_option or {}
@@ -37,6 +154,11 @@ class ProfileSession:
         self.active = int(sched.get("active", 0)) or None  # None = whole block
         self._step = 0
         self._tracing = False
+        # Host-side step breakdown rides along with the device trace: pass
+        # the stats object shared with the dataloaders (or let callers attach
+        # one later via attach_pipeline_stats).
+        self.pipeline_stats = pipeline_stats
+        self._step_breakdowns: list[dict] = []
 
     def _should_trace(self) -> bool:
         if self.active is None:
@@ -69,14 +191,35 @@ class ProfileSession:
             self._start()
         return self
 
+    def attach_pipeline_stats(self, stats: PipelineStats):
+        """Attach input-pipeline counters so ``step()`` snapshots them."""
+        self.pipeline_stats = stats
+        return self
+
     def step(self):
         """Advance the schedule (reference: torch profiler .step())."""
+        if self.pipeline_stats is not None:
+            self._step_breakdowns.append(
+                {"step": self._step, **self.pipeline_stats.summary()}
+            )
         self._step += 1
         should = self._should_trace()
         if should and not self._tracing:
             self._start()
         elif not should and self._tracing:
             self._stop()
+
+    def data_breakdown(self) -> dict:
+        """Latest input-pipeline breakdown (data_wait_ms/stage_ms/queue_depth);
+        empty when no stats object is attached."""
+        if self.pipeline_stats is None:
+            return {}
+        return self.pipeline_stats.summary()
+
+    @property
+    def step_breakdowns(self) -> list[dict]:
+        """Per-``step()`` cumulative input-pipeline snapshots."""
+        return list(self._step_breakdowns)
 
     def __exit__(self, *exc):
         self._stop()

@@ -72,7 +72,8 @@ GROUPS = [
      ["accelerate_tpu.utils.operations", "accelerate_tpu.utils.modeling",
       "accelerate_tpu.utils.memory", "accelerate_tpu.utils.random",
       "accelerate_tpu.utils.quantization", "accelerate_tpu.utils.environment",
-      "accelerate_tpu.utils.platforms", "accelerate_tpu.utils.hf_interop"], None),
+      "accelerate_tpu.utils.platforms", "accelerate_tpu.utils.hf_interop",
+      "accelerate_tpu.utils.profiling"], None),
     ("native", "Native IO", ["accelerate_tpu.native.io"],
      "The C++ parallel safetensors reader and token-bin prefetch ring."),
 ]

@@ -302,6 +302,29 @@ class TestMisc:
             assert not acc.sync_gradients
         assert acc.sync_gradients
 
+    def test_backward_cache_is_lru_on_hits(self):
+        """Satellite: a hot loss_fn re-used every step must never be evicted
+        by churn in one-shot loss_fns — hits refresh recency."""
+        acc = Accelerator()
+        acc._backward_cache_put("hot", "step-hot")
+        for i in range(acc._backward_cache_size - 1):
+            acc._backward_cache_put(f"cold{i}", f"step{i}")
+        assert len(acc._backward_cache) == acc._backward_cache_size
+        # Touch the oldest entry, then overflow: the eviction victim must be
+        # the least-recently-USED (cold0), not the least-recently-inserted.
+        assert acc._backward_cache_get("hot") == "step-hot"
+        acc._backward_cache_put("new", "step-new")
+        assert "hot" in acc._backward_cache
+        assert "cold0" not in acc._backward_cache
+
+    def test_input_pipeline_metrics_aggregate(self):
+        acc = Accelerator()
+        assert acc.input_pipeline_metrics()["batches_waited"] == 0
+        acc.pipeline_stats.record_wait(4.0)
+        acc.pipeline_stats.record_stage(1.0)
+        m = acc.input_pipeline_metrics()
+        assert m["data_wait_ms"] == 4.0 and m["stage_ms"] == 1.0
+
     def test_profile_honors_handler_trace_dir(self, tmp_path):
         """The handler's output_trace_dir must win over the default — a
         regression here silently dumps xplane protos into ./jax_trace in

@@ -313,6 +313,186 @@ class TestDataLoaderShard:
         assert rest == [2.0, 3.0, 4.0]
 
 
+def _list_loader(batches, batch_size=2, dataset_len=None):
+    class L:
+        dataset = list(range(dataset_len if dataset_len is not None else batch_size * len(batches)))
+
+        def __iter__(self):
+            return iter(batches)
+
+        def __len__(self):
+            return len(batches)
+
+    L.batch_size = batch_size
+    return L()
+
+
+class TestAsyncPrefetch:
+    """The background input pipeline must be sequence-transparent: identical
+    batches, flags, and resume behavior to inline staging — just overlapped."""
+
+    def test_async_matches_sync_order(self):
+        data = [np.full(2, i) for i in range(7)]
+        a = [b[0] for b in DataLoaderShard(_list_loader(data), stage_to_device=False,
+                                           async_prefetch=True, prefetch_size=3)]
+        b = [b[0] for b in DataLoaderShard(_list_loader(data), stage_to_device=False,
+                                           async_prefetch=False, prefetch_size=3)]
+        assert a == b == [float(i) for i in range(7)]
+
+    def test_async_multi_worker_preserves_order(self):
+        data = [np.full(2, i) for i in range(16)]
+        dl = DataLoaderShard(_list_loader(data), stage_to_device=False,
+                             async_prefetch=True, prefetch_size=4, num_workers=4)
+        assert [b[0] for b in dl] == [float(i) for i in range(16)]
+
+    def test_end_of_dataloader_flag_async(self):
+        gs = GradientState()
+        gs._set_sync_gradients(False)
+        data = [np.ones(4) * i for i in range(3)]
+        dl = DataLoaderShard(_list_loader(data, batch_size=4), stage_to_device=False,
+                             async_prefetch=True, prefetch_size=2)
+        flags = []
+        for _ in dl:
+            flags.append(dl.end_of_dataloader)
+        assert flags == [False, False, True]
+        assert gs.sync_gradients
+
+    def test_epoch_restart_reuses_loader(self):
+        data = [np.full(1, i) for i in range(4)]
+        dl = DataLoaderShard(_list_loader(data), stage_to_device=False,
+                             async_prefetch=True, prefetch_size=2)
+        first = [b[0] for b in dl]
+        second = [b[0] for b in dl]  # a fresh worker per epoch
+        assert first == second == [0.0, 1.0, 2.0, 3.0]
+        assert dl.iteration == 2
+
+    def test_producer_exception_propagates(self):
+        def gen():
+            yield np.zeros(2)
+            yield np.ones(2)
+            raise RuntimeError("bad shard")
+
+        class L:
+            dataset = list(range(6))
+            batch_size = 2
+
+            def __iter__(self):
+                return gen()
+
+            def __len__(self):
+                return 3
+
+        dl = DataLoaderShard(L(), stage_to_device=False, async_prefetch=True)
+        with pytest.raises(RuntimeError, match="bad shard"):
+            list(dl)
+
+    def test_abandoned_iterator_shuts_worker_down(self):
+        import threading
+
+        before = {t.name for t in threading.enumerate()}
+        data = [np.full(1, i) for i in range(64)]
+        dl = DataLoaderShard(_list_loader(data), stage_to_device=False,
+                             async_prefetch=True, prefetch_size=2)
+        it = iter(dl)
+        next(it)
+        it.close()  # break mid-epoch
+        leaked = [t for t in threading.enumerate()
+                  if t.name.startswith("atpu-prefetch") and t.name not in before and t.is_alive()]
+        for t in leaked:
+            t.join(timeout=2)
+        assert not [t for t in leaked if t.is_alive()], "prefetch worker leaked after close"
+
+    def test_resume_counts_only_yielded_batches(self):
+        """Satellite: state_dict after K yields must ignore batches the
+        worker already prefetched ahead."""
+        import time
+
+        data = [np.full(2, i) for i in range(8)]
+        dl = DataLoaderShard(_list_loader(data), stage_to_device=False,
+                             async_prefetch=True, prefetch_size=4)
+        it = iter(dl)
+        got = [next(it)[0] for _ in range(3)]
+        time.sleep(0.05)  # let the worker run ahead into the prefetch queue
+        sd = dl.state_dict()
+        assert sd["batches_consumed"] == 3
+        it.close()
+
+        dl2 = DataLoaderShard(_list_loader(data), stage_to_device=False,
+                              async_prefetch=True, prefetch_size=4)
+        dl2.load_state_dict(sd)
+        rest = [b[0] for b in dl2]
+        assert got + rest == [float(i) for i in range(8)]
+
+    def test_resume_through_prepare_data_loader_roundtrip(self):
+        data = [{"x": np.array([float(i)])} for i in range(12)]
+        base = NumpyDataLoader(data, batch_size=2)
+        dl = prepare_data_loader(base, mesh=None, put_on_device=False,
+                                 async_prefetch=True, prefetch_size=3)
+        it = iter(dl)
+        first = [next(it)["x"].ravel().tolist() for _ in range(2)]
+        sd = dl.state_dict()
+        it.close()
+        dl2 = prepare_data_loader(NumpyDataLoader(data, batch_size=2), mesh=None,
+                                  put_on_device=False, async_prefetch=True, prefetch_size=3)
+        dl2.load_state_dict(sd)
+        rest = [b["x"].ravel().tolist() for b in dl2]
+        assert first + rest == [[float(2 * i), float(2 * i + 1)] for i in range(6)]
+
+    def test_pipeline_stats_recorded(self):
+        data = [np.full(2, i) for i in range(5)]
+        dl = DataLoaderShard(_list_loader(data), stage_to_device=False,
+                             async_prefetch=True)
+        list(dl)
+        s = dl.pipeline_stats.summary()
+        assert s["batches_waited"] == 5
+        assert s["batches_staged"] == 5
+        assert s["data_wait_ms"] >= 0.0
+
+    def test_dispatcher_async_single_process(self):
+        from accelerate_tpu.data_loader import DataLoaderDispatcher
+
+        data = [np.full(2, i) for i in range(4)]
+        dl = DataLoaderDispatcher(_list_loader(data), stage_to_device=False,
+                                  async_prefetch=True, prefetch_size=2)
+        assert [b[0] for b in dl] == [0.0, 1.0, 2.0, 3.0]
+        assert dl.end_of_dataloader
+
+    def test_len_clamps_when_skip_exceeds_epoch(self):
+        """Satellite: skip_batches > len must read as empty, not negative."""
+        data = [np.full(1, i) for i in range(3)]
+        dl = DataLoaderShard(_list_loader(data), stage_to_device=False, skip_batches=5)
+        assert len(dl) == 0
+        assert list(dl) == []
+
+
+class TestDataLoaderConfigurationKnobs:
+    def test_knobs_thread_through_accelerator(self):
+        from accelerate_tpu import Accelerator
+        from accelerate_tpu.utils.dataclasses import DataLoaderConfiguration
+
+        acc = Accelerator(dataloader_config=DataLoaderConfiguration(
+            async_prefetch=False, prefetch_size=5, num_workers=3))
+        data = [{"x": np.array([float(i)])} for i in range(8)]
+        dl = acc.prepare_data_loader(NumpyDataLoader(data, batch_size=2),
+                                     device_placement=False)
+        assert dl.async_prefetch is False
+        assert dl.prefetch_size == 5
+        assert dl.num_workers == 3
+        # Prepared loaders share the accelerator's stats object, so
+        # input_pipeline_metrics aggregates across loaders.
+        assert dl.pipeline_stats is acc.pipeline_stats
+        list(dl)
+        assert acc.input_pipeline_metrics()["batches_waited"] == 4
+
+    def test_invalid_knobs_rejected(self):
+        from accelerate_tpu.utils.dataclasses import DataLoaderConfiguration
+
+        with pytest.raises(ValueError):
+            DataLoaderConfiguration(prefetch_size=0)
+        with pytest.raises(ValueError):
+            DataLoaderConfiguration(num_workers=0)
+
+
 class TestSkipBatches:
     def test_skip_batch_sampler(self):
         bs = make_batch_sampler(12, 3)
@@ -395,6 +575,17 @@ def test_seedable_sampler():
     assert list(s) == a  # same epoch -> same order
     s.set_epoch(1)
     assert list(s) != a
+
+
+def test_seedable_sampler_no_seed_epoch_collision():
+    """Satellite: seed+epoch summing made (seed=1, epoch=0) replay
+    (seed=0, epoch=1); the pair must be mixed, not added."""
+    a = SeedableRandomSampler(64, seed=1, epoch=0)
+    b = SeedableRandomSampler(64, seed=0, epoch=1)
+    assert list(a) != list(b)
+    # And epochs within one seed stay distinct.
+    c = SeedableRandomSampler(64, seed=1, epoch=1)
+    assert list(a) != list(c)
 
 
 def test_default_collate_nested():
