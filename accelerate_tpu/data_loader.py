@@ -35,10 +35,13 @@ from typing import Any, Callable, Iterable, Iterator, Optional
 
 import numpy as np
 
+from .logging import get_logger
 from .state import GradientState, PartialState
 from .utils.dataclasses import DataLoaderConfiguration
 from .utils.operations import find_batch_size, recursively_apply, send_to_device
 from .utils.profiling import PipelineStats
+
+logger = get_logger(__name__)
 
 
 # ---------------------------------------------------------------------------
@@ -486,8 +489,21 @@ class AsyncPrefetcher:
         except queue_lib.Empty:
             pass
         self._thread.join(timeout=5.0)
+        if self._thread.is_alive():
+            # A hung stage/produce call (slow host->device transfer, blocked
+            # broadcast) keeps the worker alive past the join timeout — and
+            # still pulling from the base iterator. Opening a new epoch now
+            # stacks a second live worker on the same source; make that
+            # visible instead of leaking silently.
+            logger.warning(
+                "atpu-prefetch worker still alive 5s after close(); a "
+                "produce/stage call is hung and the worker keeps consuming "
+                "the base iterator until it returns. Each new epoch will "
+                "add another live worker.",
+                main_process_only=False,
+            )
         if self._executor is not None:
-            self._executor.shutdown(wait=False)
+            self._executor.shutdown(wait=False, cancel_futures=True)
 
     def __del__(self):  # pragma: no cover - GC-timing dependent
         self.close()
@@ -703,13 +719,19 @@ class DataLoaderShard(DataLoaderStateMixin):
         finally:
             prefetcher.close()
 
+    def _use_async_prefetch(self) -> bool:
+        """Whether this epoch's stream runs on the background worker.
+        Subclasses veto the async path when their producer cannot safely run
+        off the training thread (see DataLoaderDispatcher)."""
+        return self.async_prefetch
+
     def _iterate(self, produce):
         """One-ahead loop shared by Shard and Dispatcher: the GradientState
         flags flip on the final batch *before* it is yielded, identically in
         sync and async modes."""
         stream = (
             self._async_staged_stream(produce)
-            if self.async_prefetch
+            if self._use_async_prefetch()
             else self._sync_staged_stream(produce)
         )
         try:
@@ -767,6 +789,13 @@ class DataLoaderDispatcher(DataLoaderShard):
     For sources that only exist on one host (e.g. a stream). Each batch incurs
     a host-network broadcast — prefer DataLoaderShard when every host can read
     its slice.
+
+    Async prefetch is forced off in multi-process runs: the broadcast is a
+    device collective, and issuing it from the prefetch thread would
+    interleave nondeterministically with the training step's collectives on
+    the shared devices — each process could enqueue (broadcast, step) in a
+    different order, mismatching collectives and deadlocking the slice. See
+    :meth:`_use_async_prefetch`.
     """
 
     def __init__(self, *args, split_batches: bool = False, **kwargs):
@@ -815,12 +844,21 @@ class DataLoaderDispatcher(DataLoaderShard):
             batch = recursively_apply(lambda t: t[lo:hi], batch)
         return batch
 
+    def _use_async_prefetch(self) -> bool:
+        """Multi-process dispatch must fetch/broadcast on the consumer
+        thread: broadcast_object_list is a device collective, and a
+        background thread would race it against the step's collectives —
+        worker-vs-worker ordering is serial (single puller), but
+        worker-vs-training-thread ordering on the shared devices is not
+        deterministic across processes. Single-process dispatch issues no
+        collective, so it keeps the async pipeline."""
+        return self.async_prefetch and PartialState().num_processes == 1
+
     def _produce_fn(self) -> Callable[[], Any]:
-        """Producer = fetch-on-rank-0 + broadcast. Every process's worker
-        issues the same broadcast sequence in the same order, so running it
-        on the prefetch thread is safe — but it must stay serial, which the
-        single-puller design guarantees (num_workers only parallelizes
-        staging)."""
+        """Producer = fetch-on-rank-0 + broadcast. In multi-process runs
+        this only ever runs on the training thread (_use_async_prefetch
+        vetoes the worker) so the broadcast keeps a deterministic order
+        relative to the step's collectives."""
         raw_iter = iter(self.base_dataloader) if PartialState().is_main_process else iter(())
         for _ in range(self.skip_batches):
             try:

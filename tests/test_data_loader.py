@@ -457,6 +457,25 @@ class TestAsyncPrefetch:
         assert [b[0] for b in dl] == [0.0, 1.0, 2.0, 3.0]
         assert dl.end_of_dataloader
 
+    def test_dispatcher_multiprocess_vetoes_async_prefetch(self):
+        """The dispatcher's producer issues a device collective (broadcast);
+        multi-process runs must fetch/broadcast on the consumer thread or the
+        broadcast races the step's collectives and can deadlock the slice."""
+        from accelerate_tpu.data_loader import DataLoaderDispatcher
+        from accelerate_tpu.state import PartialState
+
+        state = PartialState()
+        saved = state.num_processes
+        dl = DataLoaderDispatcher(_list_loader([]), stage_to_device=False,
+                                  async_prefetch=True)
+        try:
+            state.num_processes = 4
+            assert dl._use_async_prefetch() is False
+            state.num_processes = 1
+            assert dl._use_async_prefetch() is True
+        finally:
+            state.num_processes = saved
+
     def test_len_clamps_when_skip_exceeds_epoch(self):
         """Satellite: skip_batches > len must read as empty, not negative."""
         data = [np.full(1, i) for i in range(3)]

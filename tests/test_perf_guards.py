@@ -172,39 +172,55 @@ class TestInputPipelineOverlap:
     a slow producer + a jitted step must OVERLAP — wall-clock near
     max(producer, step), not their sum — and a fast producer must leave the
     step loop essentially never waiting on data. 8 ms legs keep scheduler
-    jitter small relative to the thresholds on loaded CI machines."""
+    jitter small relative to the thresholds on loaded CI machines, and each
+    guard retries once: the thresholds come from real sleeps, so a single
+    burst of scheduler/GIL contention on an oversubscribed runner must not
+    fail the suite — only a *reproducible* miss does."""
 
     PRODUCE_MS = 8.0
     STEP_MS = 8.0
     STEPS = 30
 
+    @staticmethod
+    def _retry_once(attempt):
+        try:
+            attempt()
+        except AssertionError:
+            attempt()
+
     def test_async_pipeline_overlaps_producer_and_step(self):
-        on = bench.overlap_microbench(
-            steps=self.STEPS, produce_ms=self.PRODUCE_MS, step_ms=self.STEP_MS,
-            async_prefetch=True)
-        off = bench.overlap_microbench(
-            steps=self.STEPS, produce_ms=self.PRODUCE_MS, step_ms=self.STEP_MS,
-            async_prefetch=False)
-        assert on["wall_s"] < 1.5 * on["ideal_s"], (
-            f"async pipeline took {on['wall_s']:.3f}s >= 1.5x the ideal "
-            f"max(producer, step) {on['ideal_s']:.3f}s: input work is not "
-            "overlapping the step")
-        speedup = off["wall_s"] / on["wall_s"]
-        assert speedup >= 1.4, (
-            f"async speedup vs async_prefetch=False only {speedup:.2f}x "
-            f"(async {on['wall_s']:.3f}s, sync {off['wall_s']:.3f}s): the "
-            "background worker is no longer hiding producer latency")
-        # The sync loop must *measure* its serialized data wait — that metric
-        # is how a production run discovers it needs the async path.
-        assert off["data_wait_ms"] > 0.5 * self.PRODUCE_MS
+        def attempt():
+            on = bench.overlap_microbench(
+                steps=self.STEPS, produce_ms=self.PRODUCE_MS, step_ms=self.STEP_MS,
+                async_prefetch=True)
+            off = bench.overlap_microbench(
+                steps=self.STEPS, produce_ms=self.PRODUCE_MS, step_ms=self.STEP_MS,
+                async_prefetch=False)
+            assert on["wall_s"] < 1.5 * on["ideal_s"], (
+                f"async pipeline took {on['wall_s']:.3f}s >= 1.5x the ideal "
+                f"max(producer, step) {on['ideal_s']:.3f}s: input work is not "
+                "overlapping the step")
+            speedup = off["wall_s"] / on["wall_s"]
+            assert speedup >= 1.4, (
+                f"async speedup vs async_prefetch=False only {speedup:.2f}x "
+                f"(async {on['wall_s']:.3f}s, sync {off['wall_s']:.3f}s): the "
+                "background worker is no longer hiding producer latency")
+            # The sync loop must *measure* its serialized data wait — that
+            # metric is how a production run discovers it needs the async path.
+            assert off["data_wait_ms"] > 0.5 * self.PRODUCE_MS
+
+        self._retry_once(attempt)
 
     def test_fast_producer_near_zero_data_wait(self):
-        out = bench.overlap_microbench(
-            steps=self.STEPS, produce_ms=0.0, step_ms=5.0, async_prefetch=True)
-        assert out["data_wait_ms"] < 2.0, (
-            f"mean data_wait_ms {out['data_wait_ms']:.3f} with an instant "
-            "producer: the prefetch queue is not staying ahead of the step")
-        assert out["batches_waited"] == self.STEPS
+        def attempt():
+            out = bench.overlap_microbench(
+                steps=self.STEPS, produce_ms=0.0, step_ms=5.0, async_prefetch=True)
+            assert out["data_wait_ms"] < 2.0, (
+                f"mean data_wait_ms {out['data_wait_ms']:.3f} with an instant "
+                "producer: the prefetch queue is not staying ahead of the step")
+            assert out["batches_waited"] == self.STEPS
+
+        self._retry_once(attempt)
 
 
 class TestFusedStepStructure:
