@@ -57,6 +57,7 @@ from .generation import (
     seq2seq_generate,
 )
 from .inference import PipelinedInferencer, prepare_pipeline, prepare_pippy
+from .serving import Request, RequestStatus, ServingEngine, ServingStats
 from .launchers import debug_launcher, notebook_launcher
 from .local_sgd import LocalSGD
 from .logging import get_logger

@@ -283,11 +283,16 @@ class Accelerator:
         self._rng_key = jax.random.PRNGKey(seed)
         from collections import OrderedDict
 
+        from .serving.metrics import ServingStats
         from .utils.profiling import PipelineStats
 
         # Shared across every prepared loader so step-time breakdowns
         # (data_wait_ms/stage_ms/queue depth) aggregate in one place.
         self.pipeline_stats = PipelineStats()
+        # Shared by ServingEngine(accelerator=...) instances so serving
+        # counters (TTFT, queue wait, tokens/sec, occupancy) surface through
+        # log(include_serving=True) / serving_metrics() / profile().
+        self.serving_stats = ServingStats()
         self._backward_cache: OrderedDict = OrderedDict()
         self._backward_cache_size = 16
         self._fused_cache: dict = {}
@@ -566,6 +571,13 @@ class Accelerator:
         host→device), ``queue_depth``. Log it alongside loss — a rising
         ``data_wait_ms`` is MFU leaking to the host input path."""
         return self.pipeline_stats.summary()
+
+    def serving_metrics(self) -> dict:
+        """Aggregated serving-engine counters (TTFT, queue wait, decode
+        tokens/sec, slot occupancy, batch efficiency) for every
+        ``ServingEngine(accelerator=self)``; see
+        ``serving.metrics.ServingStats.summary``."""
+        return self.serving_stats.summary()
 
     # ------------------------------------------------------------------
     # Gradient accumulation (reference: accelerator.py:1020-1090)
@@ -1219,9 +1231,12 @@ class Accelerator:
         handler = profile_handler or self.profile_handler or ProfileKwargs()
         log_dir = (handler.output_trace_dir
                    or self.project_configuration.logging_dir or "./jax_trace")
-        # The device trace and the host input-pipeline breakdown tell one
-        # story; sessions built here snapshot data_wait/stage per step().
-        return handler.build(log_dir=log_dir).attach_pipeline_stats(self.pipeline_stats)
+        # The device trace and the host-side breakdowns (input pipeline,
+        # serving engine) tell one story; sessions built here snapshot
+        # data_wait/stage and serving counters per step().
+        return (handler.build(log_dir=log_dir)
+                .attach_pipeline_stats(self.pipeline_stats)
+                .attach_serving_stats(self.serving_stats))
 
     # ------------------------------------------------------------------
     # Memory / lifecycle (reference: accelerator.py:3219-3270)
@@ -1301,15 +1316,21 @@ class Accelerator:
         )
 
     def log(self, values: dict, step: Optional[int] = None, log_kwargs: Optional[dict] = None,
-            include_input_pipeline: bool = False):
+            include_input_pipeline: bool = False, include_serving: bool = False):
         """Log scalars to every active tracker, main process only (reference: :2625).
 
         ``include_input_pipeline=True`` merges the aggregated loader
-        breakdown (``input_pipeline/data_wait_ms`` etc.) into the payload."""
+        breakdown (``input_pipeline/data_wait_ms`` etc.) into the payload;
+        ``include_serving=True`` does the same for serving-engine counters
+        (``serving/ttft_ms`` etc.)."""
         if include_input_pipeline:
             from .tracking import with_input_pipeline_metrics
 
             values = with_input_pipeline_metrics(values, self.pipeline_stats)
+        if include_serving:
+            from .tracking import with_serving_metrics
+
+            values = with_serving_metrics(values, self.serving_stats)
         for tracker in self.trackers:
             tracker.log(values, step=step, **((log_kwargs or {}).get(tracker.name, {})))
 
@@ -1321,7 +1342,13 @@ class Accelerator:
         raise ValueError(f"{name} is not an available tracker: {[t.name for t in self.trackers]}")
 
     def end_training(self):
-        """Flush/close all trackers and barrier (reference: :2645)."""
+        """Drain in-flight async checkpoint saves, then flush/close all
+        trackers and barrier (reference: :2645). The save drain comes first:
+        a script that calls ``end_training()`` and exits must not drop an
+        Orbax write that is still in flight."""
+        from . import checkpointing
+
+        checkpointing.wait_for_saves()
         for tracker in self.trackers:
             tracker.finish()
         self.wait_for_everyone()

@@ -167,6 +167,20 @@ def _cache_key(module, *parts):
     return (type(module).__name__, _freeze(dataclasses.astuple(cfg)), *parts)
 
 
+def _cache_get(key):
+    """LRU lookup: a hit moves to the back of the insertion order so the
+    eviction in :func:`_cache_put` (pop the front) drops the *least
+    recently used* entry, not merely the oldest-inserted — same fix as
+    the autograd ``_backward_cache``. A steady interleaving of one hot
+    config with churning one-shot configs must never evict the hot one."""
+    if key is None:
+        return None
+    hit = _generate_cache.pop(key, None)
+    if hit is not None:
+        _generate_cache[key] = hit  # move to end (most recently used)
+    return hit
+
+
 def _cache_put(key, value):
     if key is not None:
         if len(_generate_cache) >= 64:  # bound growth; configs rarely churn
@@ -194,6 +208,21 @@ def _mark_seen(seen, token_ids):
     return seen.at[jnp.arange(B)[:, None], ids].set(True)
 
 
+def _next_token(last, rng, seen, done, select, eos_token_id, dtype):
+    """THE single-token decode primitive, shared by the offline scan bodies
+    and the serving engine's per-slot step (which vmaps it): select one
+    token from ``last`` [B, V] with the already-split ``rng``, then apply
+    the ragged-stop EOS latch — sequences that emitted eos keep emitting
+    it. Returns ``(next_token [B], done [B])``. Keeping selection + latch
+    in one place is what makes the engine's streamed tokens bit-identical
+    to offline :func:`generate` for the same (prompt, rng, sampling)."""
+    nxt = select(last, rng, seen).astype(dtype)
+    if eos_token_id is not None:
+        nxt = jnp.where(done, jnp.asarray(eos_token_id, dtype), nxt)
+        done = done | (nxt == eos_token_id)
+    return nxt, done
+
+
 def _decode_scan(step_fn, select, first_tok, carry_extra, start_pos,
                  eos_token_id, num_steps: int, rng, seen0, track_seen=True,
                  min_new_tokens: int = 0):
@@ -213,10 +242,8 @@ def _decode_scan(step_fn, select, first_tok, carry_extra, start_pos,
         # This body emits generation index i+2 (first_tok is index 1).
         last = _suppress_eos(logits[:, -1], i + 2, eos_token_id, min_new_tokens)
         rng, sub = jax.random.split(rng)
-        nxt = select(last, sub, seen).astype(tok.dtype)
-        if eos_token_id is not None:
-            nxt = jnp.where(done, jnp.asarray(eos_token_id, tok.dtype), nxt)
-            done = done | (nxt == eos_token_id)
+        nxt, done = _next_token(last, sub, seen, done, select, eos_token_id,
+                                tok.dtype)
         if track_seen:
             seen = _mark_seen(seen, nxt)
         return (nxt, extra, pos + 1, done, rng, seen), nxt
@@ -248,7 +275,7 @@ def _compiled_generate(module, max_new_tokens: int, eos_token_id, cache_dtype,
     key = _cache_key(module, max_new_tokens, eos_token_id,
                      jnp.dtype(cache_dtype).name, sampling, repetition_penalty,
                      min_new_tokens)
-    hit = _generate_cache.get(key) if key is not None else None
+    hit = _cache_get(key)
     if hit is not None:
         return hit
 
@@ -444,7 +471,7 @@ def _compiled_lookup_generate(module, max_new_tokens: int, eos_token_id, cache_d
     key = _cache_key(module, max_new_tokens, eos_token_id,
                      jnp.dtype(cache_dtype).name, sampling, 1.0,
                      ("lookup", ngram, num_draft, buf_len))
-    hit = _generate_cache.get(key) if key is not None else None
+    hit = _cache_get(key)
     if hit is not None:
         return hit
 
@@ -645,7 +672,7 @@ def _compiled_assisted_generate(module, draft_module, max_new_tokens: int,
                       ("assisted", num_draft, buf_len))
     dkey = _cache_key(draft_module, 0)
     key = (tkey, dkey) if tkey is not None and dkey is not None else None
-    hit = _generate_cache.get(key) if key is not None else None
+    hit = _cache_get(key)
     if hit is not None:
         return hit
 
@@ -884,7 +911,7 @@ def _compiled_beam(module, max_new_tokens, K, eos_token_id, length_penalty,
                    cache_dtype):
     key = _cache_key(module, "beam", max_new_tokens, K, eos_token_id,
                      length_penalty, jnp.dtype(cache_dtype).name)
-    hit = _generate_cache.get(key) if key is not None else None
+    hit = _cache_get(key)
     if hit is not None:
         return hit
 
@@ -1046,7 +1073,7 @@ def _compiled_seq2seq(module, max_new_tokens: int, eos_token_id, cache_dtype, sa
     key = _cache_key(module, "seq2seq", max_new_tokens, eos_token_id,
                      jnp.dtype(cache_dtype).name, sampling, repetition_penalty,
                      min_new_tokens)
-    hit = _generate_cache.get(key) if key is not None else None
+    hit = _cache_get(key)
     if hit is not None:
         return hit
 

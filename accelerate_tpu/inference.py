@@ -88,6 +88,40 @@ class PipelinedInferencer:
         )
 
 
+def resolve_model_source(model, params=None, accelerator=None):
+    """Resolve ``(module, apply_fn, params, mesh, policy)`` from any model
+    spelling the library accepts — an accelerate_tpu ``Model`` /
+    ``AcceleratedModel`` (wrapped flax module + params, possibly carrying a
+    mesh and precision policy), a bare flax module (``.apply`` over a
+    variables dict), or a raw ``apply_fn(params, *args)`` callable.
+
+    Shared by :func:`prepare_pipeline` and the serving engine so both
+    unwrap prepared models identically. ``module`` is the underlying flax
+    module when one is recoverable (needed by cache-threading consumers),
+    else None; ``params`` may come back None when neither the caller nor
+    the model supplies them — callers decide whether that is an error.
+    """
+    module = getattr(model, "module", None)
+    if hasattr(model, "apply_fn"):  # accelerate_tpu Model / AcceleratedModel
+        apply_fn = model.apply_fn
+        params = params if params is not None else model.params
+    elif hasattr(model, "apply"):
+        module = model
+        raw_apply = model.apply
+
+        def apply_fn(p, *args, **kwargs):
+            variables = p if isinstance(p, dict) and "params" in p else {"params": p}
+            return raw_apply(variables, *args, **kwargs)
+
+    elif callable(model):
+        apply_fn = model
+    else:
+        raise TypeError(f"cannot resolve a model from {type(model)}")
+    policy = accelerator.policy if accelerator is not None else getattr(model, "policy", None)
+    mesh = accelerator.mesh if accelerator is not None else getattr(model, "mesh", None)
+    return module, apply_fn, params, mesh, policy
+
+
 def prepare_pipeline(
     model,
     params=None,
@@ -105,26 +139,10 @@ def prepare_pipeline(
     inputs are edge-padded to a multiple of the microbatch count and outputs
     sliced back.
     """
-    apply_fn = None
-    if hasattr(model, "apply_fn"):  # accelerate_tpu Model / AcceleratedModel
-        apply_fn = model.apply_fn
-        params = params if params is not None else model.params
-    elif hasattr(model, "apply"):
-        raw_apply = model.apply
-
-        def apply_fn(p, *args, **kwargs):
-            variables = p if isinstance(p, dict) and "params" in p else {"params": p}
-            return raw_apply(variables, *args, **kwargs)
-
-    elif callable(model):
-        apply_fn = model
-    else:
-        raise TypeError(f"prepare_pipeline cannot wrap {type(model)}")
+    _, apply_fn, params, mesh, policy = resolve_model_source(
+        model, params=params, accelerator=accelerator)
     if params is None:
         raise ValueError("prepare_pipeline needs params (pass params= or a prepared Model)")
-
-    policy = accelerator.policy if accelerator is not None else getattr(model, "policy", None)
-    mesh = accelerator.mesh if accelerator is not None else getattr(model, "mesh", None)
     if num_microbatches is None:
         # Match what the pipeline will actually use: the model's own count,
         # then the accelerator's pp plugin, then the pp axis size (the

@@ -1,0 +1,32 @@
+"""Continuous-batching serving over the compiled generation stack.
+
+Public surface:
+
+* :class:`ServingEngine` — slot-based decode service running exactly two
+  compiled programs after warmup (``prefill_into_slot`` per prompt bucket,
+  ``decode_step_all_slots`` per tick); requests join and leave the batch
+  mid-flight with zero recompiles.
+* :class:`Request` / :class:`RequestStatus` — the submit handle: streamed
+  tokens, ``result()``, cancellation, timestamps.
+* :class:`ServingStats` — TTFT/queue-wait/throughput/occupancy counters
+  (``engine.serving_metrics()``, ``Accelerator.log(include_serving=True)``).
+* :class:`AdmissionQueue` / :class:`QueueFull` / :class:`SlotScheduler` —
+  the bounded FCFS admission layer and slot free-list.
+
+See ``docs/usage_guides/serving.md``.
+"""
+
+from .engine import ServingEngine
+from .metrics import ServingStats
+from .request import Request, RequestStatus
+from .scheduler import AdmissionQueue, QueueFull, SlotScheduler
+
+__all__ = [
+    "ServingEngine",
+    "ServingStats",
+    "Request",
+    "RequestStatus",
+    "AdmissionQueue",
+    "QueueFull",
+    "SlotScheduler",
+]

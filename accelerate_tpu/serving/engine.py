@@ -1,0 +1,481 @@
+"""Continuous-batching serving engine over the compiled generation stack.
+
+The TPU constraint (GSPMD: peak performance comes from a small number of
+fixed-shape compiled programs) shapes the whole design. The engine owns a
+fixed ``[max_slots, max_len]`` decode state — per-slot KV cache, write
+position, carry rng, and eos latch — and after warmup runs exactly TWO
+compiled programs, no matter how requests arrive or leave:
+
+* ``prefill_into_slot`` — one compiled executable per 128-bucketed prompt
+  length (:func:`generation._bucket128`); the prompt is EDGE-padded on the
+  host (numpy, so no per-length jnp pad programs) and the executable reads
+  logits at the traced ``true_len - 1``, builds a fresh batch-1 cache, and
+  writes the whole slot state with ``dynamic_update_slice`` at the traced
+  slot index.
+* ``decode_step_all_slots`` — one token for every slot per tick, a
+  ``jax.vmap`` of the batch-1 single-token forward over the slot axis,
+  sharing :func:`generation._next_token` with the offline scan so engine
+  streams are bit-identical to offline :func:`generation.generate` for the
+  same (prompt, rng, sampling). Slot membership is a host-provided boolean
+  mask ARGUMENT, never a shape: admitting or retiring a request changes
+  the mask bits, not the program.
+
+Around the two programs: a bounded FCFS admission queue with backpressure,
+per-request ``max_new_tokens``/timeout/cancellation, streaming token
+callbacks, error isolation (a failing callback frees its slot without
+touching the rest of the batch), and a graceful drain on shutdown that
+cooperates with ``Accelerator.install_preemption_handler()`` — on
+preemption the engine stops admitting, finishes in-flight requests, and
+cancels the queue, so the process can exit inside the notice window.
+
+Pad-KV safety is the same argument as the offline path: the prompt is
+edge-padded to bucket P, prefill writes KV for positions [0, P), but the
+decode mask attends ``k_pos <= q_pos`` and every decode write lands at the
+current position *before* any query that could see it — pad entries past
+``true_len`` are overwritten at-or-before the first query that could
+attend them.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..generation import (
+    _bucket128,
+    _check_position_bound,
+    _make_selector,
+    _next_token,
+)
+from ..inference import resolve_model_source
+from .metrics import ServingStats
+from .request import Request, RequestStatus
+from .scheduler import AdmissionQueue, QueueFull, SlotScheduler
+
+__all__ = ["ServingEngine"]
+
+
+class ServingEngine:
+    """Slot-based continuous-batching decode service.
+
+    Args:
+      model: an accelerate_tpu ``Model``/``AcceleratedModel`` or a bare
+        cache-threading flax module (see ``generation.supports_kv_cache``).
+      params: parameter pytree (defaults to the prepared model's).
+      max_slots: decode lanes — the fixed batch dimension of the tick.
+      max_len: per-slot KV capacity; every request must satisfy
+        ``prompt_len + max_new_tokens <= max_len``.
+      eos_token_id / do_sample / temperature / top_k / top_p: ENGINE-level
+        sampling config — baked into the two executables (a per-request
+        change would be a recompile). Greedy when ``do_sample=False``.
+      cache_dtype: KV buffer dtype (default bfloat16, like offline).
+      max_queued: admission-queue bound (backpressure past it).
+      accelerator: optional — wires preemption-drain cooperation and, when
+        the accelerator carries a ``serving_stats``, shares it so
+        ``Accelerator.log(include_serving=True)`` sees this engine.
+      autostart: spawn the engine thread (and warm up) in the constructor.
+      warmup: run dummy requests through both programs at start so the
+        first real request never pays a compile; stats reset afterwards.
+    """
+
+    def __init__(self, model, params=None, *, max_slots: int = 4,
+                 max_len: int = 256, eos_token_id: Optional[int] = None,
+                 do_sample: bool = False, temperature: float = 1.0,
+                 top_k: Optional[int] = None, top_p: Optional[float] = None,
+                 cache_dtype=None, max_queued: int = 64, accelerator=None,
+                 stats: Optional[ServingStats] = None, autostart: bool = True,
+                 warmup: bool = True, idle_poll_s: float = 0.005):
+        from ..big_modeling import cache_factory_for
+
+        module, _, params, mesh, _ = resolve_model_source(
+            model, params=params, accelerator=accelerator)
+        if params is None:
+            raise ValueError("ServingEngine needs params (pass params= or a "
+                             "prepared Model)")
+        if module is None or hasattr(module, "init_decode_cache"):
+            raise NotImplementedError(
+                "ServingEngine serves decoder-only cache-threading modules; "
+                "encoder-decoder models go through seq2seq_generate")
+        factory = cache_factory_for(module)
+        if factory is None:
+            raise TypeError(
+                f"{type(module).__name__} does not thread a KV cache "
+                "(big_modeling.cache_factory_for) — the engine cannot hold "
+                "its decode state")
+        if max_slots < 1 or max_len < 2:
+            raise ValueError(f"need max_slots >= 1 and max_len >= 2 "
+                             f"(got {max_slots}, {max_len})")
+
+        self.module = module
+        self.params = params
+        self.mesh = mesh
+        self.max_slots = int(max_slots)
+        self.max_len = int(max_len)
+        self.eos_token_id = eos_token_id
+        self._dtype = cache_dtype or jnp.bfloat16
+        self._factory = factory
+        self._sampling = (float(temperature), top_k, top_p) if do_sample else None
+        self._select = _make_selector(self._sampling)
+        self._idle_poll_s = float(idle_poll_s)
+        self._accelerator = accelerator
+
+        # One slot's cache, used as the state template. Ring (sliding-window)
+        # caches rotate by stored position — the slot-stacked
+        # dynamic_update_slice layout below does not model that, so refuse
+        # loudly rather than serve corrupted windows.
+        slot_cache = factory(1, self.max_len, self._dtype)
+        if any(isinstance(layer, dict) and "pos" in layer for layer in slot_cache):
+            raise NotImplementedError(
+                "sliding-window (ring) KV caches are not supported by the "
+                "serving engine yet; set the config's window >= max_len")
+
+        self._state = {
+            "cache": jax.tree.map(
+                lambda l: jnp.zeros((self.max_slots,) + l.shape, l.dtype),
+                slot_cache),
+            "pos": jnp.zeros((self.max_slots,), jnp.int32),
+            "tok": jnp.zeros((self.max_slots,), jnp.int32),
+            "rng": jnp.zeros((self.max_slots, 2), jnp.uint32),
+            "done": jnp.zeros((self.max_slots,), bool),
+        }
+
+        # CPU jit warns (and ignores) donation; donate only where it works.
+        donate = () if jax.default_backend() == "cpu" else (1,)
+        self._prefill = jax.jit(self._prefill_fn, donate_argnums=donate)
+        self._decode = jax.jit(self._decode_fn, donate_argnums=donate)
+
+        if stats is None and accelerator is not None:
+            stats = getattr(accelerator, "serving_stats", None)
+        self._stats = stats if stats is not None else ServingStats()
+        self._queue = AdmissionQueue(max_queued)
+        self._slots = SlotScheduler(self.max_slots)
+
+        self._accepting = False
+        self._stop = False          # hard stop: cancel everything, exit now
+        self._drain = False         # finish all accepted work, then exit
+        self._abort_queue = False   # preemption: finish running, cancel queued
+        self._error: Optional[BaseException] = None
+        self._thread: Optional[threading.Thread] = None
+        self._warmup_on_start = bool(warmup)
+        if autostart:
+            self.start()
+
+    # ------------------------------------------------------------------
+    # the two compiled programs
+    # ------------------------------------------------------------------
+    def _prefill_fn(self, params, state, ids_p, slot, rng, true_len):
+        """ids_p [1, P] edge-padded prompt; slot/true_len traced i32 scalars.
+        Builds a fresh batch-1 cache, runs the prompt, selects the first
+        token exactly like offline generate (rng split into carry + prefill
+        halves, selection at ``true_len - 1``), and writes the slot's whole
+        decode state at the traced slot index. Returns (state, first_token).
+        """
+        cache = self._factory(1, self.max_len, self._dtype)
+        logits, cache = self.module.apply(
+            {"params": params}, ids_p, cache=cache, cache_pos=0)
+        rng_carry, pre_rng = jax.random.split(rng)
+        last = jax.lax.dynamic_slice_in_dim(logits, true_len - 1, 1, axis=1)[:, 0]
+        seen = jnp.zeros((1, 1), bool)
+        tok, done = _next_token(last, pre_rng, seen, jnp.zeros((1,), bool),
+                                self._select, self.eos_token_id, ids_p.dtype)
+        new_cache = jax.tree.map(
+            lambda full, one: jax.lax.dynamic_update_slice(
+                full, one[None].astype(full.dtype), (slot,) + (0,) * one.ndim),
+            state["cache"], cache)
+        state = {
+            "cache": new_cache,
+            "pos": state["pos"].at[slot].set(true_len),
+            "tok": state["tok"].at[slot].set(tok[0].astype(jnp.int32)),
+            "rng": state["rng"].at[slot].set(rng_carry),
+            "done": state["done"].at[slot].set(done[0]),
+        }
+        return state, tok[0]
+
+    def _decode_fn(self, params, state, active):
+        """One tick: a batch-1 single-token forward vmapped over the slot
+        axis (per-slot scalar cache_pos, per-slot rng chain — bitwise the
+        same selection as offline's scan body). The cache commits
+        unconditionally (an inactive slot rewrites its frozen position with
+        garbage nobody will read — its next use starts with a fresh prefill)
+        but pos/tok/rng/done advance only where ``active`` is set, so
+        retired slots stay frozen and in-bounds. Returns
+        (state, tokens [S], done [S])."""
+
+        def one_slot(cache, tok, pos, rng, done):
+            logits, cache = self.module.apply(
+                {"params": params}, tok[None, None], cache=cache, cache_pos=pos)
+            rng, sub = jax.random.split(rng)
+            nxt, done = _next_token(logits[:, -1], sub, jnp.zeros((1, 1), bool),
+                                    done[None], self._select, self.eos_token_id,
+                                    tok.dtype)
+            return cache, nxt[0], rng, done[0]
+
+        new_cache, toks, rngs, dones = jax.vmap(one_slot)(
+            state["cache"], state["tok"], state["pos"], state["rng"],
+            state["done"])
+        state = {
+            "cache": new_cache,
+            "pos": jnp.where(active, state["pos"] + 1, state["pos"]),
+            "tok": jnp.where(active, toks, state["tok"]),
+            "rng": jnp.where(active[:, None], rngs, state["rng"]),
+            "done": jnp.where(active, dones, state["done"]),
+        }
+        return state, toks, dones
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+    def start(self):
+        """Spawn the engine thread (idempotent) and run warmup traffic."""
+        if self._thread is not None:
+            return
+        self._accepting = True
+        self._thread = threading.Thread(target=self._run,
+                                        name="serving-engine", daemon=True)
+        self._thread.start()
+        if self._warmup_on_start:
+            self.warmup()
+
+    def warmup(self, timeout: float = 120.0):
+        """Compile both programs by pushing dummy requests through the
+        normal path: the smallest prompt bucket (prefill) and one decode
+        tick. ``ignore_eos`` keeps the dummy decoding even if the model
+        emits eos immediately. Counters reset afterwards so warmup traffic
+        never pollutes serving metrics."""
+        req = self.submit(np.zeros((1, 1), np.int32), max_new_tokens=2,
+                          seed=0, ignore_eos=True, block=True)
+        if not req.wait(timeout):
+            raise TimeoutError("engine warmup did not finish "
+                               f"within {timeout}s")
+        self._raise_if_failed(req)
+        self._stats.reset()
+
+    @staticmethod
+    def _raise_if_failed(req):
+        if req.status != RequestStatus.COMPLETED:
+            raise RuntimeError(f"warmup request {req.status.value}") from req.error
+
+    def shutdown(self, drain: bool = True, timeout: Optional[float] = None):
+        """Stop the engine. ``drain=True`` finishes every accepted request
+        (queued and running) first; ``drain=False`` cancels them. Either
+        way, blocks for the engine thread (up to ``timeout``) and then
+        drains in-flight async checkpoint saves — a serving process is
+        often the same process that just trained the weights it serves,
+        and exiting with Orbax writes still in flight drops them."""
+        from .. import checkpointing
+
+        self._accepting = False
+        if drain:
+            self._drain = True
+        else:
+            self._stop = True
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+        checkpointing.wait_for_saves()
+        if self._error is not None:
+            raise RuntimeError("serving engine died") from self._error
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown(drain=exc[0] is None)
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    # ------------------------------------------------------------------
+    # submission
+    # ------------------------------------------------------------------
+    def submit(self, prompt_ids=None, *, request: Optional[Request] = None,
+               max_new_tokens: int = 20, seed: Optional[int] = None,
+               rng=None, timeout: Optional[float] = None, on_token=None,
+               ignore_eos: bool = False, block: bool = False,
+               block_timeout: Optional[float] = None) -> Request:
+        """Enqueue one request; returns its :class:`Request` handle
+        immediately. Raises :class:`scheduler.QueueFull` under backpressure
+        when ``block=False``; with ``block=True`` the caller waits for
+        queue space instead (up to ``block_timeout``)."""
+        if request is None:
+            request = Request(prompt_ids, max_new_tokens=max_new_tokens,
+                              rng=rng, seed=seed, timeout=timeout,
+                              on_token=on_token, ignore_eos=ignore_eos)
+        if not self._accepting or self._stop or self._drain:
+            raise RuntimeError("serving engine is not accepting requests "
+                               "(not started, shutting down, or preempted)")
+        S = request.prompt_ids.shape[1]
+        if S < 1:
+            raise ValueError("empty prompt")
+        if S + request.max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt ({S}) + max_new_tokens ({request.max_new_tokens}) "
+                f"exceeds the engine's max_len ({self.max_len}); resize the "
+                "engine or shorten the request")
+        _check_position_bound(self.module, S + request.max_new_tokens)
+        request.submitted_at = time.monotonic()
+        try:
+            self._queue.put(request, block=block, timeout=block_timeout)
+        except QueueFull:
+            self._stats.record_reject()
+            raise
+        self._stats.record_submit(len(self._queue))
+        return request
+
+    def serving_metrics(self) -> dict:
+        """Scalar snapshot of the engine's counters (see
+        :class:`metrics.ServingStats.summary`)."""
+        return self._stats.summary()
+
+    @property
+    def stats(self) -> ServingStats:
+        return self._stats
+
+    # ------------------------------------------------------------------
+    # engine thread
+    # ------------------------------------------------------------------
+    def _run(self):
+        try:
+            while not self._stop:
+                if (self._accelerator is not None
+                        and getattr(self._accelerator, "preemption_requested", False)
+                        and not (self._drain or self._abort_queue)):
+                    # Preemption drain: stop admitting, let in-flight
+                    # requests finish, cancel the queue — the notice window
+                    # is for flushing work, not for taking more.
+                    self._accepting = False
+                    self._abort_queue = True
+                now = time.monotonic()
+                for _, req in self._slots.active():
+                    if req.cancel_requested:
+                        self._retire(req, RequestStatus.CANCELLED)
+                    elif req._deadline_passed(now):
+                        self._retire(req, RequestStatus.TIMED_OUT)
+                if self._abort_queue:
+                    for req in self._queue.drain():
+                        req._finish(RequestStatus.CANCELLED)
+                        self._stats.record_finish(req.status)
+                while self._slots.has_free():
+                    req = self._queue.get_nowait()
+                    if req is None:
+                        break
+                    if req.cancel_requested:
+                        req._finish(RequestStatus.CANCELLED)
+                        self._stats.record_finish(req.status)
+                    elif req._deadline_passed(now):
+                        req._finish(RequestStatus.TIMED_OUT)
+                        self._stats.record_finish(req.status)
+                    else:
+                        self._admit(req)
+                if self._slots.active_slots:
+                    self._tick()
+                elif self._drain and not len(self._queue):
+                    break
+                elif self._abort_queue:
+                    break
+                else:
+                    # Idle: block briefly on the queue so a submit wakes the
+                    # loop without a hot spin; the request is re-checked and
+                    # admitted on the next pass.
+                    req = self._queue.get(timeout=self._idle_poll_s)
+                    if req is not None:
+                        self._admit(req)
+        except BaseException as e:  # engine-fatal: fail everything loudly
+            self._error = e
+        finally:
+            self._accepting = False
+            terminal = (RequestStatus.FAILED if self._error is not None
+                        else RequestStatus.CANCELLED)
+            for _, req in list(self._slots.active()):
+                self._retire(req, terminal, self._error)
+            for req in self._queue.drain():
+                req._finish(terminal, self._error)
+                self._stats.record_finish(req.status)
+
+    def _admit(self, req: Request):
+        """Prefill ``req`` into a free slot: host edge-pad to the 128
+        bucket (numpy — a jnp pad would compile per prompt length), run
+        ``prefill_into_slot``, and commit the first token. TTFT is stamped
+        here because prefill itself emits token #1."""
+        req.admitted_at = time.monotonic()
+        slot = self._slots.assign(req)
+        S = req.prompt_ids.shape[1]
+        P = self._bucket(S)
+        ids_p = req.prompt_ids
+        if P > S:
+            ids_p = np.pad(ids_p, ((0, 0), (0, P - S)), mode="edge")
+        rng = req.rng if req.rng is not None else jax.random.PRNGKey(
+            req.seed if req.seed is not None else 0)
+        self._state, tok = self._prefill(
+            self.params, self._state, ids_p, np.int32(slot), rng, np.int32(S))
+        token = int(tok)
+        req.status = RequestStatus.RUNNING
+        now = time.monotonic()
+        req.first_token_at = now
+        self._stats.record_admit(
+            queue_wait_ms=(req.admitted_at - req.submitted_at) * 1e3,
+            ttft_ms=(now - req.submitted_at) * 1e3)
+        if self._commit_token(req, token):
+            if (len(req.tokens) >= req.max_new_tokens
+                    or (not req.ignore_eos and self.eos_token_id is not None
+                        and token == self.eos_token_id)):
+                self._retire(req, RequestStatus.COMPLETED)
+
+    def _bucket(self, S: int) -> int:
+        P = min(_bucket128(S), self.max_len)
+        bound = getattr(getattr(self.module, "config", None),
+                        "max_position_embeddings", None)
+        if bound is not None:
+            P = min(P, int(bound))
+        return max(P, S)
+
+    def _tick(self):
+        """One ``decode_step_all_slots`` execution + host commit/retire."""
+        mask = np.zeros((self.max_slots,), bool)
+        occupants = self._slots.active()
+        for slot, _ in occupants:
+            mask[slot] = True
+        t0 = time.monotonic()
+        self._state, toks, dones = self._decode(
+            self.params, self._state, jnp.asarray(mask))
+        toks = np.asarray(toks)     # sync point: the tick's device work
+        dones = np.asarray(dones)
+        dt = time.monotonic() - t0
+        committed = 0
+        for slot, req in occupants:
+            if not self._commit_token(req, int(toks[slot])):
+                continue  # callback failed; slot already freed
+            committed += 1
+            if (len(req.tokens) >= req.max_new_tokens
+                    or (not req.ignore_eos and bool(dones[slot]))):
+                self._retire(req, RequestStatus.COMPLETED)
+        self._stats.record_tick(active_slots=len(occupants),
+                                committed_tokens=committed,
+                                max_slots=self.max_slots, seconds=dt)
+
+    def _commit_token(self, req: Request, token: int) -> bool:
+        """Append + stream one token. A raising ``on_token`` callback fails
+        ONLY its own request (slot freed, batch untouched); returns False
+        in that case."""
+        req.tokens.append(token)
+        if req.on_token is not None:
+            try:
+                req.on_token(token)
+            except Exception as e:
+                self._retire(req, RequestStatus.FAILED, e)
+                return False
+        return True
+
+    def _retire(self, req: Request, status: RequestStatus,
+                error: Optional[BaseException] = None):
+        if req.slot is not None:
+            self._slots.release(req.slot)
+        req._finish(status, error)
+        self._stats.record_finish(req.status)

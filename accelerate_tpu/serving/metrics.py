@@ -1,0 +1,151 @@
+"""Serving-engine counters, in the style of ``utils.profiling.PipelineStats``.
+
+The engine is a host loop driving two compiled programs; the numbers that
+matter operationally are therefore host-side:
+
+* per-request **queue wait** (submit -> slot assignment) and **TTFT**
+  (submit -> first streamed token, i.e. queue wait + one prefill) — the
+  latency a caller actually feels;
+* engine-level **decode tokens/sec** (committed tokens over decode-tick
+  wall time) — the throughput the fixed-shape batch sustains;
+* **slot occupancy** (active slots per tick / ``max_slots``) and **batch
+  efficiency** (committed tokens per tick / ``max_slots``) — how much of
+  each fixed-shape decode step is doing real work. Low occupancy under
+  load means admission is starved (queue too small, prefill too slow);
+  occupancy >> efficiency means slots sit done-latched waiting on
+  retirement.
+
+Thread-safe: submit() is called from caller threads, everything else from
+the engine thread.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class ServingStats:
+    """Aggregated serving counters; ``summary()`` is a flat scalar dict
+    suitable for ``Accelerator.log`` / tracking payloads."""
+
+    #: TTFT samples kept for percentile reporting (bounded so a long-running
+    #: engine cannot grow host memory; newest samples win).
+    MAX_TTFT_SAMPLES = 4096
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self):
+        """Zero every counter (e.g. between measurement windows)."""
+        with self._lock:
+            self._submitted = 0
+            self._admitted = 0
+            self._completed = 0
+            self._failed = 0
+            self._cancelled = 0
+            self._timed_out = 0
+            self._rejected = 0
+            self._queue_wait_ms_sum = 0.0
+            self._queue_wait_ms_max = 0.0
+            self._ttft_ms_sum = 0.0
+            self._ttft_ms_max = 0.0
+            self._ttft_samples: list[float] = []
+            self._ticks = 0
+            self._tick_s_sum = 0.0
+            self._active_slot_sum = 0
+            self._slot_capacity_sum = 0
+            self._decode_tokens = 0
+            self._prefill_tokens = 0
+            self._queue_depth_last = 0
+
+    # -- caller side ----------------------------------------------------
+    def record_submit(self, queue_depth: int):
+        with self._lock:
+            self._submitted += 1
+            self._queue_depth_last = int(queue_depth)
+
+    def record_reject(self):
+        """A submit bounced off the full admission queue (backpressure)."""
+        with self._lock:
+            self._rejected += 1
+
+    # -- engine side ----------------------------------------------------
+    def record_admit(self, queue_wait_ms: float, ttft_ms: float):
+        """One request placed into a slot; TTFT is measured here because the
+        first token is emitted by the prefill itself."""
+        with self._lock:
+            self._admitted += 1
+            self._queue_wait_ms_sum += queue_wait_ms
+            self._queue_wait_ms_max = max(self._queue_wait_ms_max, queue_wait_ms)
+            self._ttft_ms_sum += ttft_ms
+            self._ttft_ms_max = max(self._ttft_ms_max, ttft_ms)
+            self._ttft_samples.append(ttft_ms)
+            if len(self._ttft_samples) > self.MAX_TTFT_SAMPLES:
+                del self._ttft_samples[: len(self._ttft_samples) // 2]
+            self._prefill_tokens += 1
+
+    def record_tick(self, active_slots: int, committed_tokens: int,
+                    max_slots: int, seconds: float):
+        """One ``decode_step_all_slots`` execution."""
+        with self._lock:
+            self._ticks += 1
+            self._tick_s_sum += seconds
+            self._active_slot_sum += int(active_slots)
+            self._slot_capacity_sum += int(max_slots)
+            self._decode_tokens += int(committed_tokens)
+
+    def record_finish(self, status):
+        """One request retired; ``status`` is a RequestStatus."""
+        from .request import RequestStatus
+
+        with self._lock:
+            if status == RequestStatus.COMPLETED:
+                self._completed += 1
+            elif status == RequestStatus.FAILED:
+                self._failed += 1
+            elif status == RequestStatus.TIMED_OUT:
+                self._timed_out += 1
+            else:
+                self._cancelled += 1
+
+    # -- reporting ------------------------------------------------------
+    @staticmethod
+    def _percentile(samples: list[float], q: float) -> float:
+        if not samples:
+            return 0.0
+        s = sorted(samples)
+        idx = min(len(s) - 1, max(0, int(round(q * (len(s) - 1)))))
+        return s[idx]
+
+    def summary(self) -> dict:
+        """Scalar snapshot: request counts, queue-wait/TTFT latencies,
+        decode tokens/sec, slot occupancy, and batch efficiency."""
+        with self._lock:
+            admits = max(1, self._admitted)
+            caps = max(1, self._slot_capacity_sum)
+            samples = list(self._ttft_samples)
+            return {
+                "requests_submitted": self._submitted,
+                "requests_admitted": self._admitted,
+                "requests_completed": self._completed,
+                "requests_failed": self._failed,
+                "requests_cancelled": self._cancelled,
+                "requests_timed_out": self._timed_out,
+                "requests_rejected": self._rejected,
+                "queue_wait_ms": round(self._queue_wait_ms_sum / admits, 3),
+                "queue_wait_ms_max": round(self._queue_wait_ms_max, 3),
+                "ttft_ms": round(self._ttft_ms_sum / admits, 3),
+                "ttft_ms_p50": round(self._percentile(samples, 0.50), 3),
+                "ttft_ms_p95": round(self._percentile(samples, 0.95), 3),
+                "ttft_ms_max": round(self._ttft_ms_max, 3),
+                "decode_ticks": self._ticks,
+                "decode_tokens": self._decode_tokens,
+                "tokens_emitted": self._decode_tokens + self._prefill_tokens,
+                "decode_tokens_per_sec": round(
+                    self._decode_tokens / self._tick_s_sum, 3)
+                    if self._tick_s_sum else 0.0,
+                "slot_occupancy": round(self._active_slot_sum / caps, 4),
+                "batch_efficiency": round(self._decode_tokens / caps, 4),
+                "queue_depth": self._queue_depth_last,
+            }

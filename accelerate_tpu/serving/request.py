@@ -1,0 +1,140 @@
+"""Request objects for the serving engine.
+
+A :class:`Request` is both the admission record the engine schedules and
+the HANDLE the caller keeps: ``submit()`` returns it immediately, tokens
+stream into it (and through ``on_token``) as they are committed, and
+``result()`` blocks until the request retires. All mutation after submit
+happens on the engine thread; the caller only reads, waits, or flips the
+cancel flag — so the only synchronization needed is the done event and a
+couple of volatile flags.
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+import time
+from typing import Callable, Optional
+
+import numpy as np
+
+
+class RequestStatus(enum.Enum):
+    QUEUED = "queued"        # submitted, waiting for a slot
+    RUNNING = "running"      # prefilled into a slot, decoding
+    COMPLETED = "completed"  # emitted eos or max_new_tokens
+    FAILED = "failed"        # admission/callback error (slot freed, batch unharmed)
+    CANCELLED = "cancelled"  # cancel() honored (or engine shutdown without drain)
+    TIMED_OUT = "timed_out"  # per-request deadline passed while queued or running
+
+
+_TERMINAL = (RequestStatus.COMPLETED, RequestStatus.FAILED,
+             RequestStatus.CANCELLED, RequestStatus.TIMED_OUT)
+
+
+class Request:
+    """One generation request: prompt + per-request knobs + result handle.
+
+    Sampling parameters (greedy vs temperature/top-k/top-p) and the eos id
+    are ENGINE-level — they are baked into the two compiled programs, so a
+    per-request change would mean a recompile; what varies per request is
+    everything host-side: ``max_new_tokens``, ``timeout``, the rng key, the
+    streaming callback, and cancellation.
+    """
+
+    def __init__(self, prompt_ids, max_new_tokens: int = 20,
+                 rng=None, seed: Optional[int] = None,
+                 timeout: Optional[float] = None,
+                 on_token: Optional[Callable[[int], None]] = None,
+                 ignore_eos: bool = False):
+        ids = np.asarray(prompt_ids, np.int32)
+        if ids.ndim == 1:
+            ids = ids[None, :]
+        if ids.ndim != 2 or ids.shape[0] != 1:
+            raise ValueError(
+                f"prompt_ids must be [S] or [1, S] (got shape {ids.shape}); "
+                "the engine schedules requests individually into slots")
+        self.prompt_ids = ids
+        self.max_new_tokens = int(max_new_tokens)
+        if self.max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens must be >= 1 (got {max_new_tokens})")
+        self.rng = rng
+        self.seed = seed
+        self.timeout = timeout
+        self.on_token = on_token
+        #: run to exactly max_new_tokens even if eos is emitted (warmup and
+        #: benchmark traffic — keeps tick counts deterministic).
+        self.ignore_eos = ignore_eos
+
+        self.tokens: list[int] = []        # committed tokens, streamed order
+        self.status = RequestStatus.QUEUED
+        self.error: Optional[BaseException] = None
+        self.slot: Optional[int] = None
+
+        self.submitted_at: Optional[float] = None   # engine-stamped (monotonic)
+        self.admitted_at: Optional[float] = None
+        self.first_token_at: Optional[float] = None
+        self.finished_at: Optional[float] = None
+
+        self._cancel_requested = False
+        self._done = threading.Event()
+
+    # -- caller API -----------------------------------------------------
+    def cancel(self):
+        """Request cancellation: a queued request is dropped before it ever
+        takes a slot; a running request retires at the next decode tick
+        (its slot frees without disturbing the rest of the batch)."""
+        self._cancel_requested = True
+
+    @property
+    def cancel_requested(self) -> bool:
+        return self._cancel_requested
+
+    @property
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until the request retires; True if it did within timeout."""
+        return self._done.wait(timeout)
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        """Generated token ids [n] (prompt excluded), blocking until done.
+
+        Raises ``TimeoutError`` if the wait times out, or ``RuntimeError``
+        (chaining the recorded error, if any) when the request did not
+        complete — failed, cancelled, or deadline-expired.
+        """
+        if not self._done.wait(timeout):
+            raise TimeoutError("request still in flight")
+        if self.status != RequestStatus.COMPLETED:
+            raise RuntimeError(
+                f"request {self.status.value}"
+                + (f": {self.error}" if self.error is not None else "")
+            ) from self.error
+        return np.asarray(self.tokens, np.int32)
+
+    def output_ids(self, timeout: Optional[float] = None) -> np.ndarray:
+        """[1, S + n] prompt + completion — the offline ``generate`` shape."""
+        toks = self.result(timeout)
+        return np.concatenate([self.prompt_ids, toks[None, :]], axis=1)
+
+    # -- engine internals ----------------------------------------------
+    def _deadline_passed(self, now: Optional[float] = None) -> bool:
+        if self.timeout is None or self.submitted_at is None:
+            return False
+        return (now if now is not None else time.monotonic()) \
+            > self.submitted_at + self.timeout
+
+    def _finish(self, status: RequestStatus, error: Optional[BaseException] = None):
+        if self.status in _TERMINAL:  # first terminal transition wins
+            return
+        self.status = status
+        self.error = error
+        self.finished_at = time.monotonic()
+        self._done.set()
+
+    def __repr__(self):
+        return (f"Request(S={self.prompt_ids.shape[1]}, "
+                f"max_new={self.max_new_tokens}, status={self.status.value}, "
+                f"tokens={len(self.tokens)})")

@@ -1,0 +1,120 @@
+"""Admission queue and slot bookkeeping for the serving engine.
+
+Two small host-side structures, deliberately independent of jax:
+
+* :class:`AdmissionQueue` — a bounded FCFS queue with backpressure. The
+  bound is the engine's only flow control: when the queue is full,
+  ``submit`` either raises :class:`QueueFull` (``block=False``) or blocks
+  the caller until the engine drains a request (``block=True``), so a
+  burst of traffic turns into caller-side latency instead of unbounded
+  host memory.
+* :class:`SlotScheduler` — a free-list over the fixed ``max_slots`` decode
+  lanes. FCFS: the engine pops the oldest queued request whenever a slot
+  is free. Slots are plain integers; all per-slot device state lives in
+  the engine's state pytree, indexed by these.
+"""
+
+from __future__ import annotations
+
+import collections
+import queue
+from typing import Optional
+
+from .request import Request
+
+
+class QueueFull(RuntimeError):
+    """Raised by non-blocking submit when the admission queue is at bound."""
+
+
+class AdmissionQueue:
+    """Bounded FCFS request queue (thread-safe; many producers, one engine
+    consumer)."""
+
+    def __init__(self, max_queued: int = 64):
+        if max_queued < 1:
+            raise ValueError(f"max_queued must be >= 1 (got {max_queued})")
+        self.max_queued = int(max_queued)
+        self._q: queue.Queue[Request] = queue.Queue(maxsize=self.max_queued)
+
+    def put(self, request: Request, block: bool = True,
+            timeout: Optional[float] = None):
+        """Enqueue; raises :class:`QueueFull` on backpressure (immediately
+        when ``block=False``, after ``timeout`` otherwise)."""
+        try:
+            self._q.put(request, block=block, timeout=timeout)
+        except queue.Full:
+            raise QueueFull(
+                f"admission queue full ({self.max_queued} requests queued); "
+                "retry later or submit with block=True") from None
+
+    def get(self, timeout: Optional[float] = None) -> Optional[Request]:
+        """Pop the oldest request, or None after ``timeout`` (engine poll)."""
+        try:
+            return self._q.get(block=timeout is not None and timeout > 0,
+                               timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def get_nowait(self) -> Optional[Request]:
+        try:
+            return self._q.get_nowait()
+        except queue.Empty:
+            return None
+
+    def __len__(self) -> int:
+        return self._q.qsize()
+
+    def drain(self) -> list[Request]:
+        """Remove and return everything currently queued (shutdown path)."""
+        out = []
+        while True:
+            r = self.get_nowait()
+            if r is None:
+                return out
+            out.append(r)
+
+
+class SlotScheduler:
+    """Free-list of decode slots + the request occupying each.
+
+    Engine-thread only (no lock): admission, retirement, and the tick loop
+    all run on the single engine thread.
+    """
+
+    def __init__(self, max_slots: int):
+        if max_slots < 1:
+            raise ValueError(f"max_slots must be >= 1 (got {max_slots})")
+        self.max_slots = int(max_slots)
+        self._free = collections.deque(range(self.max_slots))
+        self._occupant: dict[int, Request] = {}
+
+    @property
+    def free_slots(self) -> int:
+        return len(self._free)
+
+    @property
+    def active_slots(self) -> int:
+        return len(self._occupant)
+
+    def has_free(self) -> bool:
+        return bool(self._free)
+
+    def assign(self, request: Request) -> int:
+        slot = self._free.popleft()  # lowest-index-first keeps state compact
+        self._occupant[slot] = request
+        request.slot = slot
+        return slot
+
+    def release(self, slot: int) -> Request:
+        request = self._occupant.pop(slot)
+        request.slot = None
+        self._free.append(slot)
+        return request
+
+    def occupant(self, slot: int) -> Optional[Request]:
+        return self._occupant.get(slot)
+
+    def active(self) -> list[tuple[int, Request]]:
+        """(slot, request) pairs for every occupied slot, slot-ordered."""
+        return sorted(self._occupant.items())

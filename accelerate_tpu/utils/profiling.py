@@ -145,7 +145,8 @@ class ProfileSession:
     """
 
     def __init__(self, kwargs: "ProfileKwargs", log_dir: Optional[str] = None,
-                 pipeline_stats: Optional[PipelineStats] = None):
+                 pipeline_stats: Optional[PipelineStats] = None,
+                 serving_stats=None):
         self.kwargs = kwargs
         self.log_dir = log_dir or kwargs.output_trace_dir or "./jax_trace"
         sched = kwargs.schedule_option or {}
@@ -154,10 +155,12 @@ class ProfileSession:
         self.active = int(sched.get("active", 0)) or None  # None = whole block
         self._step = 0
         self._tracing = False
-        # Host-side step breakdown rides along with the device trace: pass
-        # the stats object shared with the dataloaders (or let callers attach
-        # one later via attach_pipeline_stats).
+        # Host-side step breakdowns ride along with the device trace: pass
+        # the stats objects shared with the dataloaders / serving engines
+        # (or let callers attach them later via attach_pipeline_stats /
+        # attach_serving_stats).
         self.pipeline_stats = pipeline_stats
+        self.serving_stats = serving_stats
         self._step_breakdowns: list[dict] = []
 
     def _should_trace(self) -> bool:
@@ -196,12 +199,22 @@ class ProfileSession:
         self.pipeline_stats = stats
         return self
 
+    def attach_serving_stats(self, stats):
+        """Attach serving-engine counters (``serving.metrics.ServingStats``)
+        so ``step()`` snapshots them under ``serving/`` keys."""
+        self.serving_stats = stats
+        return self
+
     def step(self):
         """Advance the schedule (reference: torch profiler .step())."""
-        if self.pipeline_stats is not None:
-            self._step_breakdowns.append(
-                {"step": self._step, **self.pipeline_stats.summary()}
-            )
+        if self.pipeline_stats is not None or self.serving_stats is not None:
+            snap = {"step": self._step}
+            if self.pipeline_stats is not None:
+                snap.update(self.pipeline_stats.summary())
+            if self.serving_stats is not None:
+                snap.update({f"serving/{k}": v
+                             for k, v in self.serving_stats.summary().items()})
+            self._step_breakdowns.append(snap)
         self._step += 1
         should = self._should_trace()
         if should and not self._tracing:
@@ -216,9 +229,17 @@ class ProfileSession:
             return {}
         return self.pipeline_stats.summary()
 
+    def serving_breakdown(self) -> dict:
+        """Latest serving-engine breakdown (ttft_ms/decode_tokens_per_sec/
+        slot_occupancy, …); empty when no serving stats are attached."""
+        if self.serving_stats is None:
+            return {}
+        return self.serving_stats.summary()
+
     @property
     def step_breakdowns(self) -> list[dict]:
-        """Per-``step()`` cumulative input-pipeline snapshots."""
+        """Per-``step()`` cumulative host-side snapshots (input pipeline +
+        ``serving/``-prefixed engine counters)."""
         return list(self._step_breakdowns)
 
     def __exit__(self, *exc):

@@ -244,6 +244,237 @@ def input_pipeline_extra(on_tpu: bool) -> dict:
     }
 
 
+def _serving_test_engine(max_slots: int = 4, max_len: int = 64,
+                         do_sample: bool = False, **kw):
+    """(engine, model, params, cfg) on a tiny Llama — the serving
+    microbenchmarks' shared fixture. Construction + warmup compile both
+    engine programs, so callers time pure serving behavior."""
+    import jax
+
+    from accelerate_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from accelerate_tpu.serving import ServingEngine
+
+    cfg = LlamaConfig.tiny()
+    model = LlamaForCausalLM(cfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+    engine = ServingEngine(model, params, max_slots=max_slots, max_len=max_len,
+                           do_sample=do_sample, **kw)
+    return engine, model, params, cfg
+
+
+def serving_sweep(offered_loads=(20.0, 60.0, 200.0), n_requests: int = 12,
+                  prompt_len: int = 4, max_new_tokens: int = 12,
+                  max_slots: int = 4) -> dict:
+    """Offered-load sweep over one warmed ServingEngine: at each load
+    (requests/sec), submit ``n_requests`` at fixed inter-arrival spacing and
+    report end-to-end throughput, p50/p95 TTFT, and mean slot occupancy.
+    CPU-runnable (tiny model, both programs compiled once up front); the
+    shape of the curve — TTFT flat while slots are free, rising once the
+    queue forms — is the payload, not absolute numbers."""
+    import numpy as np
+
+    engine, _, _, _ = _serving_test_engine(max_slots=max_slots)
+    rng = np.random.default_rng(0)
+    prompts = rng.integers(1, 200, size=(n_requests, prompt_len)).astype(np.int32)
+    points = []
+    try:
+        for load in offered_loads:
+            engine.stats.reset()
+            gap_s = 1.0 / load
+            t0 = time.perf_counter()
+            reqs = []
+            for i in range(n_requests):
+                target = t0 + i * gap_s
+                delay = target - time.perf_counter()
+                if delay > 0:
+                    time.sleep(delay)
+                reqs.append(engine.submit(prompts[i:i + 1],
+                                          max_new_tokens=max_new_tokens,
+                                          seed=i, block=True))
+            for r in reqs:
+                r.wait(timeout=120)
+            wall_s = time.perf_counter() - t0
+            s = engine.serving_metrics()
+            points.append({
+                "offered_rps": load,
+                "completed": s["requests_completed"],
+                "wall_s": round(wall_s, 4),
+                "throughput_tokens_per_sec": round(
+                    s["tokens_emitted"] / wall_s, 3) if wall_s else None,
+                "decode_tokens_per_sec": s["decode_tokens_per_sec"],
+                "ttft_ms_p50": s["ttft_ms_p50"],
+                "ttft_ms_p95": s["ttft_ms_p95"],
+                "queue_wait_ms": s["queue_wait_ms"],
+                "slot_occupancy": s["slot_occupancy"],
+                "batch_efficiency": s["batch_efficiency"],
+            })
+    finally:
+        engine.shutdown()
+    return {
+        "n_requests": n_requests,
+        "prompt_len": prompt_len,
+        "max_new_tokens": max_new_tokens,
+        "max_slots": max_slots,
+        "loads": points,
+    }
+
+
+def _sleepy_llama_cls(step_ms: float):
+    """A tiny-Llama subclass whose forward ALSO burns a deterministic
+    ``step_ms`` host sleep (pure_callback, data-dependent so XLA cannot
+    elide it; ``broadcast_all`` so the engine's vmapped tick sleeps ONCE,
+    not once per slot). Same trick as :func:`overlap_microbench`'s
+    sleep-step: on CPU the tiny model decodes a token in ~50µs inside a
+    compiled scan, so scheduling effects drown in host overhead — pinning
+    the per-step cost to a real-model magnitude makes the continuous-vs-
+    static comparison measure SCHEDULING, deterministically."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from accelerate_tpu.models.llama import LlamaForCausalLM
+
+    class _SleepyLlama(LlamaForCausalLM):
+        def apply(self, variables, *args, **kwargs):
+            out = super().apply(variables, *args, **kwargs)
+
+            def _sleep(x):
+                time.sleep(step_ms / 1e3)
+                return np.zeros(np.shape(x), np.float32)
+
+            if isinstance(out, tuple):
+                logits, cache = out
+                # The callback input must VARY per decode step (an element
+                # of the logits), or XLA hoists the loop-invariant callback
+                # out of the offline decode scan and the static path stops
+                # paying the per-step cost.
+                z = jax.pure_callback(
+                    _sleep, jax.ShapeDtypeStruct((), jnp.float32),
+                    logits[(0,) * logits.ndim].astype(jnp.float32),
+                    vmap_method="broadcast_all")
+                return logits + z.astype(logits.dtype), cache
+            return out
+
+    return _SleepyLlama
+
+
+def continuous_vs_static(n_short: int = 3, short_new_tokens: int = 8,
+                         long_new_tokens: int = 48, arrival_ms: float = 5.0,
+                         prompt_len: int = 4, max_slots: int = 4,
+                         max_len: int = 64, step_ms: float = 2.0) -> dict:
+    """Staggered-arrival latency comparison on the traffic continuous
+    batching exists for (Orca): ONE long request followed by short ones.
+
+    * static baseline — dynamic-batch-on-idle over offline ``generate``:
+      when idle, take every arrived request as one fixed batch; the batch
+      decodes to its LONGEST member, and later arrivals wait for the whole
+      batch. The shorts queue behind the long request — head-of-line
+      blocking.
+    * continuous — the ServingEngine: shorts join the batch mid-flight in
+      free slots while the long request keeps its own slot.
+
+    Both paths run the SAME sleepy model (every forward costs a
+    deterministic ``step_ms``; see :func:`_sleepy_llama_cls`) and are fully
+    precompiled before timing, so the gap is scheduling — not compilation,
+    not host-overhead asymmetry. ``speedup`` is static/continuous on the
+    SHORT requests' mean latency — the number head-of-line blocking
+    actually moves."""
+    import jax
+    import numpy as np
+
+    from accelerate_tpu import generation
+    from accelerate_tpu.models.llama import LlamaConfig
+    from accelerate_tpu.serving import ServingEngine
+
+    model = _sleepy_llama_cls(step_ms)(LlamaConfig.tiny())
+    params = model.init_params(jax.random.PRNGKey(0))
+    engine = ServingEngine(model, params, max_slots=max_slots, max_len=max_len)
+    n_requests = 1 + n_short
+    rng = np.random.default_rng(0)
+    prompts = rng.integers(1, 200, size=(n_requests, prompt_len)).astype(np.int32)
+    new_tokens = [long_new_tokens] + [short_new_tokens] * n_short
+    arrivals = [i * arrival_ms / 1e3 for i in range(n_requests)]
+
+    def run_static():
+        # Precompile every (batch, max_new) the loop can produce: the long
+        # request always rides alone (it arrives first and decodes far past
+        # the last arrival), shorts batch in any split.
+        np.asarray(generation.generate(model, params, prompts[:1],
+                                       max_new_tokens=long_new_tokens))
+        for b in range(1, min(n_short, max_slots) + 1):
+            np.asarray(generation.generate(model, params, prompts[1:1 + b],
+                                           max_new_tokens=short_new_tokens))
+        latency = [0.0] * n_requests
+        next_idx, t0 = 0, time.perf_counter()
+        while next_idx < n_requests:
+            now = time.perf_counter() - t0
+            n_arrived = next_idx
+            while n_arrived < n_requests and arrivals[n_arrived] <= now:
+                n_arrived += 1
+            if n_arrived == next_idx:
+                time.sleep(0.0005)
+                continue
+            batch = list(range(next_idx, min(n_arrived, next_idx + max_slots)))
+            np.asarray(generation.generate(
+                model, params, prompts[batch],
+                max_new_tokens=max(new_tokens[i] for i in batch)))
+            done = time.perf_counter() - t0
+            for i in batch:
+                latency[i] = done - arrivals[i]
+            next_idx = batch[-1] + 1
+        return latency
+
+    def run_continuous():
+        engine.stats.reset()
+        t0 = time.perf_counter()
+        reqs = []
+        for i in range(n_requests):
+            delay = t0 + arrivals[i] - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            reqs.append(engine.submit(prompts[i:i + 1],
+                                      max_new_tokens=new_tokens[i],
+                                      block=True))
+        for r in reqs:
+            r.wait(timeout=120)
+        return [r.finished_at - r.submitted_at for r in reqs]
+
+    try:
+        static_lat = run_static()
+        cont_lat = run_continuous()
+        stats = engine.serving_metrics()
+    finally:
+        engine.shutdown()
+    static_short = sum(static_lat[1:]) / n_short
+    cont_short = sum(cont_lat[1:]) / n_short
+    return {
+        "n_short": n_short,
+        "short_new_tokens": short_new_tokens,
+        "long_new_tokens": long_new_tokens,
+        "arrival_ms": arrival_ms,
+        "max_slots": max_slots,
+        "static_mean_latency_s": round(sum(static_lat) / n_requests, 4),
+        "continuous_mean_latency_s": round(sum(cont_lat) / n_requests, 4),
+        "static_short_latency_s": round(static_short, 4),
+        "continuous_short_latency_s": round(cont_short, 4),
+        "speedup": round(static_short / cont_short, 3) if cont_short else None,
+        "continuous_stats": stats,
+    }
+
+
+def serving_extra(on_tpu: bool) -> dict:
+    """The ``extra.serving`` payload: on CPU the offered-load sweep plus the
+    continuous-vs-static staggered-arrival comparison (cheap, tiny model);
+    on TPU skipped — serving the tier-1 model is its own benchmark, not a
+    rider on the training run (no extra compiles over the tunnel)."""
+    if on_tpu:
+        return {}
+    return {
+        "sweep": serving_sweep(),
+        "continuous_vs_static": continuous_vs_static(),
+    }
+
+
 def run_bench(on_tpu: bool) -> dict:
     import jax
     import numpy as np
@@ -392,6 +623,15 @@ def run_bench(on_tpu: bool) -> dict:
             result["extra"]["input_pipeline"] = pipeline
         except Exception as e:  # noqa: BLE001 - observability must not kill the result
             result["extra"]["input_pipeline_error"] = f"{type(e).__name__}: {e}"
+        # Serving payload: offered-load sweep + continuous-vs-static on the
+        # tiny model (CPU only; see serving_extra) — lands the serving
+        # layer's TTFT/throughput/occupancy story next to MFU.
+        try:
+            serving = serving_extra(on_tpu)
+            if serving:
+                result["extra"]["serving"] = serving
+        except Exception as e:  # noqa: BLE001 - observability must not kill the result
+            result["extra"]["serving_error"] = f"{type(e).__name__}: {e}"
         return result
 
     if on_tpu:

@@ -37,6 +37,11 @@ GROUPS = [
      "Fused KV-cached decoding: greedy/sampling, beam search, encoder-decoder."),
     ("inference", "Pipelined inference", ["accelerate_tpu.inference"],
      "PiPPy-parity staged inference over the pp axis."),
+    ("serving", "Serving",
+     ["accelerate_tpu.serving.engine", "accelerate_tpu.serving.request",
+      "accelerate_tpu.serving.scheduler", "accelerate_tpu.serving.metrics"],
+     "Continuous-batching decode service: slot scheduler, fixed-shape "
+     "prefill/decode programs, request handles, serving counters."),
     ("data_loader", "Data loading", ["accelerate_tpu.data_loader"],
      "Sharded/dispatched loaders, global-batch assembly, skip/resume, packing."),
     ("optimizer_scheduler", "Optimizer & scheduler",

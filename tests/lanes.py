@@ -56,6 +56,7 @@ LANES: dict[str, tuple[int, list[str]]] = {
         "test_models.py",
         "test_pipeline.py",
         "test_quantization.py",
+        "test_serving.py",
     ]),
     "subproc": (12, [
         "test_cli.py",

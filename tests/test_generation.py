@@ -609,3 +609,42 @@ class TestSpeculativeSampling:
         a = np.asarray(prompt_lookup_generate(model, params, jnp.asarray(ids), **kw))
         b = np.asarray(prompt_lookup_generate(model, params, jnp.asarray(ids), **kw))
         np.testing.assert_array_equal(a, b)
+
+
+class TestExecutableCacheLRU:
+    """The module-level executable cache (generation._generate_cache) is a
+    true LRU: a steadily-reused config must survive unbounded churn of
+    one-shot configs — FIFO eviction would silently recompile the hot
+    path every 64th request."""
+
+    def _scoped(self):
+        from accelerate_tpu import generation as g
+
+        saved = dict(g._generate_cache)
+        g._generate_cache.clear()
+        return g, saved
+
+    def test_hot_entry_survives_64_one_shot_inserts(self):
+        g, saved = self._scoped()
+        try:
+            g._cache_put("hot", "compiled")
+            for i in range(64):
+                assert g._cache_get("hot") == "compiled", f"evicted at churn {i}"
+                g._cache_put(("one-shot", i), i)
+            assert g._cache_get("hot") == "compiled"
+            assert len(g._generate_cache) <= 64
+        finally:
+            g._generate_cache.clear()
+            g._generate_cache.update(saved)
+
+    def test_untouched_entries_evict_oldest_first(self):
+        g, saved = self._scoped()
+        try:
+            for i in range(64):
+                g._cache_put(("cold", i), i)
+            g._cache_put(("new", 0), 0)  # bound reached: ("cold", 0) goes
+            assert g._cache_get(("cold", 0)) is None
+            assert g._cache_get(("cold", 1)) == 1
+        finally:
+            g._generate_cache.clear()
+            g._generate_cache.update(saved)

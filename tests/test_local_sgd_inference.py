@@ -199,6 +199,27 @@ class TestPipelinedInference:
         assert calls["shapes"] == ((8, 3), (8, 3)), calls
         assert out.shape == (6, 3)
 
+    def test_kwarg_attention_mask_rows_stay_aligned(self):
+        """Regression: a batch-dim attention mask passed by KEYWORD must be
+        padded with the same edge rows as the positional ids and un-sliced
+        together, so output row i is computed from (ids[i], mask[i]) — a
+        pad applied to args but not kwargs would pair real ids with a
+        neighbor's mask."""
+
+        def apply_fn(params, ids, attention_mask=None):
+            return ids * attention_mask  # row product exposes any mispairing
+
+        from accelerate_tpu.inference import PipelinedInferencer
+
+        fwd = PipelinedInferencer(apply_fn, params={}, num_microbatches=4)
+        rng = np.random.default_rng(0)
+        ids = jnp.asarray(rng.integers(1, 9, size=(6, 5)).astype(np.int32))
+        mask = jnp.asarray((rng.random((6, 5)) > 0.3).astype(np.int32))
+        out = fwd(ids, attention_mask=mask)
+        assert out.shape == (6, 5)
+        np.testing.assert_array_equal(
+            np.asarray(out), np.asarray(ids) * np.asarray(mask))
+
     def test_unpad_only_touches_batch_dim_leaves(self):
         def apply_fn(params, ids):
             # aux vector whose dim happens to exceed the batch: must NOT be cut

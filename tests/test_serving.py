@@ -1,0 +1,456 @@
+"""Continuous-batching serving engine (serving.ServingEngine).
+
+The acceptance-critical properties pinned here:
+
+* EXACTNESS — tokens streamed by the engine are bit-identical to offline
+  ``generation.generate`` for the same (prompt, rng, sampling), including
+  eos semantics, even when requests join mid-flight of other requests'
+  decode loops (staggered arrivals exercise the slot mask, not the shape).
+* ZERO RECOMPILES — after warmup, admitting and retiring requests of
+  varying prompt lengths triggers no new XLA compilation (probed via
+  jax.monitoring's event-duration listener, which fires per compile).
+* SCHEDULING SEMANTICS — bounded-queue backpressure, cancel (queued and
+  running), per-request timeout (queued and running), error isolation
+  (a raising stream callback fails only its own request), FCFS admission.
+* LIFECYCLE — graceful drain on shutdown (plus async-checkpoint flush),
+  preemption cooperation (finish in-flight, cancel queued, exit).
+
+All engines share the module-scoped tiny Llama from test_generation.py's
+convention; the slow-motion engine uses bench's deterministic-sleep model
+so timing-sensitive tests don't depend on host speed.
+"""
+
+import os
+import sys
+import threading
+import time
+import types
+
+import jax
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from accelerate_tpu import generation  # noqa: E402
+from accelerate_tpu.models.llama import LlamaConfig, LlamaForCausalLM  # noqa: E402
+from accelerate_tpu.serving import (  # noqa: E402
+    AdmissionQueue,
+    QueueFull,
+    Request,
+    RequestStatus,
+    ServingEngine,
+    ServingStats,
+    SlotScheduler,
+)
+
+EOS = 7
+
+PROMPTS = [
+    np.array([[3, 5, 7, 11, 2]], np.int32),
+    np.array([[1, 4, 9]], np.int32),
+    np.array([[8, 6, 4, 2, 10, 12, 14]], np.int32),
+    np.array([[42]], np.int32),
+]
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = LlamaConfig.tiny(use_flash_attention=False)
+    m = LlamaForCausalLM(cfg)
+    params = m.init_params(jax.random.PRNGKey(0), batch_size=2, seq_len=8)
+    return cfg, m, params
+
+
+@pytest.fixture(scope="module")
+def engine(tiny):
+    """Shared greedy engine (warmup paid once for the whole module)."""
+    _, m, params = tiny
+    eng = ServingEngine(m, params, max_slots=3, max_len=64, eos_token_id=EOS)
+    yield eng
+    if eng.running:
+        eng.shutdown(drain=False)
+
+
+@pytest.fixture(scope="module")
+def sampled_engine(tiny):
+    _, m, params = tiny
+    eng = ServingEngine(m, params, max_slots=3, max_len=64, eos_token_id=EOS,
+                        do_sample=True, temperature=0.9, top_k=50)
+    yield eng
+    if eng.running:
+        eng.shutdown(drain=False)
+
+
+@pytest.fixture(scope="module")
+def slow_engine():
+    """Engine over bench's deterministic-sleep model: ~10 ms per forward,
+    so slot-occupancy windows are wide enough for race-free scheduling
+    tests on any host."""
+    import bench
+
+    cfg = LlamaConfig.tiny(use_flash_attention=False)
+    m = bench._sleepy_llama_cls(step_ms=10.0)(cfg)
+    params = m.init_params(jax.random.PRNGKey(0), batch_size=1, seq_len=8)
+    eng = ServingEngine(m, params, max_slots=1, max_len=32, max_queued=1)
+    yield eng
+    if eng.running:
+        eng.shutdown(drain=False)
+
+
+def _offline(m, params, prompt, n, seed=None, **kw):
+    """Offline reference completion [n] (padded with eos past the latch)."""
+    rng = None if seed is None else jax.random.PRNGKey(seed)
+    out = generation.generate(m, params, prompt, max_new_tokens=n,
+                              eos_token_id=EOS, rng=rng, **kw)
+    return np.asarray(out)[0, prompt.shape[1]:]
+
+
+def _assert_matches_offline(got, ref, n):
+    """Engine stops AT eos; offline keeps the shape and pads with eos."""
+    got = np.asarray(got)
+    assert np.array_equal(got, ref[: len(got)]), (got, ref)
+    if len(got) < n:
+        assert got[-1] == EOS and np.all(ref[len(got):] == EOS), (got, ref)
+
+
+class TestSchedulerUnits:
+    def test_admission_queue_backpressure(self):
+        q = AdmissionQueue(max_queued=2)
+        a, b = Request([[1]]), Request([[2]])
+        q.put(a, block=False)
+        q.put(b, block=False)
+        with pytest.raises(QueueFull):
+            q.put(Request([[3]]), block=False)
+        with pytest.raises(QueueFull):
+            q.put(Request([[3]]), block=True, timeout=0.01)
+        assert q.get_nowait() is a  # FCFS
+        assert q.drain() == [b] and len(q) == 0
+
+    def test_slot_scheduler_free_list(self):
+        s = SlotScheduler(2)
+        r0, r1 = Request([[1]]), Request([[2]])
+        assert s.assign(r0) == 0 and s.assign(r1) == 1  # lowest-index-first
+        assert not s.has_free() and s.active() == [(0, r0), (1, r1)]
+        assert s.release(0) is r0 and r0.slot is None
+        r2 = Request([[3]])
+        assert s.assign(r2) == 0  # freed slot is reused
+        assert s.occupant(0) is r2 and s.active_slots == 2
+
+    def test_request_validation(self):
+        with pytest.raises(ValueError, match="max_new_tokens"):
+            Request([[1]], max_new_tokens=0)
+        with pytest.raises(ValueError, match="prompt_ids"):
+            Request(np.zeros((2, 3), np.int32))  # batched prompts: one per slot
+        with pytest.raises(ValueError, match="prompt_ids"):
+            Request(np.zeros((1, 1, 3), np.int32))
+        r = Request([1, 2, 3])  # 1-D promotes to [1, S]
+        assert r.prompt_ids.shape == (1, 3)
+
+    def test_request_result_semantics(self):
+        r = Request([[1]])
+        with pytest.raises(TimeoutError):
+            r.result(timeout=0.01)
+        r._finish(RequestStatus.CANCELLED)
+        with pytest.raises(RuntimeError, match="cancelled"):
+            r.result()
+        r2 = Request([[1]])
+        r2.tokens.extend([4, 5])
+        r2._finish(RequestStatus.COMPLETED)
+        r2._finish(RequestStatus.FAILED, RuntimeError("late"))  # first wins
+        assert r2.status is RequestStatus.COMPLETED
+        np.testing.assert_array_equal(r2.result(), [4, 5])
+        np.testing.assert_array_equal(r2.output_ids(), [[1, 4, 5]])
+
+    def test_stats_summary(self):
+        st = ServingStats()
+        st.record_submit(queue_depth=3)
+        st.record_admit(queue_wait_ms=4.0, ttft_ms=10.0)
+        st.record_tick(active_slots=2, committed_tokens=2, max_slots=4, seconds=0.01)
+        st.record_finish(RequestStatus.COMPLETED)
+        s = st.summary()
+        assert s["requests_submitted"] == s["requests_completed"] == 1
+        assert s["queue_wait_ms"] == 4.0 and s["ttft_ms_p50"] == 10.0
+        assert s["slot_occupancy"] == 0.5 and s["batch_efficiency"] == 0.5
+        assert s["tokens_emitted"] == 3  # 1 prefill + 2 decode
+        assert s["decode_tokens_per_sec"] == pytest.approx(200.0)
+        st.reset()
+        assert st.summary()["requests_submitted"] == 0
+
+
+class TestExactness:
+    def test_greedy_staggered_matches_offline(self, engine, tiny):
+        """Four requests (one more than there are slots) joining mid-flight:
+        every stream must equal offline greedy generate token for token."""
+        _, m, params = tiny
+        n = 10
+        reqs = []
+        for p in PROMPTS:
+            reqs.append(engine.submit(p, max_new_tokens=n))
+            time.sleep(0.015)  # staggered: later prompts join a live batch
+        for p, r in zip(PROMPTS, reqs):
+            _assert_matches_offline(r.result(timeout=120),
+                                    _offline(m, params, p, n), n)
+
+    def test_sampled_staggered_matches_offline(self, sampled_engine, tiny):
+        """Same but sampled: per-request seeds must reproduce the offline
+        rng chain (split-for-prefill, then split-per-step) exactly."""
+        _, m, params = tiny
+        n = 10
+        reqs = []
+        for i, p in enumerate(PROMPTS):
+            reqs.append(sampled_engine.submit(p, max_new_tokens=n, seed=100 + i))
+            time.sleep(0.015)
+        for i, (p, r) in enumerate(zip(PROMPTS, reqs)):
+            ref = _offline(m, params, p, n, seed=100 + i,
+                           do_sample=True, temperature=0.9, top_k=50)
+            _assert_matches_offline(r.result(timeout=120), ref, n)
+
+    def test_max_new_tokens_one_completes_at_prefill(self, engine, tiny):
+        _, m, params = tiny
+        p = PROMPTS[0]
+        r = engine.submit(p, max_new_tokens=1)
+        out = r.result(timeout=120)
+        assert out.shape == (1,)
+        assert out[0] == _offline(m, params, p, 1)[0]
+
+    def test_streaming_callback_order(self, engine):
+        streamed = []
+        r = engine.submit(PROMPTS[1], max_new_tokens=6,
+                          on_token=streamed.append)
+        out = r.result(timeout=120)
+        assert streamed == list(out)
+
+
+class TestZeroRecompile:
+    def test_no_compiles_after_warmup(self, engine):
+        """The acceptance bar: once warmed, admitting/retiring requests of
+        DIFFERENT prompt lengths into different slots runs only the two
+        existing executables — jax.monitoring's per-compile events must
+        stay silent across a full staggered round."""
+        compiles = []
+
+        def listener(event, duration, **kw):
+            if "compile" in event or "trace" in event:
+                compiles.append(event)
+
+        jax.monitoring.register_event_duration_secs_listener(listener)
+        try:
+            reqs = []
+            for i, p in enumerate(PROMPTS):
+                reqs.append(engine.submit(p, max_new_tokens=6, seed=7 + i))
+                time.sleep(0.01)
+            for r in reqs:
+                r.result(timeout=120)
+        finally:
+            from jax._src import monitoring as _mon
+
+            _mon._unregister_event_duration_listener_by_callback(listener)
+        assert not compiles, (
+            f"XLA recompiled after warmup: {compiles} — continuous batching "
+            "must change mask/state contents, never program shapes")
+
+
+class TestSchedulingSemantics:
+    @staticmethod
+    def _wait_status(req, status, timeout=60.0):
+        t0 = time.monotonic()
+        while req.status is not status:
+            if time.monotonic() - t0 > timeout:
+                raise AssertionError(f"{req} never reached {status}")
+            time.sleep(0.002)
+
+    def test_backpressure_and_cancel(self, slow_engine):
+        """max_slots=1, max_queued=1: the third concurrent submit must
+        bounce (QueueFull + rejected counter); cancelling then reaps both
+        the running and the queued request."""
+        rejected_before = slow_engine.serving_metrics()["requests_rejected"]
+        r_run = slow_engine.submit([[1]], max_new_tokens=30)
+        self._wait_status(r_run, RequestStatus.RUNNING)
+        r_queued = slow_engine.submit([[2]], max_new_tokens=30)
+        with pytest.raises(QueueFull):
+            slow_engine.submit([[3]], max_new_tokens=5)
+        assert slow_engine.serving_metrics()["requests_rejected"] == rejected_before + 1
+
+        r_queued.cancel()
+        r_run.cancel()
+        assert r_run.wait(60) and r_queued.wait(60)
+        assert r_run.status is RequestStatus.CANCELLED
+        assert r_queued.status is RequestStatus.CANCELLED
+        assert len(r_run.tokens) < 30  # actually stopped mid-decode
+        with pytest.raises(RuntimeError, match="cancelled"):
+            r_queued.result()
+
+    def test_timeout_running_request(self, slow_engine):
+        r = slow_engine.submit([[1]], max_new_tokens=30, timeout=0.08)
+        assert r.wait(60)
+        assert r.status is RequestStatus.TIMED_OUT
+        assert 1 <= len(r.tokens) < 30  # partial progress, then the deadline
+
+    def test_timeout_queued_request(self, slow_engine):
+        r_run = slow_engine.submit([[1]], max_new_tokens=30)
+        self._wait_status(r_run, RequestStatus.RUNNING)
+        r = slow_engine.submit([[2]], max_new_tokens=5, timeout=0.05)
+        time.sleep(0.06)
+        r_run.cancel()  # frees the slot; the expired request must NOT run
+        assert r.wait(60)
+        assert r.status is RequestStatus.TIMED_OUT and r.tokens == []
+        r_run.wait(60)
+
+    def test_error_isolation(self, engine, tiny):
+        """A raising on_token callback fails ITS request only: the slot
+        frees and concurrently decoding requests still finish exact."""
+        _, m, params = tiny
+        boom = RuntimeError("consumer went away")
+
+        def bad_cb(tok):
+            if bad_cb.n >= 2:
+                raise boom
+            bad_cb.n += 1
+
+        bad_cb.n = 0
+        r_bad = engine.submit(PROMPTS[0], max_new_tokens=10, on_token=bad_cb)
+        r_ok = engine.submit(PROMPTS[2], max_new_tokens=10)
+        assert r_bad.wait(120) and r_ok.wait(120)
+        assert r_bad.status is RequestStatus.FAILED and r_bad.error is boom
+        with pytest.raises(RuntimeError, match="failed"):
+            r_bad.result()
+        n = 10
+        _assert_matches_offline(r_ok.result(), _offline(m, params, PROMPTS[2], n), n)
+
+    def test_submit_validation(self, engine):
+        with pytest.raises(ValueError, match="empty prompt"):
+            engine.submit(np.zeros((1, 0), np.int32))
+        with pytest.raises(ValueError, match="max_len"):
+            engine.submit([[1, 2, 3]], max_new_tokens=62)  # 3 + 62 > 64
+
+
+class TestLifecycle:
+    def test_shutdown_drains_and_flushes_saves(self, tiny, monkeypatch):
+        """shutdown(drain=True) finishes every accepted request, then blocks
+        on async checkpoint saves before returning — a serving process is
+        usually the process that just trained the weights it serves."""
+        from accelerate_tpu import checkpointing
+
+        flushed = []
+        monkeypatch.setattr(checkpointing, "wait_for_saves",
+                            lambda: flushed.append(True))
+        _, m, params = tiny
+        eng = ServingEngine(m, params, max_slots=2, max_len=64,
+                            eos_token_id=EOS, warmup=False)
+        reqs = [eng.submit(p, max_new_tokens=5) for p in PROMPTS[:3]]
+        eng.shutdown(drain=True)
+        assert flushed == [True]
+        assert not eng.running
+        for r in reqs:
+            assert r.status is RequestStatus.COMPLETED and 1 <= len(r.tokens) <= 5
+        with pytest.raises(RuntimeError, match="not accepting"):
+            eng.submit([[1]])
+
+    def test_shutdown_without_drain_cancels(self, tiny):
+        import bench
+
+        cfg = LlamaConfig.tiny(use_flash_attention=False)
+        m = bench._sleepy_llama_cls(step_ms=10.0)(cfg)
+        params = m.init_params(jax.random.PRNGKey(0), batch_size=1, seq_len=8)
+        eng = ServingEngine(m, params, max_slots=1, max_len=32, warmup=False)
+        r1 = eng.submit([[1]], max_new_tokens=30)
+        r2 = eng.submit([[2]], max_new_tokens=30)
+        t0 = time.monotonic()
+        while r1.status is not RequestStatus.RUNNING:
+            assert time.monotonic() - t0 < 60
+            time.sleep(0.002)
+        eng.shutdown(drain=False)
+        assert r1.status is RequestStatus.CANCELLED
+        assert r2.status is RequestStatus.CANCELLED
+
+    def test_preemption_drain(self, tiny):
+        """With an accelerator reporting preemption, the engine finishes
+        what is decoding, cancels what is queued, and exits — flushing
+        work inside the notice window instead of taking more."""
+        _, m, params = tiny
+        acc = types.SimpleNamespace(policy=None, mesh=None,
+                                    preemption_requested=False)
+        eng = ServingEngine(m, params, max_slots=3, max_len=64,
+                            eos_token_id=EOS, accelerator=acc, warmup=False)
+        running = [eng.submit(p, max_new_tokens=45, ignore_eos=True)
+                   for p in PROMPTS[:3]]
+        queued = eng.submit(PROMPTS[3], max_new_tokens=45)
+        t0 = time.monotonic()
+        while eng._slots.active_slots < 3:  # all three lanes decoding
+            assert time.monotonic() - t0 < 120
+            time.sleep(0.001)
+        acc.preemption_requested = True
+        t0 = time.monotonic()
+        while eng.running:
+            assert time.monotonic() - t0 < 120, "engine did not exit on preemption"
+            time.sleep(0.005)
+        for r in running:
+            assert r.status is RequestStatus.COMPLETED and len(r.tokens) == 45
+        assert queued.status is RequestStatus.CANCELLED
+        with pytest.raises(RuntimeError, match="not accepting"):
+            eng.submit([[1]])
+
+    def test_rejects_model_without_kv_cache(self):
+        import flax.linen as nn
+
+        dense = nn.Dense(4)
+        params = dense.init(jax.random.PRNGKey(0), np.zeros((1, 4), np.float32))["params"]
+        with pytest.raises(TypeError, match="KV cache"):
+            ServingEngine(dense, params, autostart=False)
+
+
+class TestMetrics:
+    def test_serving_metrics_coherent(self, engine):
+        """Run after the exactness/streaming tests on the shared engine:
+        the cumulative counters must describe a working service."""
+        s = engine.serving_metrics()
+        assert s["requests_admitted"] >= 4
+        assert s["requests_completed"] >= 4
+        assert s["requests_submitted"] >= s["requests_admitted"]
+        assert s["ttft_ms"] > 0 and s["ttft_ms_p95"] >= s["ttft_ms_p50"] > 0
+        assert s["decode_tokens_per_sec"] > 0
+        assert 0 < s["slot_occupancy"] <= 1.0
+        assert 0 < s["batch_efficiency"] <= s["slot_occupancy"]
+        assert s["tokens_emitted"] == s["decode_tokens"] + s["requests_admitted"]
+
+    def test_accelerator_wiring(self, tiny):
+        """An engine built with accelerator= shares the accelerator's
+        ServingStats, so Accelerator.log(include_serving=True) and
+        serving_metrics() see this engine without extra plumbing."""
+        from accelerate_tpu import Accelerator
+        from accelerate_tpu.tracking import with_serving_metrics
+
+        acc = Accelerator()
+        acc.serving_stats.record_submit(queue_depth=0)
+        assert acc.serving_metrics()["requests_submitted"] == 1
+        payload = with_serving_metrics({"loss": 1.0}, acc.serving_stats)
+        assert payload["loss"] == 1.0
+        assert payload["serving/requests_submitted"] == 1
+        _, m, params = tiny
+        eng = ServingEngine(m, params, max_slots=1, max_len=64,
+                            accelerator=acc, autostart=False)
+        assert eng.stats is acc.serving_stats
+
+
+@pytest.mark.slow
+class TestSoak:
+    def test_sustained_mixed_load(self, engine, tiny):
+        """Soak: 40 mixed-length requests with jittered arrivals; every
+        stream completes, every stream is exact, and the counters balance."""
+        _, m, params = tiny
+        rng = np.random.default_rng(0)
+        before = engine.serving_metrics()
+        work = []
+        for i in range(40):
+            S = int(rng.integers(1, 24))
+            n = int(rng.integers(1, 20))
+            p = rng.integers(0, 256, size=(1, S)).astype(np.int32)
+            work.append((p, n, engine.submit(p, max_new_tokens=n)))
+            time.sleep(float(rng.random()) * 0.004)
+        for p, n, r in work:
+            _assert_matches_offline(r.result(timeout=300),
+                                    _offline(m, params, p, n), n)
+        after = engine.serving_metrics()
+        assert after["requests_completed"] - before["requests_completed"] == 40
+        assert after["requests_admitted"] - before["requests_admitted"] == 40

@@ -95,6 +95,29 @@ class TestAcceleratorIntegration:
         assert lines[0]["_type"] == "config"
         assert [l["loss"] for l in lines[1:]] == [2.0, 1.0]
 
+    def test_end_training_drains_async_saves_before_finishing(self, monkeypatch):
+        """end_training() must block on in-flight async checkpoint saves
+        BEFORE closing trackers — exiting with Orbax writes still running
+        drops the newest checkpoint on preemption."""
+        from accelerate_tpu import checkpointing
+
+        order = []
+        monkeypatch.setattr(checkpointing, "wait_for_saves",
+                            lambda: order.append("saves"))
+        tracker = CustomTracker()
+        real_finish = tracker.finish if hasattr(tracker, "finish") else None
+
+        def finish():
+            order.append("trackers")
+            if real_finish is not None:
+                real_finish()
+
+        tracker.finish = finish
+        acc = Accelerator(log_with=tracker)
+        acc.init_trackers("proj")
+        acc.end_training()
+        assert order[0] == "saves", order
+
     def test_custom_tracker_instance(self):
         tracker = CustomTracker()
         acc = Accelerator(log_with=tracker)
