@@ -223,6 +223,30 @@ def _next_token(last, rng, seen, done, select, eos_token_id, dtype):
     return nxt, done
 
 
+def _chunk_prefill_token(logits, rng, select, eos_token_id, dtype, true_len,
+                         offset=0, seen=None):
+    """THE prefill epilogue, shared by the serving engine's monolithic and
+    chunked prefill programs: split ``rng`` exactly like offline
+    :func:`generate` (decode carry first, prefill half second), read the
+    logits row of the last REAL prompt position — ``true_len - 1`` in
+    absolute positions, mapped into this chunk's ``[offset, offset + W)``
+    window and clamped so a chunk that does not contain it still indexes
+    in-bounds — and select token #1 through :func:`_next_token`. Only the
+    chunk containing ``true_len - 1`` (the final one) selects a real
+    token; earlier chunks' results are discarded by the engine. Returns
+    ``(tok [B], done [B], rng_carry)``.
+    """
+    local = jnp.clip(true_len - 1 - offset, 0, logits.shape[1] - 1)
+    last = jax.lax.dynamic_slice_in_dim(logits, local, 1, axis=1)[:, 0]
+    rng_carry, pre_rng = jax.random.split(rng)
+    if seen is None:
+        seen = jnp.zeros((last.shape[0], 1), bool)
+    tok, done = _next_token(last, pre_rng, seen,
+                            jnp.zeros((last.shape[0],), bool),
+                            select, eos_token_id, dtype)
+    return tok, done, rng_carry
+
+
 def _decode_scan(step_fn, select, first_tok, carry_extra, start_pos,
                  eos_token_id, num_steps: int, rng, seen0, track_seen=True,
                  min_new_tokens: int = 0):

@@ -2,16 +2,25 @@
 
 Public surface:
 
-* :class:`ServingEngine` — slot-based decode service running exactly two
-  compiled programs after warmup (``prefill_into_slot`` per prompt bucket,
-  ``decode_step_all_slots`` per tick); requests join and leave the batch
-  mid-flight with zero recompiles.
+* :class:`ServingEngine` — slot-based decode service running a FIXED set
+  of compiled programs after warmup (one ``prefill_chunk`` executable of
+  shape ``[1, prefill_chunk]`` for every prompt length, one
+  ``decode_step_all_slots`` tick, one ``restore_prefix`` copy); requests
+  join and leave the batch mid-flight with zero recompiles, and admission
+  is interleaved — at most ``prefill_chunks_per_tick`` chunk calls
+  between decode ticks, so long prompts never stall active streams.
 * :class:`Request` / :class:`RequestStatus` — the submit handle: streamed
-  tokens, ``result()``, cancellation, timestamps.
+  tokens, ``result()``, cancellation, timestamps; chunk-admitted requests
+  pass through ``PREFILLING`` while their prompt streams into KV.
 * :class:`ServingStats` — TTFT/queue-wait/throughput/occupancy counters
-  (``engine.serving_metrics()``, ``Accelerator.log(include_serving=True)``).
+  plus the chunked-prefill split (chunk count/ms, backlog, prefix-cache
+  hit rate/bytes) — ``engine.serving_metrics()``,
+  ``Accelerator.log(include_serving=True)``.
 * :class:`AdmissionQueue` / :class:`QueueFull` / :class:`SlotScheduler` —
   the bounded FCFS admission layer and slot free-list.
+* :class:`PrefixCache` — byte-bounded LRU of chunk-aligned prefix KV
+  blocks keyed by token-prefix hash chains (shared system prompts skip
+  their prefill FLOPs).
 
 See ``docs/usage_guides/serving.md``.
 """
@@ -19,7 +28,7 @@ See ``docs/usage_guides/serving.md``.
 from .engine import ServingEngine
 from .metrics import ServingStats
 from .request import Request, RequestStatus
-from .scheduler import AdmissionQueue, QueueFull, SlotScheduler
+from .scheduler import AdmissionQueue, PrefixCache, QueueFull, SlotScheduler
 
 __all__ = [
     "ServingEngine",
@@ -27,6 +36,7 @@ __all__ = [
     "Request",
     "RequestStatus",
     "AdmissionQueue",
+    "PrefixCache",
     "QueueFull",
     "SlotScheduler",
 ]

@@ -3,15 +3,19 @@
 The TPU constraint (GSPMD: peak performance comes from a small number of
 fixed-shape compiled programs) shapes the whole design. The engine owns a
 fixed ``[max_slots, max_len]`` decode state — per-slot KV cache, write
-position, carry rng, and eos latch — and after warmup runs exactly TWO
+position, carry rng, and eos latch — and after warmup runs a FIXED set of
 compiled programs, no matter how requests arrive or leave:
 
-* ``prefill_into_slot`` — one compiled executable per 128-bucketed prompt
-  length (:func:`generation._bucket128`); the prompt is EDGE-padded on the
-  host (numpy, so no per-length jnp pad programs) and the executable reads
-  logits at the traced ``true_len - 1``, builds a fresh batch-1 cache, and
-  writes the whole slot state with ``dynamic_update_slice`` at the traced
-  slot index.
+* ``prefill_chunk`` — ONE compiled executable of fixed shape
+  ``[1, prefill_chunk]`` serves every prompt length: a prompt is a
+  sequence of identical-shape chunk calls at traced ``cache_pos =
+  offset`` (slot index, chunk offset, and true length are all traced
+  arguments, never shapes). The tail chunk is EDGE-padded on the host
+  (numpy, so no per-length jnp pad programs); the executable reads the
+  logits row of ``true_len - 1`` mapped into the chunk window, and also
+  returns the chunk's own KV block so the prefix cache never needs a
+  separate extract program. Warmup therefore leaves ZERO lazy compiles
+  for any prompt length — there is no per-bucket prefill family anymore.
 * ``decode_step_all_slots`` — one token for every slot per tick, a
   ``jax.vmap`` of the batch-1 single-token forward over the slot axis,
   sharing :func:`generation._next_token` with the offline scan so engine
@@ -19,25 +23,51 @@ compiled programs, no matter how requests arrive or leave:
   same (prompt, rng, sampling). Slot membership is a host-provided boolean
   mask ARGUMENT, never a shape: admitting or retiring a request changes
   the mask bits, not the program.
+* ``restore_prefix`` — one compiled copy of a cached ``[1, prefill_chunk]``
+  KV block into a slot's cache at a traced offset, so a prompt whose
+  chunk-aligned prefix is in the :class:`scheduler.PrefixCache` (shared
+  system prompts, few-shot headers) skips those chunks' prefill FLOPs
+  entirely and resumes chunking at the boundary.
 
-Around the two programs: a bounded FCFS admission queue with backpressure,
-per-request ``max_new_tokens``/timeout/cancellation, streaming token
-callbacks, error isolation (a failing callback frees its slot without
-touching the rest of the batch), and a graceful drain on shutdown that
-cooperates with ``Accelerator.install_preemption_handler()`` — on
-preemption the engine stops admitting, finishes in-flight requests, and
-cancels the queue, so the process can exit inside the notice window.
+Admission is interleaved, not monolithic: an admitted request sits in
+``PREFILLING`` holding its slot, and each scheduler iteration spends at
+most ``prefill_chunks_per_tick`` chunk calls (round-robin across the
+prefill backlog) before the next decode tick — so decode lanes advance
+every tick and a 4k-token arrival can no longer stall every active
+stream for its whole prefill. Outputs stay token-identical to the
+monolithic path and to offline ``generate``: chunking changes WHEN KV is
+written, not what is written, and the first-token rng split
+(:func:`generation._chunk_prefill_token`) is the same.
 
-Pad-KV safety is the same argument as the offline path: the prompt is
-edge-padded to bucket P, prefill writes KV for positions [0, P), but the
-decode mask attends ``k_pos <= q_pos`` and every decode write lands at the
-current position *before* any query that could see it — pad entries past
-``true_len`` are overwritten at-or-before the first query that could
-attend them.
+Pad/garbage-KV safety, chunked edition: chunk calls write KV in place
+into the slot's region of the shared cache, which may hold a previous
+occupant's entries (and the tail chunk writes edge-pad KV past
+``true_len``). Both are safe for the same reason the offline bucketing
+is: the attention mask attends ``k_pos <= q_pos`` only, and masking is
+REPLACEMENT (``jnp.where(mask, logits, -1e30)``), so a masked garbage
+key contributes exactly 0 probability — finite garbage KV never changes
+a real row's output. Positions at/past ``true_len`` are overwritten by
+the first decode write at-or-before the first query that could attend
+them. One extra invariant protects ``PREFILLING`` slots from the decode
+tick (whose cache commit is unconditional): every ``prefill_chunk`` and
+``restore_prefix`` call writes ``pos[slot] = true_len``, so any garbage
+a tick writes for a mid-prefill slot lands at ``true_len`` — a position
+no prompt chunk reads and the first real decode write overwrites.
+
+Around the compiled programs: a bounded FCFS admission queue with
+backpressure, per-request ``max_new_tokens``/timeout/cancellation,
+streaming token callbacks, error isolation (a failing callback frees its
+slot without touching the rest of the batch), and a graceful drain on
+shutdown that cooperates with ``Accelerator.install_preemption_handler()``
+— on preemption the engine stops admitting, finishes in-flight requests
+(including mid-prefill ones), and cancels the queue, so the process can
+exit inside the notice window.
 """
 
 from __future__ import annotations
 
+import collections
+import hashlib
 import threading
 import time
 from typing import Optional
@@ -49,13 +79,14 @@ import numpy as np
 from ..generation import (
     _bucket128,
     _check_position_bound,
+    _chunk_prefill_token,
     _make_selector,
     _next_token,
 )
 from ..inference import resolve_model_source
 from .metrics import ServingStats
 from .request import Request, RequestStatus
-from .scheduler import AdmissionQueue, QueueFull, SlotScheduler
+from .scheduler import AdmissionQueue, PrefixCache, QueueFull, SlotScheduler
 
 __all__ = ["ServingEngine"]
 
@@ -71,15 +102,33 @@ class ServingEngine:
       max_len: per-slot KV capacity; every request must satisfy
         ``prompt_len + max_new_tokens <= max_len``.
       eos_token_id / do_sample / temperature / top_k / top_p: ENGINE-level
-        sampling config — baked into the two executables (a per-request
-        change would be a recompile). Greedy when ``do_sample=False``.
+        sampling config — baked into the compiled executables (a
+        per-request change would be a recompile). Greedy when
+        ``do_sample=False``.
       cache_dtype: KV buffer dtype (default bfloat16, like offline).
       max_queued: admission-queue bound (backpressure past it).
+      prefill_chunk: width of the single fixed-shape prefill executable
+        (clamped to ``max_len`` and the model's position table); a prompt
+        of any length runs as identical ``[1, prefill_chunk]`` chunk
+        calls. ``None`` selects the legacy monolithic path (one compiled
+        prefill per 128-bucketed prompt length, admission runs the whole
+        prompt inline) — kept for A/B measurement.
+      prefill_chunks_per_tick: admission budget — at most this many chunk
+        calls run between consecutive decode ticks, alternating
+        continuations of the ``PREFILLING`` backlog (round-robin) with
+        new admissions, bounding how much any arrival can delay active
+        streams' next token. At the default 1 a new arrival waits for the
+        backlog to drain; 2+ lets its first chunk ride alongside an
+        in-flight long prefill.
+      prefix_cache_mb: LRU budget for chunk-aligned prefix KV blocks
+        (0 disables). On admit, the longest cached chunk-aligned prefix
+        is restored by ``restore_prefix`` instead of recomputed; the
+        final chunk always re-runs so the first token's logits exist.
       accelerator: optional — wires preemption-drain cooperation and, when
         the accelerator carries a ``serving_stats``, shares it so
         ``Accelerator.log(include_serving=True)`` sees this engine.
       autostart: spawn the engine thread (and warm up) in the constructor.
-      warmup: run dummy requests through both programs at start so the
+      warmup: run dummy requests through every program at start so the
         first real request never pays a compile; stats reset afterwards.
     """
 
@@ -87,9 +136,13 @@ class ServingEngine:
                  max_len: int = 256, eos_token_id: Optional[int] = None,
                  do_sample: bool = False, temperature: float = 1.0,
                  top_k: Optional[int] = None, top_p: Optional[float] = None,
-                 cache_dtype=None, max_queued: int = 64, accelerator=None,
-                 stats: Optional[ServingStats] = None, autostart: bool = True,
-                 warmup: bool = True, idle_poll_s: float = 0.005):
+                 cache_dtype=None, max_queued: int = 64,
+                 prefill_chunk: Optional[int] = 256,
+                 prefill_chunks_per_tick: int = 1,
+                 prefix_cache_mb: float = 64.0,
+                 accelerator=None, stats: Optional[ServingStats] = None,
+                 autostart: bool = True, warmup: bool = True,
+                 idle_poll_s: float = 0.005):
         from ..big_modeling import cache_factory_for
 
         module, _, params, mesh, _ = resolve_model_source(
@@ -110,6 +163,15 @@ class ServingEngine:
         if max_slots < 1 or max_len < 2:
             raise ValueError(f"need max_slots >= 1 and max_len >= 2 "
                              f"(got {max_slots}, {max_len})")
+        if prefill_chunk is not None and int(prefill_chunk) < 1:
+            raise ValueError(
+                f"prefill_chunk must be >= 1 or None (got {prefill_chunk})")
+        if prefill_chunks_per_tick < 1:
+            raise ValueError("prefill_chunks_per_tick must be >= 1 "
+                             f"(got {prefill_chunks_per_tick})")
+        if prefix_cache_mb < 0:
+            raise ValueError(
+                f"prefix_cache_mb must be >= 0 (got {prefix_cache_mb})")
 
         self.module = module
         self.params = params
@@ -124,6 +186,28 @@ class ServingEngine:
         self._idle_poll_s = float(idle_poll_s)
         self._accelerator = accelerator
 
+        # The usable position range: max_len capped at the model's learned
+        # position table (writing KV at an OOB learned position is not just
+        # wasteful — gathers past the table poison the row).
+        bound = getattr(getattr(module, "config", None),
+                        "max_position_embeddings", None)
+        self._chunk_limit = (self.max_len if bound is None
+                             else min(self.max_len, int(bound)))
+        if prefill_chunk is None:
+            self._chunk: Optional[int] = None
+            self._chunk_cap = 0
+        else:
+            self._chunk = min(int(prefill_chunk), self._chunk_limit)
+            # The final chunk may start below its natural i*C offset so its
+            # fixed width never writes past max_len / the position table
+            # (re-running already-prefilled positions rewrites identical KV).
+            self._chunk_cap = self._chunk_limit - self._chunk
+        self._chunks_per_tick = int(prefill_chunks_per_tick)
+        self._prefix_cache = (
+            PrefixCache(int(prefix_cache_mb * 2 ** 20))
+            if self._chunk is not None and prefix_cache_mb > 0 else None)
+        self._prefilling: collections.deque[Request] = collections.deque()
+
         # One slot's cache, used as the state template. Ring (sliding-window)
         # caches rotate by stored position — the slot-stacked
         # dynamic_update_slice layout below does not model that, so refuse
@@ -133,6 +217,8 @@ class ServingEngine:
             raise NotImplementedError(
                 "sliding-window (ring) KV caches are not supported by the "
                 "serving engine yet; set the config's window >= max_len")
+        if self._chunk is not None:
+            self._cache_axes = self._cache_length_axes()
 
         self._state = {
             "cache": jax.tree.map(
@@ -146,8 +232,17 @@ class ServingEngine:
 
         # CPU jit warns (and ignores) donation; donate only where it works.
         donate = () if jax.default_backend() == "cpu" else (1,)
-        self._prefill = jax.jit(self._prefill_fn, donate_argnums=donate)
         self._decode = jax.jit(self._decode_fn, donate_argnums=donate)
+        if self._chunk is None:
+            self._prefill = jax.jit(self._prefill_fn, donate_argnums=donate)
+        else:
+            self._prefill_chunk = jax.jit(self._prefill_chunk_fn,
+                                          donate_argnums=donate)
+            # restore donates the STATE only (its arg 0) — the block is a
+            # live prefix-cache entry that must survive the copy.
+            self._restore_prefix = jax.jit(
+                self._restore_prefix_fn,
+                donate_argnums=(0,) if donate else ())
 
         if stats is None and accelerator is not None:
             stats = getattr(accelerator, "serving_stats", None)
@@ -165,24 +260,51 @@ class ServingEngine:
         if autostart:
             self.start()
 
+    def _cache_length_axes(self) -> list[int]:
+        """Per-leaf sequence-length axis of the slot cache, detected by
+        comparing ``eval_shape`` of the factory at two lengths (layouts are
+        family-specific; llama is ``[1, L, n_kv, head]`` but nothing
+        guarantees that elsewhere). The second probe length is
+        ``max_len - 1``, never ``+ 1`` — growing past ``max_len`` could
+        flip a sliding-window layer into its ring layout and change the
+        tree structure itself. Flattened-leaf order, the same order every
+        tree op in the chunk/restore programs uses."""
+        a = jax.tree.leaves(jax.eval_shape(
+            lambda: self._factory(1, self.max_len, self._dtype)))
+        b = jax.tree.leaves(jax.eval_shape(
+            lambda: self._factory(1, self.max_len - 1, self._dtype)))
+        axes = []
+        for x, y in zip(a, b):
+            diff = [i for i, (m, n) in enumerate(zip(x.shape, y.shape))
+                    if m != n]
+            if len(diff) != 1:
+                raise NotImplementedError(
+                    "chunked prefill needs every KV leaf to carry exactly "
+                    f"one length axis (leaf {x.shape} vs {y.shape} at "
+                    "max_len - 1); pass prefill_chunk=None for the "
+                    "monolithic path")
+            axes.append(diff[0])
+        return axes
+
     # ------------------------------------------------------------------
-    # the two compiled programs
+    # the compiled programs
     # ------------------------------------------------------------------
     def _prefill_fn(self, params, state, ids_p, slot, rng, true_len):
-        """ids_p [1, P] edge-padded prompt; slot/true_len traced i32 scalars.
-        Builds a fresh batch-1 cache, runs the prompt, selects the first
-        token exactly like offline generate (rng split into carry + prefill
-        halves, selection at ``true_len - 1``), and writes the slot's whole
-        decode state at the traced slot index. Returns (state, first_token).
+        """Monolithic prefill (``prefill_chunk=None`` only). ids_p [1, P]
+        edge-padded prompt; slot/true_len traced i32 scalars. Builds a
+        fresh batch-1 cache, runs the whole prompt, selects the first
+        token exactly like offline generate (the shared
+        :func:`generation._chunk_prefill_token` epilogue at offset 0), and
+        writes the slot's whole decode state at the traced slot index.
+        Returns (state, first_token). One executable per 128-bucketed
+        prompt length — the compile-family the chunked path replaces.
         """
         cache = self._factory(1, self.max_len, self._dtype)
         logits, cache = self.module.apply(
             {"params": params}, ids_p, cache=cache, cache_pos=0)
-        rng_carry, pre_rng = jax.random.split(rng)
-        last = jax.lax.dynamic_slice_in_dim(logits, true_len - 1, 1, axis=1)[:, 0]
-        seen = jnp.zeros((1, 1), bool)
-        tok, done = _next_token(last, pre_rng, seen, jnp.zeros((1,), bool),
-                                self._select, self.eos_token_id, ids_p.dtype)
+        tok, done, rng_carry = _chunk_prefill_token(
+            logits, rng, self._select, self.eos_token_id, ids_p.dtype,
+            true_len)
         new_cache = jax.tree.map(
             lambda full, one: jax.lax.dynamic_update_slice(
                 full, one[None].astype(full.dtype), (slot,) + (0,) * one.ndim),
@@ -196,14 +318,83 @@ class ServingEngine:
         }
         return state, tok[0]
 
+    def _prefill_chunk_fn(self, params, state, ids_c, slot, offset, true_len,
+                          rng):
+        """ONE chunk of prefill: ids_c ``[1, C]`` (tail chunks edge-padded
+        on the host); slot/offset/true_len traced i32 scalars. Runs the
+        chunk at ``cache_pos=offset`` directly against the slot's region
+        of the shared cache (in-place: garbage left by a previous occupant
+        is masked-out by construction, see the module docstring), selects
+        a candidate first token via the shared epilogue (real only in the
+        chunk containing ``true_len - 1``), and writes the slot rows —
+        ``pos[slot] = true_len`` on EVERY call, the invariant that keeps
+        interleaved decode ticks from corrupting a mid-prefill slot.
+
+        Also returns the chunk's own KV block (each leaf sliced to width C
+        on its length axis) so the prefix cache is fed by THIS executable
+        — no separate extract program, keeping the steady state at exactly
+        one chunk-prefill executable. Returns (state, first_token, block).
+        """
+        C = ids_c.shape[1]
+        cache = jax.tree.map(
+            lambda full: jax.lax.dynamic_slice(
+                full, (slot,) + (0,) * (full.ndim - 1),
+                (1,) + full.shape[1:])[0],
+            state["cache"])
+        logits, cache = self.module.apply(
+            {"params": params}, ids_c, cache=cache, cache_pos=offset)
+        tok, done, rng_carry = _chunk_prefill_token(
+            logits, rng, self._select, self.eos_token_id, ids_c.dtype,
+            true_len, offset)
+        leaves = jax.tree.leaves(cache)
+        block = jax.tree.unflatten(
+            jax.tree.structure(cache),
+            [jax.lax.dynamic_slice_in_dim(l, offset, C, axis=ax)
+             for l, ax in zip(leaves, self._cache_axes)])
+        new_cache = jax.tree.map(
+            lambda full, one: jax.lax.dynamic_update_slice(
+                full, one[None].astype(full.dtype), (slot,) + (0,) * one.ndim),
+            state["cache"], cache)
+        state = {
+            "cache": new_cache,
+            "pos": state["pos"].at[slot].set(true_len),
+            "tok": state["tok"].at[slot].set(tok[0].astype(jnp.int32)),
+            "rng": state["rng"].at[slot].set(rng_carry),
+            "done": state["done"].at[slot].set(done[0]),
+        }
+        return state, tok[0], block
+
+    def _restore_prefix_fn(self, state, block, slot, offset, true_len):
+        """Copy one cached ``[1, C]`` KV block into the slot's cache at the
+        traced chunk offset and stamp ``pos[slot] = true_len`` (the same
+        decode-tick-safety invariant as the chunk program). The block is
+        NOT donated — it stays live in the prefix cache."""
+        full_leaves = jax.tree.leaves(state["cache"])
+        blk_leaves = jax.tree.leaves(block)
+        out = []
+        for full, blk, ax in zip(full_leaves, blk_leaves, self._cache_axes):
+            start = [0] * full.ndim
+            start[0] = slot
+            start[ax + 1] = offset
+            out.append(jax.lax.dynamic_update_slice(
+                full, blk[None].astype(full.dtype), tuple(start)))
+        return dict(
+            state,
+            cache=jax.tree.unflatten(jax.tree.structure(state["cache"]), out),
+            pos=state["pos"].at[slot].set(true_len),
+        )
+
     def _decode_fn(self, params, state, active):
         """One tick: a batch-1 single-token forward vmapped over the slot
         axis (per-slot scalar cache_pos, per-slot rng chain — bitwise the
         same selection as offline's scan body). The cache commits
-        unconditionally (an inactive slot rewrites its frozen position with
-        garbage nobody will read — its next use starts with a fresh prefill)
-        but pos/tok/rng/done advance only where ``active`` is set, so
-        retired slots stay frozen and in-bounds. Returns
+        unconditionally — an inactive or PREFILLING slot rewrites its
+        ``pos`` with garbage — which is safe because prefill/restore pin
+        every mid-prefill slot's pos to ``true_len``, a position no prompt
+        chunk reads and the first real decode write overwrites (a retired
+        slot's next use starts with a fresh prefill of its region). But
+        pos/tok/rng/done advance only where ``active`` is set, so
+        non-running slots stay frozen and in-bounds. Returns
         (state, tokens [S], done [S])."""
 
         def one_slot(cache, tok, pos, rng, done):
@@ -242,18 +433,33 @@ class ServingEngine:
             self.warmup()
 
     def warmup(self, timeout: float = 120.0):
-        """Compile both programs by pushing dummy requests through the
-        normal path: the smallest prompt bucket (prefill) and one decode
-        tick. ``ignore_eos`` keeps the dummy decoding even if the model
-        emits eos immediately. Counters reset afterwards so warmup traffic
-        never pollutes serving metrics."""
+        """Compile every steady-state program by pushing dummy requests
+        through the normal path: one chunk call + one decode tick, and —
+        when a multi-chunk prompt fits the engine at all — two identical
+        two-chunk prompts so the second one's prefix hit compiles
+        ``restore_prefix`` too. ``ignore_eos`` keeps the dummies decoding
+        even if the model emits eos immediately. Counters reset and the
+        prefix cache is cleared afterwards so warmup traffic never
+        pollutes serving metrics (or lingers as phantom cached prefixes)."""
         req = self.submit(np.zeros((1, 1), np.int32), max_new_tokens=2,
                           seed=0, ignore_eos=True, block=True)
         if not req.wait(timeout):
             raise TimeoutError("engine warmup did not finish "
                                f"within {timeout}s")
         self._raise_if_failed(req)
+        if (self._chunk is not None and self._prefix_cache is not None
+                and self._chunk + 2 <= self._chunk_limit):
+            ids = np.zeros((1, self._chunk + 1), np.int32)
+            for _ in range(2):
+                r = self.submit(ids, max_new_tokens=1, seed=0,
+                                ignore_eos=True, block=True)
+                if not r.wait(timeout):
+                    raise TimeoutError("engine warmup did not finish "
+                                       f"within {timeout}s")
+                self._raise_if_failed(r)
         self._stats.reset()
+        if self._prefix_cache is not None:
+            self._prefix_cache.clear()
 
     @staticmethod
     def _raise_if_failed(req):
@@ -303,11 +509,21 @@ class ServingEngine:
         """Enqueue one request; returns its :class:`Request` handle
         immediately. Raises :class:`scheduler.QueueFull` under backpressure
         when ``block=False``; with ``block=True`` the caller waits for
-        queue space instead (up to ``block_timeout``)."""
+        queue space instead (up to ``block_timeout``). A pre-built
+        ``request=`` handle must be FRESH: handles are single-use, and
+        resubmitting one that is queued, in flight, or already retired
+        raises ``ValueError`` (its tokens/status/events are stale state a
+        second flight would corrupt)."""
         if request is None:
             request = Request(prompt_ids, max_new_tokens=max_new_tokens,
                               rng=rng, seed=seed, timeout=timeout,
                               on_token=on_token, ignore_eos=ignore_eos)
+        elif (request.status is not RequestStatus.QUEUED
+                or request.submitted_at is not None):
+            raise ValueError(
+                f"Request handle already used (status "
+                f"{request.status.value}); Request objects are single-use — "
+                "build a fresh Request (or pass prompt_ids) per submission")
         if not self._accepting or self._stop or self._drain:
             raise RuntimeError("serving engine is not accepting requests "
                                "(not started, shutting down, or preempted)")
@@ -338,6 +554,10 @@ class ServingEngine:
     def stats(self) -> ServingStats:
         return self._stats
 
+    @property
+    def prefix_cache(self) -> Optional[PrefixCache]:
+        return self._prefix_cache
+
     # ------------------------------------------------------------------
     # engine thread
     # ------------------------------------------------------------------
@@ -362,35 +582,63 @@ class ServingEngine:
                     for req in self._queue.drain():
                         req._finish(RequestStatus.CANCELLED)
                         self._stats.record_finish(req.status)
-                while self._slots.has_free():
-                    req = self._queue.get_nowait()
-                    if req is None:
-                        break
-                    if req.cancel_requested:
-                        req._finish(RequestStatus.CANCELLED)
-                        self._stats.record_finish(req.status)
-                    elif req._deadline_passed(now):
-                        req._finish(RequestStatus.TIMED_OUT)
-                        self._stats.record_finish(req.status)
-                    else:
-                        self._admit(req)
-                if self._slots.active_slots:
-                    self._tick()
+                # Bounded admission: spend at most chunks_per_tick chunk
+                # calls, ALTERNATING one continuation of the PREFILLING
+                # backlog (round-robin) with one new admission — so with a
+                # budget of 2+, a fresh arrival's first chunk rides
+                # alongside an in-flight long prefill instead of queueing
+                # behind all of it. Monolithic mode (prefill_chunk=None)
+                # has no budget — admission runs the whole prompt inline,
+                # the behavior this PR A/Bs against.
+                if self._chunk is None:
+                    while self._slots.has_free():
+                        req = self._queue.get_nowait()
+                        if req is None:
+                            break
+                        if self._screen(req, now):
+                            self._admit(req)
+                else:
+                    budget = self._chunks_per_tick
+                    while budget > 0:
+                        progressed = False
+                        if self._advance_one_prefill():
+                            budget -= 1
+                            progressed = True
+                        if budget > 0 and self._slots.has_free():
+                            req = self._queue.get_nowait()
+                            if req is not None:
+                                progressed = True
+                                if self._screen(req, now):
+                                    budget = self._begin_prefill(req, budget)
+                        if not progressed:
+                            break
+                running = [(slot, req) for slot, req in self._slots.active()
+                           if req.status is RequestStatus.RUNNING]
+                if running:
+                    self._tick(running)
+                elif self._slots.active_slots:
+                    pass  # prefill-only batch: loop again without idling
                 elif self._drain and not len(self._queue):
                     break
                 elif self._abort_queue:
                     break
                 else:
                     # Idle: block briefly on the queue so a submit wakes the
-                    # loop without a hot spin; the request is re-checked and
-                    # admitted on the next pass.
+                    # loop without a hot spin. The popped request goes
+                    # through the SAME screen as the busy path — one
+                    # cancelled or deadline-expired while the engine idled
+                    # must not be prefilled (or billed in stats).
                     req = self._queue.get(timeout=self._idle_poll_s)
-                    if req is not None:
-                        self._admit(req)
+                    if req is not None and self._screen(req, time.monotonic()):
+                        if self._chunk is None:
+                            self._admit(req)
+                        else:
+                            self._begin_prefill(req, self._chunks_per_tick)
         except BaseException as e:  # engine-fatal: fail everything loudly
             self._error = e
         finally:
             self._accepting = False
+            self._prefilling.clear()
             terminal = (RequestStatus.FAILED if self._error is not None
                         else RequestStatus.CANCELLED)
             for _, req in list(self._slots.active()):
@@ -399,11 +647,24 @@ class ServingEngine:
                 req._finish(terminal, self._error)
                 self._stats.record_finish(req.status)
 
+    def _screen(self, req: Request, now: float) -> bool:
+        """The check-then-admit gate both pop paths share: a request whose
+        cancellation or deadline fired while it queued is finished here,
+        never admitted."""
+        if req.cancel_requested:
+            req._finish(RequestStatus.CANCELLED)
+        elif req._deadline_passed(now):
+            req._finish(RequestStatus.TIMED_OUT)
+        else:
+            return True
+        self._stats.record_finish(req.status)
+        return False
+
     def _admit(self, req: Request):
-        """Prefill ``req`` into a free slot: host edge-pad to the 128
-        bucket (numpy — a jnp pad would compile per prompt length), run
-        ``prefill_into_slot``, and commit the first token. TTFT is stamped
-        here because prefill itself emits token #1."""
+        """Monolithic admission (``prefill_chunk=None``): host edge-pad to
+        the 128 bucket (numpy — a jnp pad would compile per prompt
+        length), run the whole prompt inline, and commit the first token.
+        TTFT is stamped here because prefill itself emits token #1."""
         req.admitted_at = time.monotonic()
         slot = self._slots.assign(req)
         S = req.prompt_ids.shape[1]
@@ -415,7 +676,122 @@ class ServingEngine:
             req.seed if req.seed is not None else 0)
         self._state, tok = self._prefill(
             self.params, self._state, ids_p, np.int32(slot), rng, np.int32(S))
-        token = int(tok)
+        self._finish_prefill(req, int(tok))
+
+    def _bucket(self, S: int) -> int:
+        return max(min(_bucket128(S), self._chunk_limit), S)
+
+    # -- chunked prefill ------------------------------------------------
+    def _begin_prefill(self, req: Request, budget: int) -> int:
+        """Assign a slot, restore the longest cached chunk-aligned prefix
+        (``restore_prefix`` copies are not billed against the chunk
+        budget — they are why the cache pays), and run the request's first
+        live chunk. Returns the remaining budget."""
+        req.admitted_at = time.monotonic()
+        slot = self._slots.assign(req)
+        req.status = RequestStatus.PREFILLING
+        req._rng_key = req.rng if req.rng is not None else jax.random.PRNGKey(
+            req.seed if req.seed is not None else 0)
+        S = req.prompt_ids.shape[1]
+        C = self._chunk
+        req._chunks_total = -(-S // C)
+        req._next_chunk = 0
+        req._chunk_keys = None
+        if self._prefix_cache is not None:
+            n_full = S // C
+            if n_full:
+                req._chunk_keys = self._prefix_keys(req.prompt_ids, n_full)
+            # The FINAL chunk always re-runs (cached blocks hold KV, not the
+            # logits the first token needs), so at most chunks 0..n-2 restore.
+            restorable = min(n_full, req._chunks_total - 1)
+            if restorable:
+                blocks = self._prefix_cache.match(req._chunk_keys[:restorable])
+                restored_bytes = 0
+                for i, blk in enumerate(blocks):
+                    self._state = self._restore_prefix(
+                        self._state, blk, np.int32(slot), np.int32(i * C),
+                        np.int32(S))
+                    restored_bytes += sum(
+                        l.nbytes for l in jax.tree.leaves(blk))
+                self._stats.record_prefix(looked_up=restorable,
+                                          hit=len(blocks),
+                                          bytes_restored=restored_bytes)
+                req._next_chunk = len(blocks)
+        self._prefilling.append(req)
+        self._run_chunk(req)
+        return budget - 1
+
+    def _prefix_keys(self, prompt_ids, n_full: int) -> list[bytes]:
+        """Hash-chain digests of the prompt's full chunks: chunk i's key
+        covers tokens ``[0, (i+1)*C)`` because each digest folds in the
+        previous one — equal keys mean equal whole prefixes, never just
+        equal chunk contents."""
+        flat = np.ascontiguousarray(prompt_ids[0], np.int32)
+        C = self._chunk
+        keys, prev = [], b"chunk:%d" % C
+        for i in range(n_full):
+            prev = hashlib.blake2b(
+                prev + flat[i * C:(i + 1) * C].tobytes(),
+                digest_size=16).digest()
+            keys.append(prev)
+        return keys
+
+    def _advance_one_prefill(self) -> bool:
+        """Run ONE chunk for the oldest live entry of the PREFILLING
+        backlog (round-robin: the entry requeues behind newer ones), so a
+        short prompt's one-chunk prefill completes promptly even while a
+        long prompt is mid-prefill — no head-of-line blocking inside
+        admission either. Entries retired mid-prefill (cancel/timeout)
+        are dropped lazily. Returns False when no live entry remains."""
+        while self._prefilling:
+            req = self._prefilling.popleft()
+            if req.status is not RequestStatus.PREFILLING:
+                continue
+            self._run_chunk(req)
+            if req.status is RequestStatus.PREFILLING:
+                self._prefilling.append(req)
+            return True
+        return False
+
+    def _run_chunk(self, req: Request):
+        """One ``prefill_chunk`` call at the request's frontier. The final
+        chunk's offset is pulled back (never past ``max_len - C`` / the
+        position table) so the fixed width stays in bounds — re-running a
+        few already-prefilled positions writes bit-identical KV. Full
+        chunks feed the prefix cache with the block the executable already
+        returned."""
+        i = req._next_chunk
+        C = self._chunk
+        S = req.prompt_ids.shape[1]
+        final = i == req._chunks_total - 1
+        offset = min(i * C, self._chunk_cap) if final else i * C
+        ids_c = req.prompt_ids[:, offset:offset + C]
+        if ids_c.shape[1] < C:
+            ids_c = np.pad(ids_c, ((0, 0), (0, C - ids_c.shape[1])),
+                           mode="edge")
+        t0 = time.monotonic()
+        self._state, tok, block = self._prefill_chunk(
+            self.params, self._state, ids_c, np.int32(req.slot),
+            np.int32(offset), np.int32(S), req._rng_key)
+        tok.block_until_ready()  # honest chunk timing, paced dispatch
+        dt_ms = (time.monotonic() - t0) * 1e3
+        backlog = sum(1 for r in self._prefilling
+                      if r.status is RequestStatus.PREFILLING)
+        self._stats.record_prefill_chunk(dt_ms, backlog=backlog)
+        if (self._prefix_cache is not None and req._chunk_keys is not None
+                and offset == i * C and offset + C <= S):
+            self._prefix_cache.put(
+                req._chunk_keys[i], block,
+                nbytes=sum(l.nbytes for l in jax.tree.leaves(block)))
+            self._stats.record_prefix_cache_size(self._prefix_cache.nbytes,
+                                                 len(self._prefix_cache))
+        req._next_chunk = i + 1
+        if final:
+            self._finish_prefill(req, int(tok))
+
+    def _finish_prefill(self, req: Request, token: int):
+        """Prompt fully in KV: the request starts decoding. TTFT is stamped
+        here because the final prefill call emits token #1."""
         req.status = RequestStatus.RUNNING
         now = time.monotonic()
         req.first_token_at = now
@@ -428,19 +804,13 @@ class ServingEngine:
                         and token == self.eos_token_id)):
                 self._retire(req, RequestStatus.COMPLETED)
 
-    def _bucket(self, S: int) -> int:
-        P = min(_bucket128(S), self.max_len)
-        bound = getattr(getattr(self.module, "config", None),
-                        "max_position_embeddings", None)
-        if bound is not None:
-            P = min(P, int(bound))
-        return max(P, S)
-
-    def _tick(self):
-        """One ``decode_step_all_slots`` execution + host commit/retire."""
+    def _tick(self, running):
+        """One ``decode_step_all_slots`` execution + host commit/retire.
+        ``running`` is the (slot, request) list in RUNNING — PREFILLING
+        slots ride along in the vmapped forward (fixed shape) but are
+        masked out of every state advance and commit no tokens."""
         mask = np.zeros((self.max_slots,), bool)
-        occupants = self._slots.active()
-        for slot, _ in occupants:
+        for slot, _ in running:
             mask[slot] = True
         t0 = time.monotonic()
         self._state, toks, dones = self._decode(
@@ -449,14 +819,14 @@ class ServingEngine:
         dones = np.asarray(dones)
         dt = time.monotonic() - t0
         committed = 0
-        for slot, req in occupants:
+        for slot, req in running:
             if not self._commit_token(req, int(toks[slot])):
                 continue  # callback failed; slot already freed
             committed += 1
             if (len(req.tokens) >= req.max_new_tokens
                     or (not req.ignore_eos and bool(dones[slot]))):
                 self._retire(req, RequestStatus.COMPLETED)
-        self._stats.record_tick(active_slots=len(occupants),
+        self._stats.record_tick(active_slots=len(running),
                                 committed_tokens=committed,
                                 max_slots=self.max_slots, seconds=dt)
 

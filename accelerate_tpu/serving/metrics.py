@@ -13,7 +13,12 @@ matter operationally are therefore host-side:
   each fixed-shape decode step is doing real work. Low occupancy under
   load means admission is starved (queue too small, prefill too slow);
   occupancy >> efficiency means slots sit done-latched waiting on
-  retirement.
+  retirement;
+* the **chunked-prefill split**: prefill chunk count and milliseconds vs
+  decode milliseconds (where the engine's device time actually goes),
+  prefill backlog depth (requests sitting in ``PREFILLING``), and the
+  prefix-cache hit rate / restored bytes — how much admission work the
+  chunk-aligned :class:`scheduler.PrefixCache` is deleting.
 
 Thread-safe: submit() is called from caller threads, everything else from
 the engine thread.
@@ -58,6 +63,15 @@ class ServingStats:
             self._decode_tokens = 0
             self._prefill_tokens = 0
             self._queue_depth_last = 0
+            self._prefill_chunks = 0
+            self._prefill_ms_sum = 0.0
+            self._prefill_backlog_last = 0
+            self._prefill_backlog_max = 0
+            self._prefix_lookup_chunks = 0
+            self._prefix_hit_chunks = 0
+            self._prefix_restored_bytes = 0
+            self._prefix_cache_bytes = 0
+            self._prefix_cache_entries = 0
 
     # -- caller side ----------------------------------------------------
     def record_submit(self, queue_depth: int):
@@ -95,6 +109,33 @@ class ServingStats:
             self._slot_capacity_sum += int(max_slots)
             self._decode_tokens += int(committed_tokens)
 
+    def record_prefill_chunk(self, ms: float, backlog: int = 0):
+        """One ``prefill_chunk`` execution; ``backlog`` is the number of
+        requests in ``PREFILLING`` at the time of the call (how much
+        admission work is still pending behind the per-tick budget)."""
+        with self._lock:
+            self._prefill_chunks += 1
+            self._prefill_ms_sum += ms
+            self._prefill_backlog_last = int(backlog)
+            self._prefill_backlog_max = max(self._prefill_backlog_max,
+                                            int(backlog))
+
+    def record_prefix(self, looked_up: int, hit: int, bytes_restored: int):
+        """One admission's prefix-cache lookup: ``looked_up`` restorable
+        chunks were probed, the first ``hit`` of them were restored by
+        ``restore_prefix`` instead of recomputed."""
+        with self._lock:
+            self._prefix_lookup_chunks += int(looked_up)
+            self._prefix_hit_chunks += int(hit)
+            self._prefix_restored_bytes += int(bytes_restored)
+
+    def record_prefix_cache_size(self, nbytes: int, entries: int):
+        """Gauge: the prefix cache's current footprint after an insert or
+        eviction sweep."""
+        with self._lock:
+            self._prefix_cache_bytes = int(nbytes)
+            self._prefix_cache_entries = int(entries)
+
     def record_finish(self, status):
         """One request retired; ``status`` is a RequestStatus."""
         from .request import RequestStatus
@@ -120,7 +161,9 @@ class ServingStats:
 
     def summary(self) -> dict:
         """Scalar snapshot: request counts, queue-wait/TTFT latencies,
-        decode tokens/sec, slot occupancy, and batch efficiency."""
+        decode tokens/sec, slot occupancy, batch efficiency, and the
+        chunked-prefill split (chunk count/ms, backlog, prefill-vs-decode
+        ms, prefix-cache hit rate/bytes)."""
         with self._lock:
             admits = max(1, self._admitted)
             caps = max(1, self._slot_capacity_sum)
@@ -148,4 +191,20 @@ class ServingStats:
                 "slot_occupancy": round(self._active_slot_sum / caps, 4),
                 "batch_efficiency": round(self._decode_tokens / caps, 4),
                 "queue_depth": self._queue_depth_last,
+                "prefill_chunks": self._prefill_chunks,
+                "prefill_ms": round(self._prefill_ms_sum, 3),
+                "prefill_ms_per_chunk": round(
+                    self._prefill_ms_sum / max(1, self._prefill_chunks), 3),
+                "prefill_chunks_per_tick": round(
+                    self._prefill_chunks / max(1, self._ticks), 4),
+                "prefill_backlog": self._prefill_backlog_last,
+                "prefill_backlog_max": self._prefill_backlog_max,
+                "decode_ms": round(self._tick_s_sum * 1e3, 3),
+                "prefix_cache_hit_rate": round(
+                    self._prefix_hit_chunks / self._prefix_lookup_chunks, 4)
+                    if self._prefix_lookup_chunks else 0.0,
+                "prefix_cache_hit_chunks": self._prefix_hit_chunks,
+                "prefix_cache_restored_bytes": self._prefix_restored_bytes,
+                "prefix_cache_bytes": self._prefix_cache_bytes,
+                "prefix_cache_entries": self._prefix_cache_entries,
             }

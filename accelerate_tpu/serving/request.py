@@ -21,6 +21,7 @@ import numpy as np
 
 class RequestStatus(enum.Enum):
     QUEUED = "queued"        # submitted, waiting for a slot
+    PREFILLING = "prefilling"  # holds a slot; prompt chunks still running
     RUNNING = "running"      # prefilled into a slot, decoding
     COMPLETED = "completed"  # emitted eos or max_new_tokens
     FAILED = "failed"        # admission/callback error (slot freed, batch unharmed)
@@ -79,11 +80,20 @@ class Request:
         self._cancel_requested = False
         self._done = threading.Event()
 
+        # Chunked-prefill bookkeeping (engine thread only): the per-request
+        # rng key is fixed at admission because every chunk call replays the
+        # same split; ``_next_chunk`` is the prefill frontier in chunk units.
+        self._rng_key = None
+        self._next_chunk = 0
+        self._chunks_total = 0
+        self._chunk_keys: Optional[list] = None
+
     # -- caller API -----------------------------------------------------
     def cancel(self):
         """Request cancellation: a queued request is dropped before it ever
-        takes a slot; a running request retires at the next decode tick
-        (its slot frees without disturbing the rest of the batch)."""
+        takes a slot; a prefilling or running request retires at the next
+        scheduler pass (its slot frees without disturbing the rest of the
+        batch, and no further prefill chunks are spent on it)."""
         self._cancel_requested = True
 
     @property

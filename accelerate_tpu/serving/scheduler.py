@@ -1,6 +1,7 @@
-"""Admission queue and slot bookkeeping for the serving engine.
+"""Admission queue, slot bookkeeping, and the prefix KV block cache for
+the serving engine.
 
-Two small host-side structures, deliberately independent of jax:
+Three small host-side structures, deliberately independent of jax:
 
 * :class:`AdmissionQueue` — a bounded FCFS queue with backpressure. The
   bound is the engine's only flow control: when the queue is full,
@@ -12,6 +13,11 @@ Two small host-side structures, deliberately independent of jax:
   lanes. FCFS: the engine pops the oldest queued request whenever a slot
   is free. Slots are plain integers; all per-slot device state lives in
   the engine's state pytree, indexed by these.
+* :class:`PrefixCache` — a byte-bounded LRU of chunk-aligned KV blocks
+  keyed by the engine's prompt-prefix hash chain. The values are opaque
+  here (device-array pytrees the engine's ``restore_prefix`` program
+  copies back into a slot); the caller supplies each entry's byte size so
+  this module stays jax-free.
 """
 
 from __future__ import annotations
@@ -118,3 +124,78 @@ class SlotScheduler:
     def active(self) -> list[tuple[int, Request]]:
         """(slot, request) pairs for every occupied slot, slot-ordered."""
         return sorted(self._occupant.items())
+
+
+class PrefixCache:
+    """Byte-bounded LRU of chunk-aligned prefix KV blocks.
+
+    Keys are hash-chain digests: the engine hashes each chunk's tokens
+    TOGETHER with the previous chunk's digest, so a key identifies the
+    entire token prefix up to and including its chunk — two prompts share
+    an entry exactly when they share that whole chunk-aligned prefix.
+    Values are opaque (device-array pytrees holding one chunk's KV slice
+    for every cache leaf); the engine passes each block's byte size into
+    :meth:`put` so accounting stays jax-free here.
+
+    Engine-thread only (no lock), like :class:`SlotScheduler`: lookups,
+    insertions, and evictions all happen on the single engine thread.
+    """
+
+    def __init__(self, capacity_bytes: int):
+        if capacity_bytes < 1:
+            raise ValueError(
+                f"capacity_bytes must be >= 1 (got {capacity_bytes}); "
+                "disable prefix caching at the engine instead")
+        self.capacity_bytes = int(capacity_bytes)
+        # key -> (block, nbytes); insertion order == LRU order (move_to_end
+        # on every touch), so eviction pops from the front.
+        self._entries: collections.OrderedDict = collections.OrderedDict()
+        self._bytes = 0
+        self.insertions = 0
+        self.evictions = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    @property
+    def nbytes(self) -> int:
+        return self._bytes
+
+    def match(self, keys) -> list:
+        """Blocks for the longest cached prefix of ``keys``, in chain order
+        (each hit is touched most-recently-used). Stops at the first miss:
+        a later chunk's KV is only valid on top of every earlier one."""
+        out = []
+        for key in keys:
+            entry = self._entries.get(key)
+            if entry is None:
+                break
+            self._entries.move_to_end(key)
+            out.append(entry[0])
+        return out
+
+    def put(self, key, block, nbytes: int):
+        """Insert one chunk's block (touch if already present), then evict
+        least-recently-used entries until within capacity. A block larger
+        than the whole capacity is not admitted."""
+        if key in self._entries:
+            self._entries.move_to_end(key)
+            return
+        nbytes = int(nbytes)
+        if nbytes > self.capacity_bytes:
+            return
+        self._entries[key] = (block, nbytes)
+        self._bytes += nbytes
+        self.insertions += 1
+        while self._bytes > self.capacity_bytes:
+            _, (_, nb) = self._entries.popitem(last=False)
+            self._bytes -= nb
+            self.evictions += 1
+
+    def clear(self):
+        """Drop every entry (engine warmup runs dummy prompts through the
+        normal path; their blocks must not linger as phantom prefixes)."""
+        self._entries.clear()
+        self._bytes = 0
+        self.insertions = 0
+        self.evictions = 0

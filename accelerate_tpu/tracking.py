@@ -423,9 +423,11 @@ def with_input_pipeline_metrics(values: dict, pipeline_stats, prefix: str = "inp
 
 def with_serving_metrics(values: dict, serving_stats, prefix: str = "serving/") -> dict:
     """Merge a serving-engine breakdown (``ttft_ms``/``queue_wait_ms``/
-    ``decode_tokens_per_sec``/``slot_occupancy``, see
-    ``serving.metrics.ServingStats``) into a tracker payload under
-    ``prefix``. User-provided keys always win on collision."""
+    ``decode_tokens_per_sec``/``slot_occupancy``, plus the chunked-prefill
+    and prefix-cache keys ``prefill_chunks``/``prefill_backlog``/
+    ``prefix_cache_hit_rate``, see ``serving.metrics.ServingStats``) into a
+    tracker payload under ``prefix``. User-provided keys always win on
+    collision."""
     if serving_stats is None:
         return values
     merged = {f"{prefix}{k}": v for k, v in serving_stats.summary().items()}

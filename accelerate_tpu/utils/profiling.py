@@ -231,7 +231,9 @@ class ProfileSession:
 
     def serving_breakdown(self) -> dict:
         """Latest serving-engine breakdown (ttft_ms/decode_tokens_per_sec/
-        slot_occupancy, …); empty when no serving stats are attached."""
+        slot_occupancy, prefill_chunks/prefill_backlog,
+        prefix_cache_hit_rate, …); empty when no serving stats are
+        attached."""
         if self.serving_stats is None:
             return {}
         return self.serving_stats.summary()

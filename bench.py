@@ -319,7 +319,7 @@ def serving_sweep(offered_loads=(20.0, 60.0, 200.0), n_requests: int = 12,
     }
 
 
-def _sleepy_llama_cls(step_ms: float):
+def _sleepy_llama_cls(step_ms: float, per_token: bool = False):
     """A tiny-Llama subclass whose forward ALSO burns a deterministic
     ``step_ms`` host sleep (pure_callback, data-dependent so XLA cannot
     elide it; ``broadcast_all`` so the engine's vmapped tick sleeps ONCE,
@@ -327,7 +327,15 @@ def _sleepy_llama_cls(step_ms: float):
     sleep-step: on CPU the tiny model decodes a token in ~50µs inside a
     compiled scan, so scheduling effects drown in host overhead — pinning
     the per-step cost to a real-model magnitude makes the continuous-vs-
-    static comparison measure SCHEDULING, deterministically."""
+    static comparison measure SCHEDULING, deterministically.
+
+    ``per_token=True`` scales the sleep by the call's STATIC sequence
+    width (``step_ms`` per input position), modeling the real cost shape
+    of prefill: a monolithic width-P prefill burns ``P * step_ms`` in one
+    uninterruptible block while a width-C chunk burns only ``C * step_ms``
+    — the asymmetry the chunked-prefill interference A/B measures. The
+    per-forward default would bill a whole 128-token prefill the same one
+    sleep as a single decode tick and invert that comparison."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -337,9 +345,10 @@ def _sleepy_llama_cls(step_ms: float):
     class _SleepyLlama(LlamaForCausalLM):
         def apply(self, variables, *args, **kwargs):
             out = super().apply(variables, *args, **kwargs)
+            width = int(np.shape(args[0])[-1]) if per_token and args else 1
 
             def _sleep(x):
-                time.sleep(step_ms / 1e3)
+                time.sleep(width * step_ms / 1e3)
                 return np.zeros(np.shape(x), np.float32)
 
             if isinstance(out, tuple):
@@ -462,16 +471,180 @@ def continuous_vs_static(n_short: int = 3, short_new_tokens: int = 8,
     }
 
 
+def chunked_prefill_interference(n_streams: int = 3, stream_new_tokens: int = 40,
+                                 long_prompt_len: int = 96,
+                                 long_new_tokens: int = 4, n_late: int = 3,
+                                 late_new_tokens: int = 4,
+                                 prefill_chunk: int = 8,
+                                 prefill_chunks_per_tick: int = 2,
+                                 step_ms: float = 1.0, max_slots: int = 8,
+                                 max_len: int = 128) -> dict:
+    """Admission-interference A/B: the traffic chunked prefill exists for.
+
+    ``n_streams`` short requests are mid-decode when one LONG prompt
+    arrives, tailed by ``n_late`` short arrivals. Monolithic admission
+    (``prefill_chunk=None``) runs the whole long prefill — and then every
+    late prefill, each padded to its 128 bucket — inline between decode
+    ticks, so the active streams stall for the full block and the late
+    arrivals queue behind it. Chunked admission spends at most
+    ``prefill_chunks_per_tick`` fixed-width chunk calls between ticks
+    (the default 2 alternates one long-prefill continuation with one new
+    admission), so the worst-case tick-to-tick gap is a couple of chunks,
+    whatever arrives — and a late short starts prefilling while the long
+    prompt is still streaming into KV.
+
+    Both engines run the same per-token sleepy model (``step_ms`` of
+    deterministic host sleep per input position, see
+    :func:`_sleepy_llama_cls`), fully warmed before timing, so the gap is
+    scheduling. Reported per engine: the decoding streams' inter-token-gap
+    p95/max inside the interference window and the late arrivals' TTFT
+    p95 — plus the chunk/tick split from ``serving_metrics()``."""
+    import jax
+    import numpy as np
+
+    from accelerate_tpu.models.llama import LlamaConfig
+    from accelerate_tpu.serving import ServingEngine
+
+    model = _sleepy_llama_cls(step_ms, per_token=True)(LlamaConfig.tiny())
+    params = model.init_params(jax.random.PRNGKey(0))
+
+    def percentile(xs, q):
+        if not xs:
+            return 0.0
+        s = sorted(xs)
+        return s[min(len(s) - 1, max(0, int(round(q * (len(s) - 1)))))]
+
+    def run(chunked: bool) -> dict:
+        engine = ServingEngine(
+            model, params, max_slots=max_slots, max_len=max_len,
+            prefill_chunk=prefill_chunk if chunked else None,
+            prefill_chunks_per_tick=prefill_chunks_per_tick,
+            prefix_cache_mb=0.0)
+        rng = np.random.default_rng(0)
+        try:
+            stamps = [[] for _ in range(n_streams)]
+            streams = []
+            for i in range(n_streams):
+                p = rng.integers(1, 200, size=(1, 4)).astype(np.int32)
+                streams.append(engine.submit(
+                    p, max_new_tokens=stream_new_tokens, ignore_eos=True,
+                    on_token=(lambda tok, s=stamps[i]:
+                              s.append(time.perf_counter()))))
+            t0 = time.perf_counter()
+            while any(len(s) < 4 for s in stamps):  # all streams decoding
+                if time.perf_counter() - t0 > 120:
+                    raise RuntimeError("short streams never started decoding")
+                time.sleep(0.001)
+            engine.stats.reset()  # count only the interference window
+            t_long = time.perf_counter()
+            long_req = engine.submit(
+                rng.integers(1, 200, size=(1, long_prompt_len)).astype(np.int32),
+                max_new_tokens=long_new_tokens, ignore_eos=True)
+            late = []
+            for _ in range(n_late):
+                time.sleep(0.002)
+                late.append(engine.submit(
+                    rng.integers(1, 200, size=(1, 4)).astype(np.int32),
+                    max_new_tokens=late_new_tokens, ignore_eos=True))
+            for r in [long_req] + late + streams:
+                r.wait(timeout=120)
+            s = engine.serving_metrics()
+        finally:
+            engine.shutdown()
+        gaps_ms = [(b - a) * 1e3 for st in stamps
+                   for a, b in zip(st, st[1:]) if b >= t_long]
+        ttfts_ms = [(r.first_token_at - r.submitted_at) * 1e3 for r in late]
+        return {
+            "late_ttft_ms_p95": round(percentile(ttfts_ms, 0.95), 3),
+            "late_ttft_ms_mean": round(sum(ttfts_ms) / len(ttfts_ms), 3),
+            "stream_itl_ms_p95": round(percentile(gaps_ms, 0.95), 3),
+            "stream_itl_ms_max": round(max(gaps_ms), 3) if gaps_ms else 0.0,
+            "prefill_chunks": s["prefill_chunks"],
+            "prefill_ms": s["prefill_ms"],
+            "decode_ms": s["decode_ms"],
+            "prefill_backlog_max": s["prefill_backlog_max"],
+        }
+
+    chunked = run(chunked=True)
+    mono = run(chunked=False)
+    return {
+        "n_streams": n_streams,
+        "long_prompt_len": long_prompt_len,
+        "n_late": n_late,
+        "prefill_chunk": prefill_chunk,
+        "prefill_chunks_per_tick": prefill_chunks_per_tick,
+        "step_ms": step_ms,
+        "chunked": chunked,
+        "monolithic": mono,
+        "ttft_speedup": round(
+            mono["late_ttft_ms_p95"] / chunked["late_ttft_ms_p95"], 3)
+            if chunked["late_ttft_ms_p95"] else None,
+        "itl_stall_speedup": round(
+            mono["stream_itl_ms_max"] / chunked["stream_itl_ms_max"], 3)
+            if chunked["stream_itl_ms_max"] else None,
+    }
+
+
+def prefix_cache_hit_bench(prompt_len: int = 33, prefill_chunk: int = 8,
+                           max_new_tokens: int = 4) -> dict:
+    """Prefix-cache payoff, counter-exact: submit one multi-chunk prompt
+    cold, then the IDENTICAL prompt again. The repeat must admit in
+    exactly ONE chunk call (the final chunk always re-runs for its
+    logits; every full chunk before it restores from cache), emit the
+    same tokens, and the hit counters must balance — all read from
+    ``serving_metrics()``, so the result is deterministic on any host."""
+    engine, _, _, _ = _serving_test_engine(
+        max_slots=2, prefill_chunk=prefill_chunk, prefix_cache_mb=4.0)
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(1, 200, size=(1, prompt_len)).astype(np.int32)
+    chunks_total = -(-prompt_len // prefill_chunk)
+    try:
+        r1 = engine.submit(prompt, max_new_tokens=max_new_tokens, seed=3)
+        r1.wait(timeout=120)
+        cold = engine.serving_metrics()
+        cold_ttft = (r1.first_token_at - r1.submitted_at) * 1e3
+        r2 = engine.submit(prompt, max_new_tokens=max_new_tokens, seed=3)
+        r2.wait(timeout=120)
+        warm = engine.serving_metrics()
+        warm_ttft = (r2.first_token_at - r2.submitted_at) * 1e3
+        tokens_equal = bool(np.array_equal(r1.result(), r2.result()))
+    finally:
+        engine.shutdown()
+    return {
+        "prompt_len": prompt_len,
+        "prefill_chunk": prefill_chunk,
+        "chunks_per_prompt": chunks_total,
+        "cold_prefill_chunks": cold["prefill_chunks"],
+        "warm_prefill_chunks": warm["prefill_chunks"] - cold["prefill_chunks"],
+        "hit_chunks": warm["prefix_cache_hit_chunks"],
+        "hit_rate": warm["prefix_cache_hit_rate"],
+        "restored_bytes": warm["prefix_cache_restored_bytes"],
+        "cache_entries": warm["prefix_cache_entries"],
+        "cache_bytes": warm["prefix_cache_bytes"],
+        "cold_ttft_ms": round(cold_ttft, 3),
+        "warm_ttft_ms": round(warm_ttft, 3),
+        "tokens_equal": tokens_equal,
+    }
+
+
 def serving_extra(on_tpu: bool) -> dict:
-    """The ``extra.serving`` payload: on CPU the offered-load sweep plus the
-    continuous-vs-static staggered-arrival comparison (cheap, tiny model);
-    on TPU skipped — serving the tier-1 model is its own benchmark, not a
-    rider on the training run (no extra compiles over the tunnel)."""
+    """The ``extra.serving`` payload: on CPU the offered-load sweep, the
+    continuous-vs-static staggered-arrival comparison, and the
+    chunked-prefill pair — admission-interference A/B plus the
+    prefix-cache hit check (cheap, tiny model); on TPU skipped — serving
+    the tier-1 model is its own benchmark, not a rider on the training
+    run (no extra compiles over the tunnel)."""
     if on_tpu:
         return {}
     return {
         "sweep": serving_sweep(),
         "continuous_vs_static": continuous_vs_static(),
+        "chunked_prefill": {
+            "interference": chunked_prefill_interference(),
+            "prefix_cache": prefix_cache_hit_bench(),
+        },
     }
 
 

@@ -8,7 +8,14 @@ The acceptance-critical properties pinned here:
   decode loops (staggered arrivals exercise the slot mask, not the shape).
 * ZERO RECOMPILES — after warmup, admitting and retiring requests of
   varying prompt lengths triggers no new XLA compilation (probed via
-  jax.monitoring's event-duration listener, which fires per compile).
+  jax.monitoring's event-duration listener, which fires per compile);
+  with chunked prefill the steady state is exactly ONE executable each
+  for prefill_chunk, restore_prefix, and decode, whatever prompt-length
+  mix arrives.
+* CHUNKED PREFILL — chunk-size x prompt-length x sampling exactness
+  against both the monolithic engine and offline generate, decode ticks
+  interleaving with a long prompt's chunk calls, and the prefix cache
+  (unit LRU semantics + a repeat prompt admitting in one chunk).
 * SCHEDULING SEMANTICS — bounded-queue backpressure, cancel (queued and
   running), per-request timeout (queued and running), error isolation
   (a raising stream callback fails only its own request), FCFS admission.
@@ -36,6 +43,7 @@ from accelerate_tpu import generation  # noqa: E402
 from accelerate_tpu.models.llama import LlamaConfig, LlamaForCausalLM  # noqa: E402
 from accelerate_tpu.serving import (  # noqa: E402
     AdmissionQueue,
+    PrefixCache,
     QueueFull,
     Request,
     RequestStatus,
@@ -162,6 +170,28 @@ class TestSchedulerUnits:
         np.testing.assert_array_equal(r2.result(), [4, 5])
         np.testing.assert_array_equal(r2.output_ids(), [[1, 4, 5]])
 
+    def test_prefix_cache_lru_and_bounds(self):
+        with pytest.raises(ValueError, match="capacity_bytes"):
+            PrefixCache(0)
+        pc = PrefixCache(capacity_bytes=100)
+        pc.put(b"a", "A", 40)
+        pc.put(b"b", "B", 40)
+        assert pc.match([b"a", b"b"]) == ["A", "B"]
+        # The chain stops at the first miss: a later chunk's KV is only
+        # valid stacked on every earlier one.
+        assert pc.match([b"a", b"x", b"b"]) == ["A"]
+        pc.put(b"c", "C", 40)  # 120 > 100: evicts the LRU entry (b)
+        assert pc.match([b"b"]) == []
+        assert pc.match([b"a"]) == ["A"] and pc.match([b"c"]) == ["C"]
+        assert len(pc) == 2 and pc.nbytes == 80
+        assert pc.insertions == 3 and pc.evictions == 1
+        pc.put(b"huge", "H", 1000)  # bigger than the whole budget: skipped
+        assert pc.match([b"huge"]) == [] and pc.nbytes == 80
+        pc.put(b"a", "A2", 40)  # re-put touches, never duplicates
+        assert len(pc) == 2 and pc.match([b"a"]) == ["A"]
+        pc.clear()
+        assert len(pc) == 0 and pc.nbytes == 0 and pc.match([b"a"]) == []
+
     def test_stats_summary(self):
         st = ServingStats()
         st.record_submit(queue_depth=3)
@@ -249,6 +279,226 @@ class TestZeroRecompile:
         assert not compiles, (
             f"XLA recompiled after warmup: {compiles} — continuous batching "
             "must change mask/state contents, never program shapes")
+
+
+class TestChunkedExactness:
+    """Chunked prefill changes WHEN prompt KV is written, never what is
+    written: every (chunk size, prompt length, sampling) cell must be
+    token-identical to the monolithic engine AND offline generate —
+    including non-multiple tails, single-chunk prompts, and S=1."""
+
+    CHUNKS = (4, 16)
+    LENS = (1, 5, 16, 23, 31)  # < C, non-multiples, == C, and multi-chunk
+
+    @pytest.fixture(scope="class")
+    def engines(self, tiny):
+        _, m, params = tiny
+        engs = {"mono": ServingEngine(m, params, max_slots=2, max_len=64,
+                                      eos_token_id=EOS, prefill_chunk=None,
+                                      warmup=False)}
+        for C in self.CHUNKS:
+            engs[C] = ServingEngine(m, params, max_slots=2, max_len=64,
+                                    eos_token_id=EOS, prefill_chunk=C,
+                                    prefix_cache_mb=0.0, warmup=False)
+        yield engs
+        for e in engs.values():
+            if e.running:
+                e.shutdown(drain=False)
+
+    def test_greedy_chunk_matrix(self, engines, tiny):
+        _, m, params = tiny
+        n = 8
+        rng = np.random.default_rng(11)
+        for C in self.CHUNKS:
+            for S in self.LENS:
+                p = rng.integers(0, 256, size=(1, S)).astype(np.int32)
+                before = engines[C].serving_metrics()["prefill_chunks"]
+                got_c = engines[C].submit(p, max_new_tokens=n).result(timeout=120)
+                chunks = engines[C].serving_metrics()["prefill_chunks"] - before
+                assert chunks == -(-S // C), (S, C, chunks)  # really chunked
+                got_m = engines["mono"].submit(p, max_new_tokens=n).result(timeout=120)
+                _assert_matches_offline(got_c, _offline(m, params, p, n), n)
+                assert np.array_equal(got_c, got_m), (S, C, got_c, got_m)
+
+    def test_sampled_chunk_matrix(self, tiny):
+        """Sampled decoding pins the rng protocol: every chunk call splits
+        the SAME per-request key the way offline generate splits it once,
+        so the first sampled token (and the whole decode chain after it)
+        cannot depend on the chunk count."""
+        _, m, params = tiny
+        kw = dict(max_slots=2, max_len=64, eos_token_id=EOS, do_sample=True,
+                  temperature=0.9, top_k=50, warmup=False)
+        eng_c = ServingEngine(m, params, prefill_chunk=4,
+                              prefix_cache_mb=0.0, **kw)
+        eng_m = ServingEngine(m, params, prefill_chunk=None, **kw)
+        try:
+            n = 10
+            rng = np.random.default_rng(12)
+            for S in (5, 13, 21):
+                p = rng.integers(0, 256, size=(1, S)).astype(np.int32)
+                seed = 200 + S
+                got_c = eng_c.submit(p, max_new_tokens=n,
+                                     seed=seed).result(timeout=120)
+                got_m = eng_m.submit(p, max_new_tokens=n,
+                                     seed=seed).result(timeout=120)
+                ref = _offline(m, params, p, n, seed=seed, do_sample=True,
+                               temperature=0.9, top_k=50)
+                _assert_matches_offline(got_c, ref, n)
+                assert np.array_equal(got_c, got_m), (S, got_c, got_m)
+        finally:
+            for e in (eng_c, eng_m):
+                if e.running:
+                    e.shutdown(drain=False)
+
+
+class TestZeroRecompileChunked:
+    def test_one_chunk_executable_for_any_length_mix(self):
+        """The tentpole's acceptance bar: prompt lengths spanning what used
+        to be THREE 128-bucket prefill executables (3..300, both sides of
+        the chunk width) run after warmup with zero compile/trace events
+        and exactly ONE cached executable each for prefill_chunk,
+        restore_prefix, and decode."""
+        cfg = LlamaConfig.tiny(use_flash_attention=False,
+                               max_position_embeddings=512)
+        m = LlamaForCausalLM(cfg)
+        params = m.init_params(jax.random.PRNGKey(0), batch_size=2, seq_len=8)
+        eng = ServingEngine(m, params, max_slots=2, max_len=384,
+                            eos_token_id=EOS, prefill_chunk=128,
+                            prefix_cache_mb=4.0)
+        compiles = []
+
+        def listener(event, duration, **kw):
+            if "compile" in event or "trace" in event:
+                compiles.append(event)
+
+        rng = np.random.default_rng(3)
+        jax.monitoring.register_event_duration_secs_listener(listener)
+        try:
+            reqs = []
+            for i, S in enumerate((3, 9, 140, 260, 300)):
+                p = rng.integers(0, 256, size=(1, S)).astype(np.int32)
+                reqs.append(eng.submit(p, max_new_tokens=6, seed=i))
+                time.sleep(0.01)
+            for r in reqs:
+                r.result(timeout=300)
+        finally:
+            from jax._src import monitoring as _mon
+
+            _mon._unregister_event_duration_listener_by_callback(listener)
+            eng.shutdown(drain=False)
+        assert not compiles, (
+            f"XLA recompiled after warmup: {compiles} — chunked prefill must "
+            "serve every prompt length with the one fixed-shape executable")
+        assert eng._prefill_chunk._cache_size() == 1
+        assert eng._restore_prefix._cache_size() == 1
+        assert eng._decode._cache_size() == 1
+
+
+class TestChunkedScheduling:
+    def test_decode_ticks_between_prefill_chunks(self):
+        """Acceptance: chunked admission must not stall active streams —
+        while a 12-chunk prompt prefills (admission -> first token),
+        an already-decoding stream keeps committing tokens. Uses the
+        deterministic per-token sleep model so the prefill window is wide
+        on any host."""
+        import bench
+
+        cfg = LlamaConfig.tiny(use_flash_attention=False)
+        m = bench._sleepy_llama_cls(step_ms=1.0, per_token=True)(cfg)
+        params = m.init_params(jax.random.PRNGKey(0), batch_size=1, seq_len=8)
+        eng = ServingEngine(m, params, max_slots=2, max_len=128,
+                            prefill_chunk=8, prefill_chunks_per_tick=1,
+                            prefix_cache_mb=0.0)
+        try:
+            stamps = []
+            stream = eng.submit([[5, 6, 7, 8]], max_new_tokens=120,
+                                ignore_eos=True,
+                                on_token=lambda t: stamps.append(time.monotonic()))
+            t0 = time.monotonic()
+            while len(stamps) < 3:
+                assert time.monotonic() - t0 < 60, "stream never decoded"
+                time.sleep(0.001)
+            long_req = eng.submit(np.arange(96, dtype=np.int32)[None, :],
+                                  max_new_tokens=1, ignore_eos=True)
+            assert long_req.wait(60)
+            mid = [s for s in stamps
+                   if long_req.admitted_at < s < long_req.first_token_at]
+            assert len(mid) >= 3, (
+                f"only {len(mid)} stream tokens during the long prompt's "
+                "12-chunk prefill: decode ticks are not interleaving")
+            assert eng.serving_metrics()["prefill_chunks"] >= 12
+            stream.cancel()
+            stream.wait(60)
+        finally:
+            eng.shutdown(drain=False)
+
+
+class TestPrefixCacheServing:
+    def test_repeat_prompt_restores_and_matches(self, tiny):
+        """A 30-token prompt (4 chunks of 8) runs cold as 4 chunk calls;
+        the identical prompt again admits in exactly ONE (the final chunk
+        — cached blocks hold KV, not the first token's logits) with its
+        3 full chunks restored, and the tokens are identical."""
+        _, m, params = tiny
+        eng = ServingEngine(m, params, max_slots=2, max_len=64,
+                            eos_token_id=EOS, prefill_chunk=8,
+                            prefix_cache_mb=4.0, warmup=False)
+        try:
+            p = np.arange(1, 31, dtype=np.int32)[None, :]
+            out1 = eng.submit(p, max_new_tokens=6).result(timeout=120)
+            s1 = eng.serving_metrics()
+            assert s1["prefill_chunks"] == 4
+            assert s1["prefix_cache_hit_chunks"] == 0
+            assert len(eng.prefix_cache) == 3  # full chunks 0..2 stored
+            out2 = eng.submit(p, max_new_tokens=6).result(timeout=120)
+            s2 = eng.serving_metrics()
+            assert np.array_equal(out1, out2)
+            _assert_matches_offline(out1, _offline(m, params, p, 6), 6)
+            assert s2["prefill_chunks"] == 5  # the repeat cost ONE chunk
+            assert s2["prefix_cache_hit_chunks"] == 3
+            assert s2["prefix_cache_hit_rate"] == 0.5  # 3 hits / 6 lookups
+            assert s2["prefix_cache_restored_bytes"] > 0
+            assert s2["prefix_cache_entries"] == 3
+            assert s2["prefix_cache_bytes"] == eng.prefix_cache.nbytes > 0
+        finally:
+            eng.shutdown(drain=False)
+
+
+class TestAdmissionScreening:
+    def test_idle_pop_screens_cancelled_and_expired(self, tiny):
+        """Regression: the idle path used to admit its popped request
+        without re-checking cancel/deadline. A request cancelled (or
+        expired) while the engine idles must finish WITHOUT taking a slot
+        — no tokens, no admit counters."""
+        _, m, params = tiny
+        eng = ServingEngine(m, params, max_slots=1, max_len=64,
+                            eos_token_id=EOS, warmup=False)
+        try:
+            r = Request([[1, 2]], max_new_tokens=4)
+            r.cancel()
+            eng.submit(request=r)
+            assert r.wait(30)
+            assert r.status is RequestStatus.CANCELLED and r.tokens == []
+            r2 = eng.submit([[3]], max_new_tokens=4, timeout=0.0)
+            assert r2.wait(30)
+            assert r2.status is RequestStatus.TIMED_OUT and r2.tokens == []
+            s = eng.serving_metrics()
+            assert s["requests_admitted"] == 0
+            assert s["requests_cancelled"] == 1
+            assert s["requests_timed_out"] == 1
+        finally:
+            eng.shutdown(drain=False)
+
+    def test_request_handles_are_single_use(self, engine):
+        r = engine.submit([[2, 4]], max_new_tokens=2)
+        r.result(timeout=120)
+        with pytest.raises(ValueError, match="single-use"):
+            engine.submit(request=r)
+        fresh = Request([[6]], max_new_tokens=2)
+        engine.submit(request=fresh)
+        with pytest.raises(ValueError, match="single-use"):
+            engine.submit(request=fresh)  # in flight: equally stale
+        fresh.wait(120)
 
 
 class TestSchedulingSemantics:
@@ -454,3 +704,30 @@ class TestSoak:
         after = engine.serving_metrics()
         assert after["requests_completed"] - before["requests_completed"] == 40
         assert after["requests_admitted"] - before["requests_admitted"] == 40
+
+    def test_sustained_mixed_load_chunked_with_prefix_hits(self, tiny):
+        """Chunked soak: 30 jittered requests drawn from a small prompt
+        pool (so multi-chunk prompts repeat and the prefix cache actually
+        fires mid-load); every stream exact, hits observed."""
+        _, m, params = tiny
+        eng = ServingEngine(m, params, max_slots=3, max_len=64,
+                            eos_token_id=EOS, prefill_chunk=4,
+                            prefix_cache_mb=2.0)
+        try:
+            rng = np.random.default_rng(1)
+            pool = [rng.integers(0, 256, size=(1, S)).astype(np.int32)
+                    for S in (1, 3, 6, 9, 14, 23)]
+            work = []
+            for _ in range(30):
+                p = pool[int(rng.integers(len(pool)))]
+                n = int(rng.integers(1, 16))
+                work.append((p, n, eng.submit(p, max_new_tokens=n)))
+                time.sleep(float(rng.random()) * 0.004)
+            for p, n, r in work:
+                _assert_matches_offline(r.result(timeout=300),
+                                        _offline(m, params, p, n), n)
+            s = eng.serving_metrics()
+            assert s["requests_completed"] == 30
+            assert s["prefix_cache_hit_chunks"] > 0
+        finally:
+            eng.shutdown(drain=False)
