@@ -14,7 +14,7 @@
 
 PYTHON ?= python
 
-.PHONY: test-fast test-models test-subproc test-multiprocess test-all test-nightly quality
+.PHONY: test-fast test-models test-subproc test-multiprocess test-all test-nightly quality serve-demo
 
 test-fast:
 	$(PYTHON) -m pytest -q $$($(PYTHON) tests/lanes.py fast)
@@ -36,3 +36,11 @@ test-nightly:
 
 quality:
 	$(PYTHON) -m compileall -q accelerate_tpu bench.py bench_watch.py __graft_entry__.py
+
+# HTTP gateway demo on a tiny random model (CPU): 2 replicas on :8000.
+# Try: curl -s localhost:8000/readyz; curl -s -XPOST localhost:8000/v1/completions \
+#        -d '{"prompt": [1,2,3,4], "max_new_tokens": 8, "seed": 0}'
+serve-demo:
+	JAX_PLATFORMS=cpu $(PYTHON) -m accelerate_tpu.commands.accelerate_cli serve \
+		--model tiny --replicas 2 --port 8000 --max-len 128 --prefill-chunk 32 \
+		--eos-token-id 7

@@ -283,7 +283,7 @@ class Accelerator:
         self._rng_key = jax.random.PRNGKey(seed)
         from collections import OrderedDict
 
-        from .serving.metrics import ServingStats
+        from .serving.metrics import GatewayStats, ServingStats
         from .utils.profiling import PipelineStats
 
         # Shared across every prepared loader so step-time breakdowns
@@ -293,6 +293,10 @@ class Accelerator:
         # counters (TTFT, queue wait, tokens/sec, occupancy) surface through
         # log(include_serving=True) / serving_metrics() / profile().
         self.serving_stats = ServingStats()
+        # Same sharing for ServingGateway(accelerator=...): HTTP counters
+        # (requests by status class, streams, in-flight) surface through
+        # log(include_gateway=True) / gateway_metrics() / profile().
+        self.gateway_stats = GatewayStats()
         self._backward_cache: OrderedDict = OrderedDict()
         self._backward_cache_size = 16
         self._fused_cache: dict = {}
@@ -578,6 +582,13 @@ class Accelerator:
         ``ServingEngine(accelerator=self)``; see
         ``serving.metrics.ServingStats.summary``."""
         return self.serving_stats.summary()
+
+    def gateway_metrics(self) -> dict:
+        """Aggregated HTTP-gateway counters (requests by status class,
+        SSE streams, in-flight) for every
+        ``ServingGateway(accelerator=self)``; see
+        ``serving.metrics.GatewayStats.summary``."""
+        return self.gateway_stats.summary()
 
     # ------------------------------------------------------------------
     # Gradient accumulation (reference: accelerator.py:1020-1090)
@@ -1236,7 +1247,8 @@ class Accelerator:
         # data_wait/stage and serving counters per step().
         return (handler.build(log_dir=log_dir)
                 .attach_pipeline_stats(self.pipeline_stats)
-                .attach_serving_stats(self.serving_stats))
+                .attach_serving_stats(self.serving_stats)
+                .attach_gateway_stats(self.gateway_stats))
 
     # ------------------------------------------------------------------
     # Memory / lifecycle (reference: accelerator.py:3219-3270)
@@ -1316,13 +1328,15 @@ class Accelerator:
         )
 
     def log(self, values: dict, step: Optional[int] = None, log_kwargs: Optional[dict] = None,
-            include_input_pipeline: bool = False, include_serving: bool = False):
+            include_input_pipeline: bool = False, include_serving: bool = False,
+            include_gateway: bool = False):
         """Log scalars to every active tracker, main process only (reference: :2625).
 
         ``include_input_pipeline=True`` merges the aggregated loader
         breakdown (``input_pipeline/data_wait_ms`` etc.) into the payload;
         ``include_serving=True`` does the same for serving-engine counters
-        (``serving/ttft_ms`` etc.)."""
+        (``serving/ttft_ms`` etc.), and ``include_gateway=True`` for the
+        HTTP gateway's counters (``gateway/http_requests`` etc.)."""
         if include_input_pipeline:
             from .tracking import with_input_pipeline_metrics
 
@@ -1331,6 +1345,10 @@ class Accelerator:
             from .tracking import with_serving_metrics
 
             values = with_serving_metrics(values, self.serving_stats)
+        if include_gateway:
+            from .tracking import with_gateway_metrics
+
+            values = with_gateway_metrics(values, self.gateway_stats)
         for tracker in self.trackers:
             tracker.log(values, step=step, **((log_kwargs or {}).get(tracker.name, {})))
 

@@ -1,0 +1,126 @@
+"""`accelerate-tpu serve` — launch the HTTP serving gateway over N
+continuous-batching engine replicas.
+
+Two ways to point it at a model:
+
+* ``--model tiny`` — a randomly initialised tiny llama (CPU-friendly):
+  the demo/smoke path, enough to exercise the full HTTP surface.
+* ``--model pkg.mod:factory`` — an import path to a zero-arg callable
+  returning ``(model, params)``; every replica shares the returned
+  params (one host copy), each gets its own engine.
+
+The process serves until SIGTERM/SIGINT, then drains gracefully: readyz
+goes 503, in-flight streams finish, replicas shut down (flushing any
+pending async checkpoint saves), and the process exits 0.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import time
+
+
+def _resolve_model(spec: str, args):
+    if spec == "tiny":
+        import jax
+        import numpy as np
+
+        from ..models.llama import LlamaConfig, LlamaForCausalLM
+
+        model = LlamaForCausalLM(LlamaConfig.tiny())
+        params = model.init(jax.random.PRNGKey(args.seed),
+                            np.zeros((1, 8), np.int32))["params"]
+        return model, params
+    if ":" not in spec:
+        raise SystemExit(
+            f"--model must be 'tiny' or 'pkg.mod:factory' (got {spec!r})")
+    mod_name, _, attr = spec.partition(":")
+    factory = getattr(importlib.import_module(mod_name), attr)
+    out = factory()
+    if not (isinstance(out, tuple) and len(out) == 2):
+        raise SystemExit(
+            f"{spec} must return a (model, params) tuple "
+            f"(got {type(out).__name__})")
+    return out
+
+
+def serve_command(args) -> int:
+    from ..serving import (
+        GatewayConfig,
+        ReplicaSet,
+        ServingEngine,
+        ServingGateway,
+    )
+
+    model, params = _resolve_model(args.model, args)
+
+    def factory():
+        return ServingEngine(
+            model, params, max_slots=args.max_slots, max_len=args.max_len,
+            max_queued=args.max_queued, eos_token_id=args.eos_token_id,
+            prefill_chunk=args.prefill_chunk,
+            prefix_cache_mb=args.prefix_cache_mb)
+
+    print(f"warming up {args.replicas} replica(s) "
+          f"(slots={args.max_slots}, max_len={args.max_len}, "
+          f"chunk={args.prefill_chunk}) ...", flush=True)
+    replica_set = ReplicaSet.from_factory(factory, args.replicas)
+    gateway = ServingGateway(
+        replica_set,
+        config=GatewayConfig(host=args.host, port=args.port,
+                             default_max_new_tokens=args.default_max_new_tokens,
+                             max_connections=args.max_connections))
+    gateway.start()
+    gateway.install_signal_handlers()
+    print(f"serving on {gateway.url}  "
+          "(POST /v1/completions, GET /healthz /readyz /metrics)",
+          flush=True)
+    print("press Ctrl-C (or send SIGTERM) to drain and exit",
+          flush=True)
+    try:
+        while gateway._server is not None:
+            time.sleep(0.2)
+    except KeyboardInterrupt:
+        pass
+    gateway.shutdown(drain=True)  # idempotent; covers the no-signal path
+    print("gateway drained; bye", flush=True)
+    return 0
+
+
+def serve_command_parser(subparsers=None):
+    description = ("Serve a model over HTTP: continuous-batching engine "
+                   "replicas behind a routing gateway")
+    if subparsers is not None:
+        parser = subparsers.add_parser("serve", description=description)
+    else:
+        parser = argparse.ArgumentParser("accelerate-tpu serve",
+                                         description=description)
+    parser.add_argument("--model", default="tiny",
+                        help="'tiny' (random demo model) or 'pkg.mod:factory' "
+                             "returning (model, params)")
+    parser.add_argument("--replicas", type=int, default=1,
+                        help="Engine replicas behind the gateway")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=8000,
+                        help="TCP port (0 = OS-assigned ephemeral)")
+    parser.add_argument("--max-slots", type=int, default=4,
+                        help="Decode lanes per replica")
+    parser.add_argument("--max-len", type=int, default=128,
+                        help="Per-slot KV capacity (prompt + new tokens)")
+    parser.add_argument("--max-queued", type=int, default=64,
+                        help="Admission queue bound per replica")
+    parser.add_argument("--prefill-chunk", type=int, default=32,
+                        help="Chunked-prefill width")
+    parser.add_argument("--prefix-cache-mb", type=float, default=64.0,
+                        help="Prefix KV cache budget per replica (0 = off)")
+    parser.add_argument("--eos-token-id", type=int, default=None)
+    parser.add_argument("--default-max-new-tokens", type=int, default=32,
+                        help="Used when a request omits max_new_tokens")
+    parser.add_argument("--max-connections", type=int, default=64,
+                        help="Concurrent in-flight HTTP exchanges")
+    parser.add_argument("--seed", type=int, default=0,
+                        help="Init seed for --model tiny")
+    if subparsers is not None:
+        parser.set_defaults(func=serve_command)
+    return parser

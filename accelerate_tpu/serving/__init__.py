@@ -16,27 +16,53 @@ Public surface:
   plus the chunked-prefill split (chunk count/ms, backlog, prefix-cache
   hit rate/bytes) — ``engine.serving_metrics()``,
   ``Accelerator.log(include_serving=True)``.
-* :class:`AdmissionQueue` / :class:`QueueFull` / :class:`SlotScheduler` —
-  the bounded FCFS admission layer and slot free-list.
+* :class:`AdmissionQueue` / :class:`QueueFull` / :class:`QueueClosed` /
+  :class:`SlotScheduler` — the bounded FCFS admission layer and slot
+  free-list.
 * :class:`PrefixCache` — byte-bounded LRU of chunk-aligned prefix KV
   blocks keyed by token-prefix hash chains (shared system prompts skip
   their prefill FLOPs).
+* :class:`ReplicaSet` / :class:`ReplicaState` / :class:`FleetRequest` —
+  N engine replicas behind one submit surface: least-loaded routing,
+  per-replica health, and failover that resumes a dead replica's
+  in-flight streams on a healthy one (``prompt + tokens_emitted``) with
+  zero duplicated or lost tokens.
+* :class:`ServingGateway` / :class:`GatewayConfig` /
+  :class:`GatewayStats` — stdlib-only HTTP front end: ``POST
+  /v1/completions`` (JSON + SSE streaming), ``/healthz`` / ``/readyz`` /
+  ``/metrics`` (Prometheus text), backpressure mapped to HTTP status
+  codes, graceful drain on SIGTERM.
 
 See ``docs/usage_guides/serving.md``.
 """
 
 from .engine import ServingEngine
-from .metrics import ServingStats
+from .gateway import GatewayConfig, ServingGateway
+from .metrics import GatewayStats, ServingStats
 from .request import Request, RequestStatus
-from .scheduler import AdmissionQueue, PrefixCache, QueueFull, SlotScheduler
+from .router import FleetRequest, ReplicaSet, ReplicaState
+from .scheduler import (
+    AdmissionQueue,
+    PrefixCache,
+    QueueClosed,
+    QueueFull,
+    SlotScheduler,
+)
 
 __all__ = [
     "ServingEngine",
     "ServingStats",
+    "GatewayStats",
     "Request",
     "RequestStatus",
     "AdmissionQueue",
     "PrefixCache",
     "QueueFull",
+    "QueueClosed",
     "SlotScheduler",
+    "ReplicaSet",
+    "ReplicaState",
+    "FleetRequest",
+    "ServingGateway",
+    "GatewayConfig",
 ]

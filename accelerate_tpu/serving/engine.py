@@ -86,7 +86,13 @@ from ..generation import (
 from ..inference import resolve_model_source
 from .metrics import ServingStats
 from .request import Request, RequestStatus
-from .scheduler import AdmissionQueue, PrefixCache, QueueFull, SlotScheduler
+from .scheduler import (
+    AdmissionQueue,
+    PrefixCache,
+    QueueClosed,
+    QueueFull,
+    SlotScheduler,
+)
 
 __all__ = ["ServingEngine"]
 
@@ -255,6 +261,7 @@ class ServingEngine:
         self._drain = False         # finish all accepted work, then exit
         self._abort_queue = False   # preemption: finish running, cancel queued
         self._error: Optional[BaseException] = None
+        self._fail_injection: Optional[BaseException] = None
         self._thread: Optional[threading.Thread] = None
         self._warmup_on_start = bool(warmup)
         if autostart:
@@ -483,6 +490,10 @@ class ServingEngine:
         if self._thread is not None:
             self._thread.join(timeout)
             self._thread = None
+        # The run loop's finally also closes the queue; doing it here too
+        # covers an engine that was never started (autostart=False), so a
+        # blocked submit can never outlive the engine either way.
+        self._queue.close()
         checkpointing.wait_for_saves()
         if self._error is not None:
             raise RuntimeError("serving engine died") from self._error
@@ -497,6 +508,46 @@ class ServingEngine:
     @property
     def running(self) -> bool:
         return self._thread is not None and self._thread.is_alive()
+
+    @property
+    def healthy(self) -> bool:
+        """Live and serviceable: the engine thread is running, no fatal
+        error has been recorded, and admission is open. The router's
+        health checks key off this."""
+        return self.running and self._error is None and self._accepting
+
+    @property
+    def error(self) -> Optional[BaseException]:
+        """The fatal error that killed the run loop, if any."""
+        return self._error
+
+    @property
+    def free_slots(self) -> int:
+        """Decode lanes currently unoccupied (router free-slot routing)."""
+        return self._slots.free_slots
+
+    @property
+    def queue_depth(self) -> int:
+        """Requests waiting for admission right now."""
+        return len(self._queue)
+
+    @property
+    def load(self) -> float:
+        """Occupancy fraction over the engine's whole admission capacity:
+        ``(active slots + queued) / (max_slots + max_queued)`` — the
+        router's least-loaded score. 1.0 means a submit would bounce."""
+        return ((self._slots.active_slots + len(self._queue))
+                / (self.max_slots + self._queue.max_queued))
+
+    def kill(self, error: Optional[BaseException] = None):
+        """Fault injection / fencing: make the run loop raise ``error`` at
+        its next iteration, exactly as a device failure inside a compiled
+        call would — the engine records the error, fails every in-flight
+        and queued request, and exits. Used by the failover tests/benches
+        and by operators fencing a suspect replica hard (prefer
+        :meth:`shutdown` for anything gentler)."""
+        self._fail_injection = error if error is not None else RuntimeError(
+            "replica killed by fault injection")
 
     # ------------------------------------------------------------------
     # submission
@@ -524,7 +575,8 @@ class ServingEngine:
                 f"Request handle already used (status "
                 f"{request.status.value}); Request objects are single-use — "
                 "build a fresh Request (or pass prompt_ids) per submission")
-        if not self._accepting or self._stop or self._drain:
+        if (not self._accepting or self._stop or self._drain
+                or self._queue.closed):
             raise RuntimeError("serving engine is not accepting requests "
                                "(not started, shutting down, or preempted)")
         S = request.prompt_ids.shape[1]
@@ -542,6 +594,13 @@ class ServingEngine:
         except QueueFull:
             self._stats.record_reject()
             raise
+        except QueueClosed as e:
+            # The engine stopped between the accepting-check above and the
+            # enqueue (or while we were blocked waiting for space): same
+            # contract as submitting to a dead engine outright.
+            raise RuntimeError(
+                "serving engine is not accepting requests "
+                "(not started, shutting down, or preempted)") from e
         self._stats.record_submit(len(self._queue))
         return request
 
@@ -564,6 +623,11 @@ class ServingEngine:
     def _run(self):
         try:
             while not self._stop:
+                if self._fail_injection is not None:
+                    # Routed through the normal engine-fatal path below, so
+                    # an injected fault is indistinguishable from a real one
+                    # to everything downstream (router fencing included).
+                    raise self._fail_injection
                 if (self._accelerator is not None
                         and getattr(self._accelerator, "preemption_requested", False)
                         and not (self._drain or self._abort_queue)):
@@ -638,6 +702,10 @@ class ServingEngine:
             self._error = e
         finally:
             self._accepting = False
+            # Close BEFORE the final drain: wakes producers blocked in
+            # put(block=True) with QueueClosed and guarantees nothing can
+            # slip into the queue after we empty it below.
+            self._queue.close()
             self._prefilling.clear()
             terminal = (RequestStatus.FAILED if self._error is not None
                         else RequestStatus.CANCELLED)

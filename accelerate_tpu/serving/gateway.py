@@ -1,0 +1,511 @@
+"""Stdlib-only HTTP gateway over a :class:`~.router.ReplicaSet`.
+
+The network front door of the serving stack: a ``ThreadingHTTPServer``
+(one handler thread per connection — the handlers only wait on queues
+and sockets, all model work stays on the engine threads) exposing:
+
+* ``POST /v1/completions`` — JSON in, JSON out, or Server-Sent Events
+  when ``"stream": true`` (one ``data:`` event per token as the engine
+  commits it, then a final summary event). Prompts are token-id lists —
+  the repo has no tokenizer dependency, and the serving tests need
+  bit-exact comparison against offline ``generate`` anyway.
+* ``GET /healthz`` — liveness: 200 while the process serves HTTP at all.
+* ``GET /readyz`` — readiness: 200 only when the gateway is not draining
+  AND at least one replica is healthy and warm; 503 otherwise. Wire this
+  one into the load balancer.
+* ``GET /metrics`` — Prometheus text exposition: the fleet-merged engine
+  counters (``ServingStats.merge`` across replicas), router health/
+  failover counters, and the gateway's own HTTP counters.
+
+Backpressure and failure map onto HTTP status codes instead of queues
+growing without bound: every healthy replica's admission queue full →
+**429** with ``Retry-After``; per-request deadline expired → **408**;
+request body over the cap → **413**; connection cap hit, gateway
+draining, or no healthy replica → **503**; malformed request → **400**.
+
+Graceful drain: ``shutdown(drain=True)`` (also wired to SIGTERM/SIGINT
+by :meth:`ServingGateway.install_signal_handlers`) flips the gateway to
+draining — ``/readyz`` goes 503 so balancers stop sending, new
+completions are refused with 503 — waits for in-flight HTTP exchanges
+to finish, then drains the replicas themselves (which flushes any
+pending async checkpoint saves; see ``ServingEngine.shutdown``).
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import signal
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+
+from .engine import ServingEngine
+from .metrics import GatewayStats
+from .request import RequestStatus
+from .router import ReplicaSet
+from .scheduler import QueueFull
+
+__all__ = ["ServingGateway", "GatewayConfig"]
+
+
+class GatewayConfig:
+    """Knobs for the HTTP layer (the model/engine knobs live on the
+    engines themselves).
+
+    Args:
+      host: bind address (default loopback — put a real proxy in front
+        before binding wider).
+      port: TCP port; **0 asks the OS for an ephemeral port** (read it
+        back from ``gateway.port`` — this is what the tests use, so no
+        fixed-port flakes).
+      max_body_bytes: request bodies over this are refused with 413
+        before being read into memory.
+      max_connections: concurrent in-flight HTTP exchanges; past it new
+        requests get 503 (the admission queues provide the real
+        backpressure — this cap only bounds handler threads).
+      default_max_new_tokens: used when a completion request omits
+        ``max_new_tokens``.
+      max_new_tokens_cap: hard per-request ceiling (400 past it);
+        ``None`` defers entirely to the engines' ``max_len`` check.
+      default_timeout_s: per-request deadline applied when the body
+        omits ``timeout``; ``None`` means no deadline.
+      retry_after_s: value of the ``Retry-After`` header on 429/503.
+      drain_grace_s: how long ``shutdown(drain=True)`` waits for
+        in-flight HTTP exchanges before proceeding anyway.
+    """
+
+    def __init__(self, *, host: str = "127.0.0.1", port: int = 0,
+                 max_body_bytes: int = 1 << 20, max_connections: int = 64,
+                 default_max_new_tokens: int = 32,
+                 max_new_tokens_cap: Optional[int] = None,
+                 default_timeout_s: Optional[float] = None,
+                 retry_after_s: float = 1.0,
+                 drain_grace_s: float = 30.0):
+        if max_body_bytes < 1 or max_connections < 1:
+            raise ValueError("max_body_bytes and max_connections must be >= 1")
+        self.host = host
+        self.port = int(port)
+        self.max_body_bytes = int(max_body_bytes)
+        self.max_connections = int(max_connections)
+        self.default_max_new_tokens = int(default_max_new_tokens)
+        self.max_new_tokens_cap = max_new_tokens_cap
+        self.default_timeout_s = default_timeout_s
+        self.retry_after_s = float(retry_after_s)
+        self.drain_grace_s = float(drain_grace_s)
+
+
+#: request terminal status -> (HTTP code, wire status string)
+_STATUS_HTTP = {
+    RequestStatus.COMPLETED: (200, "completed"),
+    RequestStatus.TIMED_OUT: (408, "timed_out"),
+    RequestStatus.CANCELLED: (500, "cancelled"),
+    RequestStatus.FAILED: (500, "failed"),
+}
+
+
+class _BadRequest(ValueError):
+    """Client error carrying the 400 payload message."""
+
+
+class ServingGateway:
+    """HTTP server over a replica set (or a single engine, auto-wrapped).
+
+    Usage::
+
+        gw = ServingGateway(replica_set, config=GatewayConfig(port=0))
+        gw.start()
+        ...  # POST to gw.url + "/v1/completions"
+        gw.shutdown(drain=True)
+
+    Also a context manager (``start`` on enter, drain-shutdown on exit).
+    """
+
+    def __init__(self, replicas, *, config: Optional[GatewayConfig] = None,
+                 stats: Optional[GatewayStats] = None, accelerator=None):
+        if isinstance(replicas, ServingEngine):
+            replicas = ReplicaSet([replicas])
+        if not isinstance(replicas, ReplicaSet):
+            raise TypeError(
+                f"replicas must be a ReplicaSet or ServingEngine "
+                f"(got {type(replicas).__name__})")
+        self.replica_set = replicas
+        self.config = config if config is not None else GatewayConfig()
+        if stats is None and accelerator is not None:
+            stats = getattr(accelerator, "gateway_stats", None)
+        self.stats = stats if stats is not None else GatewayStats()
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._draining = False
+        self._shutdown_lock = threading.Lock()
+        self._conn_slots = threading.BoundedSemaphore(
+            self.config.max_connections)
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self):
+        """Bind and serve in a daemon thread (idempotent). With
+        ``config.port == 0`` the OS picks the port; read it back from
+        :attr:`port` / :attr:`url`."""
+        if self._server is not None:
+            return
+        handler = type("GatewayHandler", (_Handler,), {"gateway": self})
+        self._server = ThreadingHTTPServer(
+            (self.config.host, self.config.port), handler)
+        self._server.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="serving-gateway",
+            daemon=True)
+        self._thread.start()
+
+    @property
+    def port(self) -> int:
+        if self._server is None:
+            raise RuntimeError("gateway not started")
+        return self._server.server_address[1]
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.config.host}:{self.port}"
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    @property
+    def ready(self) -> bool:
+        """The ``/readyz`` condition: accepting AND >= 1 healthy replica."""
+        return not self._draining and self.replica_set.ready
+
+    def drain(self):
+        """Stop taking new work (readyz 503, completions 503); in-flight
+        streams keep running. ``shutdown`` completes the exit."""
+        self._draining = True
+        self.replica_set.drain()
+
+    def shutdown(self, drain: bool = True, timeout: Optional[float] = None):
+        """Graceful exit: drain, wait (bounded by ``drain_grace_s``) for
+        in-flight HTTP exchanges, stop the listener, shut the replicas
+        down (which also flushes pending async checkpoint saves).
+        ``drain=False`` skips the waiting and cancels in-flight work."""
+        with self._shutdown_lock:
+            self._draining = True
+            if drain:
+                self.replica_set.drain()
+                deadline = time.monotonic() + self.config.drain_grace_s
+                while (self.stats.summary()["http_inflight"] > 0
+                        and time.monotonic() < deadline):
+                    time.sleep(0.01)
+            if self._server is not None:
+                self._server.shutdown()
+                self._server.server_close()
+                self._server = None
+                self._thread = None
+            self.replica_set.shutdown(drain=drain, timeout=timeout)
+
+    def install_signal_handlers(self, signals=(signal.SIGTERM,
+                                               signal.SIGINT)) -> bool:
+        """Wire graceful drain to process signals (SIGTERM is what both
+        k8s and TPU preemption notices deliver). Returns False — without
+        installing — when not on the main thread, where CPython forbids
+        ``signal.signal``."""
+        if threading.current_thread() is not threading.main_thread():
+            return False
+
+        def _handle(signum, frame):
+            # The handler must not block: drain flips flags, the real
+            # shutdown runs on its own thread.
+            threading.Thread(target=self.shutdown, kwargs={"drain": True},
+                             name="gateway-drain", daemon=True).start()
+
+        for s in signals:
+            signal.signal(s, _handle)
+        return True
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown(drain=exc[0] is None)
+
+    # -- metrics ----------------------------------------------------------
+    def metrics_text(self) -> str:
+        """The ``/metrics`` body: Prometheus text exposition (version
+        0.0.4) of fleet-merged engine counters, router health/failover
+        counters, and the gateway's HTTP counters."""
+        lines = []
+
+        def emit(name, value, mtype="gauge"):
+            lines.append(f"# TYPE {name} {mtype}")
+            v = float(value)
+            lines.append(f"{name} {int(v) if v == int(v) else v}")
+
+        for k, v in self.replica_set.fleet_metrics().items():
+            emit(f"accelerate_tpu_serving_{k}", v)
+        for k, v in self.stats.summary().items():
+            emit(f"accelerate_tpu_gateway_{k}", v)
+        lines.append(
+            "# TYPE accelerate_tpu_gateway_responses_total counter")
+        for (route, code), n in sorted(self.stats.by_route().items()):
+            lines.append(
+                'accelerate_tpu_gateway_responses_total'
+                f'{{route="{route}",code="{code}"}} {n}')
+        return "\n".join(lines) + "\n"
+
+
+class _Handler(BaseHTTPRequestHandler):
+    """Per-connection handler; ``gateway`` is injected as a class
+    attribute by ``ServingGateway.start``."""
+
+    gateway: ServingGateway = None  # type: ignore[assignment]
+    protocol_version = "HTTP/1.1"
+    # Quieten the default per-request stderr lines; errors still surface
+    # through status codes and /metrics.
+    def log_message(self, fmt, *args):  # noqa: D102
+        pass
+
+    # -- plumbing ---------------------------------------------------------
+    def _send_json(self, code: int, payload: dict, route: str,
+                   extra_headers: Optional[dict] = None,
+                   body_bytes_in: int = 0):
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (extra_headers or {}).items():
+            self.send_header(k, str(v))
+        self.end_headers()
+        self.wfile.write(body)
+        self.gateway.stats.record_response(route, code,
+                                           body_bytes=body_bytes_in)
+
+    def _send_text(self, code: int, text: str, route: str,
+                   content_type: str = "text/plain; charset=utf-8",
+                   extra_headers: Optional[dict] = None):
+        body = text.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (extra_headers or {}).items():
+            self.send_header(k, str(v))
+        self.end_headers()
+        self.wfile.write(body)
+        self.gateway.stats.record_response(route, code)
+
+    def _retry_after(self) -> dict:
+        return {"Retry-After": f"{self.gateway.config.retry_after_s:g}"}
+
+    # -- GET --------------------------------------------------------------
+    def do_GET(self):  # noqa: N802 (http.server naming)
+        gw = self.gateway
+        if not self._conn_enter(self.path):
+            return
+        try:
+            if self.path == "/healthz":
+                self._send_text(200, "ok\n", "/healthz")
+            elif self.path == "/readyz":
+                if gw.ready:
+                    self._send_text(200, "ready\n", "/readyz")
+                else:
+                    self._send_text(503,
+                                    "draining\n" if gw.draining
+                                    else "no healthy replica\n",
+                                    "/readyz", extra_headers=self._retry_after())
+            elif self.path == "/metrics":
+                self._send_text(200, gw.metrics_text(), "/metrics",
+                                content_type="text/plain; version=0.0.4; "
+                                             "charset=utf-8")
+            else:
+                self._send_json(404, {"error": "not found"}, self.path)
+        finally:
+            self._conn_exit()
+
+    # -- POST -------------------------------------------------------------
+    def do_POST(self):  # noqa: N802
+        gw = self.gateway
+        if self.path != "/v1/completions":
+            self._send_json(404, {"error": "not found"}, self.path)
+            return
+        route = "/v1/completions"
+        if not self._conn_enter(route):
+            return
+        try:
+            if gw.draining:
+                self._send_json(503, {"error": "gateway draining"}, route,
+                                extra_headers=self._retry_after())
+                return
+            try:
+                body, nbytes = self._read_body()
+                spec = self._parse_completion(body)
+            except _BadRequest as e:
+                code = 413 if "max_body_bytes" in str(e) else 400
+                self._send_json(code, {"error": str(e)}, route)
+                return
+            self._run_completion(spec, route, nbytes)
+        finally:
+            self._conn_exit()
+
+    def _read_body(self) -> tuple[dict, int]:
+        cfg = self.gateway.config
+        try:
+            length = int(self.headers.get("Content-Length", ""))
+        except ValueError:
+            raise _BadRequest("Content-Length required") from None
+        if length > cfg.max_body_bytes:
+            raise _BadRequest(
+                f"request body {length} bytes exceeds max_body_bytes "
+                f"({cfg.max_body_bytes})")
+        raw = self.rfile.read(length)
+        try:
+            body = json.loads(raw)
+        except json.JSONDecodeError as e:
+            raise _BadRequest(f"invalid JSON: {e}") from None
+        if not isinstance(body, dict):
+            raise _BadRequest("request body must be a JSON object")
+        return body, length
+
+    def _parse_completion(self, body: dict) -> dict:
+        cfg = self.gateway.config
+        prompt = body.get("prompt")
+        if prompt is None:
+            raise _BadRequest('missing "prompt" (a list of token ids — '
+                              "this gateway serves token ids, not text)")
+        try:
+            ids = np.asarray(prompt, np.int32)
+        except (ValueError, TypeError):
+            raise _BadRequest('"prompt" must be a list of token ids '
+                              "(optionally nested [[...]])") from None
+        if ids.ndim not in (1, 2) or ids.size < 1:
+            raise _BadRequest('"prompt" must be a non-empty [S] or [1, S] '
+                              "list of token ids")
+        max_new = body.get("max_new_tokens", cfg.default_max_new_tokens)
+        if not isinstance(max_new, int) or max_new < 1:
+            raise _BadRequest('"max_new_tokens" must be a positive integer')
+        if (cfg.max_new_tokens_cap is not None
+                and max_new > cfg.max_new_tokens_cap):
+            raise _BadRequest(
+                f'"max_new_tokens" {max_new} exceeds the gateway cap '
+                f"({cfg.max_new_tokens_cap})")
+        seed = body.get("seed")
+        if seed is not None and not isinstance(seed, int):
+            raise _BadRequest('"seed" must be an integer')
+        timeout = body.get("timeout", cfg.default_timeout_s)
+        if timeout is not None and (not isinstance(timeout, (int, float))
+                                    or timeout <= 0):
+            raise _BadRequest('"timeout" must be a positive number')
+        return {
+            "prompt_ids": ids,
+            "max_new_tokens": max_new,
+            "seed": seed,
+            "timeout": None if timeout is None else float(timeout),
+            "ignore_eos": bool(body.get("ignore_eos", False)),
+            "stream": bool(body.get("stream", False)),
+        }
+
+    def _run_completion(self, spec: dict, route: str, nbytes: int):
+        gw = self.gateway
+        stream = spec.pop("stream")
+        token_q: Optional[queue.Queue] = queue.Queue() if stream else None
+        try:
+            fleet = gw.replica_set.submit(
+                spec["prompt_ids"],
+                max_new_tokens=spec["max_new_tokens"],
+                seed=spec["seed"], timeout=spec["timeout"],
+                ignore_eos=spec["ignore_eos"],
+                on_token=token_q.put if stream else None)
+        except QueueFull:
+            self._send_json(429, {"error": "all replicas saturated; "
+                                           "retry later"},
+                            route, extra_headers=self._retry_after(),
+                            body_bytes_in=nbytes)
+            return
+        except RuntimeError as e:
+            self._send_json(503, {"error": f"no healthy replica: {e}"},
+                            route, extra_headers=self._retry_after(),
+                            body_bytes_in=nbytes)
+            return
+        except ValueError as e:
+            self._send_json(400, {"error": str(e)}, route,
+                            body_bytes_in=nbytes)
+            return
+        if stream:
+            self._stream_sse(fleet, token_q, route, nbytes)
+        else:
+            fleet.wait()  # bounded by the per-request deadline when set
+            code, status = _STATUS_HTTP[fleet.status]
+            payload = self._summary_payload(fleet, status)
+            if code != 200:
+                payload["error"] = (str(fleet.error)
+                                    if fleet.error is not None else status)
+            self._send_json(code, payload, route, body_bytes_in=nbytes)
+
+    @staticmethod
+    def _summary_payload(fleet, status: str) -> dict:
+        return {
+            "status": status,
+            "tokens": [int(t) for t in fleet.tokens],
+            "prompt_len": int(fleet.prompt_ids.shape[1]),
+            "failovers": fleet.failovers,
+            "replica_trail": list(fleet.replica_trail),
+        }
+
+    def _stream_sse(self, fleet, token_q: queue.Queue, route: str,
+                    nbytes: int):
+        """One SSE event per token as the engine commits it; a final
+        summary event carries the terminal status (and failover count) so
+        clients can tell a complete stream from a truncated one. A broken
+        client socket cancels the request — its slot frees at the next
+        scheduler pass instead of decoding into the void."""
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        self.send_header("Connection", "close")
+        self.end_headers()
+        self.close_connection = True
+        sent = 0
+        try:
+            while True:
+                try:
+                    tok = token_q.get(timeout=0.05)
+                except queue.Empty:
+                    if fleet.done and token_q.empty():
+                        break
+                    continue
+                self.wfile.write(
+                    f"data: {json.dumps({'token': int(tok)})}\n\n".encode())
+                self.wfile.flush()
+                sent += 1
+            code, status = _STATUS_HTTP[fleet.status]
+            final = self._summary_payload(fleet, status)
+            final["done"] = True
+            if fleet.status is not RequestStatus.COMPLETED:
+                final["error"] = (str(fleet.error)
+                                  if fleet.error is not None else status)
+            self.wfile.write(f"data: {json.dumps(final)}\n\n".encode())
+            self.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError):
+            fleet.cancel()
+            code = 499  # client closed; nothing more can be written
+        self.gateway.stats.record_response(route, code, body_bytes=nbytes)
+        self.gateway.stats.record_stream(sent)
+
+    # -- connection cap ----------------------------------------------------
+    def _conn_enter(self, route: str) -> bool:
+        """Take an in-flight slot; refuse with 503 when the cap is hit
+        (without blocking — the admission queues are the real wait)."""
+        if not self.gateway._conn_slots.acquire(blocking=False):
+            try:
+                self._send_json(503, {"error": "connection limit reached"},
+                                route, extra_headers=self._retry_after())
+            except (BrokenPipeError, ConnectionResetError):
+                pass
+            return False
+        self.gateway.stats.inflight_enter()
+        return True
+
+    def _conn_exit(self):
+        self.gateway.stats.inflight_exit()
+        self.gateway._conn_slots.release()

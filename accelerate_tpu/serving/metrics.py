@@ -150,6 +150,39 @@ class ServingStats:
             else:
                 self._cancelled += 1
 
+    # -- aggregation ----------------------------------------------------
+    def merge(self, other: "ServingStats") -> "ServingStats":
+        """Fold another engine's counters into this one — the fleet
+        aggregation the :class:`~accelerate_tpu.serving.router.ReplicaSet`
+        publishes (one merged view over N replicas). Sums add, maxima max,
+        TTFT samples concatenate (bounded), and point-in-time gauges
+        (queue depth, prefill backlog, prefix-cache footprint) ADD — the
+        fleet's total queue depth and total cache bytes are the
+        operational numbers, not any one replica's. Returns ``self`` so
+        merges chain: ``ServingStats().merge(a).merge(b)``."""
+        with other._lock:
+            o = dict(other.__dict__)
+            o_samples = list(other._ttft_samples)
+        with self._lock:
+            for k in ("_submitted", "_admitted", "_completed", "_failed",
+                      "_cancelled", "_timed_out", "_rejected",
+                      "_queue_wait_ms_sum", "_ttft_ms_sum", "_ticks",
+                      "_tick_s_sum", "_active_slot_sum", "_slot_capacity_sum",
+                      "_decode_tokens", "_prefill_tokens", "_prefill_chunks",
+                      "_prefill_ms_sum", "_prefix_lookup_chunks",
+                      "_prefix_hit_chunks", "_prefix_restored_bytes",
+                      "_queue_depth_last", "_prefill_backlog_last",
+                      "_prefix_cache_bytes", "_prefix_cache_entries"):
+                setattr(self, k, getattr(self, k) + o[k])
+            for k in ("_queue_wait_ms_max", "_ttft_ms_max",
+                      "_prefill_backlog_max"):
+                setattr(self, k, max(getattr(self, k), o[k]))
+            self._ttft_samples.extend(o_samples)
+            if len(self._ttft_samples) > self.MAX_TTFT_SAMPLES:
+                del self._ttft_samples[: len(self._ttft_samples)
+                                       - self.MAX_TTFT_SAMPLES]
+        return self
+
     # -- reporting ------------------------------------------------------
     @staticmethod
     def _percentile(samples: list[float], q: float) -> float:
@@ -207,4 +240,84 @@ class ServingStats:
                 "prefix_cache_restored_bytes": self._prefix_restored_bytes,
                 "prefix_cache_bytes": self._prefix_cache_bytes,
                 "prefix_cache_entries": self._prefix_cache_entries,
+            }
+
+
+class GatewayStats:
+    """HTTP-layer counters for the :class:`~accelerate_tpu.serving.gateway.
+    ServingGateway`: responses by route and status code, in-flight
+    connections, streamed tokens, and the backpressure/shed classes the
+    gateway maps to HTTP (429 queue-full, 408 deadline, 413 body cap,
+    503 saturated/draining). Thread-safe — every handler thread records
+    into the same object; ``summary()`` is a flat scalar dict and
+    ``by_route()`` feeds the labeled Prometheus series."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self):
+        """Zero every counter (e.g. between measurement windows)."""
+        with self._lock:
+            self._responses: dict = {}   # (route, code) -> count
+            self._inflight = 0
+            self._inflight_max = 0
+            self._streams = 0
+            self._tokens_streamed = 0
+            self._bytes_in = 0
+
+    def record_response(self, route: str, code: int, body_bytes: int = 0):
+        """One finished HTTP exchange on ``route`` with status ``code``."""
+        with self._lock:
+            key = (str(route), int(code))
+            self._responses[key] = self._responses.get(key, 0) + 1
+            self._bytes_in += int(body_bytes)
+
+    def record_stream(self, tokens: int):
+        """One SSE stream that delivered ``tokens`` token events."""
+        with self._lock:
+            self._streams += 1
+            self._tokens_streamed += int(tokens)
+
+    def inflight_enter(self):
+        with self._lock:
+            self._inflight += 1
+            self._inflight_max = max(self._inflight_max, self._inflight)
+
+    def inflight_exit(self):
+        with self._lock:
+            self._inflight -= 1
+
+    def by_route(self) -> dict:
+        """``(route, code) -> count`` snapshot (Prometheus labels)."""
+        with self._lock:
+            return dict(self._responses)
+
+    def summary(self) -> dict:
+        """Flat scalar snapshot: totals, per-class counts, in-flight."""
+        with self._lock:
+            total = sum(self._responses.values())
+
+            def klass(digit):
+                return sum(c for (_, code), c in self._responses.items()
+                           if code // 100 == digit)
+
+            def code_count(code):
+                return sum(c for (_, c2), c in self._responses.items()
+                           if c2 == code)
+
+            return {
+                "http_requests": total,
+                "http_2xx": klass(2),
+                "http_4xx": klass(4),
+                "http_5xx": klass(5),
+                "http_429": code_count(429),
+                "http_408": code_count(408),
+                "http_413": code_count(413),
+                "http_503": code_count(503),
+                "http_inflight": self._inflight,
+                "http_inflight_max": self._inflight_max,
+                "streams": self._streams,
+                "tokens_streamed": self._tokens_streamed,
+                "request_bytes_in": self._bytes_in,
             }

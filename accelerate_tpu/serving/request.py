@@ -79,6 +79,11 @@ class Request:
 
         self._cancel_requested = False
         self._done = threading.Event()
+        # Internal completion hook (router layer): called ON THE ENGINE
+        # THREAD exactly once, right after the terminal transition — the
+        # ReplicaSet uses it to fail a dead replica's in-flight requests
+        # over to a healthy one without polling.
+        self._on_finish: Optional[Callable[["Request"], None]] = None
 
         # Chunked-prefill bookkeeping (engine thread only): the per-request
         # rng key is fixed at admission because every chunk call replays the
@@ -143,6 +148,13 @@ class Request:
         self.error = error
         self.finished_at = time.monotonic()
         self._done.set()
+        if self._on_finish is not None:
+            try:
+                self._on_finish(self)
+            except Exception:
+                # The hook belongs to the router layer; a raising hook must
+                # not take down the engine thread finishing the request.
+                pass
 
     def __repr__(self):
         return (f"Request(S={self.prompt_ids.shape[1]}, "

@@ -1,0 +1,496 @@
+"""Multi-replica routing and fault-tolerant failover over serving engines.
+
+One :class:`~.engine.ServingEngine` is both a capacity ceiling and a
+single point of failure: its fixed ``[max_slots, max_len]`` decode state
+bounds concurrency, and its single engine thread dying fails every
+in-flight stream. The :class:`ReplicaSet` is the serving-side analogue of
+data-parallel sharding over the device mesh — N independently compiled,
+independently failing engine replicas behind one submit surface:
+
+* **Routing** — least-loaded: a new request goes to the healthy replica
+  with the most free decode slots (ties broken by total occupancy
+  ``engine.load``, then index). When the best replica's admission queue
+  is full the next one is tried; only when EVERY healthy replica is
+  saturated does the router surface :class:`~.scheduler.QueueFull` — the
+  signal the gateway maps to HTTP 429.
+* **Health** — per-replica :class:`ReplicaState`:
+  HEALTHY (in rotation) → DRAINING (out of rotation, finishing its
+  streams — operator-initiated via :meth:`ReplicaSet.drain_replica`) →
+  FAILED (fenced). Health is refreshed lazily on every routing decision
+  and metrics read — an engine whose run loop recorded a fatal error is
+  demoted without any monitor thread.
+* **Failover** — a replica whose run loop raises fails every request it
+  held (the engine's own cleanup path). The router hooks each request's
+  terminal transition: when the cause of death was the ENGINE (not the
+  request), the replica is fenced and the request is resubmitted to a
+  healthy replica as ``prompt + tokens_emitted_so_far``, so the stream
+  RESUMES — no token is re-emitted, none is lost. Re-prefilling the
+  grown prompt is exactly the work the chunk-aligned prefix cache makes
+  cheap. For greedy decoding the resumed stream is token-identical to an
+  uninterrupted one (prefill's first-token selection at position
+  ``len - 1`` is the same computation as the decode step there); sampled
+  streams resume without duplicates or gaps but restart the rng chain at
+  the failover point, so the continuation is a fresh draw.
+
+The caller-facing handle is a :class:`FleetRequest`: it survives
+failovers (accumulating tokens across however many inner
+:class:`~.request.Request` flights it takes) while mirroring the Request
+API — ``tokens``, ``wait``, ``result``, ``output_ids``, ``cancel``.
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+import time
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+from .engine import ServingEngine
+from .metrics import ServingStats
+from .request import Request, RequestStatus
+from .scheduler import QueueFull
+
+__all__ = ["ReplicaSet", "ReplicaState", "FleetRequest"]
+
+
+class ReplicaState(enum.Enum):
+    HEALTHY = "healthy"     # in rotation, taking new requests
+    DRAINING = "draining"   # out of rotation, finishing in-flight streams
+    FAILED = "failed"       # fenced: run loop died or operator killed it
+
+
+class _Replica:
+    """One engine plus its routing state (router internals)."""
+
+    def __init__(self, index: int, engine: ServingEngine):
+        self.index = index
+        self.engine = engine
+        self.state = ReplicaState.HEALTHY
+        self.failures = 0  # requests this replica failed over FROM
+
+    def __repr__(self):
+        return (f"_Replica({self.index}, {self.state.value}, "
+                f"free={self.engine.free_slots})")
+
+
+class FleetRequest:
+    """Router-level handle for one generation, stable across failovers.
+
+    Tokens stream into :attr:`tokens` (and through ``on_token``) exactly
+    once each, no matter how many replicas the request visits; the
+    per-flight inner :class:`~.request.Request` objects are an
+    implementation detail. The per-request deadline is GLOBAL — time
+    spent on a replica that later died still counts against ``timeout``.
+    """
+
+    def __init__(self, prompt_ids, max_new_tokens: int = 20,
+                 rng=None, seed: Optional[int] = None,
+                 timeout: Optional[float] = None,
+                 on_token: Optional[Callable[[int], None]] = None,
+                 ignore_eos: bool = False):
+        # Reuse Request's prompt validation (shape + max_new bounds).
+        proto = Request(prompt_ids, max_new_tokens=max_new_tokens)
+        self.prompt_ids = proto.prompt_ids
+        self.max_new_tokens = proto.max_new_tokens
+        self.rng = rng
+        self.seed = seed
+        self.timeout = timeout
+        self.on_token = on_token
+        self.ignore_eos = ignore_eos
+
+        self.tokens: list[int] = []
+        self.status = RequestStatus.QUEUED
+        self.error: Optional[BaseException] = None
+        #: replica indices this request ran on, in order (one entry when no
+        #: failover happened; the failover test asserts on its length).
+        self.replica_trail: list[int] = []
+
+        self.submitted_at: Optional[float] = None
+        self.first_token_at: Optional[float] = None
+        self.finished_at: Optional[float] = None
+
+        self._cancel_requested = False
+        self._done = threading.Event()
+        self._lock = threading.Lock()
+        self._inner: Optional[Request] = None
+
+    # -- caller API (mirrors Request) -----------------------------------
+    def cancel(self):
+        """Cancel the current flight; honored at the owning engine's next
+        scheduler pass, and suppresses any further failover."""
+        self._cancel_requested = True
+        with self._lock:
+            inner = self._inner
+        if inner is not None:
+            inner.cancel()
+
+    @property
+    def cancel_requested(self) -> bool:
+        return self._cancel_requested
+
+    @property
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    @property
+    def failovers(self) -> int:
+        """How many times this request was resubmitted after a replica
+        died (0 for an uninterrupted stream)."""
+        return max(0, len(self.replica_trail) - 1)
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._done.wait(timeout)
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        """Generated token ids [n] (prompt excluded), blocking until done;
+        same error contract as :meth:`Request.result`."""
+        if not self._done.wait(timeout):
+            raise TimeoutError("request still in flight")
+        if self.status != RequestStatus.COMPLETED:
+            raise RuntimeError(
+                f"request {self.status.value}"
+                + (f": {self.error}" if self.error is not None else "")
+            ) from self.error
+        return np.asarray(self.tokens, np.int32)
+
+    def output_ids(self, timeout: Optional[float] = None) -> np.ndarray:
+        """[1, S + n] prompt + completion — the offline ``generate`` shape."""
+        toks = self.result(timeout)
+        return np.concatenate([self.prompt_ids, toks[None, :]], axis=1)
+
+    # -- router internals ------------------------------------------------
+    def _emit(self, token: int):
+        """Inner on_token trampoline: runs on whichever engine thread owns
+        the current flight. Exceptions propagate so the engine applies its
+        normal callback-failure isolation (fail THIS request only)."""
+        if self.first_token_at is None:
+            self.first_token_at = time.monotonic()
+        self.tokens.append(token)
+        if self.on_token is not None:
+            self.on_token(token)
+
+    def _remaining_new_tokens(self) -> int:
+        return self.max_new_tokens - len(self.tokens)
+
+    def _remaining_timeout(self, now: Optional[float] = None) -> Optional[float]:
+        if self.timeout is None:
+            return None
+        now = time.monotonic() if now is None else now
+        return self.submitted_at + self.timeout - now
+
+    def _resume_prompt(self) -> np.ndarray:
+        """``prompt + tokens_emitted_so_far`` — the failover prompt whose
+        re-prefill resumes the stream with zero duplicated tokens."""
+        if not self.tokens:
+            return self.prompt_ids
+        return np.concatenate(
+            [self.prompt_ids, np.asarray(self.tokens, np.int32)[None, :]],
+            axis=1)
+
+    def _finish(self, status: RequestStatus,
+                error: Optional[BaseException] = None):
+        with self._lock:
+            if self._done.is_set():  # first terminal transition wins
+                return
+            self.status = status
+            self.error = error
+            self.finished_at = time.monotonic()
+            self._done.set()
+
+    def __repr__(self):
+        return (f"FleetRequest(S={self.prompt_ids.shape[1]}, "
+                f"max_new={self.max_new_tokens}, status={self.status.value}, "
+                f"tokens={len(self.tokens)}, trail={self.replica_trail})")
+
+
+class ReplicaSet:
+    """N serving-engine replicas behind one submit surface.
+
+    Args:
+      engines: the replicas (already constructed — replicas may differ in
+        placement but MUST share model, sampling config, and eos id, or
+        failover would change the distribution mid-stream).
+      failover_block_s: how long a failover resubmission may block waiting
+        for queue space on a healthy-but-saturated replica before the
+        request is failed outright. The wait runs on the dead engine's
+        exiting thread, so it only delays that replica's remaining
+        cleanup, never live traffic.
+      max_failovers: per-request cap on resubmissions (default: one per
+        OTHER replica) — a request that somehow keeps landing on dying
+        replicas fails instead of bouncing forever.
+
+    Use as a context manager, or call :meth:`shutdown`.
+    """
+
+    def __init__(self, engines: Sequence[ServingEngine], *,
+                 failover_block_s: float = 5.0,
+                 max_failovers: Optional[int] = None):
+        engines = list(engines)
+        if not engines:
+            raise ValueError("ReplicaSet needs at least one engine")
+        eos = {e.eos_token_id for e in engines}
+        samp = {e._sampling for e in engines}
+        if len(eos) > 1 or len(samp) > 1:
+            raise ValueError(
+                "replicas disagree on sampling config or eos id — failover "
+                f"would change the stream's distribution (eos={eos})")
+        self._replicas = [_Replica(i, e) for i, e in enumerate(engines)]
+        self._failover_block_s = float(failover_block_s)
+        self._max_failovers = (len(engines) - 1 if max_failovers is None
+                               else int(max_failovers))
+        self._lock = threading.Lock()
+        self._submitted = 0
+        self._failovers = 0      # fence-and-resubmit events (per request)
+        self._fences = 0         # replicas demoted to FAILED
+        self._failover_failed = 0  # resubmissions that found no home
+
+    @classmethod
+    def from_factory(cls, factory: Callable[[], ServingEngine],
+                     num_replicas: int, **kwargs) -> "ReplicaSet":
+        """Build ``num_replicas`` engines by calling ``factory()`` that
+        many times (each call should construct an independent engine —
+        sharing params between them is fine and saves host memory)."""
+        if num_replicas < 1:
+            raise ValueError(f"num_replicas must be >= 1 (got {num_replicas})")
+        return cls([factory() for _ in range(num_replicas)], **kwargs)
+
+    # -- introspection ---------------------------------------------------
+    def __len__(self) -> int:
+        return len(self._replicas)
+
+    @property
+    def replicas(self) -> list[_Replica]:
+        return list(self._replicas)
+
+    def replica_states(self) -> list[ReplicaState]:
+        self.refresh_health()
+        return [r.state for r in self._replicas]
+
+    @property
+    def ready(self) -> bool:
+        """At least one replica is healthy and accepting — the gateway's
+        ``/readyz`` condition."""
+        return bool(self._candidates())
+
+    def engine(self, index: int) -> ServingEngine:
+        return self._replicas[index].engine
+
+    # -- health ----------------------------------------------------------
+    def refresh_health(self):
+        """Demote any replica whose engine died since the last look. Lazy —
+        called on every routing decision and metrics read, so there is no
+        monitor thread to keep alive (or to crash)."""
+        for r in self._replicas:
+            if r.state is not ReplicaState.FAILED and r.engine.error is not None:
+                self._fence(r)
+
+    def _fence(self, replica: _Replica):
+        with self._lock:
+            if replica.state is ReplicaState.FAILED:
+                return
+            replica.state = ReplicaState.FAILED
+            self._fences += 1
+
+    def drain_replica(self, index: int):
+        """Take one replica out of rotation (e.g. before maintenance): no
+        new requests route to it, in-flight streams finish normally. Shut
+        the engine down once ``engine(i).free_slots == max_slots``."""
+        r = self._replicas[index]
+        if r.state is ReplicaState.HEALTHY:
+            r.state = ReplicaState.DRAINING
+
+    def kill_replica(self, index: int,
+                     error: Optional[BaseException] = None):
+        """Fault injection / hard fencing: make replica ``index``'s run
+        loop raise at its next iteration (see ``ServingEngine.kill``). Its
+        in-flight requests fail over to the surviving replicas."""
+        self._replicas[index].engine.kill(error)
+
+    # -- routing ---------------------------------------------------------
+    def _candidates(self) -> list[_Replica]:
+        """Healthy replicas, best-first: most free decode slots, then
+        lowest total occupancy, then index (stable)."""
+        self.refresh_health()
+        cands = [r for r in self._replicas
+                 if r.state is ReplicaState.HEALTHY and r.engine.healthy]
+        cands.sort(key=lambda r: (-r.engine.free_slots, r.engine.load,
+                                  r.index))
+        return cands
+
+    def submit(self, prompt_ids=None, *, max_new_tokens: int = 20,
+               seed: Optional[int] = None, rng=None,
+               timeout: Optional[float] = None, on_token=None,
+               ignore_eos: bool = False, block: bool = False,
+               block_timeout: Optional[float] = None) -> FleetRequest:
+        """Route one request to the least-loaded healthy replica; returns
+        a :class:`FleetRequest` immediately. Raises
+        :class:`~.scheduler.QueueFull` when every healthy replica's
+        admission queue is full (``block=True`` waits for space on the
+        best one first, up to ``block_timeout``), and ``RuntimeError``
+        when no replica is healthy at all."""
+        fleet = FleetRequest(prompt_ids, max_new_tokens=max_new_tokens,
+                             rng=rng, seed=seed, timeout=timeout,
+                             on_token=on_token, ignore_eos=ignore_eos)
+        fleet.submitted_at = time.monotonic()
+        with self._lock:
+            self._submitted += 1
+        self._dispatch(fleet, block=block, block_timeout=block_timeout)
+        return fleet
+
+    def _dispatch(self, fleet: FleetRequest, *, block: bool,
+                  block_timeout: Optional[float], _raise: bool = True):
+        """Try candidates best-first with non-blocking submits; only after
+        ALL are queue-full does ``block=True`` wait on the current best.
+        With ``_raise=False`` (failover path, running on a dead engine's
+        thread) failures finish the fleet request instead of raising."""
+        last_exc: Optional[BaseException] = None
+        saturated = False
+        for attempt in range(2):
+            for r in self._candidates():
+                inner = self._make_inner(fleet, r)
+                if inner is None:  # cancelled or deadline passed meanwhile
+                    return
+                try:
+                    r.engine.submit(
+                        request=inner,
+                        block=block and attempt > 0,
+                        block_timeout=block_timeout)
+                except QueueFull as e:
+                    last_exc, saturated = e, True
+                    continue
+                except RuntimeError as e:
+                    # Died between the health check and the enqueue.
+                    last_exc = e
+                    self._fence(r)
+                    continue
+                with fleet._lock:
+                    fleet._inner = inner
+                fleet.replica_trail.append(r.index)
+                if fleet.cancel_requested:
+                    inner.cancel()  # cancel raced the dispatch
+                return
+            if not (block and saturated):
+                break
+        if _raise:
+            if saturated:
+                raise QueueFull(
+                    "every healthy replica's admission queue is full; "
+                    "retry later") from last_exc
+            raise RuntimeError(
+                "no healthy replica available") from last_exc
+        with self._lock:
+            self._failover_failed += 1
+        fleet._finish(RequestStatus.FAILED, RuntimeError(
+            "failover found no healthy replica with queue space")
+            if last_exc is None else last_exc)
+
+    def _make_inner(self, fleet: FleetRequest,
+                    replica: _Replica) -> Optional[Request]:
+        """Build the next flight: the remaining-budget request whose prompt
+        is ``original + emitted`` (so token budgets, deadline, and KV
+        occupancy all add up to exactly the uninterrupted request's)."""
+        if fleet.cancel_requested:
+            fleet._finish(RequestStatus.CANCELLED)
+            return None
+        remaining_t = fleet._remaining_timeout()
+        if remaining_t is not None and remaining_t <= 0:
+            fleet._finish(RequestStatus.TIMED_OUT)
+            return None
+        inner = Request(fleet._resume_prompt(),
+                        max_new_tokens=fleet._remaining_new_tokens(),
+                        rng=fleet.rng, seed=fleet.seed,
+                        timeout=remaining_t, on_token=fleet._emit,
+                        ignore_eos=fleet.ignore_eos)
+        inner._on_finish = lambda req: self._on_inner_finish(
+            fleet, replica, req)
+        return inner
+
+    # -- failover ---------------------------------------------------------
+    def _on_inner_finish(self, fleet: FleetRequest, replica: _Replica,
+                         inner: Request):
+        """Runs ON THE ENGINE THREAD at the inner request's terminal
+        transition. Engine-death failures fence the replica and resubmit;
+        everything else (completion, cancellation, deadline, a raising
+        user callback) passes through to the fleet handle."""
+        if inner.status is RequestStatus.FAILED \
+                and replica.engine.error is not None \
+                and not fleet.cancel_requested:
+            self._fence(replica)
+            if fleet.failovers >= self._max_failovers:
+                fleet._finish(RequestStatus.FAILED, RuntimeError(
+                    f"request failed over {fleet.failovers} times "
+                    "(max_failovers reached)"))
+                return
+            with self._lock:
+                self._failovers += 1
+                replica.failures += 1
+            self._dispatch(fleet, block=True,
+                           block_timeout=self._failover_block_s,
+                           _raise=False)
+            return
+        fleet._finish(inner.status, inner.error)
+
+    # -- metrics ----------------------------------------------------------
+    def merged_stats(self) -> ServingStats:
+        """A fresh :class:`ServingStats` holding the fleet-wide fold of
+        every replica's counters (see ``ServingStats.merge``)."""
+        merged = ServingStats()
+        for r in self._replicas:
+            merged.merge(r.engine.stats)
+        return merged
+
+    def fleet_metrics(self) -> dict:
+        """Merged engine summary plus router-level counters (replica
+        states, failover/fence counts) — the dict behind ``/metrics``."""
+        self.refresh_health()
+        out = self.merged_stats().summary()
+        states = [r.state for r in self._replicas]
+        with self._lock:
+            out.update({
+                "replicas": len(self._replicas),
+                "replicas_healthy": sum(
+                    s is ReplicaState.HEALTHY for s in states),
+                "replicas_draining": sum(
+                    s is ReplicaState.DRAINING for s in states),
+                "replicas_failed": sum(
+                    s is ReplicaState.FAILED for s in states),
+                "fleet_submitted": self._submitted,
+                "fleet_failovers": self._failovers,
+                "fleet_fences": self._fences,
+                "fleet_failover_failed": self._failover_failed,
+                "fleet_free_slots": sum(
+                    r.engine.free_slots for r in self._replicas
+                    if r.state is ReplicaState.HEALTHY and r.engine.healthy),
+            })
+        return out
+
+    # -- lifecycle --------------------------------------------------------
+    def drain(self):
+        """Stop routing new work everywhere (all HEALTHY → DRAINING);
+        in-flight streams keep running. The gateway's SIGTERM path."""
+        for r in self._replicas:
+            if r.state is ReplicaState.HEALTHY:
+                r.state = ReplicaState.DRAINING
+
+    def shutdown(self, drain: bool = True, timeout: Optional[float] = None):
+        """Shut every replica down (``drain=True`` finishes accepted work
+        first). Replicas that already died are fenced, not re-raised —
+        their error was already delivered to their requests."""
+        first_exc: Optional[BaseException] = None
+        for r in self._replicas:
+            try:
+                r.engine.shutdown(drain=drain, timeout=timeout)
+            except RuntimeError as e:
+                self._fence(r)
+                if r.engine.error is None and first_exc is None:
+                    first_exc = e
+        if first_exc is not None:
+            raise first_exc
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown(drain=exc[0] is None)

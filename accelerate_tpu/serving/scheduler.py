@@ -23,7 +23,8 @@ Three small host-side structures, deliberately independent of jax:
 from __future__ import annotations
 
 import collections
-import queue
+import threading
+import time
 from typing import Optional
 
 from .request import Request
@@ -33,43 +34,92 @@ class QueueFull(RuntimeError):
     """Raised by non-blocking submit when the admission queue is at bound."""
 
 
+class QueueClosed(RuntimeError):
+    """Raised by ``put`` when the engine behind the queue has stopped — the
+    request can never be served, so the caller (blocked or not) is woken
+    with this instead of enqueueing onto (or hanging against) a dead
+    engine."""
+
+
 class AdmissionQueue:
     """Bounded FCFS request queue (thread-safe; many producers, one engine
-    consumer)."""
+    consumer).
+
+    Built on a condition pair rather than ``queue.Queue`` so the consumer
+    can :meth:`close` it: a producer blocked in ``put(block=True)`` against
+    a full queue is woken with :class:`QueueClosed` the moment the engine
+    stops, instead of sleeping forever on space that will never free.
+    """
 
     def __init__(self, max_queued: int = 64):
         if max_queued < 1:
             raise ValueError(f"max_queued must be >= 1 (got {max_queued})")
         self.max_queued = int(max_queued)
-        self._q: queue.Queue[Request] = queue.Queue(maxsize=self.max_queued)
+        self._items: collections.deque[Request] = collections.deque()
+        self._lock = threading.Lock()
+        self._not_full = threading.Condition(self._lock)
+        self._not_empty = threading.Condition(self._lock)
+        self._closed = False
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
 
     def put(self, request: Request, block: bool = True,
             timeout: Optional[float] = None):
         """Enqueue; raises :class:`QueueFull` on backpressure (immediately
-        when ``block=False``, after ``timeout`` otherwise)."""
-        try:
-            self._q.put(request, block=block, timeout=timeout)
-        except queue.Full:
+        when ``block=False``, after ``timeout`` otherwise) and
+        :class:`QueueClosed` — immediately, or mid-wait — once the engine
+        has stopped."""
+        with self._lock:
+            deadline = None if timeout is None else time.monotonic() + timeout
+            while True:
+                if self._closed:
+                    raise QueueClosed(
+                        "serving engine stopped; the admission queue is "
+                        "closed and will never drain")
+                if len(self._items) < self.max_queued:
+                    self._items.append(request)
+                    self._not_empty.notify()
+                    return
+                if not block:
+                    break
+                remaining = (None if deadline is None
+                             else deadline - time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    break
+                self._not_full.wait(remaining)
             raise QueueFull(
                 f"admission queue full ({self.max_queued} requests queued); "
-                "retry later or submit with block=True") from None
+                "retry later or submit with block=True")
 
     def get(self, timeout: Optional[float] = None) -> Optional[Request]:
-        """Pop the oldest request, or None after ``timeout`` (engine poll)."""
-        try:
-            return self._q.get(block=timeout is not None and timeout > 0,
-                               timeout=timeout)
-        except queue.Empty:
-            return None
+        """Pop the oldest request, or None after ``timeout`` (engine poll).
+        Close does not interrupt gets — the engine keeps draining what is
+        already queued during shutdown."""
+        with self._lock:
+            if not self._items and timeout is not None and timeout > 0:
+                self._not_empty.wait(timeout)
+            if not self._items:
+                return None
+            item = self._items.popleft()
+            self._not_full.notify()
+            return item
 
     def get_nowait(self) -> Optional[Request]:
-        try:
-            return self._q.get_nowait()
-        except queue.Empty:
-            return None
+        return self.get()
+
+    def close(self):
+        """Mark the queue dead (engine stopped) and wake every producer
+        blocked in ``put`` with :class:`QueueClosed`. Items already queued
+        stay poppable so the shutdown path can drain and finish them."""
+        with self._lock:
+            self._closed = True
+            self._not_full.notify_all()
+            self._not_empty.notify_all()
 
     def __len__(self) -> int:
-        return self._q.qsize()
+        return len(self._items)
 
     def drain(self) -> list[Request]:
         """Remove and return everything currently queued (shutdown path)."""
@@ -153,6 +203,7 @@ class PrefixCache:
         self._bytes = 0
         self.insertions = 0
         self.evictions = 0
+        self.oversize_rejects = 0
 
     def __len__(self) -> int:
         return len(self._entries)
@@ -177,12 +228,15 @@ class PrefixCache:
     def put(self, key, block, nbytes: int):
         """Insert one chunk's block (touch if already present), then evict
         least-recently-used entries until within capacity. A block larger
-        than the whole capacity is not admitted."""
+        than the whole capacity is rejected outright — admitting it would
+        evict EVERY resident entry and still not fit, so the cache keeps
+        what it has and counts the reject instead."""
         if key in self._entries:
             self._entries.move_to_end(key)
             return
         nbytes = int(nbytes)
         if nbytes > self.capacity_bytes:
+            self.oversize_rejects += 1
             return
         self._entries[key] = (block, nbytes)
         self._bytes += nbytes
@@ -199,3 +253,4 @@ class PrefixCache:
         self._bytes = 0
         self.insertions = 0
         self.evictions = 0
+        self.oversize_rejects = 0

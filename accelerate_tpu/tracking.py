@@ -435,6 +435,30 @@ def with_serving_metrics(values: dict, serving_stats, prefix: str = "serving/") 
     return merged
 
 
+def with_gateway_metrics(values: dict, gateway_stats, prefix: str = "gateway/") -> dict:
+    """Merge the HTTP gateway's counters (``http_requests``/``http_2xx``/
+    ``http_429``/``streams``/``tokens_streamed``, see
+    ``serving.metrics.GatewayStats``) into a tracker payload under
+    ``prefix``. User-provided keys always win on collision."""
+    if gateway_stats is None:
+        return values
+    merged = {f"{prefix}{k}": v for k, v in gateway_stats.summary().items()}
+    merged.update(values)
+    return merged
+
+
+def with_fleet_metrics(values: dict, replica_set, prefix: str = "fleet/") -> dict:
+    """Merge a replica set's fleet view (the ``ServingStats.merge`` fold of
+    every replica plus router health/failover counters, see
+    ``serving.router.ReplicaSet.fleet_metrics``) into a tracker payload
+    under ``prefix``. User-provided keys always win on collision."""
+    if replica_set is None:
+        return values
+    merged = {f"{prefix}{k}": v for k, v in replica_set.fleet_metrics().items()}
+    merged.update(values)
+    return merged
+
+
 def filter_trackers(log_with, logging_dir: Optional[str] = None):
     """Resolve requested tracker names to available ones (reference:
     tracking.py:971)."""

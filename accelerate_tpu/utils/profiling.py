@@ -146,7 +146,7 @@ class ProfileSession:
 
     def __init__(self, kwargs: "ProfileKwargs", log_dir: Optional[str] = None,
                  pipeline_stats: Optional[PipelineStats] = None,
-                 serving_stats=None):
+                 serving_stats=None, gateway_stats=None):
         self.kwargs = kwargs
         self.log_dir = log_dir or kwargs.output_trace_dir or "./jax_trace"
         sched = kwargs.schedule_option or {}
@@ -161,6 +161,7 @@ class ProfileSession:
         # attach_serving_stats).
         self.pipeline_stats = pipeline_stats
         self.serving_stats = serving_stats
+        self.gateway_stats = gateway_stats
         self._step_breakdowns: list[dict] = []
 
     def _should_trace(self) -> bool:
@@ -205,15 +206,25 @@ class ProfileSession:
         self.serving_stats = stats
         return self
 
+    def attach_gateway_stats(self, stats):
+        """Attach HTTP gateway counters (``serving.metrics.GatewayStats``)
+        so ``step()`` snapshots them under ``gateway/`` keys."""
+        self.gateway_stats = stats
+        return self
+
     def step(self):
         """Advance the schedule (reference: torch profiler .step())."""
-        if self.pipeline_stats is not None or self.serving_stats is not None:
+        if (self.pipeline_stats is not None or self.serving_stats is not None
+                or self.gateway_stats is not None):
             snap = {"step": self._step}
             if self.pipeline_stats is not None:
                 snap.update(self.pipeline_stats.summary())
             if self.serving_stats is not None:
                 snap.update({f"serving/{k}": v
                              for k, v in self.serving_stats.summary().items()})
+            if self.gateway_stats is not None:
+                snap.update({f"gateway/{k}": v
+                             for k, v in self.gateway_stats.summary().items()})
             self._step_breakdowns.append(snap)
         self._step += 1
         should = self._should_trace()
@@ -237,6 +248,13 @@ class ProfileSession:
         if self.serving_stats is None:
             return {}
         return self.serving_stats.summary()
+
+    def gateway_breakdown(self) -> dict:
+        """Latest HTTP-gateway breakdown (http_requests/http_429/streams/
+        tokens_streamed, …); empty when no gateway stats are attached."""
+        if self.gateway_stats is None:
+            return {}
+        return self.gateway_stats.summary()
 
     @property
     def step_breakdowns(self) -> list[dict]:

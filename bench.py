@@ -629,13 +629,172 @@ def prefix_cache_hit_bench(prompt_len: int = 33, prefill_chunk: int = 8,
     }
 
 
+def _post_stream_ttft(url: str, payload: dict, timeout: float = 60.0):
+    """POST a streaming completion and return (ttft_s, tokens, final_event):
+    time from request send to the first SSE token event, the streamed
+    token list, and the final summary event."""
+    import json
+    import urllib.request
+
+    req = urllib.request.Request(
+        url + "/v1/completions",
+        data=json.dumps(dict(payload, stream=True)).encode(),
+        headers={"Content-Type": "application/json"})
+    tokens, final, ttft = [], None, None
+    t0 = time.perf_counter()
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        for line in resp:
+            line = line.strip()
+            if not line.startswith(b"data: "):
+                continue
+            ev = json.loads(line[6:])
+            if ev.get("done"):
+                final = ev
+                break
+            if ttft is None:
+                ttft = time.perf_counter() - t0
+            tokens.append(ev["token"])
+    return ttft, tokens, final
+
+
+def gateway_overhead_bench(n_requests: int = 8, prompt_len: int = 4,
+                           max_new_tokens: int = 8,
+                           step_ms: float = 5.0) -> dict:
+    """Closed-loop HTTP load against the gateway vs direct
+    ``engine.submit`` on the SAME warmed engine: sequential requests, p95
+    TTFT each way. The sleepy model pins per-token cost to a real-model
+    magnitude so the ratio measures the HTTP+routing layer against real
+    work, not against a ~50µs tiny-model forward where any socket
+    round-trip would look catastrophic. The perf guard pins the ratio."""
+    import jax
+    import numpy as np
+
+    from accelerate_tpu.models.llama import LlamaConfig
+    from accelerate_tpu.serving import (
+        GatewayConfig,
+        ReplicaSet,
+        ServingEngine,
+        ServingGateway,
+    )
+
+    model = _sleepy_llama_cls(step_ms)(LlamaConfig.tiny())
+    params = model.init_params(jax.random.PRNGKey(0))
+    engine = ServingEngine(model, params, max_slots=4, max_len=64,
+                           prefill_chunk=16, prefix_cache_mb=0.0)
+    rng = np.random.default_rng(0)
+    prompts = rng.integers(1, 200, size=(n_requests, prompt_len)).astype(np.int32)
+    gw = ServingGateway(ReplicaSet([engine]),
+                        config=GatewayConfig(port=0))
+    gw.start()
+    try:
+        direct_ttft, http_ttft = [], []
+        # One untimed exchange per path: first HTTP hit pays connection /
+        # handler-thread setup that steady-state traffic never sees again.
+        engine.submit(prompts[0:1], max_new_tokens=2, seed=0,
+                      block=True).wait(timeout=60)
+        _post_stream_ttft(gw.url, {"prompt": prompts[0].tolist(),
+                                   "max_new_tokens": 2, "seed": 0})
+        for i in range(n_requests):
+            r = engine.submit(prompts[i:i + 1],
+                              max_new_tokens=max_new_tokens, seed=i,
+                              block=True)
+            r.wait(timeout=60)
+            direct_ttft.append(r.first_token_at - r.submitted_at)
+        for i in range(n_requests):
+            ttft, toks, final = _post_stream_ttft(
+                gw.url, {"prompt": prompts[i].tolist(),
+                         "max_new_tokens": max_new_tokens, "seed": i})
+            http_ttft.append(ttft)
+    finally:
+        gw.shutdown()
+
+    def p95(xs):
+        return sorted(xs)[min(len(xs) - 1, int(round(0.95 * (len(xs) - 1))))]
+
+    d95, h95 = p95(direct_ttft) * 1e3, p95(http_ttft) * 1e3
+    return {
+        "n_requests": n_requests,
+        "step_ms": step_ms,
+        "direct_ttft_ms_p95": round(d95, 3),
+        "http_ttft_ms_p95": round(h95, 3),
+        "overhead_ratio_p95": round(h95 / d95, 3) if d95 else None,
+    }
+
+
+def replica_failover_bench(n_inflight: int = 4, step_ms: float = 20.0,
+                           prompt_len: int = 6,
+                           max_new_tokens: int = 24) -> dict:
+    """Kill 1 of 2 replicas with ``n_inflight`` streams in flight and
+    measure failover: recovery time (kill -> every stream finished on the
+    survivor), whether every resumed stream is token-identical to the
+    uninterrupted offline reference (greedy: it must be), and the
+    router's fence/failover counters."""
+    import jax
+    import numpy as np
+
+    from accelerate_tpu import generation
+    from accelerate_tpu.models.llama import LlamaConfig
+    from accelerate_tpu.serving import ReplicaSet, ServingEngine
+
+    model = _sleepy_llama_cls(step_ms)(LlamaConfig.tiny())
+    params = model.init_params(jax.random.PRNGKey(0))
+
+    def factory():
+        return ServingEngine(model, params, max_slots=max(4, n_inflight),
+                             max_len=64, prefill_chunk=16,
+                             prefix_cache_mb=4.0)
+
+    rs = ReplicaSet.from_factory(factory, 2)
+    rng = np.random.default_rng(0)
+    prompts = rng.integers(1, 200,
+                           size=(n_inflight, prompt_len)).astype(np.int32)
+    refs = [np.asarray(generation.generate(
+        model, params, prompts[i:i + 1], max_new_tokens=max_new_tokens)
+        )[0, prompt_len:] for i in range(n_inflight)]
+    try:
+        reqs = [rs.submit(prompts[i:i + 1], max_new_tokens=max_new_tokens,
+                          seed=i) for i in range(n_inflight)]
+        # Let every stream emit a few tokens, then kill the replica that
+        # holds the FIRST request (some requests ride along, some don't —
+        # both paths are exercised).
+        deadline = time.perf_counter() + 60
+        while (min(len(r.tokens) for r in reqs) < 3
+               and time.perf_counter() < deadline):
+            time.sleep(0.01)
+        victim = reqs[0].replica_trail[0]
+        t_kill = time.perf_counter()
+        rs.kill_replica(victim)
+        for r in reqs:
+            r.wait(timeout=120)
+        recovery_s = time.perf_counter() - t_kill
+        exact = all(
+            np.array_equal(np.asarray(r.tokens), refs[i][:len(r.tokens)])
+            for i, r in enumerate(reqs))
+        completed = all(r.status.value == "completed" for r in reqs)
+        fleet = rs.fleet_metrics()
+    finally:
+        rs.shutdown()
+    return {
+        "n_inflight": n_inflight,
+        "step_ms": step_ms,
+        "recovery_s": round(recovery_s, 4),
+        "all_completed": completed,
+        "tokens_exact": bool(exact),
+        "failovers": fleet["fleet_failovers"],
+        "fences": fleet["fleet_fences"],
+        "replicas_failed": fleet["replicas_failed"],
+    }
+
+
 def serving_extra(on_tpu: bool) -> dict:
     """The ``extra.serving`` payload: on CPU the offered-load sweep, the
-    continuous-vs-static staggered-arrival comparison, and the
+    continuous-vs-static staggered-arrival comparison, the
     chunked-prefill pair — admission-interference A/B plus the
-    prefix-cache hit check (cheap, tiny model); on TPU skipped — serving
-    the tier-1 model is its own benchmark, not a rider on the training
-    run (no extra compiles over the tunnel)."""
+    prefix-cache hit check — and the gateway pair — HTTP-overhead-vs-
+    direct-submit plus the replica-kill failover drill (cheap, tiny
+    model); on TPU skipped — serving the tier-1 model is its own
+    benchmark, not a rider on the training run (no extra compiles over
+    the tunnel)."""
     if on_tpu:
         return {}
     return {
@@ -644,6 +803,10 @@ def serving_extra(on_tpu: bool) -> dict:
         "chunked_prefill": {
             "interference": chunked_prefill_interference(),
             "prefix_cache": prefix_cache_hit_bench(),
+        },
+        "gateway": {
+            "overhead": gateway_overhead_bench(),
+            "failover": replica_failover_bench(),
         },
     }
 

@@ -39,9 +39,12 @@ GROUPS = [
      "PiPPy-parity staged inference over the pp axis."),
     ("serving", "Serving",
      ["accelerate_tpu.serving.engine", "accelerate_tpu.serving.request",
-      "accelerate_tpu.serving.scheduler", "accelerate_tpu.serving.metrics"],
+      "accelerate_tpu.serving.scheduler", "accelerate_tpu.serving.metrics",
+      "accelerate_tpu.serving.router", "accelerate_tpu.serving.gateway"],
      "Continuous-batching decode service: slot scheduler, fixed-shape "
-     "prefill/decode programs, request handles, serving counters."),
+     "prefill/decode programs, request handles, serving counters — plus "
+     "the multi-replica router (health states, fault-tolerant failover) "
+     "and the stdlib HTTP gateway in front of it."),
     ("data_loader", "Data loading", ["accelerate_tpu.data_loader"],
      "Sharded/dispatched loaders, global-batch assembly, skip/resume, packing."),
     ("optimizer_scheduler", "Optimizer & scheduler",

@@ -57,6 +57,7 @@ LANES: dict[str, tuple[int, list[str]]] = {
         "test_pipeline.py",
         "test_quantization.py",
         "test_serving.py",
+        "test_serving_gateway.py",
     ]),
     "subproc": (12, [
         "test_cli.py",

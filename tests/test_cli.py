@@ -160,8 +160,8 @@ class TestConfigBackcompat:
 class TestCLISubprocess:
     def test_help_lists_all_subcommands(self):
         out = _run_cli("--help")
-        for cmd in ["config", "env", "estimate-memory", "launch", "merge-weights", "test",
-                    "tpu-config"]:
+        for cmd in ["config", "env", "estimate-memory", "launch", "merge-weights", "serve",
+                    "test", "tpu-config"]:
             assert cmd in out.stdout
 
     def test_config_default_and_env(self, tmp_path):
@@ -303,6 +303,59 @@ class TestCLISubprocess:
         assert out.returncode == 0, out.stderr
         merged = load_file(str(out_path))
         assert set(merged) == {"a.w", "b.w"}
+
+    def test_serve_help(self):
+        out = _run_cli("serve", "--help")
+        assert out.returncode == 0, out.stderr
+        for flag in ["--model", "--replicas", "--port", "--max-slots"]:
+            assert flag in out.stdout
+
+    @pytest.mark.slow
+    def test_serve_tiny_end_to_end(self):
+        """`accelerate-tpu serve --model tiny --port 0`: the process must
+        announce its OS-assigned URL, answer a real completion + /readyz
+        over HTTP, then drain cleanly on SIGTERM (exit 0, 'bye' printed)."""
+        import json as _json
+        import re
+        import signal
+        import urllib.request
+
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "accelerate_tpu.commands.accelerate_cli",
+             "serve", "--model", "tiny", "--replicas", "1", "--port", "0",
+             "--max-slots", "2", "--max-len", "64", "--prefill-chunk", "32",
+             "--eos-token-id", "7"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            cwd=os.path.dirname(os.path.dirname(__file__)))
+        try:
+            url = None
+            for line in proc.stdout:  # warmup chatter, then the URL line
+                m = re.search(r"serving on (http://\S+)", line)
+                if m:
+                    url = m.group(1)
+                    break
+            assert url, "serve never announced its URL"
+            req = urllib.request.Request(
+                url + "/v1/completions",
+                data=_json.dumps({"prompt": [3, 5, 7, 11],
+                                  "max_new_tokens": 4}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                assert resp.status == 200
+                body = _json.loads(resp.read())
+            assert body["status"] == "completed"
+            assert 1 <= len(body["tokens"]) <= 4
+            with urllib.request.urlopen(url + "/readyz", timeout=10) as resp:
+                assert resp.status == 200
+            proc.send_signal(signal.SIGTERM)
+            out, err = proc.communicate(timeout=60)
+            assert proc.returncode == 0, err
+            assert "gateway drained; bye" in out
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.communicate(timeout=30)
 
 
 class TestLaunchValidation:

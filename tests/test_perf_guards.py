@@ -349,3 +349,32 @@ class TestChunkedPrefill:
         assert out["tokens_equal"], (
             "restored-prefix decode diverged from the cold run")
         assert out["restored_bytes"] > 0 and out["cache_entries"] >= 1
+
+
+class TestGatewayOverhead:
+    """CPU guard for the HTTP serving layer (bench.gateway_overhead_bench):
+    on the deterministic-sleep model, p95 TTFT through the full gateway
+    stack (HTTP parse -> router -> engine -> SSE first event) must stay
+    within 2x of direct ``engine.submit`` on the same warmed engine — the
+    acceptance bound on what the network front door may cost. Sleep-driven
+    and retried once, same as the other timing guards."""
+
+    @staticmethod
+    def _retry_once(attempt):
+        try:
+            attempt()
+        except AssertionError:
+            attempt()
+
+    @pytest.mark.slow
+    def test_gateway_ttft_within_2x_of_direct_submit(self):
+        def attempt():
+            out = bench.gateway_overhead_bench()
+            assert out["overhead_ratio_p95"] is not None
+            assert out["overhead_ratio_p95"] <= 2.0, (
+                f"gateway p95 TTFT {out['http_ttft_ms_p95']:.1f} ms is "
+                f"{out['overhead_ratio_p95']:.2f}x direct submit "
+                f"({out['direct_ttft_ms_p95']:.1f} ms): the HTTP layer is "
+                "adding more than routing + serialization")
+
+        self._retry_once(attempt)

@@ -44,6 +44,7 @@ from accelerate_tpu.models.llama import LlamaConfig, LlamaForCausalLM  # noqa: E
 from accelerate_tpu.serving import (  # noqa: E402
     AdmissionQueue,
     PrefixCache,
+    QueueClosed,
     QueueFull,
     Request,
     RequestStatus,
@@ -729,5 +730,191 @@ class TestSoak:
             s = eng.serving_metrics()
             assert s["requests_completed"] == 30
             assert s["prefix_cache_hit_chunks"] > 0
+        finally:
+            eng.shutdown(drain=False)
+
+
+class TestLifecycleEdges:
+    """Lifecycle races hardened for the gateway: submits outside the
+    accepting window fail fast, and producers blocked on a full admission
+    queue are woken (with an error) when the engine stops instead of
+    hanging for their full block_timeout."""
+
+    def test_submit_before_start_raises_immediately(self, tiny):
+        _, m, params = tiny
+        eng = ServingEngine(m, params, max_slots=1, max_len=32,
+                            eos_token_id=EOS, autostart=False, warmup=False)
+        try:
+            with pytest.raises(RuntimeError, match="not accepting"):
+                eng.submit([[1, 2]], max_new_tokens=2)
+            eng.start()
+            r = eng.submit([[1, 2]], max_new_tokens=2)
+            assert r.wait(120)
+        finally:
+            eng.shutdown(drain=False)
+        with pytest.raises(RuntimeError, match="not accepting"):
+            eng.submit([[1, 2]], max_new_tokens=2)
+
+    def test_submit_after_shutdown_raises_even_with_block(self, tiny):
+        """block=True must not buy a stopped engine a grace period: the
+        error is immediate, not a block_timeout-long hang."""
+        _, m, params = tiny
+        eng = ServingEngine(m, params, max_slots=1, max_len=32,
+                            eos_token_id=EOS, warmup=False)
+        eng.shutdown(drain=True)
+        t0 = time.monotonic()
+        with pytest.raises(RuntimeError, match="not accepting"):
+            eng.submit([[1]], max_new_tokens=2, block=True, block_timeout=30)
+        assert time.monotonic() - t0 < 5.0
+
+    def test_queue_close_wakes_blocked_put(self):
+        """Unit: a producer parked in put(block=True) on a FULL queue is
+        woken by close() with QueueClosed — not left to ride out its
+        timeout; items already accepted stay drainable."""
+        q = AdmissionQueue(max_queued=1)
+        q.put("held")
+        woke = {}
+
+        def producer():
+            t0 = time.monotonic()
+            try:
+                q.put("late", block=True, timeout=30.0)
+                woke["outcome"] = "accepted"
+            except QueueClosed:
+                woke["outcome"] = "closed"
+            except QueueFull:
+                woke["outcome"] = "full"
+            woke["elapsed"] = time.monotonic() - t0
+
+        t = threading.Thread(target=producer)
+        t.start()
+        time.sleep(0.05)  # parked in the condition wait
+        q.close()
+        t.join(timeout=10)
+        assert not t.is_alive()
+        assert woke["outcome"] == "closed"
+        assert woke["elapsed"] < 5.0
+        assert q.get_nowait() == "held"  # close() does not eat the backlog
+        with pytest.raises(QueueClosed):
+            q.put("post-close")
+
+    @pytest.mark.slow
+    def test_engine_stop_wakes_blocked_submit(self):
+        """End-to-end: a submit(block=True) stuck behind a full admission
+        queue errors out promptly when the engine shuts down underneath
+        it."""
+        import bench
+
+        cfg = LlamaConfig.tiny(use_flash_attention=False)
+        m = bench._sleepy_llama_cls(step_ms=10.0)(cfg)
+        params = m.init_params(jax.random.PRNGKey(0), batch_size=1, seq_len=8)
+        eng = ServingEngine(m, params, max_slots=1, max_len=32, max_queued=1)
+        r_run = eng.submit([[1]], max_new_tokens=30)
+        deadline = time.monotonic() + 60
+        while r_run.status is not RequestStatus.RUNNING \
+                and time.monotonic() < deadline:
+            time.sleep(0.005)  # in its slot -> the 1-deep queue is free
+        r_queued = eng.submit([[2]], max_new_tokens=30)
+        outcome = {}
+
+        def producer():
+            t0 = time.monotonic()
+            try:
+                eng.submit([[3]], max_new_tokens=5, block=True,
+                           block_timeout=60.0)
+                outcome["kind"] = "accepted"
+            except QueueFull:
+                outcome["kind"] = "full"
+            except RuntimeError:
+                outcome["kind"] = "stopped"
+            outcome["elapsed"] = time.monotonic() - t0
+
+        t = threading.Thread(target=producer)
+        t.start()
+        time.sleep(0.1)  # parked in the queue's not_full wait
+        eng.shutdown(drain=False)
+        t.join(timeout=15)
+        assert not t.is_alive(), "blocked submit hung past engine shutdown"
+        assert outcome["kind"] == "stopped"
+        assert outcome["elapsed"] < 10.0
+        for r in (r_run, r_queued):
+            assert r.wait(60)
+            assert r.status in (RequestStatus.CANCELLED, RequestStatus.FAILED)
+
+    def test_prefix_cache_oversize_put_rejected_without_eviction(self):
+        """An oversize block must bounce at the door — never by evicting
+        the whole (useful) cache first."""
+        cache = PrefixCache(capacity_bytes=1024)
+        cache.put(("a",), "blockA", 400)
+        cache.put(("b",), "blockB", 400)
+        assert cache.oversize_rejects == 0
+        cache.put(("huge",), "big", 4096)  # > whole capacity
+        assert cache.oversize_rejects == 1
+        assert cache.match([("huge",)]) == []
+        # The resident entries survived the oversize attempt untouched.
+        assert len(cache) == 2 and cache.nbytes == 800
+        assert cache.match([("a",)]) == ["blockA"]
+        assert cache.match([("b",)]) == ["blockB"]
+        assert cache.evictions == 0
+        cache.clear()
+        assert cache.oversize_rejects == 0
+
+
+class TestConcurrentSubmit:
+    @pytest.mark.slow
+    def test_32_threads_no_lost_or_duplicated_requests(self, tiny):
+        """32 producer threads x 4 submits each hammer one engine; queue
+        bounce (QueueFull) is legal under the bounded queue, but every
+        ACCEPTED request must complete exactly once with an exact stream,
+        and the admission counters must balance to the thread-side tally."""
+        _, m, params = tiny
+        eng = ServingEngine(m, params, max_slots=3, max_len=64,
+                            eos_token_id=EOS, max_queued=256)
+        n_threads, per_thread, n_tok = 32, 4, 6
+        refs = {i: _offline(m, params, p, n_tok)
+                for i, p in enumerate(PROMPTS)}
+        accepted = [[] for _ in range(n_threads)]
+        bounced = [0] * n_threads
+        start = threading.Barrier(n_threads)
+
+        def worker(tid):
+            start.wait()
+            for j in range(per_thread):
+                pi = (tid + j) % len(PROMPTS)
+                try:
+                    r = eng.submit(PROMPTS[pi], max_new_tokens=n_tok)
+                except QueueFull:
+                    bounced[tid] += 1
+                    continue
+                accepted[tid].append((pi, r))
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(n_threads)]
+        before = eng.serving_metrics()
+        try:
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+            assert not any(t.is_alive() for t in threads)
+            flat = [ar for per in accepted for ar in per]
+            for pi, r in flat:
+                assert r.wait(300)
+                assert r.status is RequestStatus.COMPLETED
+                _assert_matches_offline(r.tokens, refs[pi], n_tok)
+            after = eng.serving_metrics()
+            n_acc = len(flat)
+            n_rej = sum(bounced)
+            assert n_acc + n_rej == n_threads * per_thread
+            assert after["requests_submitted"] - before["requests_submitted"] == n_acc
+            assert after["requests_completed"] - before["requests_completed"] == n_acc
+            assert after["requests_rejected"] - before["requests_rejected"] == n_rej
+            # One terminal transition per handle: result() replays, and
+            # output_ids() is exactly prompt + the streamed tokens.
+            for pi, r in flat:
+                full = r.output_ids()
+                S = PROMPTS[pi].shape[1]
+                assert full.shape == (1, S + len(r.tokens))
+                assert list(full[0, S:]) == [int(t) for t in r.tokens]
         finally:
             eng.shutdown(drain=False)

@@ -1,0 +1,633 @@
+"""HTTP serving gateway + multi-replica router (serving.router/gateway).
+
+The acceptance-critical properties pinned here:
+
+* END-TO-END EXACTNESS over real HTTP on localhost: completions (JSON
+  and SSE-streamed) are token-identical to offline
+  ``generation.generate`` for the same (prompt, seed, sampling).
+* FAILOVER — killing 1 of 2 replicas mid-stream resumes every in-flight
+  request on the survivor with ZERO duplicated and ZERO lost tokens
+  (greedy resumption via ``prompt + tokens_emitted`` re-prefill is
+  bit-exact); the dead replica is fenced (HEALTHY -> FAILED) and the
+  router's counters record the event.
+* ROUTING — least-loaded replica selection over free slots, DRAINING
+  replicas out of rotation, QueueFull only when EVERY healthy replica is
+  saturated.
+* HTTP CONTRACT — /healthz, /readyz (503 while draining or with no
+  healthy replica), /metrics in Prometheus text format carrying the
+  fleet-MERGED engine counters; backpressure mapped to status codes
+  (429 + Retry-After on queue-full, 408 on deadline, 413 on body cap,
+  400 on malformed requests); graceful drain semantics.
+
+Every server binds port 0 (OS-assigned ephemeral) — no fixed-port
+flakes. Timing-sensitive failover tests run on bench's deterministic-
+sleep model, like test_serving.py's slow-motion engine.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench  # noqa: E402
+from accelerate_tpu import generation  # noqa: E402
+from accelerate_tpu.models.llama import LlamaConfig, LlamaForCausalLM  # noqa: E402
+from accelerate_tpu.serving import (  # noqa: E402
+    FleetRequest,
+    GatewayConfig,
+    QueueFull,
+    ReplicaSet,
+    ReplicaState,
+    RequestStatus,
+    ServingEngine,
+    ServingGateway,
+    ServingStats,
+)
+
+EOS = 7
+
+PROMPTS = [
+    np.array([[3, 5, 7, 11, 2]], np.int32),
+    np.array([[1, 4, 9]], np.int32),
+    np.array([[8, 6, 4, 2, 10, 12, 14]], np.int32),
+    np.array([[42]], np.int32),
+]
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = LlamaConfig.tiny(use_flash_attention=False)
+    m = LlamaForCausalLM(cfg)
+    params = m.init_params(jax.random.PRNGKey(0), batch_size=2, seq_len=8)
+    return cfg, m, params
+
+
+@pytest.fixture(scope="module")
+def sleepy(tiny):
+    """Deterministic-sleep twin of the tiny model (~15 ms per forward):
+    wide enough slot-occupancy windows to kill a replica mid-stream
+    race-free on any host."""
+    cfg, _, params = tiny
+    m = bench._sleepy_llama_cls(step_ms=15.0)(cfg)
+    return m, params
+
+
+def _offline(m, params, prompt, n, seed=None):
+    rng = None if seed is None else jax.random.PRNGKey(seed)
+    out = generation.generate(m, params, prompt, max_new_tokens=n,
+                              eos_token_id=EOS, rng=rng)
+    return np.asarray(out)[0, prompt.shape[1]:]
+
+
+def _assert_matches_offline(got, ref, n):
+    got = np.asarray(got)
+    assert np.array_equal(got, ref[: len(got)]), (got, ref)
+    if len(got) < n:
+        assert got[-1] == EOS and np.all(ref[len(got):] == EOS), (got, ref)
+
+
+def _fleet(m, params, n=2, **kw):
+    kw.setdefault("max_slots", 3)
+    kw.setdefault("max_len", 64)
+    kw.setdefault("eos_token_id", EOS)
+    return ReplicaSet.from_factory(
+        lambda: ServingEngine(m, params, **kw), n)
+
+
+# -- HTTP helpers ------------------------------------------------------
+def _post(url, payload, timeout=60):
+    req = urllib.request.Request(
+        url + "/v1/completions", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read()), dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), dict(e.headers)
+
+
+def _get(url, path, timeout=10):
+    try:
+        with urllib.request.urlopen(url + path, timeout=timeout) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def _sse(url, payload, timeout=60):
+    """(streamed tokens, final summary event)."""
+    req = urllib.request.Request(
+        url + "/v1/completions",
+        data=json.dumps(dict(payload, stream=True)).encode(),
+        headers={"Content-Type": "application/json"})
+    tokens, final = [], None
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        assert resp.headers["Content-Type"].startswith("text/event-stream")
+        for line in resp:
+            line = line.strip()
+            if not line.startswith(b"data: "):
+                continue
+            ev = json.loads(line[6:])
+            if ev.get("done"):
+                final = ev
+                break
+            tokens.append(ev["token"])
+    return tokens, final
+
+
+@pytest.fixture(scope="module")
+def gateway(tiny):
+    """Shared 2-replica gateway on an ephemeral port (warmup paid once).
+    Only stateless/read-only tests use it; lifecycle tests build their
+    own."""
+    _, m, params = tiny
+    rs = _fleet(m, params, n=2)
+    gw = ServingGateway(rs, config=GatewayConfig(port=0))
+    gw.start()
+    yield gw
+    if gw._server is not None:
+        gw.shutdown(drain=False)
+    elif rs.replicas[0].engine.running:
+        rs.shutdown(drain=False)
+
+
+class TestReplicaSet:
+    @pytest.mark.slow
+    def test_submit_matches_offline(self, tiny):
+        _, m, params = tiny
+        rs = _fleet(m, params, n=2)
+        try:
+            n = 12
+            reqs = [rs.submit(p, max_new_tokens=n, seed=0) for p in PROMPTS]
+            for p, r in zip(PROMPTS, reqs):
+                _assert_matches_offline(r.result(timeout=120),
+                                        _offline(m, params, p, n), n)
+                assert r.failovers == 0 and len(r.replica_trail) == 1
+        finally:
+            rs.shutdown()
+
+    @pytest.mark.slow
+    def test_routing_prefers_free_slots(self, sleepy):
+        m, params = sleepy
+        rs = _fleet(m, params, n=2, max_slots=2)
+        try:
+            # Two long requests land on DIFFERENT replicas: after the first
+            # occupies a slot on its replica, the other replica has more
+            # free slots and must win the next routing decision.
+            r1 = rs.submit(PROMPTS[0], max_new_tokens=30, seed=0)
+            deadline = time.monotonic() + 30
+            while not r1.tokens and time.monotonic() < deadline:
+                time.sleep(0.005)
+            r2 = rs.submit(PROMPTS[1], max_new_tokens=30, seed=0)
+            r1.wait(timeout=120), r2.wait(timeout=120)
+            assert r1.replica_trail[0] != r2.replica_trail[0]
+        finally:
+            rs.shutdown()
+
+    @pytest.mark.slow
+    def test_draining_replica_leaves_rotation(self, tiny):
+        _, m, params = tiny
+        rs = _fleet(m, params, n=2)
+        try:
+            rs.drain_replica(0)
+            assert rs.replica_states()[0] is ReplicaState.DRAINING
+            assert rs.ready  # replica 1 still serves
+            reqs = [rs.submit(p, max_new_tokens=4, seed=0) for p in PROMPTS]
+            for r in reqs:
+                r.result(timeout=120)
+                assert r.replica_trail == [1]
+        finally:
+            rs.shutdown()
+
+    @pytest.mark.slow
+    def test_queue_full_only_when_all_replicas_saturated(self, sleepy):
+        m, params = sleepy
+        rs = _fleet(m, params, n=2, max_slots=1, max_queued=1)
+        try:
+            # 2 replicas x (1 slot + 1 queued) = 4 accepted, 5th bounces.
+            # Let the first pair reach their decode slots before loading
+            # the queues — until a request is admitted, the 1-deep queue
+            # IS the replica's whole capacity.
+            running = [rs.submit(PROMPTS[0], max_new_tokens=30, seed=0)
+                       for _ in range(2)]
+            deadline = time.monotonic() + 60
+            while (min(len(r.tokens) for r in running) < 1
+                   and time.monotonic() < deadline):
+                time.sleep(0.005)
+            assert min(len(r.tokens) for r in running) >= 1
+            reqs = running + [rs.submit(PROMPTS[0], max_new_tokens=30, seed=0)
+                              for _ in range(2)]
+            with pytest.raises(QueueFull):
+                rs.submit(PROMPTS[1], max_new_tokens=2, seed=0)
+            for r in reqs:
+                r.cancel()
+            for r in reqs:
+                r.wait(timeout=120)
+        finally:
+            rs.shutdown(drain=False)
+
+    @pytest.mark.slow
+    def test_merged_stats_sum_replicas(self, tiny):
+        _, m, params = tiny
+        rs = _fleet(m, params, n=2)
+        try:
+            for p in PROMPTS:
+                rs.submit(p, max_new_tokens=4, seed=0).result(timeout=120)
+            merged = rs.merged_stats()
+            assert isinstance(merged, ServingStats)
+            s = merged.summary()
+            per = [r.engine.serving_metrics() for r in rs.replicas]
+            assert s["requests_submitted"] == sum(
+                x["requests_submitted"] for x in per) == len(PROMPTS)
+            assert s["requests_completed"] == len(PROMPTS)
+            assert s["decode_tokens"] == sum(x["decode_tokens"] for x in per)
+            fm = rs.fleet_metrics()
+            assert fm["replicas"] == 2 and fm["replicas_healthy"] == 2
+            assert fm["fleet_submitted"] == len(PROMPTS)
+            assert fm["fleet_failovers"] == 0
+        finally:
+            rs.shutdown()
+
+    def test_mismatched_replicas_rejected(self, tiny):
+        _, m, params = tiny
+        a = ServingEngine(m, params, max_slots=1, max_len=32,
+                          eos_token_id=EOS, autostart=False, warmup=False)
+        b = ServingEngine(m, params, max_slots=1, max_len=32,
+                          eos_token_id=EOS + 1, autostart=False, warmup=False)
+        with pytest.raises(ValueError, match="disagree"):
+            ReplicaSet([a, b])
+        with pytest.raises(ValueError):
+            ReplicaSet([])
+
+
+class TestFailover:
+    @pytest.mark.slow
+    def test_kill_one_of_two_resumes_streams_exactly(self, sleepy):
+        """The tentpole acceptance test: kill 1 of 2 replicas with streams
+        in flight on BOTH; every request finishes on the survivor with
+        zero duplicated and zero lost tokens (greedy = bit-exact)."""
+        m, params = sleepy
+        rs = _fleet(m, params, n=2, max_slots=4, prefill_chunk=16,
+                    prefix_cache_mb=4.0)
+        n = 24
+        refs = [_offline(m, params, p, n) for p in PROMPTS]
+        try:
+            reqs = [rs.submit(p, max_new_tokens=n, seed=0) for p in PROMPTS]
+            deadline = time.monotonic() + 60
+            while (min(len(r.tokens) for r in reqs) < 3
+                   and time.monotonic() < deadline):
+                time.sleep(0.005)
+            assert min(len(r.tokens) for r in reqs) >= 3, "streams stalled"
+            victim = reqs[0].replica_trail[0]
+            rs.kill_replica(victim)
+            for r in reqs:
+                assert r.wait(timeout=120)
+            for r, ref in zip(reqs, refs):
+                assert r.status is RequestStatus.COMPLETED
+                _assert_matches_offline(r.tokens, ref, n)
+            moved = [r for r in reqs if r.replica_trail[0] == victim]
+            assert moved, "no request was on the killed replica"
+            for r in moved:
+                assert r.failovers == 1
+                assert r.replica_trail == [victim, 1 - victim]
+            states = rs.replica_states()
+            assert states[victim] is ReplicaState.FAILED
+            assert states[1 - victim] is ReplicaState.HEALTHY
+            fm = rs.fleet_metrics()
+            assert fm["fleet_fences"] == 1
+            assert fm["fleet_failovers"] == len(moved)
+            assert fm["replicas_failed"] == 1
+        finally:
+            rs.shutdown()
+
+    @pytest.mark.slow
+    def test_queued_requests_fail_over_too(self, sleepy):
+        """Requests still in the dead replica's ADMISSION QUEUE (never
+        admitted, zero tokens) resubmit from scratch on the survivor."""
+        m, params = sleepy
+        rs = _fleet(m, params, n=2, max_slots=1, max_queued=4)
+        n = 10
+        try:
+            # Saturate both slots, then queue two more (one per replica).
+            running = [rs.submit(PROMPTS[0], max_new_tokens=30, seed=0)
+                       for _ in range(2)]
+            queued = [rs.submit(p, max_new_tokens=n, seed=0)
+                      for p in PROMPTS[1:3]]
+            victim = running[0].replica_trail[0]
+            rs.kill_replica(victim)
+            for r in running + queued:
+                assert r.wait(timeout=120)
+            for r, p in zip(queued, PROMPTS[1:3]):
+                assert r.status is RequestStatus.COMPLETED
+                _assert_matches_offline(r.tokens,
+                                        _offline(m, params, p, n), n)
+        finally:
+            rs.shutdown()
+
+    @pytest.mark.slow
+    def test_cancel_suppresses_failover(self, sleepy):
+        m, params = sleepy
+        rs = _fleet(m, params, n=2, max_slots=2)
+        try:
+            r = rs.submit(PROMPTS[0], max_new_tokens=40, seed=0)
+            deadline = time.monotonic() + 30
+            while not r.tokens and time.monotonic() < deadline:
+                time.sleep(0.005)
+            r.cancel()
+            rs.kill_replica(r.replica_trail[0])
+            assert r.wait(timeout=60)
+            # Terminal state must be cancelled (or already-failed), never a
+            # resumed stream on the survivor.
+            assert r.failovers == 0
+            assert r.status in (RequestStatus.CANCELLED, RequestStatus.FAILED)
+        finally:
+            rs.shutdown()
+
+    @pytest.mark.slow
+    def test_no_survivor_fails_cleanly(self, sleepy):
+        m, params = sleepy
+        rs = ReplicaSet([ServingEngine(m, params, max_slots=2, max_len=64,
+                                       eos_token_id=EOS)])
+        try:
+            r = rs.submit(PROMPTS[0], max_new_tokens=40, seed=0)
+            deadline = time.monotonic() + 30
+            while not r.tokens and time.monotonic() < deadline:
+                time.sleep(0.005)
+            rs.kill_replica(0)
+            assert r.wait(timeout=60)
+            assert r.status is RequestStatus.FAILED
+            assert not rs.ready
+            with pytest.raises(RuntimeError, match="no healthy replica"):
+                rs.submit(PROMPTS[1], max_new_tokens=2)
+        finally:
+            rs.shutdown()
+
+
+class TestGatewayHTTP:
+    def test_completion_matches_offline(self, gateway, tiny):
+        _, m, params = tiny
+        n = 12
+        for i, p in enumerate(PROMPTS):
+            code, out, _ = _post(gateway.url, {
+                "prompt": p[0].tolist(), "max_new_tokens": n, "seed": 0})
+            assert code == 200 and out["status"] == "completed"
+            assert out["prompt_len"] == p.shape[1]
+            _assert_matches_offline(out["tokens"],
+                                    _offline(m, params, p, n), n)
+
+    def test_sse_stream_matches_offline(self, gateway, tiny):
+        _, m, params = tiny
+        n = 12
+        p = PROMPTS[0]
+        tokens, final = _sse(gateway.url, {
+            "prompt": p[0].tolist(), "max_new_tokens": n, "seed": 0})
+        _assert_matches_offline(tokens, _offline(m, params, p, n), n)
+        assert final["done"] and final["status"] == "completed"
+        assert final["tokens"] == tokens  # summary == stream, no dup/loss
+
+    def test_nested_prompt_and_default_max_new(self, gateway):
+        code, out, _ = _post(gateway.url,
+                             {"prompt": PROMPTS[1].tolist(), "seed": 0})
+        assert code == 200
+        assert (len(out["tokens"])
+                <= gateway.config.default_max_new_tokens)
+
+    def test_healthz_readyz(self, gateway):
+        assert _get(gateway.url, "/healthz")[0] == 200
+        code, body = _get(gateway.url, "/readyz")
+        assert code == 200 and "ready" in body
+
+    def test_metrics_prometheus_text(self, gateway):
+        _post(gateway.url, {"prompt": [1, 2, 3], "max_new_tokens": 2,
+                            "seed": 0})
+        code, text = _get(gateway.url, "/metrics")
+        assert code == 200
+        lines = text.splitlines()
+        # Exposition format: "# TYPE name type" declarations + "name value".
+        assert any(l.startswith("# TYPE accelerate_tpu_serving_")
+                   for l in lines)
+        metrics = {}
+        for l in lines:
+            if l.startswith("#") or "{" in l:
+                continue
+            name, val = l.rsplit(" ", 1)
+            metrics[name] = float(val)
+        assert metrics["accelerate_tpu_serving_replicas"] == 2
+        assert metrics["accelerate_tpu_serving_replicas_healthy"] == 2
+        assert metrics["accelerate_tpu_serving_requests_completed"] >= 1
+        assert metrics["accelerate_tpu_gateway_http_requests"] >= 1
+        assert metrics["accelerate_tpu_gateway_http_2xx"] >= 1
+        # The labeled per-route counter series is present too.
+        assert any(l.startswith(
+            'accelerate_tpu_gateway_responses_total{route="/v1/completions"')
+            for l in lines)
+
+    def test_bad_requests_get_400(self, gateway):
+        for payload in ({}, {"prompt": []}, {"prompt": "text"},
+                        {"prompt": [1, 2], "max_new_tokens": 0},
+                        {"prompt": [1, 2], "max_new_tokens": "four"},
+                        {"prompt": [1, 2], "timeout": -1},
+                        {"prompt": [1, 2], "seed": "zero"}):
+            code, out, _ = _post(gateway.url, payload)
+            assert code == 400, payload
+            assert "error" in out
+        # Over the engine's max_len -> engine-side ValueError -> 400 too.
+        code, out, _ = _post(gateway.url,
+                             {"prompt": [1] * 60, "max_new_tokens": 30})
+        assert code == 400 and "max_len" in out["error"]
+
+    def test_unknown_route_404(self, gateway):
+        assert _get(gateway.url, "/v2/nope")[0] == 404
+        req = urllib.request.Request(
+            gateway.url + "/v1/nope", data=b"{}",
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 404
+
+    @pytest.mark.slow
+    def test_body_cap_413(self, tiny):
+        _, m, params = tiny
+        rs = _fleet(m, params, n=1)
+        gw = ServingGateway(rs, config=GatewayConfig(
+            port=0, max_body_bytes=64))
+        gw.start()
+        try:
+            code, out, _ = _post(gw.url, {"prompt": [1] * 500})
+            assert code == 413 and "max_body_bytes" in out["error"]
+        finally:
+            gw.shutdown()
+
+    def test_invalid_json_400(self, gateway):
+        req = urllib.request.Request(
+            gateway.url + "/v1/completions", data=b"{not json",
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 400
+
+
+class TestGatewayBackpressure:
+    @pytest.mark.slow
+    def test_queue_full_429_with_retry_after(self, sleepy):
+        m, params = sleepy
+        rs = _fleet(m, params, n=1, max_slots=1, max_queued=1)
+        gw = ServingGateway(rs, config=GatewayConfig(port=0))
+        gw.start()
+        try:
+            first = rs.submit(PROMPTS[0], max_new_tokens=40, seed=0)
+            deadline = time.monotonic() + 60
+            while not first.tokens and time.monotonic() < deadline:
+                time.sleep(0.005)  # in its slot -> the queue is free again
+            blockers = [first,
+                        rs.submit(PROMPTS[0], max_new_tokens=40, seed=0)]
+            code, out, headers = _post(gw.url, {"prompt": [1, 2, 3],
+                                                "max_new_tokens": 2})
+            assert code == 429
+            assert "Retry-After" in headers
+            for b in blockers:
+                b.cancel()
+            for b in blockers:
+                b.wait(timeout=120)
+        finally:
+            gw.shutdown(drain=False)
+
+    @pytest.mark.slow
+    def test_deadline_408(self, sleepy):
+        m, params = sleepy
+        rs = _fleet(m, params, n=1, max_slots=1, max_queued=4)
+        gw = ServingGateway(rs, config=GatewayConfig(port=0))
+        gw.start()
+        try:
+            blocker = rs.submit(PROMPTS[0], max_new_tokens=50, seed=0)
+            deadline = time.monotonic() + 60
+            while not blocker.tokens and time.monotonic() < deadline:
+                time.sleep(0.005)
+            # Queued behind a ~1 s stream with a 100 ms deadline.
+            code, out, _ = _post(gw.url, {"prompt": [1, 2, 3],
+                                          "max_new_tokens": 2,
+                                          "timeout": 0.1})
+            assert code == 408 and out["status"] == "timed_out"
+            blocker.cancel()
+            blocker.wait(timeout=120)
+        finally:
+            gw.shutdown(drain=False)
+
+    @pytest.mark.slow
+    def test_connection_cap_503(self, tiny):
+        _, m, params = tiny
+        rs = _fleet(m, params, n=1)
+        gw = ServingGateway(rs, config=GatewayConfig(port=0,
+                                                     max_connections=1))
+        gw.start()
+        try:
+            gw._conn_slots.acquire()  # simulate a busy in-flight exchange
+            code, body = _get(gw.url, "/readyz")
+            assert code == 503
+            gw._conn_slots.release()
+            assert _get(gw.url, "/readyz")[0] == 200
+        finally:
+            gw.shutdown()
+
+
+class TestDrainSemantics:
+    @pytest.mark.slow
+    def test_drain_stops_admission_finishes_inflight(self, sleepy):
+        m, params = sleepy
+        rs = _fleet(m, params, n=2, max_slots=2)
+        gw = ServingGateway(rs, config=GatewayConfig(port=0))
+        gw.start()
+        try:
+            n = 20
+            inflight = rs.submit(PROMPTS[0], max_new_tokens=n, seed=0)
+            deadline = time.monotonic() + 30
+            while not inflight.tokens and time.monotonic() < deadline:
+                time.sleep(0.005)
+            gw.drain()
+            # readyz flips 503, new completions are refused...
+            code, body = _get(gw.url, "/readyz")
+            assert code == 503 and "draining" in body
+            code, out, headers = _post(gw.url, {"prompt": [1, 2],
+                                                "max_new_tokens": 2})
+            assert code == 503 and "Retry-After" in headers
+            # ...but liveness holds and the in-flight stream completes.
+            assert _get(gw.url, "/healthz")[0] == 200
+            assert inflight.wait(timeout=120)
+            assert inflight.status is RequestStatus.COMPLETED
+            _assert_matches_offline(inflight.tokens,
+                                    _offline(m, params, PROMPTS[0], n), n)
+        finally:
+            gw.shutdown()
+
+    @pytest.mark.slow
+    def test_shutdown_is_idempotent_and_final(self, tiny):
+        _, m, params = tiny
+        rs = _fleet(m, params, n=1)
+        gw = ServingGateway(rs, config=GatewayConfig(port=0))
+        gw.start()
+        url = gw.url
+        gw.shutdown()
+        gw.shutdown()  # second call must be a no-op, not an error
+        with pytest.raises((urllib.error.URLError, ConnectionError, OSError)):
+            urllib.request.urlopen(url + "/healthz", timeout=2)
+        with pytest.raises(RuntimeError):
+            rs.engine(0).submit(PROMPTS[0], max_new_tokens=2)
+
+    @pytest.mark.slow
+    def test_engine_autowrap_and_context_manager(self, tiny):
+        _, m, params = tiny
+        eng = ServingEngine(m, params, max_slots=2, max_len=64,
+                            eos_token_id=EOS)
+        with ServingGateway(eng, config=GatewayConfig(port=0)) as gw:
+            assert isinstance(gw.replica_set, ReplicaSet)
+            code, out, _ = _post(gw.url, {"prompt": [1, 2, 3],
+                                          "max_new_tokens": 2, "seed": 0})
+            assert code == 200
+        assert not eng.running
+
+
+@pytest.mark.slow
+class TestFailoverSoak:
+    def test_waves_of_streams_survive_sequential_kills(self, sleepy):
+        """Nightly soak: 3 replicas, continuous request waves, kill two
+        replicas one after another mid-traffic — every request must end
+        terminal (completed exactly, or failed ONLY with the no-survivor
+        error after the last kill), and the final survivor must still
+        serve fresh traffic exactly."""
+        m, params = sleepy
+        rs = _fleet(m, params, n=3, max_slots=4, max_queued=16,
+                    prefill_chunk=16, prefix_cache_mb=4.0)
+        n = 16
+        refs = {i: _offline(m, params, p, n) for i, p in enumerate(PROMPTS)}
+        done: list[FleetRequest] = []
+        try:
+            for wave in range(3):
+                reqs = [(i, rs.submit(p, max_new_tokens=n, seed=0))
+                        for i, p in enumerate(PROMPTS)]
+                time.sleep(0.15)
+                if wave < 2:
+                    victims = [r.index for r in rs.replicas
+                               if r.state is ReplicaState.HEALTHY]
+                    rs.kill_replica(victims[0])
+                for i, r in reqs:
+                    assert r.wait(timeout=180)
+                    assert r.status is RequestStatus.COMPLETED, (wave, i, r)
+                    _assert_matches_offline(r.tokens, refs[i], n)
+                    done.append(r)
+            fm = rs.fleet_metrics()
+            assert fm["replicas_failed"] == 2
+            assert fm["replicas_healthy"] == 1
+            assert fm["fleet_fences"] == 2
+            total_failovers = sum(r.failovers for r in done)
+            assert total_failovers == fm["fleet_failovers"] > 0
+        finally:
+            rs.shutdown()
