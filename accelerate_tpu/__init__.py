@@ -33,6 +33,18 @@ if _os.environ.get("ACCELERATE_TPU_PLATFORM") or _os.environ.get("JAX_PLATFORMS"
         pass
 
 from .accelerator import AcceleratedModel, Accelerator, Model
+from .adapters import (
+    AdapterBank,
+    AdapterBankFull,
+    LoRAConfig,
+    LoRATrainState,
+    UnknownAdapterError,
+    init_lora_params,
+    load_adapter,
+    merge_adapter,
+    prepare_lora,
+    save_adapter,
+)
 from .big_modeling import (
     BlockSpec,
     UserCpuOffloadHook,

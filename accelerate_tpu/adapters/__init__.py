@@ -1,0 +1,44 @@
+"""Multi-tenant LoRA adapters: train, hot-load, and serve many adapters
+over one base model with zero recompiles.
+
+Core (``adapters.lora``): config/init/merge plus the pure low-rank
+application path. Serving (``adapters.registry``): the stacked device
+:class:`AdapterBank` with host-side named LRU residency. Checkpoint
+round-trips live in :mod:`accelerate_tpu.checkpointing`
+(``save_adapter``/``load_adapter``) and are re-exported here.
+"""
+
+from ..checkpointing import load_adapter, save_adapter
+from .lora import (
+    DEFAULT_TARGET_MODULES,
+    LoRAConfig,
+    LoRATrainState,
+    adapter_rank,
+    count_lora_params,
+    init_lora_params,
+    lora_delta,
+    merge_adapter,
+    pad_adapter,
+    prepare_lora,
+    target_paths,
+)
+from .registry import AdapterBank, AdapterBankFull, UnknownAdapterError
+
+__all__ = [
+    "DEFAULT_TARGET_MODULES",
+    "LoRAConfig",
+    "LoRATrainState",
+    "AdapterBank",
+    "AdapterBankFull",
+    "UnknownAdapterError",
+    "adapter_rank",
+    "count_lora_params",
+    "init_lora_params",
+    "load_adapter",
+    "lora_delta",
+    "merge_adapter",
+    "pad_adapter",
+    "prepare_lora",
+    "save_adapter",
+    "target_paths",
+]

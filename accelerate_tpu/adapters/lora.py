@@ -1,0 +1,310 @@
+"""LoRA core: config, init, merge, and the pure low-rank application path.
+
+One adapter is a *pytree mirroring the base params*: every targeted
+projection module (a dict holding a 2-D ``kernel``) is replaced by
+``{"a": [in, r], "b": [r, out], "scale": []}``. That uniform shape is what
+lets the serving side stack many adapters into one bank array per leaf and
+gather a slot's adapter inside a compiled forward — the low-rank delta is
+always computed as ``((x @ a) @ b) * scale`` and *added* to the base
+projection output; the merged matrix ``W + a @ b * scale`` is only ever
+materialized offline by :func:`merge_adapter`.
+
+Training uses the same tree: :func:`prepare_lora` splits params into a
+frozen base and a trainable adapter plus a boolean mask shaped like the
+combined tree for ``optax.masked`` — the base never sees an optimizer
+update, so adapter checkpoints stay a few MB regardless of model size.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import fnmatch
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+#: Llama-family projection names; the default target set covers attention
+#: and MLP, matching the common "all-linear" LoRA recipe.
+DEFAULT_TARGET_MODULES = (
+    "q_proj", "k_proj", "v_proj", "o_proj",
+    "gate_proj", "up_proj", "down_proj",
+)
+
+#: Leaf names of one adapter module, in stacking order.
+ADAPTER_LEAVES = ("a", "b", "scale")
+
+
+@dataclasses.dataclass(frozen=True)
+class LoRAConfig:
+    """Hyperparameters + which modules to adapt.
+
+    ``target_modules`` entries are fnmatch patterns. A pattern containing a
+    ``.`` or ``/`` is matched against the full dot-joined module path
+    (``model.layers_0.self_attn.q_proj``); otherwise it matches the module's
+    own name (``q_proj``), the usual shorthand.
+    """
+
+    rank: int = 8
+    alpha: float = 16.0
+    dropout: float = 0.0
+    target_modules: Sequence[str] = DEFAULT_TARGET_MODULES
+
+    def __post_init__(self):
+        if self.rank < 1:
+            raise ValueError(f"LoRA rank must be >= 1 (got {self.rank})")
+        if not 0.0 <= self.dropout < 1.0:
+            raise ValueError(f"dropout must be in [0, 1) (got {self.dropout})")
+        if not self.target_modules:
+            raise ValueError("target_modules must not be empty")
+        object.__setattr__(self, "target_modules", tuple(self.target_modules))
+
+    @property
+    def scale(self) -> float:
+        return self.alpha / self.rank
+
+
+def _matches(path: tuple, patterns: Sequence[str]) -> bool:
+    dotted = ".".join(path)
+    name = path[-1]
+    for pat in patterns:
+        if "." in pat or "/" in pat:
+            if fnmatch.fnmatch(dotted, pat.replace("/", ".")):
+                return True
+        elif fnmatch.fnmatch(name, pat):
+            return True
+    return False
+
+
+def target_paths(params, config: LoRAConfig) -> list:
+    """Dot-paths of the modules a :class:`LoRAConfig` adapts.
+
+    A target is a dict with a 2-D ``kernel`` whose path matches one of
+    ``config.target_modules``. Embeddings, norms, and higher-rank kernels
+    (convs) are never matched.
+    """
+    found = []
+
+    def walk(node, path):
+        if not isinstance(node, dict):
+            return
+        kernel = node.get("kernel")
+        if (
+            path
+            and hasattr(kernel, "ndim")
+            and kernel.ndim == 2
+            and _matches(path, config.target_modules)
+        ):
+            found.append(".".join(path))
+            return
+        for k in sorted(node):
+            walk(node[k], path + (k,))
+
+    walk(params, ())
+    if not found:
+        raise ValueError(
+            f"target_modules {tuple(config.target_modules)!r} matched nothing "
+            "in the params pytree"
+        )
+    return found
+
+
+def _get_path(tree, dotted: str):
+    node = tree
+    for part in dotted.split("."):
+        node = node[part]
+    return node
+
+
+def _set_path(tree: dict, dotted: str, value) -> None:
+    parts = dotted.split(".")
+    node = tree
+    for part in parts[:-1]:
+        node = node.setdefault(part, {})
+    node[parts[-1]] = value
+
+
+def init_lora_params(rng, params, config: LoRAConfig, dtype=jnp.float32):
+    """Fresh adapter for ``params``: ``a`` ~ N(0, 1/r), ``b`` = 0.
+
+    ``b = 0`` makes the initial delta exactly zero — training starts from
+    the base model's function, the standard LoRA init.
+    """
+    paths = target_paths(params, config)
+    adapter: dict = {}
+    keys = jax.random.split(rng, len(paths))
+    for key, dotted in zip(keys, paths):
+        kernel = _get_path(params, dotted)["kernel"]
+        d_in, d_out = int(kernel.shape[0]), int(kernel.shape[1])
+        _set_path(adapter, dotted, {
+            "a": jax.random.normal(key, (d_in, config.rank), dtype) / config.rank,
+            "b": jnp.zeros((config.rank, d_out), dtype),
+            "scale": jnp.asarray(config.scale, dtype),
+        })
+    return adapter
+
+
+def is_adapter_module(node) -> bool:
+    return isinstance(node, dict) and set(node) == set(ADAPTER_LEAVES)
+
+
+def adapter_module_paths(adapter) -> list:
+    """Dot-paths of every ``{"a","b","scale"}`` module in an adapter tree."""
+    found = []
+
+    def walk(node, path):
+        if is_adapter_module(node):
+            found.append(".".join(path))
+        elif isinstance(node, dict):
+            for k in sorted(node):
+                walk(node[k], path + (k,))
+
+    walk(adapter, ())
+    return found
+
+
+def adapter_rank(adapter) -> int:
+    ranks = [
+        _get_path(adapter, p)["a"].shape[-1] for p in adapter_module_paths(adapter)
+    ]
+    if not ranks:
+        raise ValueError("not an adapter tree: no {'a','b','scale'} modules found")
+    return int(max(ranks))
+
+
+def pad_adapter(adapter, rank: int):
+    """Zero-pad every module to ``rank`` (a: extra columns, b: extra rows).
+
+    Padding with zeros leaves ``a @ b`` unchanged, so rank-4 and rank-8
+    adapters can share one rank-8 bank row layout.
+    """
+
+    def pad(node):
+        r = node["a"].shape[-1]
+        if r > rank:
+            raise ValueError(f"adapter rank {r} exceeds bank rank {rank}")
+        if r == rank:
+            return dict(node)
+        a = jnp.pad(node["a"], ((0, 0), (0, rank - r)))
+        b = jnp.pad(node["b"], ((0, rank - r), (0, 0)))
+        return {"a": a, "b": b, "scale": node["scale"]}
+
+    out: dict = {}
+    for dotted in adapter_module_paths(adapter):
+        _set_path(out, dotted, pad(_get_path(adapter, dotted)))
+    return out
+
+
+def lora_delta(x, module, dtype=None):
+    """Low-rank delta ``((x @ a) @ b) * scale`` — never forms ``a @ b``."""
+    dtype = dtype or x.dtype
+    a = module["a"].astype(dtype)
+    b = module["b"].astype(dtype)
+    return ((x @ a) @ b) * module["scale"].astype(dtype)
+
+
+def merge_adapter(params, adapter):
+    """Fold an adapter into full weights: ``kernel += a @ b * scale``.
+
+    Offline-only path (single-tenant export, exactness references). The
+    batched serving path never calls this — it applies the low-rank delta
+    per token instead.
+    """
+    merged = jax.tree_util.tree_map(lambda x: x, params)  # structural copy
+    for dotted in adapter_module_paths(adapter):
+        mod = _get_path(adapter, dotted)
+        target = _get_path(merged, dotted)
+        kernel = target["kernel"]
+        delta = (mod["a"] @ mod["b"]) * mod["scale"]
+        target["kernel"] = (kernel.astype(jnp.float32) + delta.astype(jnp.float32)).astype(kernel.dtype)
+    return merged
+
+
+@dataclasses.dataclass
+class LoRATrainState:
+    """Frozen-base / trainable-adapter split from :func:`prepare_lora`.
+
+    ``train_params()`` is what you differentiate and hand to the optimizer;
+    ``param_mask`` (True = trainable) has the same structure. Wrap your
+    optimizer with :meth:`wrap_optimizer` — a bare ``optax.masked(tx,
+    mask)`` is NOT enough, because masked passes the unmasked leaves'
+    gradients through unmodified instead of zeroing them.
+    """
+
+    base_params: dict
+    adapter: dict
+    param_mask: dict
+    config: LoRAConfig
+
+    def train_params(self) -> dict:
+        return {"base": self.base_params, "lora": self.adapter}
+
+    def wrap_optimizer(self, tx):
+        """``tx`` on the trainable leaves, hard zero everywhere else —
+        the frozen base (and the scale hyperparameter leaves) come out of
+        every update bit-identical."""
+        import optax
+
+        frozen = jax.tree_util.tree_map(lambda t: not t, self.param_mask)
+        return optax.chain(optax.masked(tx, self.param_mask),
+                           optax.masked(optax.set_to_zero(), frozen))
+
+
+def prepare_lora(model, params, config: LoRAConfig, rng=None) -> LoRATrainState:
+    """Split ``params`` into a frozen base + fresh trainable adapter.
+
+    ``model`` is accepted for API symmetry with the training stack (it is
+    only used to validate that the adapter's targets exist); apply the
+    adapter at call time via the model's ``lora=`` hook, e.g.::
+
+        ts = prepare_lora(model, params, LoRAConfig(rank=8))
+        tx = ts.wrap_optimizer(optax.adamw(1e-4))
+
+        def loss_fn(train):
+            logits = model.apply({"params": train["base"]}, batch,
+                                 lora=train["lora"])
+            ...
+    """
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    adapter = init_lora_params(rng, params, config)
+
+    def leaf_mask(tree, value, scale_value):
+        def walk(node, path):
+            if not isinstance(node, dict):
+                return scale_value if path and path[-1] == "scale" else value
+            return {k: walk(v, path + (k,)) for k, v in node.items()}
+
+        return walk(tree, ())
+
+    # scale is a hyperparameter leaf, not a weight — keep it frozen too.
+    mask = {
+        "base": leaf_mask(params, False, False),
+        "lora": leaf_mask(adapter, True, False),
+    }
+    return LoRATrainState(base_params=params, adapter=adapter,
+                          param_mask=mask, config=config)
+
+
+def count_lora_params(abstract_params, config: LoRAConfig) -> tuple:
+    """(trainable parameter count, fp32 checkpoint bytes) for an adapter.
+
+    Works on abstract trees (``jax.eval_shape`` output) — used by the
+    ``estimate-memory --lora-rank`` CLI without materializing weights.
+    """
+    n = 0
+    for dotted in target_paths(abstract_params, config):
+        kernel = _get_path(abstract_params, dotted)["kernel"]
+        d_in, d_out = int(kernel.shape[0]), int(kernel.shape[1])
+        n += d_in * config.rank + config.rank * d_out
+    return n, n * 4
+
+
+def adapter_spec(adapter) -> dict:
+    """Shape spec used to validate bank registration and checkpoints."""
+    spec = {}
+    for dotted in adapter_module_paths(adapter):
+        mod = _get_path(adapter, dotted)
+        spec[dotted] = {k: tuple(np.shape(mod[k])) for k in ADAPTER_LEAVES}
+    return spec

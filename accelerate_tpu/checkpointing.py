@@ -210,6 +210,63 @@ def load_array_tree(path: str | Path, target=None, shardings=None, via_host: boo
 
 
 # ---------------------------------------------------------------------------
+# Adapter-only checkpoints (LoRA)
+# ---------------------------------------------------------------------------
+
+ADAPTER_META_NAME = "adapter.json"
+ADAPTER_FORMAT = "accelerate-tpu-lora"
+
+
+def save_adapter(adapter, path: str | Path, *, config=None, blocking: bool = True):
+    """Write an adapter-only checkpoint: stacked arrays + JSON metadata.
+
+    A few MB regardless of base-model size — the trainable LoRA leaves only.
+    The format is shared by training (:func:`~accelerate_tpu.adapters.prepare_lora`
+    output) and serving (:meth:`AdapterBank.register` input): arrays under
+    ``<path>/arrays`` via :func:`save_array_tree`, hyperparameters in
+    ``<path>/adapter.json``.
+    """
+    from .adapters.lora import adapter_module_paths, adapter_rank
+
+    paths = adapter_module_paths(adapter)
+    if not paths:
+        raise ValueError("not an adapter tree: no {'a','b','scale'} modules found")
+    path = Path(path).absolute()
+    path.mkdir(parents=True, exist_ok=True)
+    meta = {
+        "format": ADAPTER_FORMAT,
+        "version": 1,
+        "rank": adapter_rank(adapter),
+        "modules": paths,
+    }
+    if config is not None:
+        meta.update({
+            "alpha": float(config.alpha),
+            "dropout": float(config.dropout),
+            "target_modules": list(config.target_modules),
+        })
+    (path / ADAPTER_META_NAME).write_text(json.dumps(meta, indent=2))
+    save_array_tree(adapter, path / "arrays", blocking=blocking)
+    return str(path)
+
+
+def load_adapter(path: str | Path):
+    """Restore ``(adapter_tree, meta_dict)`` written by :func:`save_adapter`."""
+    wait_for_saves()
+    path = Path(path).absolute()
+    meta_path = path / ADAPTER_META_NAME
+    if not meta_path.exists():
+        raise FileNotFoundError(
+            f"{path} is not an adapter checkpoint (missing {ADAPTER_META_NAME})")
+    meta = json.loads(meta_path.read_text())
+    if meta.get("format") != ADAPTER_FORMAT:
+        raise ValueError(
+            f"{path} has format {meta.get('format')!r}, expected {ADAPTER_FORMAT!r}")
+    adapter = load_array_tree(path / "arrays")
+    return adapter, meta
+
+
+# ---------------------------------------------------------------------------
 # RNG state (reference: checkpointing.py:144-160)
 # ---------------------------------------------------------------------------
 

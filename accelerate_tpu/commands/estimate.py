@@ -186,6 +186,24 @@ def estimate_command(args) -> int:
         if args.fsdp > 1 and training == training:
             row += f" | {_fmt(training / args.fsdp):>14}"
         print(row)
+    if args.lora_rank is not None:
+        from ..adapters.lora import LoRAConfig, count_lora_params
+
+        try:
+            n_lora, ckpt_bytes = count_lora_params(
+                abstract, LoRAConfig(rank=args.lora_rank))
+        except ValueError as e:
+            # e.g. a model family with no matching target modules.
+            print(f"\nLoRA rank {args.lora_rank}: {e}")
+            return 2
+        print(f"\nLoRA rank {args.lora_rank} "
+              f"(targets: q/k/v/o + gate/up/down projections):")
+        print(f"  trainable params : {n_lora:,} "
+              f"({100.0 * n_lora / max(n_params, 1):.3f}% of base)")
+        print(f"  adapter checkpoint (fp32): {_fmt(ckpt_bytes)}")
+        # Optimizer state only covers the trainable low-rank factors —
+        # the base stays frozen, so Adam costs 2 fp32 moments on n_lora.
+        print(f"  Adam moments (fp32)      : {_fmt(ckpt_bytes * 2)}")
     return 0
 
 
@@ -203,6 +221,9 @@ def estimate_command_parser(subparsers=None):
     parser.add_argument("--dtypes", nargs="+", default=["float32", "bfloat16", "int8", "int4"])
     parser.add_argument("--fsdp", type=int, default=1,
                         help="Also print the per-chip share under this FSDP axis size")
+    parser.add_argument("--lora-rank", type=int, default=None,
+                        help="Also print the LoRA trainable-parameter count and "
+                             "adapter checkpoint size at this rank")
     if subparsers is not None:
         parser.set_defaults(func=estimate_command)
     return parser

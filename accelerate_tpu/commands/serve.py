@@ -45,6 +45,18 @@ def _resolve_model(spec: str, args):
     return out
 
 
+def _parse_adapter_specs(specs):
+    """``--adapter NAME=PATH`` pairs → list of (name, path)."""
+    out = []
+    for spec in specs or ():
+        name, sep, path = spec.partition("=")
+        if not sep or not name or not path:
+            raise SystemExit(
+                f"--adapter must be NAME=PATH (got {spec!r})")
+        out.append((name, path))
+    return out
+
+
 def serve_command(args) -> int:
     from ..serving import (
         GatewayConfig,
@@ -54,18 +66,43 @@ def serve_command(args) -> int:
     )
 
     model, params = _resolve_model(args.model, args)
+    adapter_specs = _parse_adapter_specs(args.adapter)
+    max_adapters = args.max_adapters
+    if adapter_specs and max_adapters < 2:
+        # Preloading adapters implies multi-tenant serving; size the bank
+        # to fit them all (plus the reserved base row) if not asked for.
+        max_adapters = len(adapter_specs) + 1
+
+    def make_bank():
+        if max_adapters < 2:
+            return None
+        from ..adapters import AdapterBank, LoRAConfig
+
+        return AdapterBank(params, config=LoRAConfig(rank=args.lora_rank),
+                           max_adapters=max_adapters)
 
     def factory():
         return ServingEngine(
             model, params, max_slots=args.max_slots, max_len=args.max_len,
             max_queued=args.max_queued, eos_token_id=args.eos_token_id,
             prefill_chunk=args.prefill_chunk,
-            prefix_cache_mb=args.prefix_cache_mb)
+            prefix_cache_mb=args.prefix_cache_mb,
+            adapters=make_bank())
 
     print(f"warming up {args.replicas} replica(s) "
           f"(slots={args.max_slots}, max_len={args.max_len}, "
-          f"chunk={args.prefill_chunk}) ...", flush=True)
+          f"chunk={args.prefill_chunk}"
+          + (f", adapters={max_adapters - 1}" if max_adapters >= 2 else "")
+          + ") ...", flush=True)
     replica_set = ReplicaSet.from_factory(factory, args.replicas)
+    if adapter_specs:
+        from ..adapters import load_adapter
+
+        for name, path in adapter_specs:
+            adapter, meta = load_adapter(path)
+            replica_set.register_adapter(name, adapter)
+            print(f"registered adapter {name!r} from {path} "
+                  f"(rank {meta.get('rank', '?')})", flush=True)
     gateway = ServingGateway(
         replica_set,
         config=GatewayConfig(host=args.host, port=args.port,
@@ -121,6 +158,17 @@ def serve_command_parser(subparsers=None):
                         help="Concurrent in-flight HTTP exchanges")
     parser.add_argument("--seed", type=int, default=0,
                         help="Init seed for --model tiny")
+    parser.add_argument("--max-adapters", type=int, default=0,
+                        help="Device LoRA bank rows per replica, incl. the "
+                             "reserved base row (0/1 = no adapter bank; "
+                             ">= 2 enables multi-tenant serving)")
+    parser.add_argument("--lora-rank", type=int, default=8,
+                        help="Bank rank ceiling: registered adapters of any "
+                             "lower rank are zero-padded up to it")
+    parser.add_argument("--adapter", action="append", metavar="NAME=PATH",
+                        help="Preload a saved adapter (save_adapter dir) "
+                             "under NAME on every replica; repeatable. "
+                             "Implies an adapter bank sized to fit")
     if subparsers is not None:
         parser.set_defaults(func=serve_command)
     return parser

@@ -559,6 +559,26 @@ def update_kv_cache_and_attend(cache, q, k, v, cache_pos, n_rep: int, sliding_wi
     return out, new_cache
 
 
+def _lora_delta(y, x, lora, name):
+    """Add a gathered low-rank LoRA delta to a projection output.
+
+    ``lora`` is a per-module dict of ``{"a","b","scale"}`` trees (or None).
+    Membership is a *static* Python-dict lookup, so a given adapter target
+    set traces one fixed program; the delta is computed as
+    ``((x @ a) @ b) * scale`` — ``W + a@b`` is never materialized.
+    """
+    if not lora or name not in lora:
+        return y
+    mod = lora[name]
+    a = mod["a"].astype(x.dtype)
+    b = mod["b"].astype(x.dtype)
+    return y + ((x @ a) @ b) * mod["scale"].astype(x.dtype)
+
+
+def _lora_sub(lora, name):
+    return None if lora is None else lora.get(name)
+
+
 class LlamaAttention(nn.Module):
     config: LlamaConfig
     # Per-layer sliding window: the sentinel "config" reads the uniform
@@ -568,15 +588,18 @@ class LlamaAttention(nn.Module):
 
     @nn.compact
     def __call__(self, x, positions, causal=True, cache=None, cache_pos=None,
-                 segment_ids=None):
+                 segment_ids=None, lora=None):
         cfg = self.config
         B, S, _ = x.shape
         n_q, n_kv, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
         dense = _dense_factory(cfg, x.dtype)
         qkv_bias = cfg.attention_qkv_bias
-        q = dense(n_q * hd, "q_proj", use_bias=qkv_bias)(x).reshape(B, S, n_q, hd)
-        k = dense(n_kv * hd, "k_proj", use_bias=qkv_bias)(x).reshape(B, S, n_kv, hd)
-        v = dense(n_kv * hd, "v_proj", use_bias=qkv_bias)(x).reshape(B, S, n_kv, hd)
+        q = dense(n_q * hd, "q_proj", use_bias=qkv_bias)(x)
+        k = dense(n_kv * hd, "k_proj", use_bias=qkv_bias)(x)
+        v = dense(n_kv * hd, "v_proj", use_bias=qkv_bias)(x)
+        q = _lora_delta(q, x, lora, "q_proj").reshape(B, S, n_q, hd)
+        k = _lora_delta(k, x, lora, "k_proj").reshape(B, S, n_kv, hd)
+        v = _lora_delta(v, x, lora, "v_proj").reshape(B, S, n_kv, hd)
 
         cos, sin = rotary_embedding(positions, hd, cfg.rope_theta, dtype=x.dtype,
                                     rope_scaling=cfg.rope_scaling)
@@ -595,7 +618,8 @@ class LlamaAttention(nn.Module):
                 cache, q, k, v, cache_pos, n_q // n_kv,
                 sliding_window=window, sm_scale=sm_scale, logit_softcap=softcap)
             out = out.reshape(B, S, n_q * hd)
-            return dense(cfg.hidden_size, "o_proj", use_bias=cfg.attention_out_bias)(out), new_cache
+            proj = dense(cfg.hidden_size, "o_proj", use_bias=cfg.attention_out_bias)(out)
+            return _lora_delta(proj, out, lora, "o_proj"), new_cache
 
         # GQA KV goes in unrepeated: every dense path is narrow-KV-native,
         # and CP strategies move G-wide KV over ICI.
@@ -607,18 +631,19 @@ class LlamaAttention(nn.Module):
             sm_scale=sm_scale, logit_softcap=softcap,
         )
         out = out.reshape(B, S, n_q * hd)
-        return dense(cfg.hidden_size, "o_proj", use_bias=cfg.attention_out_bias)(out)
+        proj = dense(cfg.hidden_size, "o_proj", use_bias=cfg.attention_out_bias)(out)
+        return _lora_delta(proj, out, lora, "o_proj")
 
 
 class LlamaMLP(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, lora=None):
         cfg = self.config
         dense = _dense_factory(cfg, x.dtype)
-        gate = dense(cfg.intermediate_size, "gate_proj")(x)
-        up = dense(cfg.intermediate_size, "up_proj")(x)
+        gate = _lora_delta(dense(cfg.intermediate_size, "gate_proj")(x), x, lora, "gate_proj")
+        up = _lora_delta(dense(cfg.intermediate_size, "up_proj")(x), x, lora, "up_proj")
         if cfg.mlp_activation == "gelu_tanh":    # GeGLU, tanh approx (Gemma)
             act = jax.nn.gelu(gate, approximate=True)
         elif cfg.mlp_activation == "gelu_exact":  # GeGLU, exact erf
@@ -627,7 +652,8 @@ class LlamaMLP(nn.Module):
             act = jax.nn.silu(gate)
         else:
             raise NotImplementedError(f"mlp_activation {cfg.mlp_activation!r}")
-        return dense(cfg.hidden_size, "down_proj")(act * up)
+        h = act * up
+        return _lora_delta(dense(cfg.hidden_size, "down_proj")(h), h, lora, "down_proj")
 
 
 class LlamaBlock(nn.Module):
@@ -635,26 +661,29 @@ class LlamaBlock(nn.Module):
     layer_idx: int = 0
 
     @nn.compact
-    def __call__(self, x, positions, cache=None, cache_pos=None, segment_ids=None):
+    def __call__(self, x, positions, cache=None, cache_pos=None, segment_ids=None,
+                 lora=None):
         cfg = self.config
         norm = functools.partial(RMSNorm, cfg.rms_norm_eps, unit_offset=cfg.rms_norm_unit_offset)
         attn_in = norm(name="input_norm")(x)
         attn = LlamaAttention(cfg, window=cfg.window_for(self.layer_idx),
                               name="self_attn")(attn_in, positions, cache=cache,
                                                 cache_pos=cache_pos,
-                                                segment_ids=segment_ids)
+                                                segment_ids=segment_ids,
+                                                lora=_lora_sub(lora, "self_attn"))
         new_cache = None
         if cache is not None:
             attn, new_cache = attn
+        mlp_lora = _lora_sub(lora, "mlp")
         if cfg.post_norms:
             # Gemma2 sandwich block: sublayer OUTPUTS are normed before their
             # residual adds, and the MLP gets its own pre-norm.
             h = x + norm(name="post_attn_norm")(attn)
             mlp_in = norm(name="pre_ffn_norm")(h)
-            h = h + norm(name="post_ffn_norm")(LlamaMLP(cfg, name="mlp")(mlp_in))
+            h = h + norm(name="post_ffn_norm")(LlamaMLP(cfg, name="mlp")(mlp_in, lora=mlp_lora))
         else:
             h = x + attn
-            h = h + LlamaMLP(cfg, name="mlp")(norm(name="post_attn_norm")(h))
+            h = h + LlamaMLP(cfg, name="mlp")(norm(name="post_attn_norm")(h), lora=mlp_lora)
         return h if cache is None else (h, new_cache)
 
 
@@ -665,7 +694,7 @@ class LlamaModel(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, positions=None, cache=None, cache_pos=None,
-                 segment_ids=None):
+                 segment_ids=None, lora=None):
         cfg = self.config
         if positions is None:
             start = 0 if cache_pos is None else cache_pos
@@ -690,12 +719,14 @@ class LlamaModel(nn.Module):
             block_cls = nn.remat(LlamaBlock, policy=resolve_remat_policy(cfg.remat_policy))
         new_caches = []
         for i in range(cfg.num_hidden_layers):
+            layer_lora = _lora_sub(lora, f"layers_{i}")
             if cache is None:
                 x = block_cls(cfg, layer_idx=i, name=f"layers_{i}")(
-                    x, positions, segment_ids=segment_ids)
+                    x, positions, segment_ids=segment_ids, lora=layer_lora)
             else:
                 x, layer_cache = block_cls(cfg, layer_idx=i, name=f"layers_{i}")(
-                    x, positions, cache=cache[i], cache_pos=cache_pos
+                    x, positions, cache=cache[i], cache_pos=cache_pos,
+                    lora=layer_lora,
                 )
                 new_caches.append(layer_cache)
         x = RMSNorm(cfg.rms_norm_eps, unit_offset=cfg.rms_norm_unit_offset, name="norm")(x)
@@ -707,10 +738,11 @@ class LlamaForCausalLM(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, positions=None, cache=None, cache_pos=None,
-                 return_hidden=False, segment_ids=None):
+                 return_hidden=False, segment_ids=None, lora=None):
         cfg = self.config
         x = LlamaModel(cfg, name="model")(input_ids, positions, cache=cache,
-                                          cache_pos=cache_pos, segment_ids=segment_ids)
+                                          cache_pos=cache_pos, segment_ids=segment_ids,
+                                          lora=_lora_sub(lora, "model"))
         new_cache = None
         if cache is not None:
             x, new_cache = x

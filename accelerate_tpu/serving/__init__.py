@@ -33,6 +33,13 @@ Public surface:
   ``/metrics`` (Prometheus text), backpressure mapped to HTTP status
   codes, graceful drain on SIGTERM.
 
+Multi-tenant LoRA serving (``accelerate_tpu.adapters``) plugs in through
+the same surface: construct the engine with an
+:class:`~..adapters.registry.AdapterBank`, register named adapters at
+runtime (zero recompiles — the bank is a regular traced argument), and
+pass ``adapter="name"`` to ``submit`` / the gateway's JSON body. See
+``docs/usage_guides/lora.md``.
+
 See ``docs/usage_guides/serving.md``.
 """
 

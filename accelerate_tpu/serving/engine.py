@@ -76,6 +76,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..adapters.registry import AdapterBank
 from ..generation import (
     _bucket128,
     _check_position_bound,
@@ -130,6 +131,16 @@ class ServingEngine:
         (0 disables). On admit, the longest cached chunk-aligned prefix
         is restored by ``restore_prefix`` instead of recomputed; the
         final chunk always re-runs so the first token's logits exist.
+        Cache keys include the request's adapter identity — two tenants
+        with identical prompts never share KV blocks.
+      adapters: optional :class:`~accelerate_tpu.adapters.AdapterBank` —
+        multi-tenant LoRA serving. The bank rides into every compiled
+        program as a regular stacked-array argument and each slot gathers
+        its own adapter row inside the forward, so requests naming
+        different adapters share one decode batch and adapter load/evict
+        (a ``dynamic_update_slice`` row write) compiles nothing new.
+        Requests with ``adapter=None`` use bank row 0, the reserved
+        identity adapter — their output is the base model's, unchanged.
       accelerator: optional — wires preemption-drain cooperation and, when
         the accelerator carries a ``serving_stats``, shares it so
         ``Accelerator.log(include_serving=True)`` sees this engine.
@@ -146,6 +157,7 @@ class ServingEngine:
                  prefill_chunk: Optional[int] = 256,
                  prefill_chunks_per_tick: int = 1,
                  prefix_cache_mb: float = 64.0,
+                 adapters: Optional[AdapterBank] = None,
                  accelerator=None, stats: Optional[ServingStats] = None,
                  autostart: bool = True, warmup: bool = True,
                  idle_poll_s: float = 0.005):
@@ -235,6 +247,13 @@ class ServingEngine:
             "rng": jnp.zeros((self.max_slots, 2), jnp.uint32),
             "done": jnp.zeros((self.max_slots,), bool),
         }
+        # Adapter bank: the per-slot adapter row index joins the decode
+        # state ONLY when a bank is attached — a bank-less engine traces
+        # byte-identical programs to the pre-adapter engine.
+        self._adapters = adapters
+        if adapters is not None:
+            self._state["adapter_idx"] = jnp.zeros((self.max_slots,),
+                                                   jnp.int32)
 
         # CPU jit warns (and ignores) donation; donate only where it works.
         donate = () if jax.default_backend() == "cpu" else (1,)
@@ -296,7 +315,19 @@ class ServingEngine:
     # ------------------------------------------------------------------
     # the compiled programs
     # ------------------------------------------------------------------
-    def _prefill_fn(self, params, state, ids_p, slot, rng, true_len):
+    @staticmethod
+    def _lora_kwargs(bank, aidx) -> dict:
+        """Gather one adapter row from the stacked bank at a traced index.
+
+        Returns the ``lora=`` kwargs for ``module.apply`` — empty when no
+        bank is attached, so bank-less engines never pass the kwarg (and
+        non-LoRA-aware modules never see it)."""
+        if bank is None:
+            return {}
+        return {"lora": jax.tree.map(lambda s: s[aidx], bank)}
+
+    def _prefill_fn(self, params, state, ids_p, slot, rng, true_len,
+                    aidx=None, bank=None):
         """Monolithic prefill (``prefill_chunk=None`` only). ids_p [1, P]
         edge-padded prompt; slot/true_len traced i32 scalars. Builds a
         fresh batch-1 cache, runs the whole prompt, selects the first
@@ -308,7 +339,8 @@ class ServingEngine:
         """
         cache = self._factory(1, self.max_len, self._dtype)
         logits, cache = self.module.apply(
-            {"params": params}, ids_p, cache=cache, cache_pos=0)
+            {"params": params}, ids_p, cache=cache, cache_pos=0,
+            **self._lora_kwargs(bank, aidx))
         tok, done, rng_carry = _chunk_prefill_token(
             logits, rng, self._select, self.eos_token_id, ids_p.dtype,
             true_len)
@@ -316,17 +348,20 @@ class ServingEngine:
             lambda full, one: jax.lax.dynamic_update_slice(
                 full, one[None].astype(full.dtype), (slot,) + (0,) * one.ndim),
             state["cache"], cache)
-        state = {
-            "cache": new_cache,
-            "pos": state["pos"].at[slot].set(true_len),
-            "tok": state["tok"].at[slot].set(tok[0].astype(jnp.int32)),
-            "rng": state["rng"].at[slot].set(rng_carry),
-            "done": state["done"].at[slot].set(done[0]),
-        }
-        return state, tok[0]
+        new_state = dict(
+            state,
+            cache=new_cache,
+            pos=state["pos"].at[slot].set(true_len),
+            tok=state["tok"].at[slot].set(tok[0].astype(jnp.int32)),
+            rng=state["rng"].at[slot].set(rng_carry),
+            done=state["done"].at[slot].set(done[0]),
+        )
+        if bank is not None:
+            new_state["adapter_idx"] = state["adapter_idx"].at[slot].set(aidx)
+        return new_state, tok[0]
 
     def _prefill_chunk_fn(self, params, state, ids_c, slot, offset, true_len,
-                          rng):
+                          rng, aidx=None, bank=None):
         """ONE chunk of prefill: ids_c ``[1, C]`` (tail chunks edge-padded
         on the host); slot/offset/true_len traced i32 scalars. Runs the
         chunk at ``cache_pos=offset`` directly against the slot's region
@@ -349,7 +384,8 @@ class ServingEngine:
                 (1,) + full.shape[1:])[0],
             state["cache"])
         logits, cache = self.module.apply(
-            {"params": params}, ids_c, cache=cache, cache_pos=offset)
+            {"params": params}, ids_c, cache=cache, cache_pos=offset,
+            **self._lora_kwargs(bank, aidx))
         tok, done, rng_carry = _chunk_prefill_token(
             logits, rng, self._select, self.eos_token_id, ids_c.dtype,
             true_len, offset)
@@ -362,14 +398,17 @@ class ServingEngine:
             lambda full, one: jax.lax.dynamic_update_slice(
                 full, one[None].astype(full.dtype), (slot,) + (0,) * one.ndim),
             state["cache"], cache)
-        state = {
-            "cache": new_cache,
-            "pos": state["pos"].at[slot].set(true_len),
-            "tok": state["tok"].at[slot].set(tok[0].astype(jnp.int32)),
-            "rng": state["rng"].at[slot].set(rng_carry),
-            "done": state["done"].at[slot].set(done[0]),
-        }
-        return state, tok[0], block
+        new_state = dict(
+            state,
+            cache=new_cache,
+            pos=state["pos"].at[slot].set(true_len),
+            tok=state["tok"].at[slot].set(tok[0].astype(jnp.int32)),
+            rng=state["rng"].at[slot].set(rng_carry),
+            done=state["done"].at[slot].set(done[0]),
+        )
+        if bank is not None:
+            new_state["adapter_idx"] = state["adapter_idx"].at[slot].set(aidx)
+        return new_state, tok[0], block
 
     def _restore_prefix_fn(self, state, block, slot, offset, true_len):
         """Copy one cached ``[1, C]`` KV block into the slot's cache at the
@@ -391,7 +430,7 @@ class ServingEngine:
             pos=state["pos"].at[slot].set(true_len),
         )
 
-    def _decode_fn(self, params, state, active):
+    def _decode_fn(self, params, state, active, bank=None):
         """One tick: a batch-1 single-token forward vmapped over the slot
         axis (per-slot scalar cache_pos, per-slot rng chain — bitwise the
         same selection as offline's scan body). The cache commits
@@ -404,25 +443,31 @@ class ServingEngine:
         non-running slots stay frozen and in-bounds. Returns
         (state, tokens [S], done [S])."""
 
-        def one_slot(cache, tok, pos, rng, done):
+        def one_slot(cache, tok, pos, rng, done, aidx=None):
             logits, cache = self.module.apply(
-                {"params": params}, tok[None, None], cache=cache, cache_pos=pos)
+                {"params": params}, tok[None, None], cache=cache, cache_pos=pos,
+                **self._lora_kwargs(bank, aidx))
             rng, sub = jax.random.split(rng)
             nxt, done = _next_token(logits[:, -1], sub, jnp.zeros((1, 1), bool),
                                     done[None], self._select, self.eos_token_id,
                                     tok.dtype)
             return cache, nxt[0], rng, done[0]
 
-        new_cache, toks, rngs, dones = jax.vmap(one_slot)(
-            state["cache"], state["tok"], state["pos"], state["rng"],
-            state["done"])
-        state = {
-            "cache": new_cache,
-            "pos": jnp.where(active, state["pos"] + 1, state["pos"]),
-            "tok": jnp.where(active, toks, state["tok"]),
-            "rng": jnp.where(active[:, None], rngs, state["rng"]),
-            "done": jnp.where(active, dones, state["done"]),
-        }
+        # The bank is closed over (broadcast): each slot gathers its own
+        # adapter row at its vmapped adapter_idx.
+        vmap_args = [state["cache"], state["tok"], state["pos"], state["rng"],
+                     state["done"]]
+        if bank is not None:
+            vmap_args.append(state["adapter_idx"])
+        new_cache, toks, rngs, dones = jax.vmap(one_slot)(*vmap_args)
+        state = dict(
+            state,
+            cache=new_cache,
+            pos=jnp.where(active, state["pos"] + 1, state["pos"]),
+            tok=jnp.where(active, toks, state["tok"]),
+            rng=jnp.where(active[:, None], rngs, state["rng"]),
+            done=jnp.where(active, dones, state["done"]),
+        )
         return state, toks, dones
 
     # ------------------------------------------------------------------
@@ -555,7 +600,8 @@ class ServingEngine:
     def submit(self, prompt_ids=None, *, request: Optional[Request] = None,
                max_new_tokens: int = 20, seed: Optional[int] = None,
                rng=None, timeout: Optional[float] = None, on_token=None,
-               ignore_eos: bool = False, block: bool = False,
+               ignore_eos: bool = False, adapter: Optional[str] = None,
+               block: bool = False,
                block_timeout: Optional[float] = None) -> Request:
         """Enqueue one request; returns its :class:`Request` handle
         immediately. Raises :class:`scheduler.QueueFull` under backpressure
@@ -568,13 +614,22 @@ class ServingEngine:
         if request is None:
             request = Request(prompt_ids, max_new_tokens=max_new_tokens,
                               rng=rng, seed=seed, timeout=timeout,
-                              on_token=on_token, ignore_eos=ignore_eos)
+                              on_token=on_token, ignore_eos=ignore_eos,
+                              adapter=adapter)
         elif (request.status is not RequestStatus.QUEUED
                 or request.submitted_at is not None):
             raise ValueError(
                 f"Request handle already used (status "
                 f"{request.status.value}); Request objects are single-use — "
                 "build a fresh Request (or pass prompt_ids) per submission")
+        if request.adapter is not None:
+            if self._adapters is None:
+                raise ValueError(
+                    f"request names adapter {request.adapter!r} but this "
+                    "engine has no adapter bank (pass adapters=AdapterBank(...))")
+            # Unknown names raise UnknownAdapterError (a LookupError) here,
+            # synchronously — the gateway maps it to HTTP 404.
+            self._adapters.check_known(request.adapter)
         if (not self._accepting or self._stop or self._drain
                 or self._queue.closed):
             raise RuntimeError("serving engine is not accepting requests "
@@ -616,6 +671,23 @@ class ServingEngine:
     @property
     def prefix_cache(self) -> Optional[PrefixCache]:
         return self._prefix_cache
+
+    @property
+    def adapters(self) -> Optional[AdapterBank]:
+        return self._adapters
+
+    def register_adapter(self, name: str, adapter, **kwargs) -> None:
+        """Register a named LoRA adapter with this engine's bank (host-side;
+        the device load happens lazily at first use)."""
+        if self._adapters is None:
+            raise RuntimeError(
+                "engine has no adapter bank; construct it with "
+                "adapters=AdapterBank(params, ...)")
+        self._adapters.register(name, adapter, **kwargs)
+
+    def adapter_resident(self, name: str) -> bool:
+        """Whether ``name`` currently occupies a bank row (router affinity)."""
+        return self._adapters is not None and self._adapters.resident(name)
 
     # ------------------------------------------------------------------
     # engine thread
@@ -728,11 +800,43 @@ class ServingEngine:
         self._stats.record_finish(req.status)
         return False
 
+    def _acquire_adapter(self, req: Request) -> bool:
+        """Pin the request's adapter into a bank row before it takes a slot.
+
+        Base requests (or bank-less engines) use row 0, the identity.
+        Failure is REQUEST-fatal, never engine-fatal: an unknown name or a
+        fully-pinned bank fails this request with the original exception
+        (``engine.error`` stays None, so the router does not fail over) and
+        the loop moves on."""
+        if self._adapters is None or req.adapter is None:
+            req._adapter_row = 0
+            return True
+        try:
+            row, hit, evicted = self._adapters.acquire(req.adapter)
+        except Exception as e:
+            req._finish(RequestStatus.FAILED, e)
+            self._stats.record_finish(req.status)
+            return False
+        req._adapter_row = row
+        req._adapter_pinned = True
+        self._stats.record_adapter_admit(req.adapter, hit=hit, evicted=evicted)
+        return True
+
+    def _adapter_args(self, req: Request) -> tuple:
+        """Trailing (adapter_idx, bank) args for the prefill programs —
+        empty for bank-less engines, so their call signature (and traced
+        program) is exactly the pre-adapter one."""
+        if self._adapters is None:
+            return ()
+        return (np.int32(req._adapter_row), self._adapters.stacks)
+
     def _admit(self, req: Request):
         """Monolithic admission (``prefill_chunk=None``): host edge-pad to
         the 128 bucket (numpy — a jnp pad would compile per prompt
         length), run the whole prompt inline, and commit the first token.
         TTFT is stamped here because prefill itself emits token #1."""
+        if not self._acquire_adapter(req):
+            return
         req.admitted_at = time.monotonic()
         slot = self._slots.assign(req)
         S = req.prompt_ids.shape[1]
@@ -743,7 +847,8 @@ class ServingEngine:
         rng = req.rng if req.rng is not None else jax.random.PRNGKey(
             req.seed if req.seed is not None else 0)
         self._state, tok = self._prefill(
-            self.params, self._state, ids_p, np.int32(slot), rng, np.int32(S))
+            self.params, self._state, ids_p, np.int32(slot), rng, np.int32(S),
+            *self._adapter_args(req))
         self._finish_prefill(req, int(tok))
 
     def _bucket(self, S: int) -> int:
@@ -755,6 +860,8 @@ class ServingEngine:
         (``restore_prefix`` copies are not billed against the chunk
         budget — they are why the cache pays), and run the request's first
         live chunk. Returns the remaining budget."""
+        if not self._acquire_adapter(req):
+            return budget
         req.admitted_at = time.monotonic()
         slot = self._slots.assign(req)
         req.status = RequestStatus.PREFILLING
@@ -768,7 +875,8 @@ class ServingEngine:
         if self._prefix_cache is not None:
             n_full = S // C
             if n_full:
-                req._chunk_keys = self._prefix_keys(req.prompt_ids, n_full)
+                req._chunk_keys = self._prefix_keys(req.prompt_ids, n_full,
+                                                    req.adapter)
             # The FINAL chunk always re-runs (cached blocks hold KV, not the
             # logits the first token needs), so at most chunks 0..n-2 restore.
             restorable = min(n_full, req._chunks_total - 1)
@@ -789,14 +897,21 @@ class ServingEngine:
         self._run_chunk(req)
         return budget - 1
 
-    def _prefix_keys(self, prompt_ids, n_full: int) -> list[bytes]:
+    def _prefix_keys(self, prompt_ids, n_full: int,
+                     adapter: Optional[str] = None) -> list[bytes]:
         """Hash-chain digests of the prompt's full chunks: chunk i's key
         covers tokens ``[0, (i+1)*C)`` because each digest folds in the
         previous one — equal keys mean equal whole prefixes, never just
-        equal chunk contents."""
+        equal chunk contents. The chain is seeded with the request's
+        adapter identity: a LoRA adapter changes the KV a prefix produces,
+        so two tenants with byte-identical prompts must never share cached
+        blocks (cross-tenant KV leak)."""
         flat = np.ascontiguousarray(prompt_ids[0], np.int32)
         C = self._chunk
-        keys, prev = [], b"chunk:%d" % C
+        seed = b"chunk:%d" % C
+        if adapter is not None:
+            seed += b"/adapter:" + adapter.encode("utf-8")
+        keys, prev = [], seed
         for i in range(n_full):
             prev = hashlib.blake2b(
                 prev + flat[i * C:(i + 1) * C].tobytes(),
@@ -840,7 +955,8 @@ class ServingEngine:
         t0 = time.monotonic()
         self._state, tok, block = self._prefill_chunk(
             self.params, self._state, ids_c, np.int32(req.slot),
-            np.int32(offset), np.int32(S), req._rng_key)
+            np.int32(offset), np.int32(S), req._rng_key,
+            *self._adapter_args(req))
         tok.block_until_ready()  # honest chunk timing, paced dispatch
         dt_ms = (time.monotonic() - t0) * 1e3
         backlog = sum(1 for r in self._prefilling
@@ -881,8 +997,13 @@ class ServingEngine:
         for slot, _ in running:
             mask[slot] = True
         t0 = time.monotonic()
-        self._state, toks, dones = self._decode(
-            self.params, self._state, jnp.asarray(mask))
+        if self._adapters is None:
+            self._state, toks, dones = self._decode(
+                self.params, self._state, jnp.asarray(mask))
+        else:
+            self._state, toks, dones = self._decode(
+                self.params, self._state, jnp.asarray(mask),
+                self._adapters.stacks)
         toks = np.asarray(toks)     # sync point: the tick's device work
         dones = np.asarray(dones)
         dt = time.monotonic() - t0
@@ -915,5 +1036,10 @@ class ServingEngine:
                 error: Optional[BaseException] = None):
         if req.slot is not None:
             self._slots.release(req.slot)
+        if req._adapter_pinned:
+            req._adapter_pinned = False
+            self._adapters.release(req.adapter)
+        if req.adapter is not None:
+            self._stats.record_adapter_tokens(req.adapter, len(req.tokens))
         req._finish(status, error)
         self._stats.record_finish(req.status)

@@ -22,6 +22,10 @@ growing without bound: every healthy replica's admission queue full →
 **429** with ``Retry-After``; per-request deadline expired → **408**;
 request body over the cap → **413**; connection cap hit, gateway
 draining, or no healthy replica → **503**; malformed request → **400**.
+Multi-tenant LoRA maps the same way: ``"adapter"`` naming an adapter no
+replica has registered → **404** ``unknown_adapter``; every bank row
+pinned by an in-flight stream (momentary residency pressure) → **503**
+``adapter_bank_full`` with ``Retry-After``.
 
 Graceful drain: ``shutdown(drain=True)`` (also wired to SIGTERM/SIGINT
 by :meth:`ServingGateway.install_signal_handlers`) flips the gateway to
@@ -43,6 +47,7 @@ from typing import Optional
 
 import numpy as np
 
+from ..adapters.registry import AdapterBankFull
 from .engine import ServingEngine
 from .metrics import GatewayStats
 from .request import RequestStatus
@@ -243,8 +248,21 @@ class ServingGateway:
             v = float(value)
             lines.append(f"{name} {int(v) if v == int(v) else v}")
 
+        merged = self.replica_set.merged_stats()
         for k, v in self.replica_set.fleet_metrics().items():
+            if k.startswith("adapter/"):
+                continue  # re-emitted below as properly labeled series
             emit(f"accelerate_tpu_serving_{k}", v)
+        per_adapter = merged.per_adapter()
+        if per_adapter:
+            counters = sorted(next(iter(per_adapter.values())))
+            for c in counters:
+                lines.append(
+                    f"# TYPE accelerate_tpu_serving_adapter_{c} counter")
+                for name in sorted(per_adapter):
+                    lines.append(
+                        f'accelerate_tpu_serving_adapter_{c}'
+                        f'{{adapter="{name}"}} {per_adapter[name][c]}')
         for k, v in self.stats.summary().items():
             emit(f"accelerate_tpu_gateway_{k}", v)
         lines.append(
@@ -396,12 +414,18 @@ class _Handler(BaseHTTPRequestHandler):
         if timeout is not None and (not isinstance(timeout, (int, float))
                                     or timeout <= 0):
             raise _BadRequest('"timeout" must be a positive number')
+        adapter = body.get("adapter")
+        if adapter is not None and (not isinstance(adapter, str)
+                                    or not adapter):
+            raise _BadRequest('"adapter" must be a non-empty string '
+                              "(a registered LoRA adapter name) or omitted")
         return {
             "prompt_ids": ids,
             "max_new_tokens": max_new,
             "seed": seed,
             "timeout": None if timeout is None else float(timeout),
             "ignore_eos": bool(body.get("ignore_eos", False)),
+            "adapter": adapter,
             "stream": bool(body.get("stream", False)),
         }
 
@@ -415,12 +439,18 @@ class _Handler(BaseHTTPRequestHandler):
                 max_new_tokens=spec["max_new_tokens"],
                 seed=spec["seed"], timeout=spec["timeout"],
                 ignore_eos=spec["ignore_eos"],
+                adapter=spec["adapter"],
                 on_token=token_q.put if stream else None)
         except QueueFull:
             self._send_json(429, {"error": "all replicas saturated; "
                                            "retry later"},
                             route, extra_headers=self._retry_after(),
                             body_bytes_in=nbytes)
+            return
+        except LookupError as e:
+            self._send_json(404, {"error": "unknown_adapter",
+                                  "detail": str(e)},
+                            route, body_bytes_in=nbytes)
             return
         except RuntimeError as e:
             self._send_json(503, {"error": f"no healthy replica: {e}"},
@@ -435,6 +465,18 @@ class _Handler(BaseHTTPRequestHandler):
             self._stream_sse(fleet, token_q, route, nbytes)
         else:
             fleet.wait()  # bounded by the per-request deadline when set
+            if (fleet.status is RequestStatus.FAILED
+                    and isinstance(fleet.error, AdapterBankFull)):
+                # Residency pressure, not a server fault: every bank row
+                # was pinned by an in-flight stream at admission time.
+                # Structured 503 so clients can back off and retry.
+                payload = self._summary_payload(fleet, "failed")
+                payload["error"] = "adapter_bank_full"
+                payload["detail"] = str(fleet.error)
+                self._send_json(503, payload, route,
+                                extra_headers=self._retry_after(),
+                                body_bytes_in=nbytes)
+                return
             code, status = _STATUS_HTTP[fleet.status]
             payload = self._summary_payload(fleet, status)
             if code != 200:

@@ -72,6 +72,9 @@ class ServingStats:
             self._prefix_restored_bytes = 0
             self._prefix_cache_bytes = 0
             self._prefix_cache_entries = 0
+            # Per-adapter (multi-tenant LoRA) counters:
+            # name -> {requests, tokens, hits, misses, loads, evictions}.
+            self._adapter: dict = {}
 
     # -- caller side ----------------------------------------------------
     def record_submit(self, queue_depth: int):
@@ -136,6 +139,41 @@ class ServingStats:
             self._prefix_cache_bytes = int(nbytes)
             self._prefix_cache_entries = int(entries)
 
+    def _adapter_entry(self, name: str) -> dict:
+        # call with self._lock held
+        entry = self._adapter.get(name)
+        if entry is None:
+            entry = {"requests": 0, "tokens": 0, "hits": 0, "misses": 0,
+                     "loads": 0, "evictions": 0}
+            self._adapter[name] = entry
+        return entry
+
+    def record_adapter_admit(self, name: str, hit: bool, evicted=None):
+        """One adapter request admitted: a residency ``hit`` found the
+        adapter already in its bank row; a miss loaded it (possibly
+        evicting another tenant, billed to the EVICTED adapter)."""
+        with self._lock:
+            entry = self._adapter_entry(name)
+            entry["requests"] += 1
+            if hit:
+                entry["hits"] += 1
+            else:
+                entry["misses"] += 1
+                entry["loads"] += 1
+            if evicted is not None:
+                self._adapter_entry(evicted)["evictions"] += 1
+
+    def record_adapter_tokens(self, name: str, tokens: int):
+        """Tokens emitted by one retiring adapter request."""
+        with self._lock:
+            self._adapter_entry(name)["tokens"] += int(tokens)
+
+    def per_adapter(self) -> dict:
+        """``name -> {requests, tokens, hits, misses, loads, evictions}``
+        snapshot — the gateway's labeled Prometheus series."""
+        with self._lock:
+            return {name: dict(entry) for name, entry in self._adapter.items()}
+
     def record_finish(self, status):
         """One request retired; ``status`` is a RequestStatus."""
         from .request import RequestStatus
@@ -163,7 +201,12 @@ class ServingStats:
         with other._lock:
             o = dict(other.__dict__)
             o_samples = list(other._ttft_samples)
+            o_adapter = {name: dict(e) for name, e in other._adapter.items()}
         with self._lock:
+            for name, entry in o_adapter.items():
+                mine = self._adapter_entry(name)
+                for k, v in entry.items():
+                    mine[k] += v
             for k in ("_submitted", "_admitted", "_completed", "_failed",
                       "_cancelled", "_timed_out", "_rejected",
                       "_queue_wait_ms_sum", "_ttft_ms_sum", "_ticks",
@@ -201,7 +244,7 @@ class ServingStats:
             admits = max(1, self._admitted)
             caps = max(1, self._slot_capacity_sum)
             samples = list(self._ttft_samples)
-            return {
+            out = {
                 "requests_submitted": self._submitted,
                 "requests_admitted": self._admitted,
                 "requests_completed": self._completed,
@@ -241,6 +284,26 @@ class ServingStats:
                 "prefix_cache_bytes": self._prefix_cache_bytes,
                 "prefix_cache_entries": self._prefix_cache_entries,
             }
+            # Multi-tenant LoRA: flat aggregates plus per-name counters
+            # ("adapter/<name>/<counter>" — slash-pathed like tracker keys;
+            # the gateway re-emits these as labeled Prometheus series).
+            a_req = sum(e["requests"] for e in self._adapter.values())
+            a_hit = sum(e["hits"] for e in self._adapter.values())
+            a_lookups = a_hit + sum(e["misses"] for e in self._adapter.values())
+            out.update({
+                "adapters_tracked": len(self._adapter),
+                "adapter_requests": a_req,
+                "adapter_tokens": sum(e["tokens"] for e in self._adapter.values()),
+                "adapter_loads": sum(e["loads"] for e in self._adapter.values()),
+                "adapter_evictions": sum(
+                    e["evictions"] for e in self._adapter.values()),
+                "adapter_residency_hit_rate": round(a_hit / a_lookups, 4)
+                    if a_lookups else 0.0,
+            })
+            for name in sorted(self._adapter):
+                for k, v in self._adapter[name].items():
+                    out[f"adapter/{name}/{k}"] = v
+            return out
 
 
 class GatewayStats:

@@ -47,7 +47,8 @@ class Request:
                  rng=None, seed: Optional[int] = None,
                  timeout: Optional[float] = None,
                  on_token: Optional[Callable[[int], None]] = None,
-                 ignore_eos: bool = False):
+                 ignore_eos: bool = False,
+                 adapter: Optional[str] = None):
         ids = np.asarray(prompt_ids, np.int32)
         if ids.ndim == 1:
             ids = ids[None, :]
@@ -66,6 +67,11 @@ class Request:
         #: run to exactly max_new_tokens even if eos is emitted (warmup and
         #: benchmark traffic — keeps tick counts deterministic).
         self.ignore_eos = ignore_eos
+        if adapter is not None and (not isinstance(adapter, str) or not adapter):
+            raise ValueError(
+                f"adapter must be a non-empty string or None (got {adapter!r})")
+        #: named LoRA adapter this request decodes under (None = base model).
+        self.adapter = adapter
 
         self.tokens: list[int] = []        # committed tokens, streamed order
         self.status = RequestStatus.QUEUED
@@ -92,6 +98,12 @@ class Request:
         self._next_chunk = 0
         self._chunks_total = 0
         self._chunk_keys: Optional[list] = None
+
+        # Adapter bookkeeping (engine thread only): the bank row this
+        # request gathers, and whether it holds a residency pin that
+        # _retire must release.
+        self._adapter_row = 0
+        self._adapter_pinned = False
 
     # -- caller API -----------------------------------------------------
     def cancel(self):

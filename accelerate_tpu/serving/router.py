@@ -89,9 +89,12 @@ class FleetRequest:
                  rng=None, seed: Optional[int] = None,
                  timeout: Optional[float] = None,
                  on_token: Optional[Callable[[int], None]] = None,
-                 ignore_eos: bool = False):
-        # Reuse Request's prompt validation (shape + max_new bounds).
-        proto = Request(prompt_ids, max_new_tokens=max_new_tokens)
+                 ignore_eos: bool = False,
+                 adapter: Optional[str] = None):
+        # Reuse Request's prompt validation (shape + max_new bounds +
+        # adapter name form).
+        proto = Request(prompt_ids, max_new_tokens=max_new_tokens,
+                        adapter=adapter)
         self.prompt_ids = proto.prompt_ids
         self.max_new_tokens = proto.max_new_tokens
         self.rng = rng
@@ -99,6 +102,8 @@ class FleetRequest:
         self.timeout = timeout
         self.on_token = on_token
         self.ignore_eos = ignore_eos
+        #: named LoRA adapter, preserved across failovers (None = base).
+        self.adapter = proto.adapter
 
         self.tokens: list[int] = []
         self.status = RequestStatus.QUEUED
@@ -309,30 +314,45 @@ class ReplicaSet:
         self._replicas[index].engine.kill(error)
 
     # -- routing ---------------------------------------------------------
-    def _candidates(self) -> list[_Replica]:
+    def _candidates(self, adapter: Optional[str] = None) -> list[_Replica]:
         """Healthy replicas, best-first: most free decode slots, then
-        lowest total occupancy, then index (stable)."""
+        lowest total occupancy, then index (stable). When the request
+        names a LoRA adapter, replicas with that adapter already RESIDENT
+        in their device bank rank first (routing affinity saves a host→
+        device row upload), engines built without a bank drop out
+        entirely, and load order breaks ties as usual."""
         self.refresh_health()
         cands = [r for r in self._replicas
-                 if r.state is ReplicaState.HEALTHY and r.engine.healthy]
-        cands.sort(key=lambda r: (-r.engine.free_slots, r.engine.load,
-                                  r.index))
+                 if r.state is ReplicaState.HEALTHY and r.engine.healthy
+                 and (adapter is None or r.engine.adapters is not None)]
+        if adapter is None:
+            cands.sort(key=lambda r: (-r.engine.free_slots, r.engine.load,
+                                      r.index))
+        else:
+            cands.sort(key=lambda r: (not r.engine.adapter_resident(adapter),
+                                      -r.engine.free_slots, r.engine.load,
+                                      r.index))
         return cands
 
     def submit(self, prompt_ids=None, *, max_new_tokens: int = 20,
                seed: Optional[int] = None, rng=None,
                timeout: Optional[float] = None, on_token=None,
-               ignore_eos: bool = False, block: bool = False,
+               ignore_eos: bool = False, adapter: Optional[str] = None,
+               block: bool = False,
                block_timeout: Optional[float] = None) -> FleetRequest:
         """Route one request to the least-loaded healthy replica; returns
         a :class:`FleetRequest` immediately. Raises
         :class:`~.scheduler.QueueFull` when every healthy replica's
         admission queue is full (``block=True`` waits for space on the
-        best one first, up to ``block_timeout``), and ``RuntimeError``
-        when no replica is healthy at all."""
+        best one first, up to ``block_timeout``), ``RuntimeError`` when no
+        replica is healthy at all, and ``LookupError``
+        (:class:`~..adapters.registry.UnknownAdapterError`) when
+        ``adapter`` names an adapter no healthy replica has registered —
+        the signal the gateway maps to HTTP 404."""
         fleet = FleetRequest(prompt_ids, max_new_tokens=max_new_tokens,
                              rng=rng, seed=seed, timeout=timeout,
-                             on_token=on_token, ignore_eos=ignore_eos)
+                             on_token=on_token, ignore_eos=ignore_eos,
+                             adapter=adapter)
         fleet.submitted_at = time.monotonic()
         with self._lock:
             self._submitted += 1
@@ -348,7 +368,7 @@ class ReplicaSet:
         last_exc: Optional[BaseException] = None
         saturated = False
         for attempt in range(2):
-            for r in self._candidates():
+            for r in self._candidates(fleet.adapter):
                 inner = self._make_inner(fleet, r)
                 if inner is None:  # cancelled or deadline passed meanwhile
                     return
@@ -359,6 +379,13 @@ class ReplicaSet:
                         block_timeout=block_timeout)
                 except QueueFull as e:
                     last_exc, saturated = e, True
+                    continue
+                except LookupError as e:
+                    # THIS replica's registry doesn't know the adapter
+                    # (registries may trail during a rollout) — try the
+                    # next one; when nobody knows, the LookupError
+                    # surfaces to the caller as-is (gateway → 404).
+                    last_exc = e
                     continue
                 except RuntimeError as e:
                     # Died between the health check and the enqueue.
@@ -378,6 +405,8 @@ class ReplicaSet:
                 raise QueueFull(
                     "every healthy replica's admission queue is full; "
                     "retry later") from last_exc
+            if isinstance(last_exc, LookupError):
+                raise last_exc
             raise RuntimeError(
                 "no healthy replica available") from last_exc
         with self._lock:
@@ -402,10 +431,30 @@ class ReplicaSet:
                         max_new_tokens=fleet._remaining_new_tokens(),
                         rng=fleet.rng, seed=fleet.seed,
                         timeout=remaining_t, on_token=fleet._emit,
-                        ignore_eos=fleet.ignore_eos)
+                        ignore_eos=fleet.ignore_eos,
+                        adapter=fleet.adapter)
         inner._on_finish = lambda req: self._on_inner_finish(
             fleet, replica, req)
         return inner
+
+    # -- adapters ---------------------------------------------------------
+    def register_adapter(self, name: str, adapter, **kwargs):
+        """Register a LoRA adapter on EVERY replica's bank. Fleet-wide
+        registration is what makes failover tenant-preserving: a stream
+        decoding under adapter X can resume on any survivor, which loads
+        X into its own bank at admission if it isn't already resident.
+        Raises ``RuntimeError`` if any replica was built without an
+        :class:`~..adapters.registry.AdapterBank`."""
+        for r in self._replicas:
+            r.engine.register_adapter(name, adapter, **kwargs)
+
+    def unregister_adapter(self, name: str):
+        """Drop a named adapter from every replica that knows it (idle
+        banks only free the device row lazily on the next eviction)."""
+        for r in self._replicas:
+            bank = r.engine.adapters
+            if bank is not None and name in bank.names():
+                bank.unregister(name)
 
     # -- failover ---------------------------------------------------------
     def _on_inner_finish(self, fleet: FleetRequest, replica: _Replica,

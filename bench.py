@@ -786,6 +786,126 @@ def replica_failover_bench(n_inflight: int = 4, step_ms: float = 20.0,
     }
 
 
+def _test_lora_adapters(params, n_tenants: int, rank: int):
+    """``n_tenants`` distinct rank-``rank`` adapters with nonzero B factors
+    (a fresh ``init_lora_params`` is a zero delta — useless for telling
+    tenants apart)."""
+    import jax
+
+    from accelerate_tpu.adapters import LoRAConfig, init_lora_params
+
+    cfg = LoRAConfig(rank=rank)
+    out = []
+    for t in range(n_tenants):
+        ad = init_lora_params(jax.random.PRNGKey(t), params, cfg)
+        flat, _ = jax.tree_util.tree_flatten_with_path(ad)
+        leaves = []
+        for i, (path, leaf) in enumerate(flat):
+            if getattr(path[-1], "key", None) == "b":
+                k = jax.random.fold_in(jax.random.PRNGKey(1000 + t), i)
+                leaf = 0.05 * jax.random.normal(k, leaf.shape, leaf.dtype)
+            leaves.append(leaf)
+        out.append(jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(ad), leaves))
+    return out
+
+
+def multi_tenant_adapter_bench(n_tenants: int = 4, prompt_len: int = 4,
+                               max_new_tokens: int = 24, rank: int = 4,
+                               step_ms: float = 10.0) -> dict:
+    """Batched multi-tenant LoRA serving vs sequential merged-weight
+    swapping, ``n_tenants`` tenants with one request each:
+
+    * batched — ONE engine with an :class:`AdapterBank`: every tenant's
+      request decodes in its own slot of the SAME vmapped tick, each slot
+      gathering its own bank row; the per-tick sleepy cost is paid once
+      for all tenants.
+    * sequential — the no-bank alternative: per tenant, merge the adapter
+      into the base weights (the swap cost) and run offline ``generate``;
+      tenants serialize, so every tenant pays the full per-token cost.
+
+    Both paths run the SAME sleepy model and are precompiled before
+    timing (merged params are jit ARGUMENTS, so swapping tenants never
+    recompiles the sequential path either — the measured gap is
+    batching, not compilation). ``tokens_equal`` asserts each tenant's
+    served stream is token-identical to offline generate on its merged
+    weights — the correctness half of the A/B."""
+    import jax
+    import numpy as np
+
+    from accelerate_tpu import generation
+    from accelerate_tpu.adapters import AdapterBank, LoRAConfig, merge_adapter
+    from accelerate_tpu.models.llama import LlamaConfig
+    from accelerate_tpu.serving import ServingEngine
+
+    model = _sleepy_llama_cls(step_ms)(LlamaConfig.tiny())
+    params = model.init_params(jax.random.PRNGKey(0))
+    adapters = _test_lora_adapters(params, n_tenants, rank)
+    names = [f"tenant{t}" for t in range(n_tenants)]
+    rng = np.random.default_rng(0)
+    prompts = rng.integers(1, 200,
+                           size=(n_tenants, prompt_len)).astype(np.int32)
+
+    merged = [merge_adapter(params, ad) for ad in adapters]
+
+    # Sequential baseline, precompiled: one untimed generate so the timed
+    # loop pays merge + execution only, never compilation.
+    np.asarray(generation.generate(model, merged[0], prompts[:1],
+                                   max_new_tokens=max_new_tokens))
+    t0 = time.perf_counter()
+    seq_out = []
+    for t in range(n_tenants):
+        w = merge_adapter(params, adapters[t])  # the per-tenant swap cost
+        jax.block_until_ready(w)
+        seq_out.append(np.asarray(generation.generate(
+            model, w, prompts[t:t + 1],
+            max_new_tokens=max_new_tokens))[0, prompt_len:])
+    sequential_s = time.perf_counter() - t0
+
+    bank = AdapterBank(params, config=LoRAConfig(rank=rank),
+                       max_adapters=n_tenants + 1)
+    engine = ServingEngine(model, params, max_slots=n_tenants, max_len=64,
+                           prefix_cache_mb=0.0, adapters=bank)
+    try:
+        for name, ad in zip(names, adapters):
+            engine.register_adapter(name, ad)
+        t0 = time.perf_counter()
+        reqs = [engine.submit(prompts[t:t + 1],
+                              max_new_tokens=max_new_tokens,
+                              adapter=names[t], block=True)
+                for t in range(n_tenants)]
+        for r in reqs:
+            r.wait(timeout=120)
+        batched_s = time.perf_counter() - t0
+        tokens_equal = all(
+            np.array_equal(np.asarray(reqs[t].tokens), seq_out[t])
+            for t in range(n_tenants))
+        stats = engine.serving_metrics()
+    finally:
+        engine.shutdown()
+    return {
+        "n_tenants": n_tenants,
+        "rank": rank,
+        "step_ms": step_ms,
+        "max_new_tokens": max_new_tokens,
+        "sequential_swap_s": round(sequential_s, 4),
+        "batched_s": round(batched_s, 4),
+        "speedup": round(sequential_s / batched_s, 3) if batched_s else None,
+        "tokens_equal": bool(tokens_equal),
+        "adapter_requests": stats.get("adapter_requests"),
+        "adapter_loads": stats.get("adapter_loads"),
+    }
+
+
+def adapters_extra(on_tpu: bool) -> dict:
+    """The ``extra.adapters`` payload: the batched-vs-sequential-swap
+    multi-tenant A/B on the sleepy tiny model (CPU only, same reasoning
+    as :func:`serving_extra`)."""
+    if on_tpu:
+        return {}
+    return {"multi_tenant": multi_tenant_adapter_bench()}
+
+
 def serving_extra(on_tpu: bool) -> dict:
     """The ``extra.serving`` payload: on CPU the offered-load sweep, the
     continuous-vs-static staggered-arrival comparison, the
@@ -968,6 +1088,14 @@ def run_bench(on_tpu: bool) -> dict:
                 result["extra"]["serving"] = serving
         except Exception as e:  # noqa: BLE001 - observability must not kill the result
             result["extra"]["serving_error"] = f"{type(e).__name__}: {e}"
+        # Multi-tenant LoRA payload: batched-bank vs sequential merged-
+        # weight swapping on the tiny model (CPU only; see adapters_extra).
+        try:
+            adapters = adapters_extra(on_tpu)
+            if adapters:
+                result["extra"]["adapters"] = adapters
+        except Exception as e:  # noqa: BLE001 - observability must not kill the result
+            result["extra"]["adapters_error"] = f"{type(e).__name__}: {e}"
         return result
 
     if on_tpu:

@@ -45,6 +45,12 @@ GROUPS = [
      "prefill/decode programs, request handles, serving counters — plus "
      "the multi-replica router (health states, fault-tolerant failover) "
      "and the stdlib HTTP gateway in front of it."),
+    ("adapters", "LoRA adapters",
+     ["accelerate_tpu.adapters.lora", "accelerate_tpu.adapters.registry"],
+     "Multi-tenant LoRA: config/init/merge and the frozen-base training "
+     "split, plus the device-resident adapter bank the serving engine "
+     "gathers from per slot — many tenants over one base model with "
+     "zero recompiles."),
     ("data_loader", "Data loading", ["accelerate_tpu.data_loader"],
      "Sharded/dispatched loaders, global-batch assembly, skip/resume, packing."),
     ("optimizer_scheduler", "Optimizer & scheduler",

@@ -47,6 +47,7 @@ LANES: dict[str, tuple[int, list[str]]] = {
         "test_tracking.py",
     ]),
     "models": (12, [
+        "test_adapters.py",
         "test_big_modeling.py",
         "test_fp8.py",
         "test_generation.py",

@@ -179,6 +179,14 @@ class TestCLISubprocess:
         assert out.returncode == 0, out.stderr
         assert "float32" in out.stdout and "bfloat16" in out.stdout
 
+    def test_estimate_memory_lora_rank(self):
+        out = _run_cli("estimate-memory", "llama-tiny",
+                       "--dtypes", "float32", "--lora-rank", "8")
+        assert out.returncode == 0, out.stderr
+        assert "trainable params" in out.stdout
+        assert "% of base" in out.stdout
+        assert "adapter checkpoint" in out.stdout
+
     def test_estimate_memory_unknown_model(self):
         out = _run_cli("estimate-memory", "not-a-model")
         assert out.returncode == 2

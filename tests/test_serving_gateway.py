@@ -18,6 +18,12 @@ The acceptance-critical properties pinned here:
   fleet-MERGED engine counters; backpressure mapped to status codes
   (429 + Retry-After on queue-full, 408 on deadline, 413 on body cap,
   400 on malformed requests); graceful drain semantics.
+* MULTI-TENANCY — requests carry an ``adapter`` name end to end:
+  per-tenant streams are token-identical to offline generation on
+  merged weights, the router prefers adapter-resident replicas,
+  failover re-routes a tenant onto a survivor that lazily hot-loads
+  the adapter row, unknown names map to HTTP 404 and bank pressure to
+  a structured 503 that never poisons the engine.
 
 Every server binds port 0 (OS-assigned ephemeral) — no fixed-port
 flakes. Timing-sensitive failover tests run on bench's deterministic-
@@ -40,6 +46,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import bench  # noqa: E402
 from accelerate_tpu import generation  # noqa: E402
+from accelerate_tpu.adapters import (  # noqa: E402
+    AdapterBank,
+    LoRAConfig,
+    merge_adapter,
+)
 from accelerate_tpu.models.llama import LlamaConfig, LlamaForCausalLM  # noqa: E402
 from accelerate_tpu.serving import (  # noqa: E402
     FleetRequest,
@@ -631,3 +642,190 @@ class TestFailoverSoak:
             assert total_failovers == fm["fleet_failovers"] > 0
         finally:
             rs.shutdown()
+
+
+# -- multi-tenant LoRA adapters over the fleet -------------------------
+def _adapter_fleet(m, params, adapters, n=2, rank=4, **kw):
+    """Bank-equipped fleet with every adapter registered fleet-wide.
+
+    Residency is lazy (a bank row loads at first acquire, on the engine
+    thread), so a freshly built fleet has nothing resident — exactly the
+    starting state the survivor-must-load failover test needs."""
+    kw.setdefault("max_slots", 3)
+    kw.setdefault("max_len", 64)
+    kw.setdefault("eos_token_id", EOS)
+    bank_rows = kw.pop("max_adapters", len(adapters) + 1)
+    rs = ReplicaSet.from_factory(
+        lambda: ServingEngine(
+            m, params,
+            adapters=AdapterBank(params, config=LoRAConfig(rank=rank),
+                                 max_adapters=bank_rows), **kw), n)
+    for name, ad in adapters.items():
+        rs.register_adapter(name, ad)
+    return rs
+
+
+class TestAdapterGatewayHTTP:
+    """HTTP surface of multi-tenant serving: per-tenant exactness, the
+    404/400 contract for bad adapter names, and labeled /metrics."""
+
+    @pytest.fixture(scope="class")
+    def agw(self, tiny):
+        _, m, params = tiny
+        ads = dict(zip(("acme", "globex"),
+                       bench._test_lora_adapters(params, 2, rank=4)))
+        rs = _adapter_fleet(m, params, ads, n=1)
+        gw = ServingGateway(rs, config=GatewayConfig(port=0))
+        gw.start()
+        yield gw, m, params, ads
+        gw.shutdown(drain=False)
+
+    def test_tenants_exact_and_isolated(self, agw):
+        gw, m, params, ads = agw
+        n, p = 12, PROMPTS[0]
+        streams = {}
+        for name in (None, "acme", "globex"):
+            payload = {"prompt": p[0].tolist(), "max_new_tokens": n,
+                       "seed": 0}
+            if name:
+                payload["adapter"] = name
+            code, out, _ = _post(gw.url, payload)
+            assert code == 200 and out["status"] == "completed", out
+            ref_params = merge_adapter(params, ads[name]) if name else params
+            _assert_matches_offline(out["tokens"],
+                                    _offline(m, ref_params, p, n), n)
+            streams[name] = tuple(out["tokens"])
+        # Same prompt, three tenants (base + two adapters), three streams.
+        assert len(set(streams.values())) == 3, streams
+
+    def test_unknown_adapter_404(self, agw):
+        gw, *_ = agw
+        code, out, _ = _post(gw.url, {"prompt": [1, 2, 3],
+                                      "max_new_tokens": 4,
+                                      "adapter": "nobody"})
+        assert code == 404 and out["error"] == "unknown_adapter"
+        assert "nobody" in out["detail"]
+
+    def test_malformed_adapter_400(self, agw):
+        gw, *_ = agw
+        for bad in ("", 7, ["acme"]):
+            code, out, _ = _post(gw.url, {"prompt": [1, 2], "adapter": bad})
+            assert code == 400 and "adapter" in out["error"], bad
+
+    def test_metrics_carry_adapter_labels(self, agw):
+        gw, *_ = agw
+        _post(gw.url, {"prompt": [1, 2, 3], "max_new_tokens": 2,
+                       "seed": 0, "adapter": "acme"})
+        code, text = _get(gw.url, "/metrics")
+        assert code == 200
+        assert any(l.startswith(
+            'accelerate_tpu_serving_adapter_requests{adapter="acme"}')
+            for l in text.splitlines())
+        # The flat "adapter/<name>/..." internal keys never leak as raw
+        # (invalid) Prometheus metric names.
+        assert "adapter/" not in text
+
+
+class TestAdapterFailover:
+    @pytest.mark.slow
+    def test_router_prefers_resident_replica(self, tiny):
+        """Once a tenant's row is resident somewhere, subsequent requests
+        for that tenant stick to it instead of ping-ponging rows across
+        banks (load still wins between equally-resident replicas)."""
+        _, m, params = tiny
+        (ad,) = bench._test_lora_adapters(params, 1, rank=4)
+        rs = _adapter_fleet(m, params, {"acme": ad}, n=2)
+        try:
+            first = rs.submit(PROMPTS[0], max_new_tokens=4, seed=0,
+                              adapter="acme")
+            assert first.wait(timeout=120)
+            home = first.replica_trail[0]
+            assert rs.replicas[home].engine.adapter_resident("acme")
+            for _ in range(3):
+                r = rs.submit(PROMPTS[1], max_new_tokens=4, seed=0,
+                              adapter="acme")
+                assert r.wait(timeout=120)
+                assert r.replica_trail == [home]
+            other = rs.replicas[1 - home].engine
+            assert not other.adapter_resident("acme")
+            assert other.adapters.counters()["loads"] == 0
+        finally:
+            rs.shutdown()
+
+    @pytest.mark.slow
+    def test_failover_preserves_tenant_and_loads_on_survivor(self, sleepy):
+        """Kill the replica serving a tenant's stream mid-flight. The
+        retry must carry the adapter with it: the survivor — which has
+        never served this tenant, so its bank row is NOT resident —
+        lazily hot-loads the adapter and resumes the stream token-exact
+        against the merged-weights offline reference."""
+        m, params = sleepy
+        (ad,) = bench._test_lora_adapters(params, 1, rank=4)
+        rs = _adapter_fleet(m, params, {"acme": ad}, n=2, max_slots=2)
+        n = 24
+        ref_t = _offline(m, merge_adapter(params, ad), PROMPTS[0], n)
+        ref_b = _offline(m, params, PROMPTS[1], n)
+        try:
+            rt = rs.submit(PROMPTS[0], max_new_tokens=n, seed=0,
+                           adapter="acme")
+            rb = rs.submit(PROMPTS[1], max_new_tokens=n, seed=0)
+            deadline = time.monotonic() + 60
+            while (min(len(rt.tokens), len(rb.tokens)) < 3
+                   and time.monotonic() < deadline):
+                time.sleep(0.01)
+            assert min(len(rt.tokens), len(rb.tokens)) >= 3
+            victim = rt.replica_trail[0]
+            survivor = 1 - victim
+            assert rs.replicas[victim].engine.adapter_resident("acme")
+            assert not rs.replicas[survivor].engine.adapter_resident("acme")
+            rs.kill_replica(victim)
+            assert rt.wait(timeout=120) and rb.wait(timeout=120)
+            assert rt.status is RequestStatus.COMPLETED, rt
+            assert rb.status is RequestStatus.COMPLETED, rb
+            _assert_matches_offline(rt.tokens, ref_t, n)
+            _assert_matches_offline(rb.tokens, ref_b, n)
+            assert rt.adapter == "acme"
+            assert rt.failovers == 1
+            assert rt.replica_trail == [victim, survivor]
+            # Finishing the stream forced the survivor to hot-load the row.
+            surv = rs.replicas[survivor].engine
+            assert surv.adapter_resident("acme")
+            assert surv.adapters.counters()["loads"] == 1
+        finally:
+            rs.shutdown()
+
+    @pytest.mark.slow
+    def test_bank_full_maps_to_structured_503(self, sleepy):
+        """Every non-base row pinned by an in-flight tenant: a second
+        tenant's HTTP request gets a structured 503 (adapter_bank_full +
+        Retry-After) while the replica stays HEALTHY, and the same
+        request succeeds once the pin releases."""
+        m, params = sleepy
+        ads = dict(zip(("acme", "globex"),
+                       bench._test_lora_adapters(params, 2, rank=4)))
+        rs = _adapter_fleet(m, params, ads, n=1, max_adapters=2,
+                            max_slots=2)
+        gw = ServingGateway(rs, config=GatewayConfig(port=0))
+        gw.start()
+        try:
+            long = rs.submit(PROMPTS[0], max_new_tokens=48, seed=0,
+                             ignore_eos=True, adapter="acme")
+            deadline = time.monotonic() + 60
+            while not long.tokens and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert long.tokens  # the single non-base row is now pinned
+            code, out, hdrs = _post(gw.url, {
+                "prompt": PROMPTS[1][0].tolist(), "max_new_tokens": 4,
+                "seed": 0, "adapter": "globex"})
+            assert code == 503 and out["error"] == "adapter_bank_full"
+            assert "globex" in out["detail"]
+            assert "Retry-After" in hdrs
+            assert rs.replicas[0].state is ReplicaState.HEALTHY
+            assert long.wait(timeout=120)
+            assert long.status is RequestStatus.COMPLETED
+            code, out, _ = _post(gw.url, {
+                "prompt": PROMPTS[1][0].tolist(), "max_new_tokens": 4,
+                "seed": 0, "adapter": "globex"})
+            assert code == 200 and out["status"] == "completed"
+        finally:
+            gw.shutdown(drain=False)
