@@ -97,9 +97,49 @@ class AdapterBank:
                 stacks, host)
 
         self._write = jax.jit(write_row)
+        self._write_row_fn = write_row
+        self._placed_mesh = None
         # Compile the (only) row-write program up front by re-writing the
         # identity into row 0 — later loads reuse this executable.
         self.stacks = self._write(self.stacks, jnp.int32(0), self._identity())
+
+    def place(self, shardings) -> None:
+        """Shard the bank across a serving slice (mesh-sliced engines).
+
+        ``shardings`` is a NamedSharding pytree matching :attr:`stacks`
+        (from ``SliceExec.bank_shardings``: each target's LoRA factors laid
+        out like its base kernel — column-parallel targets shard ``b`` on
+        ``d_out``, row-parallel ``a`` on ``d_in``; the row axis never
+        splits). The stacks move onto the slice and the row-write program
+        is re-jitted with matching in/out shardings, so later
+        loads/evictions keep writing ONE ``dynamic_update_slice`` per leaf
+        straight into the sharded layout — residency stays recompile-free.
+
+        Engine-construction time only, and once per bank: a bank placed on
+        one slice cannot serve another (each ``from_mesh`` slice engine
+        builds its own via ``make_adapters``).
+        """
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        leaves = jax.tree_util.tree_leaves(
+            shardings, is_leaf=lambda x: hasattr(x, "spec"))
+        mesh = leaves[0].mesh
+        with self._lock:
+            if self._placed_mesh is not None and self._placed_mesh != mesh:
+                raise ValueError(
+                    "AdapterBank is already placed on another mesh slice; "
+                    "each mesh-sliced engine needs its OWN bank (pass a "
+                    "make_adapters factory to ReplicaSet.from_mesh)")
+            self._placed_mesh = mesh
+            replicated = NamedSharding(mesh, PartitionSpec())
+            self.stacks = jax.tree_util.tree_map(
+                lambda s, sh: jax.device_put(s, sh), self.stacks, shardings)
+            self._write = jax.jit(
+                self._write_row_fn,
+                in_shardings=(shardings, replicated, replicated),
+                out_shardings=shardings)
+            self.stacks = self._write(self.stacks, jnp.int32(0),
+                                      self._identity())
 
     # ------------------------------------------------------------------
     # host registry

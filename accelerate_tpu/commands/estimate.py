@@ -117,6 +117,56 @@ def _abstract_from_path(path: str):
     return None
 
 
+def _tp_param_split(abstract, tp: int):
+    """(per_chip_elems, sharded_elems, total_elems) under the serving TP
+    rules: a leaf divides by ``tp`` exactly when a Megatron column/row rule
+    matches its path AND the ruled dimension is divisible — the same
+    predicate ``SliceExec.param_shardings`` compiles, so the printed
+    number is the layout a mesh-sliced engine actually serves."""
+    import numpy as np
+    from jax.tree_util import tree_map_with_path
+
+    from ..parallel.sharding import ShardingRules, _leaf_path_str
+
+    rules = ShardingRules()
+    counts = {"per_chip": 0, "sharded": 0, "total": 0}
+
+    def visit(path, leaf):
+        shape = tuple(getattr(leaf, "shape", ()) or ())
+        n = int(np.prod(shape)) if shape else 1
+        counts["total"] += n
+        dim = rules.tp_dim_for(_leaf_path_str(path))
+        if dim is not None and shape and shape[dim % len(shape)] % tp == 0:
+            counts["per_chip"] += n // tp
+            counts["sharded"] += n
+        else:
+            counts["per_chip"] += n
+        return leaf
+
+    tree_map_with_path(visit, abstract)
+    return counts["per_chip"], counts["sharded"], counts["total"]
+
+
+def _kv_geometry(module):
+    """(layers, kv_heads, head_dim) from the module's config, or None when
+    the abstract tree came from bare safetensors headers (no config)."""
+    config = getattr(module, "config", None)
+    if config is None:
+        return None
+    layers = getattr(config, "num_hidden_layers", None)
+    heads = getattr(config, "num_attention_heads", None)
+    if layers is None or heads is None:
+        return None
+    kv = getattr(config, "num_key_value_heads", None) or heads
+    head_dim = getattr(config, "head_dim", None)
+    if head_dim is None:
+        hidden = getattr(config, "hidden_size", None)
+        if hidden is None:
+            return None
+        head_dim = hidden // heads
+    return int(layers), int(kv), int(head_dim)
+
+
 def _fmt(nbytes: float) -> str:
     for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
         if nbytes < 1024 or unit == "TiB":
@@ -140,6 +190,7 @@ def estimate_command(args) -> int:
     from ..utils.modeling import calculate_maximum_sizes, compute_module_sizes
 
     registry = _model_registry()
+    module = None
     if args.model_name in registry:
         module = registry[args.model_name]()
         abstract = init_empty_weights(module)
@@ -204,6 +255,55 @@ def estimate_command(args) -> int:
         # Optimizer state only covers the trainable low-rank factors —
         # the base stays frozen, so Adam costs 2 fp32 moments on n_lora.
         print(f"  Adam moments (fp32)      : {_fmt(ckpt_bytes * 2)}")
+    if args.tp > 1:
+        per_chip, sharded, total_elems = _tp_param_split(abstract, args.tp)
+        print(f"\nTensor-parallel slice (tp={args.tp}, Megatron "
+              "column/row layout — the mesh-sliced serving engine's split):")
+        print(f"  params per chip (bfloat16): {_fmt(per_chip * 2)}  "
+              f"({100.0 * sharded / max(total_elems, 1):.1f}% of weights "
+              f"sharded, rest replicated)")
+        print(f"  params per chip (float32) : {_fmt(per_chip * 4)}")
+        # Grads + fp32 master + 2 Adam moments shard exactly like their
+        # params (same PartitionSpecs), so per-chip training state is the
+        # table's formula applied to the per-chip element count.
+        print(f"  training (Adam) per chip  : {_fmt(per_chip * 2 * 2 + per_chip * 4 * 3)}")
+        geom = _kv_geometry(module)
+        if geom is not None:
+            layers, kv_heads, head_dim = geom
+            # The engine shards the KV heads axis when divisible, else the
+            # head_dim axis, else the cache replicates (SliceExec.heads_axis).
+            div = (args.tp if kv_heads % args.tp == 0
+                   else args.tp if head_dim % args.tp == 0 else 1)
+            per_tok = 2 * layers * kv_heads * head_dim * 2  # k+v, bf16
+            note = "" if div == args.tp else "  (heads not divisible: REPLICATED)"
+            print(f"  KV cache per chip (bf16)  : {_fmt(per_tok / div)}/token/slot"
+                  f"  [2 x {layers} layers x {kv_heads} kv-heads x "
+                  f"{head_dim} head-dim]{note}")
+        else:
+            print("  KV cache per chip         : n/a (no model config — pass "
+                  "a built-in name or config.json)")
+        if args.lora_rank is not None:
+            from ..adapters.lora import LoRAConfig, target_paths, _get_path
+
+            from ..parallel.sharding import ShardingRules
+
+            rules = ShardingRules()
+            bank_pc = bank_total = 0
+            for dotted in target_paths(abstract, LoRAConfig(rank=args.lora_rank)):
+                d_in, d_out = _get_path(abstract, dotted)["kernel"].shape[-2:]
+                a_n, b_n = int(d_in) * args.lora_rank, args.lora_rank * int(d_out)
+                tp_dim = rules.tp_dim_for(dotted.replace(".", "/") + "/kernel")
+                if tp_dim == -1 and d_out % args.tp == 0:      # column: shard b
+                    pc = a_n + b_n // args.tp
+                elif tp_dim == -2 and d_in % args.tp == 0:     # row: shard a
+                    pc = a_n // args.tp + b_n
+                else:
+                    pc = a_n + b_n
+                bank_pc += pc
+                bank_total += a_n + b_n
+            print(f"  adapter bank row per chip (rank {args.lora_rank}, fp32): "
+                  f"{_fmt(bank_pc * 4)}  (x max_adapters rows; "
+                  f"{_fmt(bank_total * 4)} unsharded)")
     return 0
 
 
@@ -224,6 +324,10 @@ def estimate_command_parser(subparsers=None):
     parser.add_argument("--lora-rank", type=int, default=None,
                         help="Also print the LoRA trainable-parameter count and "
                              "adapter checkpoint size at this rank")
+    parser.add_argument("--tp", type=int, default=1,
+                        help="Also print per-chip params / KV-cache / adapter-bank "
+                             "sizes for a mesh-sliced serving replica of this "
+                             "tensor-parallel width")
     if subparsers is not None:
         parser.set_defaults(func=estimate_command)
     return parser

@@ -92,9 +92,21 @@ def serve_command(args) -> int:
     print(f"warming up {args.replicas} replica(s) "
           f"(slots={args.max_slots}, max_len={args.max_len}, "
           f"chunk={args.prefill_chunk}"
+          + (f", tp={args.tp}" if args.tp > 1 else "")
           + (f", adapters={max_adapters - 1}" if max_adapters >= 2 else "")
           + ") ...", flush=True)
-    replica_set = ReplicaSet.from_factory(factory, args.replicas)
+    if args.tp > 1:
+        # One replica = one tp-wide mesh slice; the fleet shares a
+        # host-portable prefix cache so failover keeps its prefix hits.
+        replica_set = ReplicaSet.from_mesh(
+            model, params, tp=args.tp, num_slices=args.replicas,
+            make_adapters=(make_bank if max_adapters >= 2 else None),
+            max_slots=args.max_slots, max_len=args.max_len,
+            max_queued=args.max_queued, eos_token_id=args.eos_token_id,
+            prefill_chunk=args.prefill_chunk,
+            prefix_cache_mb=args.prefix_cache_mb)
+    else:
+        replica_set = ReplicaSet.from_factory(factory, args.replicas)
     if adapter_specs:
         from ..adapters import load_adapter
 
@@ -138,6 +150,11 @@ def serve_command_parser(subparsers=None):
                              "returning (model, params)")
     parser.add_argument("--replicas", type=int, default=1,
                         help="Engine replicas behind the gateway")
+    parser.add_argument("--tp", type=int, default=1,
+                        help="Tensor-parallel width per replica: each replica "
+                             "becomes a disjoint tp-chip mesh slice "
+                             "(ReplicaSet.from_mesh); needs replicas*tp "
+                             "local devices")
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--port", type=int, default=8000,
                         help="TCP port (0 = OS-assigned ephemeral)")

@@ -27,6 +27,11 @@ Public surface:
   per-replica health, and failover that resumes a dead replica's
   in-flight streams on a healthy one (``prompt + tokens_emitted``) with
   zero duplicated or lost tokens.
+* :class:`SlicePlan` / :class:`SliceExec` — mesh-sliced tensor
+  parallelism: carve ``jax.devices()`` into disjoint ``tp``-wide slices,
+  each one replica of a ``ReplicaSet.from_mesh`` fleet serving sharded
+  params / KV / adapter bank through the same three warm executables
+  (``ServingEngine(tp=...)`` for a single slice).
 * :class:`ServingGateway` / :class:`GatewayConfig` /
   :class:`GatewayStats` — stdlib-only HTTP front end: ``POST
   /v1/completions`` (JSON + SSE streaming), ``/healthz`` / ``/readyz`` /
@@ -45,6 +50,7 @@ See ``docs/usage_guides/serving.md``.
 
 from .engine import ServingEngine
 from .gateway import GatewayConfig, ServingGateway
+from .mesh_exec import SliceExec, SlicePlan
 from .metrics import GatewayStats, ServingStats
 from .request import Request, RequestStatus
 from .router import FleetRequest, ReplicaSet, ReplicaState
@@ -70,6 +76,8 @@ __all__ = [
     "ReplicaSet",
     "ReplicaState",
     "FleetRequest",
+    "SlicePlan",
+    "SliceExec",
     "ServingGateway",
     "GatewayConfig",
 ]

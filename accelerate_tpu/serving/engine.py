@@ -29,6 +29,21 @@ compiled programs, no matter how requests arrive or leave:
   system prompts, few-shot headers) skips those chunks' prefill FLOPs
   entirely and resumes chunking at the boundary.
 
+Mesh-sliced mode (``tp=`` / ``mesh=``): the same three programs compile
+with ``in_shardings``/``out_shardings`` from
+:class:`~.mesh_exec.SliceExec`, so one engine spans a tensor-parallel
+slice of devices — params in the Megatron column/row layout the training
+side uses, the KV cache sharded on its heads axis, the adapter bank
+matching its base kernels — while slot membership, pos/tok/rng/done
+rows, prompt chunks, and masks stay replicated *data*. Nothing about the
+zero-recompile discipline changes: membership is still a traced
+argument, the warm-executable count is still three, and streams are
+token-identical to the single-chip engine. Prefix-cache blocks are
+fetched to host numpy in this mode, so one :class:`PrefixCache` can be
+shared by every slice of a ``ReplicaSet.from_mesh`` fleet (a block saved
+by one slice restores into any other's shardings — cross-slice hits
+survive failover).
+
 Admission is interleaved, not monolithic: an admitted request sits in
 ``PREFILLING`` holding its slot, and each scheduler iteration spends at
 most ``prefill_chunks_per_tick`` chunk calls (round-robin across the
@@ -141,6 +156,23 @@ class ServingEngine:
         (a ``dynamic_update_slice`` row write) compiles nothing new.
         Requests with ``adapter=None`` use bank row 0, the reserved
         identity adapter — their output is the base model's, unchanged.
+        In mesh mode the bank is placed onto this engine's slice (see
+        :meth:`AdapterBank.place`), so each slice engine needs its OWN
+        bank instance.
+      tp: tensor-parallel width — carve ``tp`` devices (the first ``tp``
+        of ``devices``/``jax.devices()``) into ONE slice and serve this
+        engine across it. Mutually consistent with ``mesh=``.
+      mesh: an explicit tp-only :class:`jax.sharding.Mesh` (e.g. from
+        :meth:`~.mesh_exec.SlicePlan.build_mesh`) for this engine's
+        slice. A tp-only mesh resolved from a prepared model/accelerator
+        routes here automatically; a mesh with non-trivial dp/fsdp/...
+        axes is rejected (see ``_resolve_serving_mesh``).
+      devices: with ``tp=``, the device pool to carve the slice from
+        (default ``jax.devices()``).
+      prefix_cache: a pre-built (possibly fleet-shared)
+        :class:`~.scheduler.PrefixCache` to use instead of constructing
+        one from ``prefix_cache_mb`` — how ``ReplicaSet.from_mesh``
+        gives every slice one cache for cross-slice prefix hits.
       accelerator: optional — wires preemption-drain cooperation and, when
         the accelerator carries a ``serving_stats``, shares it so
         ``Accelerator.log(include_serving=True)`` sees this engine.
@@ -158,12 +190,14 @@ class ServingEngine:
                  prefill_chunks_per_tick: int = 1,
                  prefix_cache_mb: float = 64.0,
                  adapters: Optional[AdapterBank] = None,
+                 tp: Optional[int] = None, mesh=None, devices=None,
+                 prefix_cache: Optional[PrefixCache] = None,
                  accelerator=None, stats: Optional[ServingStats] = None,
                  autostart: bool = True, warmup: bool = True,
                  idle_poll_s: float = 0.005):
         from ..big_modeling import cache_factory_for
 
-        module, _, params, mesh, _ = resolve_model_source(
+        module, _, params, resolved_mesh, _ = resolve_model_source(
             model, params=params, accelerator=accelerator)
         if params is None:
             raise ValueError("ServingEngine needs params (pass params= or a "
@@ -193,7 +227,24 @@ class ServingEngine:
 
         self.module = module
         self.params = params
-        self.mesh = mesh
+        serving_mesh = self._resolve_serving_mesh(tp, mesh, devices,
+                                                  resolved_mesh, params)
+        #: the engine's slice mesh when mesh-sliced, else whatever mesh the
+        #: model source carried (informational, as before).
+        self.mesh = serving_mesh if serving_mesh is not None else resolved_mesh
+        if serving_mesh is not None:
+            from .mesh_exec import SliceExec
+
+            self._exec: Optional["SliceExec"] = SliceExec(serving_mesh)
+            if prefill_chunk is None:
+                raise NotImplementedError(
+                    "the monolithic prefill path (prefill_chunk=None) is "
+                    "single-chip only; mesh-sliced engines require chunked "
+                    "prefill (pass a prefill_chunk width)")
+        else:
+            self._exec = None
+        #: tensor-parallel width of this engine's slice (1 = single-chip).
+        self.tp = self._exec.tp if self._exec is not None else 1
         self.max_slots = int(max_slots)
         self.max_len = int(max_len)
         self.eos_token_id = eos_token_id
@@ -221,9 +272,16 @@ class ServingEngine:
             # (re-running already-prefilled positions rewrites identical KV).
             self._chunk_cap = self._chunk_limit - self._chunk
         self._chunks_per_tick = int(prefill_chunks_per_tick)
-        self._prefix_cache = (
-            PrefixCache(int(prefix_cache_mb * 2 ** 20))
-            if self._chunk is not None and prefix_cache_mb > 0 else None)
+        if prefix_cache is not None:
+            if self._chunk is None:
+                raise ValueError(
+                    "prefix_cache= requires chunked prefill "
+                    "(prefill_chunk=None has no chunk-aligned blocks)")
+            self._prefix_cache: Optional[PrefixCache] = prefix_cache
+        else:
+            self._prefix_cache = (
+                PrefixCache(int(prefix_cache_mb * 2 ** 20))
+                if self._chunk is not None and prefix_cache_mb > 0 else None)
         self._prefilling: collections.deque[Request] = collections.deque()
 
         # One slot's cache, used as the state template. Ring (sliding-window)
@@ -257,17 +315,53 @@ class ServingEngine:
 
         # CPU jit warns (and ignores) donation; donate only where it works.
         donate = () if jax.default_backend() == "cpu" else (1,)
-        self._decode = jax.jit(self._decode_fn, donate_argnums=donate)
-        if self._chunk is None:
-            self._prefill = jax.jit(self._prefill_fn, donate_argnums=donate)
+        if self._exec is None:
+            self._decode = jax.jit(self._decode_fn, donate_argnums=donate)
+            if self._chunk is None:
+                self._prefill = jax.jit(self._prefill_fn,
+                                        donate_argnums=donate)
+            else:
+                self._prefill_chunk = jax.jit(self._prefill_chunk_fn,
+                                              donate_argnums=donate)
+                # restore donates the STATE only (its arg 0) — the block is
+                # a live prefix-cache entry that must survive the copy.
+                self._restore_prefix = jax.jit(
+                    self._restore_prefix_fn,
+                    donate_argnums=(0,) if donate else ())
         else:
-            self._prefill_chunk = jax.jit(self._prefill_chunk_fn,
-                                          donate_argnums=donate)
-            # restore donates the STATE only (its arg 0) — the block is a
-            # live prefix-cache entry that must survive the copy.
-            self._restore_prefix = jax.jit(
+            # Mesh-sliced compilation: derive every placement once, put
+            # params/state/bank exactly onto it (jit with explicit
+            # in_shardings rejects committed arrays laid out differently),
+            # and compile the SAME three program functions with those
+            # shardings — the engine's call sites don't change at all.
+            exec_ = self._exec
+            self._param_sh = exec_.param_shardings(params)
+            self.params = params = exec_.place(params, self._param_sh)
+            tmpl = jax.tree.leaves(slot_cache)
+            self._state_sh = exec_.state_shardings(self._state, tmpl,
+                                                   self._cache_axes)
+            self._block_sh = exec_.block_shardings(
+                jax.tree.structure(slot_cache), tmpl, self._cache_axes)
+            self._state = exec_.place(self._state, self._state_sh)
+            rep = exec_.replicated
+            decode_in = [self._param_sh, self._state_sh, rep]
+            chunk_in = [self._param_sh, self._state_sh, rep, rep, rep, rep,
+                        rep]
+            if adapters is not None:
+                self._bank_sh = exec_.bank_shardings(adapters)
+                adapters.place(self._bank_sh)
+                decode_in.append(self._bank_sh)
+                chunk_in += [rep, self._bank_sh]
+            self._decode = exec_.jit(
+                self._decode_fn, tuple(decode_in),
+                (self._state_sh, rep, rep), donate_argnums=donate)
+            self._prefill_chunk = exec_.jit(
+                self._prefill_chunk_fn, tuple(chunk_in),
+                (self._state_sh, rep, self._block_sh), donate_argnums=donate)
+            self._restore_prefix = exec_.jit(
                 self._restore_prefix_fn,
-                donate_argnums=(0,) if donate else ())
+                (self._state_sh, self._block_sh, rep, rep, rep),
+                self._state_sh, donate_argnums=(0,) if donate else ())
 
         if stats is None and accelerator is not None:
             stats = getattr(accelerator, "serving_stats", None)
@@ -285,6 +379,61 @@ class ServingEngine:
         self._warmup_on_start = bool(warmup)
         if autostart:
             self.start()
+
+    @staticmethod
+    def _resolve_serving_mesh(tp, mesh, devices, resolved_mesh, params):
+        """Decide this engine's slice mesh (None = single-chip path).
+
+        Explicit spellings win: ``mesh=`` is validated tp-only (and
+        checked against ``tp=`` if both are given); ``tp=`` carves one
+        slice of that width from ``devices``/``jax.devices()``. Otherwise
+        a mesh resolved from a prepared model/accelerator routes
+        automatically when it is a multi-device tp-only mesh — and when it
+        is NOT tp-only but the params are genuinely sharded across
+        devices, serving it replicated would silently gather (or crash
+        deep in jit with a device-set mismatch), so that raises the clear
+        error here instead. A non-tp training mesh over host-resident
+        params (e.g. a default dp accelerator whose params were never
+        prepared) keeps the single-chip path: nothing is sharded, so
+        nothing is gathered.
+        """
+        from .mesh_exec import SlicePlan, validate_serving_mesh
+
+        if mesh is not None:
+            validate_serving_mesh(mesh)
+            if tp is not None and int(mesh.shape["tp"]) != int(tp):
+                raise ValueError(
+                    f"mesh= has tp={mesh.shape['tp']} but tp={tp} was also "
+                    "passed; drop one or make them agree")
+            return mesh
+        if tp is not None:
+            return SlicePlan.plan(int(tp), num_slices=1,
+                                  devices=devices).build_mesh(0)
+        if devices is not None:
+            raise ValueError("devices= only makes sense together with tp=")
+        if resolved_mesh is None or resolved_mesh.devices.size <= 1:
+            return None
+        import math
+
+        non_tp = math.prod(s for ax, s in resolved_mesh.shape.items()
+                           if ax != "tp")
+        if non_tp == 1 and resolved_mesh.shape.get("tp", 1) > 1:
+            return resolved_mesh  # tp-only training mesh: serve sliced
+        spanned = set()
+        for leaf in jax.tree.leaves(params):
+            sharding = getattr(leaf, "sharding", None)
+            device_set = getattr(sharding, "device_set", None)
+            if device_set:
+                spanned |= set(device_set)
+        if len(spanned) > 1:
+            raise ValueError(
+                "params are sharded across "
+                f"{len(spanned)} devices on a non-tensor-parallel mesh "
+                f"({dict(resolved_mesh.shape)}); the serving engine only "
+                "runs tp-only slices. Re-prepare the model under "
+                "MeshConfig(dp=1, tp=N), pass tp=/mesh= explicitly, or "
+                "gather params to host before serving.")
+        return None
 
     def _cache_length_axes(self) -> list[int]:
         """Per-leaf sequence-length axis of the slot cache, detected by
@@ -689,6 +838,34 @@ class ServingEngine:
         """Whether ``name`` currently occupies a bank row (router affinity)."""
         return self._adapters is not None and self._adapters.resident(name)
 
+    def kv_cache_per_chip_bytes(self) -> int:
+        """Per-device byte footprint of the decode KV state (max shard per
+        leaf): the HBM-planning number, ≈ ``1/tp`` of the single-chip
+        figure for heads-sharded leaves (docs/performance.md)."""
+        if self._exec is not None:
+            return self._exec.per_chip_bytes(self._state["cache"])
+        return sum(l.nbytes for l in jax.tree.leaves(self._state["cache"]))
+
+    def decode_memory_analysis(self):
+        """``CompiledMemoryStats`` for the decode tick, compiled FRESH from
+        the same function + shardings — lowering through the serving jit
+        itself would add a cache entry and break the warm-executable
+        accounting the zero-recompile tests pin."""
+        args = [self.params, self._state,
+                np.zeros((self.max_slots,), bool)]
+        if self._adapters is not None:
+            args.append(self._adapters.stacks)
+        if self._exec is None:
+            fn = jax.jit(self._decode_fn)
+        else:
+            rep = self._exec.replicated
+            ins = [self._param_sh, self._state_sh, rep]
+            if self._adapters is not None:
+                ins.append(self._bank_sh)
+            fn = self._exec.jit(self._decode_fn, tuple(ins),
+                                (self._state_sh, rep, rep))
+        return fn.lower(*args).compile().memory_analysis()
+
     # ------------------------------------------------------------------
     # engine thread
     # ------------------------------------------------------------------
@@ -964,6 +1141,12 @@ class ServingEngine:
         self._stats.record_prefill_chunk(dt_ms, backlog=backlog)
         if (self._prefix_cache is not None and req._chunk_keys is not None
                 and offset == i * C and offset + C <= S):
+            if self._exec is not None:
+                # Host-portable blocks: a device_get'd chunk block restores
+                # into ANY slice's shardings via restore_prefix's
+                # in_shardings, so a fleet-shared PrefixCache serves
+                # cross-slice hits (the failover resume path).
+                block = jax.device_get(block)
             self._prefix_cache.put(
                 req._chunk_keys[i], block,
                 nbytes=sum(l.nbytes for l in jax.tree.leaves(block)))

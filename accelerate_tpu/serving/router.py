@@ -242,6 +242,8 @@ class ReplicaSet:
                 "replicas disagree on sampling config or eos id — failover "
                 f"would change the stream's distribution (eos={eos})")
         self._replicas = [_Replica(i, e) for i, e in enumerate(engines)]
+        #: the SlicePlan behind a from_mesh fleet (None otherwise).
+        self.slice_plan = None
         self._failover_block_s = float(failover_block_s)
         self._max_failovers = (len(engines) - 1 if max_failovers is None
                                else int(max_failovers))
@@ -260,6 +262,58 @@ class ReplicaSet:
         if num_replicas < 1:
             raise ValueError(f"num_replicas must be >= 1 (got {num_replicas})")
         return cls([factory() for _ in range(num_replicas)], **kwargs)
+
+    @classmethod
+    def from_mesh(cls, model, params=None, *, tp: int,
+                  num_slices: Optional[int] = None, devices=None,
+                  make_adapters: Optional[Callable] = None,
+                  share_prefix_cache: bool = True,
+                  failover_block_s: float = 5.0,
+                  max_failovers: Optional[int] = None,
+                  **engine_kwargs) -> "ReplicaSet":
+        """A fleet of tensor-parallel slices: carve the device pool into
+        ``num_slices`` disjoint ``tp``-wide slices (every full slice the
+        pool affords by default — 8 devices at ``tp=2`` give 4 replicas)
+        and build one mesh-sliced :class:`~.engine.ServingEngine` per
+        slice. Routing, health, adapter affinity, and token-exact failover
+        are exactly the existing machinery — one replica is just a
+        multi-chip slice now.
+
+        By default every slice shares ONE host-resident
+        :class:`~.scheduler.PrefixCache` (mesh engines cache blocks as
+        host numpy, portable across slices), so a prefix prefilled on a
+        slice that later dies is still a cache hit when its requests
+        resume on a survivor. ``make_adapters`` is a zero-arg factory
+        called once per slice — banks hold device state placed on their
+        slice's mesh, so they cannot be shared the way params are.
+
+        Remaining ``engine_kwargs`` (``max_slots``, ``max_len``,
+        sampling, ...) pass through to every engine.
+        """
+        from .mesh_exec import SlicePlan
+        from .scheduler import PrefixCache
+
+        plan = SlicePlan.plan(tp, num_slices=num_slices, devices=devices)
+        cache_mb = engine_kwargs.pop("prefix_cache_mb", 64.0)
+        shared_cache = None
+        if (share_prefix_cache and cache_mb > 0
+                and engine_kwargs.get("prefill_chunk", 256) is not None):
+            shared_cache = PrefixCache(int(cache_mb * 2 ** 20))
+        engines = []
+        for i in range(len(plan)):
+            kw = dict(engine_kwargs)
+            if make_adapters is not None:
+                kw["adapters"] = make_adapters()
+            if shared_cache is not None:
+                kw["prefix_cache"] = shared_cache
+            else:
+                kw["prefix_cache_mb"] = cache_mb
+            engines.append(ServingEngine(model, params,
+                                         mesh=plan.build_mesh(i), **kw))
+        fleet = cls(engines, failover_block_s=failover_block_s,
+                    max_failovers=max_failovers)
+        fleet.slice_plan = plan
+        return fleet
 
     # -- introspection ---------------------------------------------------
     def __len__(self) -> int:

@@ -184,11 +184,17 @@ class PrefixCache:
     entire token prefix up to and including its chunk — two prompts share
     an entry exactly when they share that whole chunk-aligned prefix.
     Values are opaque (device-array pytrees holding one chunk's KV slice
-    for every cache leaf); the engine passes each block's byte size into
-    :meth:`put` so accounting stays jax-free here.
+    for every cache leaf — or host numpy trees for mesh-sliced engines,
+    which is what makes the blocks portable ACROSS slices); the engine
+    passes each block's byte size into :meth:`put` so accounting stays
+    jax-free here.
 
-    Engine-thread only (no lock), like :class:`SlotScheduler`: lookups,
-    insertions, and evictions all happen on the single engine thread.
+    Thread-safe: unlike :class:`SlotScheduler`, one instance may be shared
+    by every slice of a ``ReplicaSet.from_mesh`` fleet (each slice engine
+    reads and writes from its own engine thread), so a prefix one slice
+    prefilled is a hit on any other — including the failover resume path.
+    The lock covers each operation; blocks themselves are immutable once
+    inserted.
     """
 
     def __init__(self, capacity_bytes: int):
@@ -200,6 +206,7 @@ class PrefixCache:
         # key -> (block, nbytes); insertion order == LRU order (move_to_end
         # on every touch), so eviction pops from the front.
         self._entries: collections.OrderedDict = collections.OrderedDict()
+        self._lock = threading.Lock()
         self._bytes = 0
         self.insertions = 0
         self.evictions = 0
@@ -217,12 +224,13 @@ class PrefixCache:
         (each hit is touched most-recently-used). Stops at the first miss:
         a later chunk's KV is only valid on top of every earlier one."""
         out = []
-        for key in keys:
-            entry = self._entries.get(key)
-            if entry is None:
-                break
-            self._entries.move_to_end(key)
-            out.append(entry[0])
+        with self._lock:
+            for key in keys:
+                entry = self._entries.get(key)
+                if entry is None:
+                    break
+                self._entries.move_to_end(key)
+                out.append(entry[0])
         return out
 
     def put(self, key, block, nbytes: int):
@@ -231,26 +239,28 @@ class PrefixCache:
         than the whole capacity is rejected outright — admitting it would
         evict EVERY resident entry and still not fit, so the cache keeps
         what it has and counts the reject instead."""
-        if key in self._entries:
-            self._entries.move_to_end(key)
-            return
-        nbytes = int(nbytes)
-        if nbytes > self.capacity_bytes:
-            self.oversize_rejects += 1
-            return
-        self._entries[key] = (block, nbytes)
-        self._bytes += nbytes
-        self.insertions += 1
-        while self._bytes > self.capacity_bytes:
-            _, (_, nb) = self._entries.popitem(last=False)
-            self._bytes -= nb
-            self.evictions += 1
+        with self._lock:
+            if key in self._entries:
+                self._entries.move_to_end(key)
+                return
+            nbytes = int(nbytes)
+            if nbytes > self.capacity_bytes:
+                self.oversize_rejects += 1
+                return
+            self._entries[key] = (block, nbytes)
+            self._bytes += nbytes
+            self.insertions += 1
+            while self._bytes > self.capacity_bytes:
+                _, (_, nb) = self._entries.popitem(last=False)
+                self._bytes -= nb
+                self.evictions += 1
 
     def clear(self):
         """Drop every entry (engine warmup runs dummy prompts through the
         normal path; their blocks must not linger as phantom prefixes)."""
-        self._entries.clear()
-        self._bytes = 0
-        self.insertions = 0
-        self.evictions = 0
-        self.oversize_rejects = 0
+        with self._lock:
+            self._entries.clear()
+            self._bytes = 0
+            self.insertions = 0
+            self.evictions = 0
+            self.oversize_rejects = 0

@@ -906,6 +906,72 @@ def adapters_extra(on_tpu: bool) -> dict:
     return {"multi_tenant": multi_tenant_adapter_bench()}
 
 
+def serving_tp_bench(n_requests: int = 3, prompt_len: int = 6,
+                     max_new_tokens: int = 16) -> dict:
+    """Mesh-sliced serving A/B: the SAME requests through a single-chip
+    engine and a tp=2 slice. The payload is correctness + footprint, not
+    wall-clock (CPU collectives prove nothing about a real interconnect):
+
+    * ``tokens_equal`` — tp=2 must be token-identical to tp=1 (GSPMD
+      shards the math, never changes it);
+    * ``warm_executables`` — both engines hold exactly the three warm
+      programs (chunk / decode tick / restore), sharded or not;
+    * ``kv_per_chip_ratio`` — live KV state bytes per chip ≈ 1/tp;
+    * ``compiled_arg_bytes`` — ``memory_analysis()`` of a fresh decode
+      compile, showing XLA itself plans ~1/tp the argument bytes.
+    """
+    import jax
+    import numpy as np
+
+    if jax.device_count() < 2:
+        return {"skipped": f"needs >= 2 devices (have {jax.device_count()})"}
+
+    from accelerate_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from accelerate_tpu.serving import ServingEngine
+
+    model = LlamaForCausalLM(LlamaConfig.tiny())
+    params = model.init_params(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    prompts = rng.integers(1, 200,
+                           size=(n_requests, prompt_len)).astype(np.int32)
+    kw = dict(max_slots=2, max_len=64, prefill_chunk=16,
+              do_sample=True, temperature=0.8, top_k=40)
+
+    def serve(tp):
+        engine = ServingEngine(model, params,
+                               **(dict(kw, tp=tp) if tp > 1 else kw))
+        try:
+            toks = []
+            for i in range(n_requests):
+                r = engine.submit(prompts[i:i + 1],
+                                  max_new_tokens=max_new_tokens,
+                                  seed=i, block=True)
+                toks.append(np.asarray(r.result(timeout=120)))
+            warm = [engine._prefill_chunk._cache_size(),
+                    engine._decode._cache_size(),
+                    engine._restore_prefix._cache_size()]
+            kv_pc = engine.kv_cache_per_chip_bytes()
+            mem = engine.decode_memory_analysis()
+            arg_bytes = getattr(mem, "argument_size_in_bytes", None)
+        finally:
+            engine.shutdown()
+        return toks, warm, kv_pc, arg_bytes
+
+    toks1, warm1, kv1, arg1 = serve(1)
+    toks2, warm2, kv2, arg2 = serve(2)
+    tokens_equal = all(np.array_equal(a, b) for a, b in zip(toks1, toks2))
+    return {
+        "tp": 2,
+        "n_requests": n_requests,
+        "max_new_tokens": max_new_tokens,
+        "tokens_equal": bool(tokens_equal),
+        "warm_executables": {"tp1": warm1, "tp2": warm2},
+        "kv_per_chip_bytes": {"tp1": kv1, "tp2": kv2},
+        "kv_per_chip_ratio": round(kv2 / kv1, 4) if kv1 else None,
+        "compiled_arg_bytes": {"tp1": arg1, "tp2": arg2},
+    }
+
+
 def serving_extra(on_tpu: bool) -> dict:
     """The ``extra.serving`` payload: on CPU the offered-load sweep, the
     continuous-vs-static staggered-arrival comparison, the
@@ -928,6 +994,7 @@ def serving_extra(on_tpu: bool) -> dict:
             "overhead": gateway_overhead_bench(),
             "failover": replica_failover_bench(),
         },
+        "tp": serving_tp_bench(),
     }
 
 

@@ -40,11 +40,13 @@ GROUPS = [
     ("serving", "Serving",
      ["accelerate_tpu.serving.engine", "accelerate_tpu.serving.request",
       "accelerate_tpu.serving.scheduler", "accelerate_tpu.serving.metrics",
+      "accelerate_tpu.serving.mesh_exec",
       "accelerate_tpu.serving.router", "accelerate_tpu.serving.gateway"],
      "Continuous-batching decode service: slot scheduler, fixed-shape "
      "prefill/decode programs, request handles, serving counters — plus "
-     "the multi-replica router (health states, fault-tolerant failover) "
-     "and the stdlib HTTP gateway in front of it."),
+     "mesh-sliced tensor-parallel execution (one replica = a multi-chip "
+     "slice), the multi-replica router (health states, fault-tolerant "
+     "failover) and the stdlib HTTP gateway in front of it."),
     ("adapters", "LoRA adapters",
      ["accelerate_tpu.adapters.lora", "accelerate_tpu.adapters.registry"],
      "Multi-tenant LoRA: config/init/merge and the frozen-base training "

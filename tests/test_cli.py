@@ -187,6 +187,27 @@ class TestCLISubprocess:
         assert "% of base" in out.stdout
         assert "adapter checkpoint" in out.stdout
 
+    def test_estimate_memory_tp(self):
+        out = _run_cli("estimate-memory", "llama-tiny",
+                       "--dtypes", "bfloat16", "--tp", "2", "--lora-rank", "8")
+        assert out.returncode == 0, out.stderr
+        assert "Tensor-parallel slice (tp=2" in out.stdout
+        assert "params per chip" in out.stdout
+        assert "KV cache per chip" in out.stdout
+        assert "adapter bank row per chip" in out.stdout
+        # tiny llama: 2 kv-heads x 16 head-dim x 2 layers, k+v in bf16 is
+        # 256 B/token unsharded; tp=2 splits the kv-heads axis -> 128 B.
+        assert "128 B/token/slot" in out.stdout
+
+    def test_estimate_memory_tp_not_divisible_replicates(self):
+        out = _run_cli("estimate-memory", "llama-tiny",
+                       "--dtypes", "bfloat16", "--tp", "3")
+        assert out.returncode == 0, out.stderr
+        # Nothing in the tiny model divides by 3: every weight stays
+        # replicated and the KV line flags it rather than lying.
+        assert "0.0% of weights sharded" in out.stdout
+        assert "REPLICATED" in out.stdout
+
     def test_estimate_memory_unknown_model(self):
         out = _run_cli("estimate-memory", "not-a-model")
         assert out.returncode == 2
@@ -315,7 +336,7 @@ class TestCLISubprocess:
     def test_serve_help(self):
         out = _run_cli("serve", "--help")
         assert out.returncode == 0, out.stderr
-        for flag in ["--model", "--replicas", "--port", "--max-slots"]:
+        for flag in ["--model", "--replicas", "--port", "--max-slots", "--tp"]:
             assert flag in out.stdout
 
     @pytest.mark.slow
@@ -356,6 +377,50 @@ class TestCLISubprocess:
             assert 1 <= len(body["tokens"]) <= 4
             with urllib.request.urlopen(url + "/readyz", timeout=10) as resp:
                 assert resp.status == 200
+            proc.send_signal(signal.SIGTERM)
+            out, err = proc.communicate(timeout=60)
+            assert proc.returncode == 0, err
+            assert "gateway drained; bye" in out
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.communicate(timeout=30)
+
+    @pytest.mark.slow
+    def test_serve_tp_end_to_end(self):
+        """`serve --tp 2 --replicas 2` carves the 8 emulated devices into
+        two 2-chip mesh slices and serves a completion through them."""
+        import json as _json
+        import re
+        import signal
+        import urllib.request
+
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "accelerate_tpu.commands.accelerate_cli",
+             "serve", "--model", "tiny", "--replicas", "2", "--tp", "2",
+             "--port", "0", "--max-slots", "2", "--max-len", "64",
+             "--prefill-chunk", "32", "--eos-token-id", "7"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            cwd=os.path.dirname(os.path.dirname(__file__)))
+        try:
+            url = None
+            for line in proc.stdout:
+                m = re.search(r"serving on (http://\S+)", line)
+                if m:
+                    url = m.group(1)
+                    break
+            assert url, "serve --tp never announced its URL"
+            req = urllib.request.Request(
+                url + "/v1/completions",
+                data=_json.dumps({"prompt": [3, 5, 7, 11],
+                                  "max_new_tokens": 4}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                assert resp.status == 200
+                body = _json.loads(resp.read())
+            assert body["status"] == "completed"
+            assert 1 <= len(body["tokens"]) <= 4
             proc.send_signal(signal.SIGTERM)
             out, err = proc.communicate(timeout=60)
             assert proc.returncode == 0, err
