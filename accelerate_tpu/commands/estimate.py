@@ -255,6 +255,46 @@ def estimate_command(args) -> int:
         # Optimizer state only covers the trainable low-rank factors —
         # the base stays frozen, so Adam costs 2 fp32 moments on n_lora.
         print(f"  Adam moments (fp32)      : {_fmt(ckpt_bytes * 2)}")
+    if args.page_size is not None:
+        geom = _kv_geometry(module)
+        if geom is None:
+            print("\nPaged KV pool: n/a (no model config — pass a built-in "
+                  "name or config.json)")
+            return 2
+        layers, kv_heads, head_dim = geom
+        per_tok = 2 * layers * kv_heads * head_dim * 2  # k+v, bf16
+        page_bytes = per_tok * args.page_size
+        # Per-chip share under --tp: pool leaves shard on kv-heads (or
+        # head_dim) exactly like the dense cache, so the divisor matches
+        # the KV-cache-per-chip line above.
+        div = 1
+        if args.tp > 1:
+            div = (args.tp if kv_heads % args.tp == 0
+                   else args.tp if head_dim % args.tp == 0 else 1)
+        print(f"\nPaged KV pool (page_size={args.page_size} tokens, bf16, "
+              f"2 x {layers} layers x {kv_heads} kv-heads x "
+              f"{head_dim} head-dim):")
+        print(f"  bytes per token : {_fmt(per_tok)}")
+        print(f"  bytes per page  : {_fmt(page_bytes)}"
+              + (f"  ({_fmt(page_bytes / div)}/chip at tp={args.tp})"
+                 if args.tp > 1 else ""))
+        if args.max_pages is not None:
+            pool = args.max_pages * page_bytes
+            print(f"  pool ({args.max_pages} pages): {_fmt(pool)}"
+                  + (f"  ({_fmt(pool / div)}/chip at tp={args.tp})"
+                     if args.tp > 1 else ""))
+        print("  pages per request at sequence length "
+              "(ceil(len / page_size) — dense reserves the max_len row):")
+        for s in args.seq_lens:
+            pages = -(-s // args.page_size)
+            print(f"    {s:>7} tokens: {pages:>6} pages = {_fmt(pages * page_bytes)}"
+                  + (f"  ({_fmt(pages * page_bytes / div)}/chip)"
+                     if args.tp > 1 else ""))
+        if args.max_pages is not None:
+            print("  concurrent requests the pool fits at those lengths: "
+                  + ", ".join(
+                      f"{s}tok x {args.max_pages // max(1, -(-s // args.page_size))}"
+                      for s in args.seq_lens))
     if args.tp > 1:
         per_chip, sharded, total_elems = _tp_param_split(abstract, args.tp)
         print(f"\nTensor-parallel slice (tp={args.tp}, Megatron "
@@ -328,6 +368,16 @@ def estimate_command_parser(subparsers=None):
                         help="Also print per-chip params / KV-cache / adapter-bank "
                              "sizes for a mesh-sliced serving replica of this "
                              "tensor-parallel width")
+    parser.add_argument("--page-size", type=int, default=None,
+                        help="Also print paged-KV pool sizing at this many "
+                             "tokens per page (bytes/page, pages-per-request "
+                             "at --seq-lens, per-chip share under --tp)")
+    parser.add_argument("--max-pages", type=int, default=None,
+                        help="With --page-size: total pool bytes and how many "
+                             "concurrent requests the pool fits at --seq-lens")
+    parser.add_argument("--seq-lens", type=int, nargs="+",
+                        default=[128, 512, 2048, 8192],
+                        help="Sequence lengths for the pages-per-request table")
     if subparsers is not None:
         parser.set_defaults(func=estimate_command)
     return parser

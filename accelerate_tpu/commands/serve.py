@@ -81,13 +81,16 @@ def serve_command(args) -> int:
         return AdapterBank(params, config=LoRAConfig(rank=args.lora_rank),
                            max_adapters=max_adapters)
 
+    paging = dict(paged=(False if args.no_paged else None),
+                  page_size=args.page_size, max_pages=args.max_pages)
+
     def factory():
         return ServingEngine(
             model, params, max_slots=args.max_slots, max_len=args.max_len,
             max_queued=args.max_queued, eos_token_id=args.eos_token_id,
             prefill_chunk=args.prefill_chunk,
             prefix_cache_mb=args.prefix_cache_mb,
-            adapters=make_bank())
+            adapters=make_bank(), **paging)
 
     print(f"warming up {args.replicas} replica(s) "
           f"(slots={args.max_slots}, max_len={args.max_len}, "
@@ -104,7 +107,7 @@ def serve_command(args) -> int:
             max_slots=args.max_slots, max_len=args.max_len,
             max_queued=args.max_queued, eos_token_id=args.eos_token_id,
             prefill_chunk=args.prefill_chunk,
-            prefix_cache_mb=args.prefix_cache_mb)
+            prefix_cache_mb=args.prefix_cache_mb, **paging)
     else:
         replica_set = ReplicaSet.from_factory(factory, args.replicas)
     if adapter_specs:
@@ -168,6 +171,19 @@ def serve_command_parser(subparsers=None):
                         help="Chunked-prefill width")
     parser.add_argument("--prefix-cache-mb", type=float, default=64.0,
                         help="Prefix KV cache budget per replica (0 = off)")
+    parser.add_argument("--page-size", type=int, default=None,
+                        help="Tokens per KV page (default: prefill chunk, so "
+                             "prefix-cache blocks alias onto pages 1:1; must "
+                             "divide the chunk)")
+    parser.add_argument("--max-pages", type=int, default=None,
+                        help="KV pool pages per replica (default: enough for "
+                             "every slot at max_len — same HBM as dense; "
+                             "lower it to oversubscribe capacity and rely on "
+                             "preemption under pressure)")
+    parser.add_argument("--no-paged", action="store_true",
+                        help="Use the dense per-slot KV layout instead of "
+                             "the paged pool (the pre-paging engine; also "
+                             "the A/B baseline)")
     parser.add_argument("--eos-token-id", type=int, default=None)
     parser.add_argument("--default-max-new-tokens", type=int, default=32,
                         help="Used when a request omits max_new_tokens")

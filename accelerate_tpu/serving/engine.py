@@ -69,6 +69,35 @@ tick (whose cache commit is unconditional): every ``prefill_chunk`` and
 a tick writes for a mid-prefill slot lands at ``true_len`` — a position
 no prompt chunk reads and the first real decode write overwrites.
 
+Paged KV memory (``paged=True``, the default with chunked prefill): the
+dense per-slot rows are replaced by a global pool of fixed-size KV PAGES
+(``page_size`` tokens, default one prefill chunk) plus a host-side
+``[max_slots, max_pages_per_slot]`` page table. The table rides into the
+SAME warm executables as traced integer data — the prefill chunk, decode
+tick, and restore programs gather each slot's pages into a dense view,
+run the unchanged forward, and scatter only the written pages back — so
+page allocation, free, preemption, and prefix-block ALIASING (a cache
+hit becomes a host table write + refcount, zero device copies) all
+compile nothing. Page 0 is a reserved scratch page: unallocated table
+entries point at it, so clamped gathers/scatters for inactive slots land
+there harmlessly (garbage KV is masked or overwritten, the same
+invariant as the dense path). Short requests now hold pages, not
+worst-case rows — severalfold more concurrent slots at equal HBM — and
+a pool-exhausted engine preempts the newest stream at a chunk boundary
+and resumes it token-exactly later (the router-failover
+resume-as-longer-prompt trick). Sliding-window models serve under
+paging too: pages that fall wholly out of the attention window are freed
+(ring semantics as a page-lifetime policy, no new kernel).
+
+Speculative decoding (``draft_model=``, paged single-chip greedy): each
+tick runs a fourth warm executable that scans ``spec_tokens`` greedy
+draft proposals through a small dense draft cache, verifies them with
+ONE fixed-width ``[1, K+1]`` target forward against the paged view, and
+accepts the longest matching prefix — per-slot acceptance is traced
+data, and the emitted stream is token-identical to non-speculative
+greedy (the verify logits ARE the dense tick's logits; eos latching
+replays :func:`generation._next_token`'s after-chain).
+
 Around the compiled programs: a bounded FCFS admission queue with
 backpressure, per-request ``max_new_tokens``/timeout/cancellation,
 streaming token callbacks, error isolation (a failing callback frees its
@@ -104,6 +133,7 @@ from .metrics import ServingStats
 from .request import Request, RequestStatus
 from .scheduler import (
     AdmissionQueue,
+    PagePool,
     PrefixCache,
     QueueClosed,
     QueueFull,
@@ -176,6 +206,25 @@ class ServingEngine:
       accelerator: optional — wires preemption-drain cooperation and, when
         the accelerator carries a ``serving_stats``, shares it so
         ``Accelerator.log(include_serving=True)`` sees this engine.
+      paged: use the paged KV pool instead of dense per-slot rows.
+        ``None`` (default) auto-selects paging whenever chunked prefill
+        is on; ``False`` keeps the dense layout (the A/B baseline);
+        ``True`` with ``prefill_chunk=None`` is an error (pages are
+        chunk-granular).
+      page_size: tokens per KV page (default = ``prefill_chunk`` so
+        PrefixCache blocks map onto whole pages and cache hits restore
+        by table ALIASING); must divide ``prefill_chunk``.
+      max_pages: usable pool pages (page 0 scratch is extra). Default
+        ``max_slots * ceil(max_len / page_size)`` — enough that paging
+        can never serve FEWER requests than dense; pass less to
+        overcommit memory and lean on preemption.
+      draft_model / draft_params: enable speculative decoding — a small
+        cache-threading draft module proposing ``spec_tokens`` greedy
+        tokens per tick, verified by one fixed-width target forward.
+        Requires ``paged=True``, greedy sampling, single chip, no
+        adapter bank; the engine's private prefix cache is disabled
+        (cached target blocks carry no draft KV).
+      spec_tokens: draft proposals per speculative tick (default 4).
       autostart: spawn the engine thread (and warm up) in the constructor.
       warmup: run dummy requests through every program at start so the
         first real request never pays a compile; stats reset afterwards.
@@ -190,6 +239,10 @@ class ServingEngine:
                  prefill_chunks_per_tick: int = 1,
                  prefix_cache_mb: float = 64.0,
                  adapters: Optional[AdapterBank] = None,
+                 paged: Optional[bool] = None,
+                 page_size: Optional[int] = None,
+                 max_pages: Optional[int] = None,
+                 draft_model=None, draft_params=None, spec_tokens: int = 4,
                  tp: Optional[int] = None, mesh=None, devices=None,
                  prefix_cache: Optional[PrefixCache] = None,
                  accelerator=None, stats: Optional[ServingStats] = None,
@@ -272,39 +325,194 @@ class ServingEngine:
             # (re-running already-prefilled positions rewrites identical KV).
             self._chunk_cap = self._chunk_limit - self._chunk
         self._chunks_per_tick = int(prefill_chunks_per_tick)
+
+        # -- paged-pool resolution (before the prefix cache: an alias-mode
+        # cache wires its eviction hook to the page pool) ----------------
+        if paged is None:
+            paged = self._chunk is not None
+        if paged and self._chunk is None:
+            raise ValueError(
+                "paged=True requires chunked prefill (pages are allocated at "
+                "chunk granularity); pass a prefill_chunk width")
+        self._paged = bool(paged)
+        if self._paged:
+            P = int(page_size) if page_size is not None else self._chunk
+            if P < 1 or self._chunk % P != 0:
+                raise ValueError(
+                    f"page_size ({page_size}) must be >= 1 and divide the "
+                    f"prefill chunk ({self._chunk}) so chunk writes and "
+                    "cached blocks cover whole pages")
+            self._page: Optional[int] = P
+        else:
+            if page_size is not None or max_pages is not None:
+                raise ValueError(
+                    "page_size=/max_pages= only apply to the paged engine "
+                    "(paged=False keeps dense per-slot rows)")
+            self._page = None
+
+        # -- speculative-decoding resolution ------------------------------
+        if draft_model is not None:
+            if not self._paged:
+                raise NotImplementedError(
+                    "speculative decoding requires the paged engine "
+                    "(paged=True)")
+            if self._exec is not None:
+                raise NotImplementedError(
+                    "speculative decoding is single-chip only for now")
+            if do_sample:
+                raise NotImplementedError(
+                    "speculative decoding is greedy-only (do_sample=False); "
+                    "sampled acceptance needs the rejection-sampling rule")
+            if adapters is not None:
+                raise NotImplementedError(
+                    "speculative decoding does not compose with an adapter "
+                    "bank yet")
+            if int(spec_tokens) < 1:
+                raise ValueError(
+                    f"spec_tokens must be >= 1 (got {spec_tokens})")
+            self._spec_k: Optional[int] = int(spec_tokens)
+            dmod, _, dparams, _, _ = resolve_model_source(
+                draft_model, params=draft_params)
+            if dparams is None:
+                raise ValueError("draft_model needs params (pass "
+                                 "draft_params= or a prepared Model)")
+            dfactory = cache_factory_for(dmod)
+            if dfactory is None:
+                raise TypeError(
+                    f"{type(dmod).__name__} does not thread a KV cache; it "
+                    "cannot draft for the serving engine")
+            tv = getattr(getattr(module, "config", None), "vocab_size", None)
+            dv = getattr(getattr(dmod, "config", None), "vocab_size", None)
+            if tv is not None and dv is not None and tv != dv:
+                raise ValueError(
+                    f"draft vocab ({dv}) != target vocab ({tv}); acceptance "
+                    "compares token ids, so the vocabularies must match")
+            self._draft_module, self._draft_params = dmod, dparams
+            self._draft_factory = dfactory
+        else:
+            self._spec_k = None
+            self._draft_module = self._draft_params = None
+
         if prefix_cache is not None:
             if self._chunk is None:
                 raise ValueError(
                     "prefix_cache= requires chunked prefill "
                     "(prefill_chunk=None has no chunk-aligned blocks)")
+            if self._spec_k is not None:
+                raise ValueError(
+                    "speculative engines cannot use a prefix cache: cached "
+                    "target KV blocks carry no draft-model KV, so a restored "
+                    "prefix would leave the draft cache unfilled")
             self._prefix_cache: Optional[PrefixCache] = prefix_cache
+            self._alias_cache = False   # external/shared cache: COPY restores
+        elif (self._chunk is not None and prefix_cache_mb > 0
+                and self._spec_k is None):
+            # A PRIVATE cache on a paged engine stores page-id tuples, not
+            # KV blocks: a hit is a host table write + refcount (aliasing),
+            # and eviction gives the pages back through the hook.
+            self._alias_cache = self._paged
+            self._prefix_cache = PrefixCache(
+                int(prefix_cache_mb * 2 ** 20),
+                on_evict=self._on_prefix_evict if self._alias_cache else None)
         else:
-            self._prefix_cache = (
-                PrefixCache(int(prefix_cache_mb * 2 ** 20))
-                if self._chunk is not None and prefix_cache_mb > 0 else None)
+            self._prefix_cache = None
+            self._alias_cache = False
         self._prefilling: collections.deque[Request] = collections.deque()
 
-        # One slot's cache, used as the state template. Ring (sliding-window)
-        # caches rotate by stored position — the slot-stacked
-        # dynamic_update_slice layout below does not model that, so refuse
-        # loudly rather than serve corrupted windows.
-        slot_cache = factory(1, self.max_len, self._dtype)
-        if any(isinstance(layer, dict) and "pos" in layer for layer in slot_cache):
+        # One slot's cache is the state template. Ring (sliding-window)
+        # caches rotate by stored position — the dense slot-stacked layout
+        # cannot model that, but the PAGED layout serves them: the gathered
+        # view is always a full-length LINEAR cache (the model's linear
+        # branch applies the window mask), and ring semantics become a
+        # page-lifetime policy (out-of-window pages are freed). Only the
+        # dense path refuses.
+        slot_shape = jax.eval_shape(
+            lambda: self._factory(1, self.max_len, self._dtype))
+        has_ring = any(isinstance(layer, dict) and "pos" in layer
+                       for layer in slot_shape)
+        if has_ring and not self._paged:
             raise NotImplementedError(
-                "sliding-window (ring) KV caches are not supported by the "
-                "serving engine yet; set the config's window >= max_len")
+                "sliding-window (ring) KV caches need the paged engine "
+                "(paged=True frees out-of-window pages); the dense slot "
+                "layout cannot rotate them — or set the config's window "
+                ">= max_len")
         if self._chunk is not None:
-            self._cache_axes = self._cache_length_axes()
+            # The paged template probes at tiny lengths where every layer is
+            # linear (a window >= 2 never rings at length 2) because the
+            # gathered page view is a full-length linear cache; the dense
+            # chunked path keeps the max_len probes.
+            self._cache_axes = (self._cache_length_axes(2, 1) if self._paged
+                                else self._cache_length_axes())
+        cfg = getattr(module, "config", None)
+        win = getattr(cfg, "sliding_window", None)
+        #: window width when pages wholly out of the attention window may be
+        #: freed: paged + every layer uniformly windowed (mixed local/global
+        #: stacks keep all pages — correctness first, no freeing).
+        self._page_window = (
+            int(win) if (self._paged and has_ring and isinstance(win, int)
+                         and getattr(cfg, "layer_types", None) is None)
+            else None)
 
-        self._state = {
-            "cache": jax.tree.map(
-                lambda l: jnp.zeros((self.max_slots,) + l.shape, l.dtype),
-                slot_cache),
-            "pos": jnp.zeros((self.max_slots,), jnp.int32),
-            "tok": jnp.zeros((self.max_slots,), jnp.int32),
-            "rng": jnp.zeros((self.max_slots, 2), jnp.uint32),
-            "done": jnp.zeros((self.max_slots,), bool),
-        }
+        if self._paged:
+            probe = jax.eval_shape(lambda: self._factory(1, 2, self._dtype))
+            self._cache_struct = jax.tree.structure(probe)
+            K = self._spec_k or 0
+            # The view must hold max_len + K positions: a verify near the
+            # end of a stream writes up to pos + K, and the model's internal
+            # dynamic_update_slice would CLAMP (corrupting earlier
+            # positions) if the view were shorter.
+            self._pages_per_slot = -(-(self.max_len + K) // self._page)
+            usable = (int(max_pages) if max_pages is not None
+                      else self.max_slots * (-(-self.max_len // self._page)))
+            if usable < 1:
+                raise ValueError(f"max_pages must be >= 1 (got {max_pages})")
+            self._pool = PagePool(usable)
+            self._table = np.zeros((self.max_slots, self._pages_per_slot),
+                                   np.int32)
+            pool_leaves, self._page_bytes = [], 0
+            for sh, ax in zip(jax.tree.leaves(probe), self._cache_axes):
+                shape = list(sh.shape)
+                shape[ax] = self._page
+                # +1: page 0 is the reserved scratch page every clamped or
+                # inactive write routes to.
+                pool_leaves.append(
+                    jnp.zeros((usable + 1,) + tuple(shape), sh.dtype))
+                self._page_bytes += (int(np.prod(shape))
+                                     * np.dtype(sh.dtype).itemsize)
+            self._state = {
+                "pool": jax.tree.unflatten(self._cache_struct, pool_leaves),
+                "pos": jnp.zeros((self.max_slots,), jnp.int32),
+                "tok": jnp.zeros((self.max_slots,), jnp.int32),
+                "rng": jnp.zeros((self.max_slots, 2), jnp.uint32),
+                "done": jnp.zeros((self.max_slots,), bool),
+            }
+            if self._spec_k is not None:
+                dshape = jax.eval_shape(lambda: self._draft_factory(
+                    1, self.max_len + self._spec_k, self._dtype))
+                if any(isinstance(layer, dict) and "pos" in layer
+                       for layer in dshape):
+                    raise NotImplementedError(
+                        "the draft model's KV cache must be linear at "
+                        "max_len + spec_tokens (raise its sliding window)")
+                # Small dense per-slot draft cache (the draft is what makes
+                # speculation pay — its KV is not worth paging).
+                self._state["draft"] = jax.tree.map(
+                    lambda l: jnp.zeros((self.max_slots,) + l.shape, l.dtype),
+                    self._draft_factory(1, self.max_len + self._spec_k,
+                                        self._dtype))
+        else:
+            self._pool = None
+            self._table = None
+            slot_cache = self._factory(1, self.max_len, self._dtype)
+            self._state = {
+                "cache": jax.tree.map(
+                    lambda l: jnp.zeros((self.max_slots,) + l.shape, l.dtype),
+                    slot_cache),
+                "pos": jnp.zeros((self.max_slots,), jnp.int32),
+                "tok": jnp.zeros((self.max_slots,), jnp.int32),
+                "rng": jnp.zeros((self.max_slots, 2), jnp.uint32),
+                "done": jnp.zeros((self.max_slots,), bool),
+            }
         # Adapter bank: the per-slot adapter row index joins the decode
         # state ONLY when a bank is attached — a bank-less engine traces
         # byte-identical programs to the pre-adapter engine.
@@ -315,53 +523,95 @@ class ServingEngine:
 
         # CPU jit warns (and ignores) donation; donate only where it works.
         donate = () if jax.default_backend() == "cpu" else (1,)
+        # A paged engine with its private alias cache restores prefixes by
+        # host page-table writes — there is no compiled restore program at
+        # all (steady state is TWO warm executables, not three).
+        self._restore_prefix = None
+        self._spec = None
         if self._exec is None:
-            self._decode = jax.jit(self._decode_fn, donate_argnums=donate)
-            if self._chunk is None:
-                self._prefill = jax.jit(self._prefill_fn,
-                                        donate_argnums=donate)
-            else:
-                self._prefill_chunk = jax.jit(self._prefill_chunk_fn,
+            if self._paged:
+                self._decode = jax.jit(self._paged_decode_fn,
+                                       donate_argnums=donate)
+                self._prefill_chunk = jax.jit(self._paged_prefill_chunk_fn,
                                               donate_argnums=donate)
-                # restore donates the STATE only (its arg 0) — the block is
-                # a live prefix-cache entry that must survive the copy.
-                self._restore_prefix = jax.jit(
-                    self._restore_prefix_fn,
-                    donate_argnums=(0,) if donate else ())
+                if self._prefix_cache is not None and not self._alias_cache:
+                    # Only a shared EXTERNAL cache needs the copy-restore
+                    # program — the private cache restores by table aliasing
+                    # (pure host work, nothing to compile).
+                    self._restore_prefix = jax.jit(
+                        self._paged_restore_prefix_fn,
+                        donate_argnums=(0,) if donate else ())
+                if self._spec_k is not None:
+                    # state is positional arg 2 of the spec program.
+                    self._spec = jax.jit(self._spec_fn,
+                                         donate_argnums=(2,) if donate else ())
+            else:
+                self._decode = jax.jit(self._decode_fn, donate_argnums=donate)
+                if self._chunk is None:
+                    self._prefill = jax.jit(self._prefill_fn,
+                                            donate_argnums=donate)
+                else:
+                    self._prefill_chunk = jax.jit(self._prefill_chunk_fn,
+                                                  donate_argnums=donate)
+                    # restore donates the STATE only (its arg 0) — the block
+                    # is a live prefix-cache entry that must survive the copy.
+                    self._restore_prefix = jax.jit(
+                        self._restore_prefix_fn,
+                        donate_argnums=(0,) if donate else ())
         else:
             # Mesh-sliced compilation: derive every placement once, put
             # params/state/bank exactly onto it (jit with explicit
             # in_shardings rejects committed arrays laid out differently),
-            # and compile the SAME three program functions with those
-            # shardings — the engine's call sites don't change at all.
+            # and compile the SAME program functions with those shardings —
+            # the engine's call sites don't change at all. The page pool
+            # shards exactly like the dense cache (kv-heads axis split, page
+            # axis replicated-in-index like the slot axis); the page table,
+            # masks, and per-call scalars stay replicated data.
             exec_ = self._exec
             self._param_sh = exec_.param_shardings(params)
             self.params = params = exec_.place(params, self._param_sh)
-            tmpl = jax.tree.leaves(slot_cache)
+            if self._paged:
+                tmpl = [jax.ShapeDtypeStruct(l.shape[1:], l.dtype)
+                        for l in jax.tree.leaves(self._state["pool"])]
+                struct = self._cache_struct
+            else:
+                tmpl = jax.tree.leaves(slot_cache)
+                struct = jax.tree.structure(slot_cache)
             self._state_sh = exec_.state_shardings(self._state, tmpl,
                                                    self._cache_axes)
-            self._block_sh = exec_.block_shardings(
-                jax.tree.structure(slot_cache), tmpl, self._cache_axes)
+            self._block_sh = exec_.block_shardings(struct, tmpl,
+                                                   self._cache_axes)
             self._state = exec_.place(self._state, self._state_sh)
             rep = exec_.replicated
-            decode_in = [self._param_sh, self._state_sh, rep]
-            chunk_in = [self._param_sh, self._state_sh, rep, rep, rep, rep,
-                        rep]
+            if self._paged:
+                decode_in = [self._param_sh, self._state_sh, rep, rep]
+                chunk_in = [self._param_sh, self._state_sh] + [rep] * 6
+                restore_in = (self._state_sh, self._block_sh, rep, rep, rep)
+                decode_fn = self._paged_decode_fn
+                chunk_fn = self._paged_prefill_chunk_fn
+                restore_fn = self._paged_restore_prefix_fn
+            else:
+                decode_in = [self._param_sh, self._state_sh, rep]
+                chunk_in = [self._param_sh, self._state_sh] + [rep] * 5
+                restore_in = (self._state_sh, self._block_sh, rep, rep, rep)
+                decode_fn = self._decode_fn
+                chunk_fn = self._prefill_chunk_fn
+                restore_fn = self._restore_prefix_fn
             if adapters is not None:
                 self._bank_sh = exec_.bank_shardings(adapters)
                 adapters.place(self._bank_sh)
                 decode_in.append(self._bank_sh)
                 chunk_in += [rep, self._bank_sh]
             self._decode = exec_.jit(
-                self._decode_fn, tuple(decode_in),
+                decode_fn, tuple(decode_in),
                 (self._state_sh, rep, rep), donate_argnums=donate)
             self._prefill_chunk = exec_.jit(
-                self._prefill_chunk_fn, tuple(chunk_in),
+                chunk_fn, tuple(chunk_in),
                 (self._state_sh, rep, self._block_sh), donate_argnums=donate)
-            self._restore_prefix = exec_.jit(
-                self._restore_prefix_fn,
-                (self._state_sh, self._block_sh, rep, rep, rep),
-                self._state_sh, donate_argnums=(0,) if donate else ())
+            if not (self._paged and self._alias_cache):
+                self._restore_prefix = exec_.jit(
+                    restore_fn, restore_in,
+                    self._state_sh, donate_argnums=(0,) if donate else ())
 
         if stats is None and accelerator is not None:
             stats = getattr(accelerator, "serving_stats", None)
@@ -435,19 +685,28 @@ class ServingEngine:
                 "gather params to host before serving.")
         return None
 
-    def _cache_length_axes(self) -> list[int]:
+    def _cache_length_axes(self, la: Optional[int] = None,
+                           lb: Optional[int] = None) -> list[int]:
         """Per-leaf sequence-length axis of the slot cache, detected by
         comparing ``eval_shape`` of the factory at two lengths (layouts are
         family-specific; llama is ``[1, L, n_kv, head]`` but nothing
-        guarantees that elsewhere). The second probe length is
+        guarantees that elsewhere). Default probes are ``max_len`` vs
         ``max_len - 1``, never ``+ 1`` — growing past ``max_len`` could
         flip a sliding-window layer into its ring layout and change the
-        tree structure itself. Flattened-leaf order, the same order every
-        tree op in the chunk/restore programs uses."""
+        tree structure itself; the PAGED engine probes at (2, 1) instead,
+        where a windowed layer is still linear, because its page template
+        must be the linear layout regardless of the window. Flattened-leaf
+        order, the same order every tree op in the programs uses."""
+        la = self.max_len if la is None else la
+        lb = self.max_len - 1 if lb is None else lb
         a = jax.tree.leaves(jax.eval_shape(
-            lambda: self._factory(1, self.max_len, self._dtype)))
+            lambda: self._factory(1, la, self._dtype)))
         b = jax.tree.leaves(jax.eval_shape(
-            lambda: self._factory(1, self.max_len - 1, self._dtype)))
+            lambda: self._factory(1, lb, self._dtype)))
+        if len(a) != len(b):
+            raise NotImplementedError(
+                "the KV cache changes structure between probe lengths "
+                f"({la} vs {lb}); this layout cannot be paged/chunked")
         axes = []
         for x, y in zip(a, b):
             diff = [i for i, (m, n) in enumerate(zip(x.shape, y.shape))
@@ -456,8 +715,8 @@ class ServingEngine:
                 raise NotImplementedError(
                     "chunked prefill needs every KV leaf to carry exactly "
                     f"one length axis (leaf {x.shape} vs {y.shape} at "
-                    "max_len - 1); pass prefill_chunk=None for the "
-                    "monolithic path")
+                    f"probe lengths {la}/{lb}); pass prefill_chunk=None "
+                    "for the monolithic path")
             axes.append(diff[0])
         return axes
 
@@ -619,6 +878,290 @@ class ServingEngine:
         )
         return state, toks, dones
 
+    # -- paged programs -------------------------------------------------
+    def _gather_view(self, pool, pages):
+        """One slot's dense cache VIEW from the pool: gather its page rows
+        (``pages`` [Np] i32 pool ids, 0 = scratch for unallocated entries)
+        and merge the page axis into the length axis — each leaf becomes
+        ``[1, Np * P, ...]``, exactly the linear cache the unchanged
+        forward expects. Scratch garbage sits at positions the attention
+        mask (causal and/or sliding-window) already excludes."""
+        leaves = []
+        for l, ax in zip(jax.tree.leaves(pool), self._cache_axes):
+            g = jnp.moveaxis(l[pages], 0, ax)
+            shape = (list(g.shape[:ax]) + [g.shape[ax] * g.shape[ax + 1]]
+                     + list(g.shape[ax + 2:]))
+            leaves.append(g.reshape(shape))
+        return jax.tree.unflatten(self._cache_struct, leaves)
+
+    def _scatter_page(self, pool_leaves, view_leaves, src_page, tgt):
+        """Write view page ``src_page`` back into pool page ``tgt`` (both
+        traced i32). ``tgt = 0`` discards into scratch; an out-of-range
+        ``src_page`` clamps to the view's last page (jax dynamic_slice
+        semantics), which callers pair with a scratch target — the two
+        clamps together are what let a FIXED number of scatter steps cover
+        a variable number of genuinely-written pages."""
+        out = []
+        for pl, vl, ax in zip(pool_leaves, view_leaves, self._cache_axes):
+            start = [0] * vl.ndim
+            start[ax] = src_page * self._page
+            sizes = list(vl.shape)
+            sizes[ax] = self._page
+            pb = jax.lax.dynamic_slice(vl, tuple(start), tuple(sizes))
+            out.append(jax.lax.dynamic_update_slice(
+                pl, pb[None].astype(pl.dtype), (tgt,) + (0,) * pb.ndim))
+        return out
+
+    def _paged_prefill_chunk_fn(self, params, state, ids_c, slot, pages,
+                                offset, true_len, rng, aidx=None, bank=None,
+                                dparams=None):
+        """Paged twin of :meth:`_prefill_chunk_fn`: gather the slot's pages
+        into a dense view, run the chunk at ``cache_pos=offset`` exactly as
+        the dense program does, then scatter back only the pages the chunk
+        wrote. A chunk touches at most ``C/P + 1`` pages (the pulled-back
+        final chunk may start mid-page); the possibly-untouched trailing
+        step routes to scratch. The returned block is sliced from the view
+        — same bytes as the dense block, so external prefix caches stay
+        layout-compatible. With a draft model attached the SAME call also
+        prefills the slot's dense draft cache (``dparams`` kwarg), keeping
+        the warm-executable count unchanged."""
+        C = ids_c.shape[1]
+        view = self._gather_view(state["pool"], pages)
+        logits, view = self.module.apply(
+            {"params": params}, ids_c, cache=view, cache_pos=offset,
+            **self._lora_kwargs(bank, aidx))
+        tok, done, rng_carry = _chunk_prefill_token(
+            logits, rng, self._select, self.eos_token_id, ids_c.dtype,
+            true_len, offset)
+        view_leaves = jax.tree.leaves(view)
+        block = jax.tree.unflatten(
+            self._cache_struct,
+            [jax.lax.dynamic_slice_in_dim(l, offset, C, axis=ax)
+             for l, ax in zip(view_leaves, self._cache_axes)])
+        pool_leaves = jax.tree.leaves(state["pool"])
+        p0 = offset // self._page
+        for pg in range(C // self._page + 1):
+            tid = jax.lax.dynamic_slice(pages, (p0 + pg,), (1,))[0]
+            touched = (p0 + pg) * self._page < offset + C
+            pool_leaves = self._scatter_page(
+                pool_leaves, view_leaves, p0 + pg,
+                jnp.where(touched, tid, 0))
+        new_state = dict(
+            state,
+            pool=jax.tree.unflatten(self._cache_struct, pool_leaves),
+            pos=state["pos"].at[slot].set(true_len),
+            tok=state["tok"].at[slot].set(tok[0].astype(jnp.int32)),
+            rng=state["rng"].at[slot].set(rng_carry),
+            done=state["done"].at[slot].set(done[0]),
+        )
+        if bank is not None:
+            new_state["adapter_idx"] = state["adapter_idx"].at[slot].set(aidx)
+        if dparams is not None:
+            dc = jax.tree.map(
+                lambda full: jax.lax.dynamic_slice(
+                    full, (slot,) + (0,) * (full.ndim - 1),
+                    (1,) + full.shape[1:])[0],
+                state["draft"])
+            _, dc = self._draft_module.apply(
+                {"params": dparams}, ids_c, cache=dc, cache_pos=offset)
+            new_state["draft"] = jax.tree.map(
+                lambda full, one: jax.lax.dynamic_update_slice(
+                    full, one[None].astype(full.dtype),
+                    (slot,) + (0,) * one.ndim),
+                state["draft"], dc)
+        return new_state, tok[0], block
+
+    def _paged_restore_prefix_fn(self, state, block, pages_c, slot, true_len):
+        """Copy-restore for paged engines with an EXTERNAL (fleet-shared)
+        prefix cache: split one cached ``[1, C]`` block into ``C/P`` pages
+        and write each into the pool page named by ``pages_c`` (traced
+        [C/P] i32 — the slot's freshly-allocated table entries). Pins
+        ``pos[slot] = true_len`` like every restore. The engine's PRIVATE
+        cache never calls this — it restores by host table aliasing."""
+        pool_leaves = jax.tree.leaves(state["pool"])
+        out = []
+        for pl, blk, ax in zip(pool_leaves, jax.tree.leaves(block),
+                               self._cache_axes):
+            Cp = blk.shape[ax] // self._page
+            shape = list(blk.shape)
+            shape[ax:ax + 1] = [Cp, self._page]
+            pages_blk = jnp.moveaxis(blk.reshape(shape), ax, 0)
+            for j in range(Cp):
+                pl = jax.lax.dynamic_update_slice(
+                    pl, pages_blk[j][None].astype(pl.dtype),
+                    (pages_c[j],) + (0,) * pages_blk[j].ndim)
+            out.append(pl)
+        return dict(
+            state,
+            pool=jax.tree.unflatten(self._cache_struct, out),
+            pos=state["pos"].at[slot].set(true_len),
+        )
+
+    def _gather_views_all_slots(self, pool, table):
+        """Batched :meth:`_gather_view`: ``table`` [S, Np] → per-leaf
+        ``[S, 1, Np*P, ...]`` dense views, slot axis leading so the decode
+        vmap runs over it unchanged."""
+        leaves = []
+        for l, ax in zip(jax.tree.leaves(pool), self._cache_axes):
+            g = jnp.moveaxis(l[table], 1, ax + 1)
+            shape = (list(g.shape[:ax + 1])
+                     + [g.shape[ax + 1] * g.shape[ax + 2]]
+                     + list(g.shape[ax + 3:]))
+            leaves.append(g.reshape(shape))
+        return jax.tree.unflatten(self._cache_struct, leaves)
+
+    def _paged_decode_fn(self, params, state, active, table, bank=None):
+        """Paged twin of :meth:`_decode_fn`: gather every slot's view, run
+        the identical vmapped batch-1 forward (same logits, same
+        :func:`generation._next_token` — paged streams are bit-identical
+        to dense), then scatter back ONE page per slot: the page holding
+        ``pos[slot]``, the only position a tick writes. Inactive slots
+        scatter to scratch, so their stale ``pos`` can't corrupt the pool
+        — the paged analogue of the dense path's unconditional-commit
+        safety. The host guarantees an active slot's ``pos`` page is
+        allocated before every tick."""
+        P = self._page
+        views = self._gather_views_all_slots(state["pool"], table)
+
+        def one_slot(cache, tok, pos, rng, done, aidx=None):
+            logits, cache = self.module.apply(
+                {"params": params}, tok[None, None], cache=cache,
+                cache_pos=pos, **self._lora_kwargs(bank, aidx))
+            rng, sub = jax.random.split(rng)
+            nxt, done = _next_token(logits[:, -1], sub, jnp.zeros((1, 1), bool),
+                                    done[None], self._select, self.eos_token_id,
+                                    tok.dtype)
+            return cache, nxt[0], rng, done[0]
+
+        vmap_args = [views, state["tok"], state["pos"], state["rng"],
+                     state["done"]]
+        if bank is not None:
+            vmap_args.append(state["adapter_idx"])
+        new_views, toks, rngs, dones = jax.vmap(one_slot)(*vmap_args)
+        nv_leaves = jax.tree.leaves(new_views)
+        pool_leaves = jax.tree.leaves(state["pool"])
+        for s in range(self.max_slots):
+            pg = state["pos"][s] // P
+            tid = jax.lax.dynamic_slice(table[s], (pg,), (1,))[0]
+            tgt = jnp.where(active[s], tid, 0)
+            new_pool = []
+            for pl, vl, ax in zip(pool_leaves, nv_leaves, self._cache_axes):
+                start = [0] * vl.ndim
+                start[0] = s
+                start[ax + 1] = pg * P
+                sizes = list(vl.shape)
+                sizes[0] = 1
+                sizes[ax + 1] = P
+                pb = jax.lax.dynamic_slice(vl, tuple(start), tuple(sizes))[0]
+                new_pool.append(jax.lax.dynamic_update_slice(
+                    pl, pb[None].astype(pl.dtype), (tgt,) + (0,) * pb.ndim))
+            pool_leaves = new_pool
+        state = dict(
+            state,
+            pool=jax.tree.unflatten(self._cache_struct, pool_leaves),
+            pos=jnp.where(active, state["pos"] + 1, state["pos"]),
+            tok=jnp.where(active, toks, state["tok"]),
+            rng=jnp.where(active[:, None], rngs, state["rng"]),
+            done=jnp.where(active, dones, state["done"]),
+        )
+        return state, toks, dones
+
+    def _spec_fn(self, params, dparams, state, active, table, remaining):
+        """One SPECULATIVE tick (greedy, paged, single-chip): per slot, scan
+        K greedy draft steps through the slot's dense draft cache, verify
+        draft + carry token in ONE fixed ``[1, K+1]`` target forward
+        against the paged view, and accept the longest prefix where the
+        draft matches the target's emitted chain. The emitted chain
+        replays :func:`generation._next_token`'s eos latch (once eos, all
+        later emissions are eos), so committing its first ``n`` tokens is
+        token-identical to ``n`` dense greedy ticks. ``n = min(accepted +
+        1, remaining)`` — remaining is per-slot traced data, so a stream
+        never overruns its ``max_new_tokens``. The carry rng is untouched
+        (greedy selection never consumes it), keeping spec streams
+        comparable to dense greedy ones.
+
+        Rejected-draft KV (positions past ``pos + n - 1``) is garbage, but
+        the NEXT verify rewrites positions ``pos+n .. pos+n+K`` before any
+        query can attend them — the same overwrite-before-attend argument
+        the chunked prefill pad relies on. Returns
+        ``(state, emitted [S, K+1], n [S])``."""
+        P, K = self._page, self._spec_k
+        views = self._gather_views_all_slots(state["pool"], table)
+
+        def one_slot(view, dcache, tok, pos, done, rem):
+            def dstep(carry, _):
+                dc, cur, p = carry
+                dlog, dc = self._draft_module.apply(
+                    {"params": dparams}, cur[None, None], cache=dc,
+                    cache_pos=p)
+                nxt = jnp.argmax(dlog[0, -1], axis=-1).astype(tok.dtype)
+                return (dc, nxt, p + 1), nxt
+            (dcache, _, _), drafts = jax.lax.scan(
+                dstep, (dcache, tok, pos), None, length=K)
+            ids_v = jnp.concatenate([tok[None], drafts])[None]
+            logits, view = self.module.apply(
+                {"params": params}, ids_v, cache=view, cache_pos=pos)
+            preds = jnp.argmax(logits[0], axis=-1).astype(tok.dtype)
+            if self.eos_token_id is not None:
+                eos = jnp.asarray(self.eos_token_id, tok.dtype)
+
+                def latch(d0, p):
+                    t = jnp.where(d0, eos, p)
+                    return d0 | (t == eos), t
+                _, emit = jax.lax.scan(latch, done, preds)
+            else:
+                emit = preds
+            matches = (drafts == emit[:K]).astype(jnp.int32)
+            m = jnp.sum(jnp.cumprod(matches))
+            n = jnp.minimum(m + 1, rem)
+            new_tok = emit[jnp.clip(n - 1, 0, K)]
+            if self.eos_token_id is not None:
+                new_done = new_tok == jnp.asarray(self.eos_token_id,
+                                                  tok.dtype)
+            else:
+                new_done = done
+            return view, dcache, new_tok, n, emit, new_done
+
+        new_views, new_draft, toks, ns, emit, dones = jax.vmap(one_slot)(
+            views, state["draft"], state["tok"], state["pos"],
+            state["done"], remaining)
+        nv_leaves = jax.tree.leaves(new_views)
+        pool_leaves = jax.tree.leaves(state["pool"])
+        # A verify writes positions pos .. pos+K: at most K//P + 2 pages.
+        # Pages past the slot's allocated frontier (table entry 0, or the
+        # untouched trailing step) land in scratch; their positions are
+        # rewritten by the next verify before anything attends them.
+        for s in range(self.max_slots):
+            p0 = state["pos"][s] // P
+            for pg in range(K // P + 2):
+                tid = jax.lax.dynamic_slice(table[s], (p0 + pg,), (1,))[0]
+                touched = (p0 + pg) * P <= state["pos"][s] + K
+                tgt = jnp.where(active[s] & touched, tid, 0)
+                new_pool = []
+                for pl, vl, ax in zip(pool_leaves, nv_leaves,
+                                      self._cache_axes):
+                    start = [0] * vl.ndim
+                    start[0] = s
+                    start[ax + 1] = (p0 + pg) * P
+                    sizes = list(vl.shape)
+                    sizes[0] = 1
+                    sizes[ax + 1] = P
+                    pb = jax.lax.dynamic_slice(vl, tuple(start),
+                                               tuple(sizes))[0]
+                    new_pool.append(jax.lax.dynamic_update_slice(
+                        pl, pb[None].astype(pl.dtype),
+                        (tgt,) + (0,) * pb.ndim))
+                pool_leaves = new_pool
+        state = dict(
+            state,
+            pool=jax.tree.unflatten(self._cache_struct, pool_leaves),
+            draft=new_draft,
+            pos=jnp.where(active, state["pos"] + ns, state["pos"]),
+            tok=jnp.where(active, toks, state["tok"]),
+            done=jnp.where(active, dones, state["done"]),
+        )
+        return state, emit, ns
+
     # ------------------------------------------------------------------
     # lifecycle
     # ------------------------------------------------------------------
@@ -726,12 +1269,39 @@ class ServingEngine:
         return len(self._queue)
 
     @property
+    def paged(self) -> bool:
+        """Whether this engine uses the paged KV pool."""
+        return self._paged
+
+    @property
+    def page_size(self) -> Optional[int]:
+        """Tokens per KV page (None for dense engines)."""
+        return self._page
+
+    @property
+    def total_pages(self) -> int:
+        """Usable pool pages (0 for dense engines)."""
+        return self._pool.num_pages if self._paged else 0
+
+    @property
+    def free_pages(self) -> int:
+        """Unallocated pool pages right now (0 for dense engines — their
+        capacity is slots, which ``free_slots`` already reports)."""
+        return self._pool.free_pages if self._paged else 0
+
+    @property
     def load(self) -> float:
         """Occupancy fraction over the engine's whole admission capacity:
         ``(active slots + queued) / (max_slots + max_queued)`` — the
-        router's least-loaded score. 1.0 means a submit would bounce."""
-        return ((self._slots.active_slots + len(self._queue))
+        router's least-loaded score; 1.0 means a submit would bounce. A
+        paged engine also folds in POOL pressure (used/total pages), so
+        the router steers traffic away from a replica whose memory, not
+        slots, is the bottleneck."""
+        base = ((self._slots.active_slots + len(self._queue))
                 / (self.max_slots + self._queue.max_queued))
+        if self._paged:
+            return max(base, self._pool.used_pages / self._pool.num_pages)
+        return base
 
     def kill(self, error: Optional[BaseException] = None):
         """Fault injection / fencing: make the run loop raise ``error`` at
@@ -791,7 +1361,27 @@ class ServingEngine:
                 f"prompt ({S}) + max_new_tokens ({request.max_new_tokens}) "
                 f"exceeds the engine's max_len ({self.max_len}); resize the "
                 "engine or shorten the request")
-        _check_position_bound(self.module, S + request.max_new_tokens)
+        if self._paged:
+            # A lone request must always be satisfiable: with everyone else
+            # preempted and the alias cache drained, its worst-case footprint
+            # has to fit the pool, or admission could wedge forever.
+            need = -(-(S + request.max_new_tokens) // self._page)
+            if need > self._pool.num_pages:
+                raise ValueError(
+                    f"request needs up to {need} KV pages (prompt {S} + "
+                    f"max_new_tokens {request.max_new_tokens} at page_size "
+                    f"{self._page}) but the pool only has "
+                    f"{self._pool.num_pages}; raise max_pages or shorten "
+                    "the request")
+        if self._spec_k is not None:
+            # A verify near the end of the stream writes positions up to
+            # (S + max_new - 1) + K; the draft scan stops one short.
+            K = self._spec_k
+            _check_position_bound(self.module, S + request.max_new_tokens + K)
+            _check_position_bound(self._draft_module,
+                                  S + request.max_new_tokens + K - 1)
+        else:
+            _check_position_bound(self.module, S + request.max_new_tokens)
         request.submitted_at = time.monotonic()
         try:
             self._queue.put(request, block=block, timeout=block_timeout)
@@ -841,10 +1431,30 @@ class ServingEngine:
     def kv_cache_per_chip_bytes(self) -> int:
         """Per-device byte footprint of the decode KV state (max shard per
         leaf): the HBM-planning number, ≈ ``1/tp`` of the single-chip
-        figure for heads-sharded leaves (docs/performance.md)."""
+        figure for heads-sharded leaves (docs/performance.md). For a
+        paged engine this is the page POOL — the number ``max_pages``
+        controls directly, independent of ``max_slots``."""
+        tree = (self._state["pool"] if self._paged
+                else self._state["cache"])
         if self._exec is not None:
-            return self._exec.per_chip_bytes(self._state["cache"])
-        return sum(l.nbytes for l in jax.tree.leaves(self._state["cache"]))
+            return self._exec.per_chip_bytes(tree)
+        return sum(l.nbytes for l in jax.tree.leaves(tree))
+
+    def page_pool_metrics(self) -> dict:
+        """Host-side pool snapshot (empty for dense engines): page size,
+        totals, occupancy, allocation and preemption counters."""
+        if not self._paged:
+            return {}
+        return {
+            "page_size": self._page,
+            "pages_per_slot": self._pages_per_slot,
+            "page_bytes": self._page_bytes,
+            "pages_total": self._pool.num_pages,
+            "pages_free": self._pool.free_pages,
+            "pages_used": self._pool.used_pages,
+            "page_allocations": self._pool.allocations,
+            "preemptions": self._pool.preemptions,
+        }
 
     def decode_memory_analysis(self):
         """``CompiledMemoryStats`` for the decode tick, compiled FRESH from
@@ -853,16 +1463,21 @@ class ServingEngine:
         accounting the zero-recompile tests pin."""
         args = [self.params, self._state,
                 np.zeros((self.max_slots,), bool)]
+        if self._paged:
+            args.append(self._table.copy())
         if self._adapters is not None:
             args.append(self._adapters.stacks)
+        decode_fn = self._paged_decode_fn if self._paged else self._decode_fn
         if self._exec is None:
-            fn = jax.jit(self._decode_fn)
+            fn = jax.jit(decode_fn)
         else:
             rep = self._exec.replicated
             ins = [self._param_sh, self._state_sh, rep]
+            if self._paged:
+                ins.append(rep)
             if self._adapters is not None:
                 ins.append(self._bank_sh)
-            fn = self._exec.jit(self._decode_fn, tuple(ins),
+            fn = self._exec.jit(decode_fn, tuple(ins),
                                 (self._state_sh, rep, rep))
         return fn.lower(*args).compile().memory_analysis()
 
@@ -923,12 +1538,20 @@ class ServingEngine:
                                 progressed = True
                                 if self._screen(req, now):
                                     budget = self._begin_prefill(req, budget)
+                                    if budget is None:
+                                        # Paged admission gate: the request
+                                        # went back to the queue front; stop
+                                        # admitting until decode frees pages.
+                                        break
                         if not progressed:
                             break
                 running = [(slot, req) for slot, req in self._slots.active()
                            if req.status is RequestStatus.RUNNING]
                 if running:
-                    self._tick(running)
+                    if self._spec_k is not None:
+                        self._tick_spec(running)
+                    else:
+                        self._tick(running)
                 elif self._slots.active_slots:
                     pass  # prefill-only batch: loop again without idling
                 elif self._drain and not len(self._queue):
@@ -1007,6 +1630,124 @@ class ServingEngine:
             return ()
         return (np.int32(req._adapter_row), self._adapters.stacks)
 
+    # -- host-side page accounting (engine thread only) -----------------
+    def _on_prefix_evict(self, key, value):
+        """Alias-cache eviction hook: the evicted entry's value is the
+        tuple of pool page ids the cache held a reference on — give them
+        back. Pages still referenced by a live slot survive (refcounts);
+        only the last reference frees."""
+        for pid in value:
+            self._pool.decref(int(pid))
+
+    def _release_slot_pages(self, slot: int):
+        """Drop the slot's reference on every table entry and clear the
+        row. Aliased pages shared with the prefix cache or other slots
+        stay allocated until their last reference goes."""
+        row = self._table[slot]
+        for idx in range(self._pages_per_slot):
+            if row[idx]:
+                self._pool.decref(int(row[idx]))
+        row[:] = 0
+
+    def _alloc_page_into(self, req: Request, idx: int) -> bool:
+        """Allocate one pool page into ``table[req.slot, idx]``. On
+        exhaustion, first reclaim alias-cache entries LRU-first (an entry
+        whose pages nobody else references frees real pages), then preempt
+        other streams. False only when the requester is alone and the pool
+        is still dry — which the submit-time page bound makes impossible,
+        so callers treat it as an engine invariant violation."""
+        while True:
+            pid = self._pool.alloc()
+            if pid is not None:
+                self._table[req.slot, idx] = pid
+                return True
+            if (self._alias_cache and self._prefix_cache is not None
+                    and self._prefix_cache.evict_lru()):
+                continue
+            if not self._preempt_one(req):
+                return False
+
+    def _ensure_pages(self, req: Request, upto_pos: int) -> bool:
+        """Make the slot's table cover position ``upto_pos`` (allocating
+        every missing page up to and including its page)."""
+        row = self._table[req.slot]
+        # Indices below the request's window floor were freed on purpose
+        # (sliding-window page lifetime) — never bring them back.
+        for idx in range(req._page_floor, upto_pos // self._page + 1):
+            if not row[idx]:
+                if not self._alloc_page_into(req, idx):
+                    return False
+        return True
+
+    def _reclaimable_pages(self) -> int:
+        """Pages the admission gate could free without preempting anyone:
+        alias-cache pages whose only reference is the cache's own."""
+        if not (self._alias_cache and self._prefix_cache is not None):
+            return 0
+        return sum(
+            1 for _, val in self._prefix_cache.entries()
+            for pid in val if self._pool.refcount(int(pid)) == 1)
+
+    def _preempt_one(self, requester: Request) -> bool:
+        """Pool exhausted: evict the NEWEST-admitted other stream back to
+        the FRONT of the queue and free its pages. Newest loses because it
+        has the least sunk prefill work and the shortest resume. The
+        victim resumes token-exactly later: its prompt becomes
+        ``prompt + tokens`` (for greedy decoding the resumed prefill's
+        first token IS the interrupted stream's next token — the router
+        failover argument; sampled streams re-draw from the resume point).
+        Returns False when no other stream holds a slot."""
+        victim = None
+        for _, r in self._slots.active():
+            if r is requester:
+                continue
+            if victim is None or (r.admitted_at or 0.0) > (victim.admitted_at
+                                                           or 0.0):
+                victim = r
+        if victim is None:
+            return False
+        if victim.tokens:
+            victim._serve_ids = np.concatenate(
+                [victim.prompt_ids, np.asarray([victim.tokens], np.int32)],
+                axis=1)
+        self._release_slot_pages(victim.slot)
+        self._slots.release(victim.slot)
+        victim.slot = None
+        if victim._adapter_pinned:
+            victim._adapter_pinned = False
+            self._adapters.release(victim.adapter)
+        try:
+            self._prefilling.remove(victim)
+        except ValueError:
+            pass
+        victim.status = RequestStatus.QUEUED
+        victim._preempted += 1
+        self._pool.preemptions += 1
+        self._stats.record_preemption()
+        try:
+            self._queue.putleft(victim)
+        except QueueClosed:
+            victim._finish(RequestStatus.CANCELLED)
+            self._stats.record_finish(victim.status)
+        return True
+
+    def _free_window_pages(self, req: Request):
+        """Sliding-window page lifetime: page ``j``'s last position is
+        ``(j+1)*P - 1``; every future query sits at ``q >= pos``, and the
+        model's window mask only attends ``k > q - window`` — so once
+        ``(j+1)*P - 1 <= pos - window`` the page can never be read again
+        and its reference is dropped (the zeroed table entry gathers
+        scratch garbage, which that same mask excludes)."""
+        pos = req._pos_base + len(req.tokens)
+        row = self._table[req.slot]
+        for j in range(self._pages_per_slot):
+            if (j + 1) * self._page - 1 > pos - self._page_window:
+                break
+            if row[j]:
+                self._pool.decref(int(row[j]))
+                row[j] = 0
+            req._page_floor = j + 1
+
     def _admit(self, req: Request):
         """Monolithic admission (``prefill_chunk=None``): host edge-pad to
         the 128 bucket (numpy — a jnp pad would compile per prompt
@@ -1016,6 +1757,7 @@ class ServingEngine:
             return
         req.admitted_at = time.monotonic()
         slot = self._slots.assign(req)
+        req._serve_ids = req.prompt_ids
         S = req.prompt_ids.shape[1]
         P = self._bucket(S)
         ids_p = req.prompt_ids
@@ -1032,11 +1774,33 @@ class ServingEngine:
         return max(min(_bucket128(S), self._chunk_limit), S)
 
     # -- chunked prefill ------------------------------------------------
-    def _begin_prefill(self, req: Request, budget: int) -> int:
+    def _begin_prefill(self, req: Request, budget: int) -> Optional[int]:
         """Assign a slot, restore the longest cached chunk-aligned prefix
-        (``restore_prefix`` copies are not billed against the chunk
-        budget — they are why the cache pays), and run the request's first
-        live chunk. Returns the remaining budget."""
+        (restores are not billed against the chunk budget — they are why
+        the cache pays), and run the request's first live chunk. Returns
+        the remaining budget — or ``None`` when the paged admission gate
+        refuses: the prompt needs more pages than are free or reclaimable,
+        so the request goes back to the queue FRONT and the caller stops
+        admitting until decode progress frees pages (admitting anyway
+        would just trigger preemption thrash).
+
+        A paged engine prefills ``req._serve_ids`` — the original prompt,
+        or prompt + committed tokens after a preemption — so the same code
+        path is both first admission and token-exact resume."""
+        if req._serve_ids is None:
+            req._serve_ids = req.prompt_ids
+        req._page_floor = 0  # every (re)admission prefills from page 0
+        S = req._serve_ids.shape[1]
+        C = self._chunk
+        if self._paged:
+            need = -(-S // self._page)
+            if need > self._pool.free_pages + self._reclaimable_pages():
+                try:
+                    self._queue.putleft(req)
+                except QueueClosed:
+                    req._finish(RequestStatus.CANCELLED)
+                    self._stats.record_finish(req.status)
+                return None
         if not self._acquire_adapter(req):
             return budget
         req.admitted_at = time.monotonic()
@@ -1044,31 +1808,55 @@ class ServingEngine:
         req.status = RequestStatus.PREFILLING
         req._rng_key = req.rng if req.rng is not None else jax.random.PRNGKey(
             req.seed if req.seed is not None else 0)
-        S = req.prompt_ids.shape[1]
-        C = self._chunk
         req._chunks_total = -(-S // C)
         req._next_chunk = 0
         req._chunk_keys = None
         if self._prefix_cache is not None:
             n_full = S // C
             if n_full:
-                req._chunk_keys = self._prefix_keys(req.prompt_ids, n_full,
+                req._chunk_keys = self._prefix_keys(req._serve_ids, n_full,
                                                     req.adapter)
             # The FINAL chunk always re-runs (cached blocks hold KV, not the
             # logits the first token needs), so at most chunks 0..n-2 restore.
             restorable = min(n_full, req._chunks_total - 1)
             if restorable:
                 blocks = self._prefix_cache.match(req._chunk_keys[:restorable])
-                restored_bytes = 0
+                restored_bytes = aliased = 0
+                Cp = C // self._page if self._paged else 0
                 for i, blk in enumerate(blocks):
-                    self._state = self._restore_prefix(
-                        self._state, blk, np.int32(slot), np.int32(i * C),
-                        np.int32(S))
+                    if self._alias_cache:
+                        # blk is a tuple of page ids: restoring is a host
+                        # table write + refcount — zero device work. The
+                        # pos-pin invariant holds because the first chunk
+                        # call below runs before any tick can see the slot.
+                        for j, pid in enumerate(blk):
+                            self._pool.incref(int(pid))
+                            self._table[slot, i * Cp + j] = int(pid)
+                        restored_bytes += len(blk) * self._page_bytes
+                        aliased += 1
+                        continue
+                    if self._paged:
+                        ok = all(self._alloc_page_into(req, i * Cp + j)
+                                 for j in range(Cp))
+                        if not ok:
+                            raise RuntimeError(
+                                "page pool exhausted during prefix restore "
+                                "with no preemptable stream — the submit "
+                                "page bound should make this impossible")
+                        pages_c = self._table[slot, i * Cp:(i + 1) * Cp]
+                        self._state = self._restore_prefix(
+                            self._state, blk, pages_c.astype(np.int32),
+                            np.int32(slot), np.int32(S))
+                    else:
+                        self._state = self._restore_prefix(
+                            self._state, blk, np.int32(slot), np.int32(i * C),
+                            np.int32(S))
                     restored_bytes += sum(
                         l.nbytes for l in jax.tree.leaves(blk))
                 self._stats.record_prefix(looked_up=restorable,
                                           hit=len(blocks),
-                                          bytes_restored=restored_bytes)
+                                          bytes_restored=restored_bytes,
+                                          aliased=aliased)
                 req._next_chunk = len(blocks)
         self._prefilling.append(req)
         self._run_chunk(req)
@@ -1122,18 +1910,34 @@ class ServingEngine:
         returned."""
         i = req._next_chunk
         C = self._chunk
-        S = req.prompt_ids.shape[1]
+        S = req._serve_ids.shape[1]
         final = i == req._chunks_total - 1
         offset = min(i * C, self._chunk_cap) if final else i * C
-        ids_c = req.prompt_ids[:, offset:offset + C]
+        ids_c = req._serve_ids[:, offset:offset + C]
         if ids_c.shape[1] < C:
             ids_c = np.pad(ids_c, ((0, 0), (0, C - ids_c.shape[1])),
                            mode="edge")
         t0 = time.monotonic()
-        self._state, tok, block = self._prefill_chunk(
-            self.params, self._state, ids_c, np.int32(req.slot),
-            np.int32(offset), np.int32(S), req._rng_key,
-            *self._adapter_args(req))
+        if self._paged:
+            # Cover the chunk's whole write span (including the edge-pad
+            # tail — decode writes land there next) before the call; the
+            # program scatters only into these table entries.
+            if not self._ensure_pages(req, offset + C - 1):
+                raise RuntimeError(
+                    "page pool exhausted mid-prefill with no preemptable "
+                    "stream — the submit page bound should make this "
+                    "impossible")
+            kw = ({"dparams": self._draft_params}
+                  if self._spec_k is not None else {})
+            self._state, tok, block = self._prefill_chunk(
+                self.params, self._state, ids_c, np.int32(req.slot),
+                self._table[req.slot].copy(), np.int32(offset), np.int32(S),
+                req._rng_key, *self._adapter_args(req), **kw)
+        else:
+            self._state, tok, block = self._prefill_chunk(
+                self.params, self._state, ids_c, np.int32(req.slot),
+                np.int32(offset), np.int32(S), req._rng_key,
+                *self._adapter_args(req))
         tok.block_until_ready()  # honest chunk timing, paced dispatch
         dt_ms = (time.monotonic() - t0) * 1e3
         backlog = sum(1 for r in self._prefilling
@@ -1141,15 +1945,33 @@ class ServingEngine:
         self._stats.record_prefill_chunk(dt_ms, backlog=backlog)
         if (self._prefix_cache is not None and req._chunk_keys is not None
                 and offset == i * C and offset + C <= S):
-            if self._exec is not None:
-                # Host-portable blocks: a device_get'd chunk block restores
-                # into ANY slice's shardings via restore_prefix's
-                # in_shardings, so a fleet-shared PrefixCache serves
-                # cross-slice hits (the failover resume path).
-                block = jax.device_get(block)
-            self._prefix_cache.put(
-                req._chunk_keys[i], block,
-                nbytes=sum(l.nbytes for l in jax.tree.leaves(block)))
+            if self._alias_cache:
+                # The cache entry is the chunk's PAGE IDS, not a KV copy:
+                # a future hit aliases these very pages into another
+                # slot's table. The cache takes its own reference on each
+                # page (returned on eviction via the hook); a rejected or
+                # duplicate put hands the references straight back.
+                p0 = offset // self._page
+                Cp = C // self._page
+                pids = tuple(int(x)
+                             for x in self._table[req.slot, p0:p0 + Cp])
+                for pid in pids:
+                    self._pool.incref(pid)
+                if not self._prefix_cache.put(req._chunk_keys[i], pids,
+                                              nbytes=Cp * self._page_bytes):
+                    for pid in pids:
+                        self._pool.decref(pid)
+            else:
+                if self._exec is not None:
+                    # Host-portable blocks: a device_get'd chunk block
+                    # restores into ANY slice's shardings via
+                    # restore_prefix's in_shardings, so a fleet-shared
+                    # PrefixCache serves cross-slice hits (the failover
+                    # resume path).
+                    block = jax.device_get(block)
+                self._prefix_cache.put(
+                    req._chunk_keys[i], block,
+                    nbytes=sum(l.nbytes for l in jax.tree.leaves(block)))
             self._stats.record_prefix_cache_size(self._prefix_cache.nbytes,
                                                  len(self._prefix_cache))
         req._next_chunk = i + 1
@@ -1158,13 +1980,19 @@ class ServingEngine:
 
     def _finish_prefill(self, req: Request, token: int):
         """Prompt fully in KV: the request starts decoding. TTFT is stamped
-        here because the final prefill call emits token #1."""
+        here because the final prefill call emits token #1 — but only on
+        the FIRST completion: a preemption-resumed request already has
+        tokens and an admit record, and must not be billed twice."""
         req.status = RequestStatus.RUNNING
         now = time.monotonic()
-        req.first_token_at = now
-        self._stats.record_admit(
-            queue_wait_ms=(req.admitted_at - req.submitted_at) * 1e3,
-            ttft_ms=(now - req.submitted_at) * 1e3)
+        if req.first_token_at is None:
+            req.first_token_at = now
+            self._stats.record_admit(
+                queue_wait_ms=(req.admitted_at - req.submitted_at) * 1e3,
+                ttft_ms=(now - req.submitted_at) * 1e3)
+        # Host mirror of the device write position: after this commit,
+        # pos = serve length + 0 more; each committed token adds one.
+        req._pos_base = req._serve_ids.shape[1] - len(req.tokens) - 1
         if self._commit_token(req, token):
             if (len(req.tokens) >= req.max_new_tokens
                     or (not req.ignore_eos and self.eos_token_id is not None
@@ -1175,18 +2003,34 @@ class ServingEngine:
         """One ``decode_step_all_slots`` execution + host commit/retire.
         ``running`` is the (slot, request) list in RUNNING — PREFILLING
         slots ride along in the vmapped forward (fixed shape) but are
-        masked out of every state advance and commit no tokens."""
+        masked out of every state advance and commit no tokens. Paged
+        engines first guarantee every running slot's write position has a
+        page (allocating — and preempting on exhaustion — at this tick
+        boundary), then pass the page table as traced data."""
+        if self._paged:
+            for slot, req in running:
+                if req.status is not RequestStatus.RUNNING:
+                    continue  # preempted by an earlier slot's allocation
+                if not self._ensure_pages(req,
+                                          req._pos_base + len(req.tokens)):
+                    raise RuntimeError(
+                        "page pool exhausted at a tick with no preemptable "
+                        "stream — the submit page bound should make this "
+                        "impossible")
+            running = [(s, r) for s, r in running
+                       if r.status is RequestStatus.RUNNING]
+            if not running:
+                return
         mask = np.zeros((self.max_slots,), bool)
         for slot, _ in running:
             mask[slot] = True
         t0 = time.monotonic()
-        if self._adapters is None:
-            self._state, toks, dones = self._decode(
-                self.params, self._state, jnp.asarray(mask))
-        else:
-            self._state, toks, dones = self._decode(
-                self.params, self._state, jnp.asarray(mask),
-                self._adapters.stacks)
+        args = [self.params, self._state, jnp.asarray(mask)]
+        if self._paged:
+            args.append(self._table.copy())
+        if self._adapters is not None:
+            args.append(self._adapters.stacks)
+        self._state, toks, dones = self._decode(*args)
         toks = np.asarray(toks)     # sync point: the tick's device work
         dones = np.asarray(dones)
         dt = time.monotonic() - t0
@@ -1198,9 +2042,80 @@ class ServingEngine:
             if (len(req.tokens) >= req.max_new_tokens
                     or (not req.ignore_eos and bool(dones[slot]))):
                 self._retire(req, RequestStatus.COMPLETED)
+            elif self._page_window is not None:
+                self._free_window_pages(req)
         self._stats.record_tick(active_slots=len(running),
                                 committed_tokens=committed,
                                 max_slots=self.max_slots, seconds=dt)
+        if self._paged:
+            self._stats.record_pages(self._pool.free_pages,
+                                     self._pool.used_pages,
+                                     self._pool.num_pages)
+
+    def _tick_spec(self, running):
+        """One speculative tick: up to ``spec_tokens + 1`` tokens per slot
+        from a single draft-scan + verify executable. Page coverage is
+        guaranteed only up to the furthest position a slot can COMMIT this
+        tick (``pos + min(K+1, remaining) - 1``) — overshoot writes route
+        to scratch inside the program. The host commits the emitted chain
+        exactly like ``n`` dense ticks would: stop at ``max_new_tokens``
+        or at the first eos (later emissions are all eos, discarded with
+        the slot)."""
+        K = self._spec_k
+        for slot, req in running:
+            if req.status is not RequestStatus.RUNNING:
+                continue
+            rem = req.max_new_tokens - len(req.tokens)
+            cover = (req._pos_base + len(req.tokens)
+                     + min(K + 1, max(rem, 1)) - 1)
+            if not self._ensure_pages(req, cover):
+                raise RuntimeError(
+                    "page pool exhausted at a speculative tick with no "
+                    "preemptable stream — the submit page bound should "
+                    "make this impossible")
+        running = [(s, r) for s, r in running
+                   if r.status is RequestStatus.RUNNING]
+        if not running:
+            return
+        mask = np.zeros((self.max_slots,), bool)
+        remaining = np.ones((self.max_slots,), np.int32)
+        for slot, req in running:
+            mask[slot] = True
+            remaining[slot] = max(req.max_new_tokens - len(req.tokens), 1)
+        t0 = time.monotonic()
+        self._state, emit, ns = self._spec(
+            self.params, self._draft_params, self._state, jnp.asarray(mask),
+            self._table.copy(), remaining)
+        emit = np.asarray(emit)
+        ns = np.asarray(ns)
+        dt = time.monotonic() - t0
+        committed = accepted = 0
+        for slot, req in running:
+            n = int(ns[slot])
+            accepted += n - 1
+            retired = False
+            for j in range(n):
+                token = int(emit[slot, j])
+                if not self._commit_token(req, token):
+                    retired = True
+                    break
+                committed += 1
+                if (len(req.tokens) >= req.max_new_tokens
+                        or (not req.ignore_eos
+                            and self.eos_token_id is not None
+                            and token == self.eos_token_id)):
+                    self._retire(req, RequestStatus.COMPLETED)
+                    retired = True
+                    break
+            if not retired and self._page_window is not None:
+                self._free_window_pages(req)
+        self._stats.record_spec(proposed=K * len(running), accepted=accepted)
+        self._stats.record_tick(active_slots=len(running),
+                                committed_tokens=committed,
+                                max_slots=self.max_slots, seconds=dt)
+        self._stats.record_pages(self._pool.free_pages,
+                                 self._pool.used_pages,
+                                 self._pool.num_pages)
 
     def _commit_token(self, req: Request, token: int) -> bool:
         """Append + stream one token. A raising ``on_token`` callback fails
@@ -1218,6 +2133,8 @@ class ServingEngine:
     def _retire(self, req: Request, status: RequestStatus,
                 error: Optional[BaseException] = None):
         if req.slot is not None:
+            if self._paged:
+                self._release_slot_pages(req.slot)
             self._slots.release(req.slot)
         if req._adapter_pinned:
             req._adapter_pinned = False

@@ -210,16 +210,23 @@ class SliceExec:
 
     def state_shardings(self, state, template_leaves, length_axes):
         """Shardings pytree matching the engine state dict exactly: the
-        ``cache`` subtree per-leaf heads-sharded, every other row
-        (pos/tok/rng/done/adapter_idx — the membership-as-data arrays)
-        replicated so host writes and mask flips stay collective-free."""
+        KV subtree (dense ``cache`` or paged ``pool``) per-leaf
+        heads-sharded, every other row (pos/tok/rng/done/adapter_idx — the
+        membership-as-data arrays) replicated so host writes and mask
+        flips stay collective-free. The paged pool reuses the slot-axis
+        path unchanged: a pool leaf is ``[num_pages+1, P, heads, hd]``
+        where a slot cache leaf is ``[max_slots, L, heads, hd]`` — the
+        leading axis is just pages instead of slots (replicated either
+        way; pages are data-parallel rows), and the heads axis sits at the
+        same template-relative offset."""
         import jax
 
-        cache_sh = jax.tree.unflatten(
-            jax.tree.structure(state["cache"]),
+        kv_key = "pool" if "pool" in state else "cache"
+        kv_sh = jax.tree.unflatten(
+            jax.tree.structure(state[kv_key]),
             self.cache_leaf_shardings(template_leaves, length_axes,
                                       with_slot_axis=True))
-        return {key: (cache_sh if key == "cache" else self.replicated)
+        return {key: (kv_sh if key == kv_key else self.replicated)
                 for key in state}
 
     def block_shardings(self, cache_structure, template_leaves, length_axes):

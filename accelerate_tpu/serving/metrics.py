@@ -69,9 +69,19 @@ class ServingStats:
             self._prefill_backlog_max = 0
             self._prefix_lookup_chunks = 0
             self._prefix_hit_chunks = 0
+            self._prefix_alias_chunks = 0
             self._prefix_restored_bytes = 0
             self._prefix_cache_bytes = 0
             self._prefix_cache_entries = 0
+            # Paged KV pool (gauges sampled each tick + preemption count).
+            self._pages_free = 0
+            self._pages_used = 0
+            self._pages_total = 0
+            self._preemptions = 0
+            # Speculative decoding: draft proposals vs target acceptances.
+            self._spec_ticks = 0
+            self._spec_proposed = 0
+            self._spec_accepted = 0
             # Per-adapter (multi-tenant LoRA) counters:
             # name -> {requests, tokens, hits, misses, loads, evictions}.
             self._adapter: dict = {}
@@ -123,14 +133,42 @@ class ServingStats:
             self._prefill_backlog_max = max(self._prefill_backlog_max,
                                             int(backlog))
 
-    def record_prefix(self, looked_up: int, hit: int, bytes_restored: int):
+    def record_prefix(self, looked_up: int, hit: int, bytes_restored: int,
+                      aliased: int = 0):
         """One admission's prefix-cache lookup: ``looked_up`` restorable
         chunks were probed, the first ``hit`` of them were restored by
-        ``restore_prefix`` instead of recomputed."""
+        ``restore_prefix`` instead of recomputed. On the paged engine,
+        ``aliased`` of those hits were satisfied by page-table aliasing
+        (a host page-id write, zero device copies)."""
         with self._lock:
             self._prefix_lookup_chunks += int(looked_up)
             self._prefix_hit_chunks += int(hit)
+            self._prefix_alias_chunks += int(aliased)
             self._prefix_restored_bytes += int(bytes_restored)
+
+    def record_pages(self, free: int, used: int, total: int):
+        """Gauge: paged-KV pool occupancy after a tick (page counts)."""
+        with self._lock:
+            self._pages_free = int(free)
+            self._pages_used = int(used)
+            self._pages_total = int(total)
+
+    def record_preemption(self):
+        """A running request was evicted at a chunk/tick boundary because
+        the page pool was exhausted; it re-queues and resumes token-exact
+        as a longer prompt."""
+        with self._lock:
+            self._preemptions += 1
+
+    def record_spec(self, proposed: int, accepted: int):
+        """One speculative tick: the draft proposed ``proposed`` tokens
+        across active slots, the target verify accepted ``accepted``
+        (committed tokens beyond the one-per-tick baseline count here too:
+        accepted / ticks is tokens-per-tick, the headline spec metric)."""
+        with self._lock:
+            self._spec_ticks += 1
+            self._spec_proposed += int(proposed)
+            self._spec_accepted += int(accepted)
 
     def record_prefix_cache_size(self, nbytes: int, entries: int):
         """Gauge: the prefix cache's current footprint after an insert or
@@ -213,9 +251,13 @@ class ServingStats:
                       "_tick_s_sum", "_active_slot_sum", "_slot_capacity_sum",
                       "_decode_tokens", "_prefill_tokens", "_prefill_chunks",
                       "_prefill_ms_sum", "_prefix_lookup_chunks",
-                      "_prefix_hit_chunks", "_prefix_restored_bytes",
+                      "_prefix_hit_chunks", "_prefix_alias_chunks",
+                      "_prefix_restored_bytes",
                       "_queue_depth_last", "_prefill_backlog_last",
-                      "_prefix_cache_bytes", "_prefix_cache_entries"):
+                      "_prefix_cache_bytes", "_prefix_cache_entries",
+                      "_pages_free", "_pages_used", "_pages_total",
+                      "_preemptions", "_spec_ticks", "_spec_proposed",
+                      "_spec_accepted"):
                 setattr(self, k, getattr(self, k) + o[k])
             for k in ("_queue_wait_ms_max", "_ttft_ms_max",
                       "_prefill_backlog_max"):
@@ -280,9 +322,29 @@ class ServingStats:
                     self._prefix_hit_chunks / self._prefix_lookup_chunks, 4)
                     if self._prefix_lookup_chunks else 0.0,
                 "prefix_cache_hit_chunks": self._prefix_hit_chunks,
+                "prefix_alias_chunks": self._prefix_alias_chunks,
                 "prefix_cache_restored_bytes": self._prefix_restored_bytes,
                 "prefix_cache_bytes": self._prefix_cache_bytes,
                 "prefix_cache_entries": self._prefix_cache_entries,
+                # Paged-KV pool pressure (all zero on a dense engine).
+                "pages_total": self._pages_total,
+                "pages_free": self._pages_free,
+                "pages_used": self._pages_used,
+                "page_utilization": round(
+                    self._pages_used / self._pages_total, 4)
+                    if self._pages_total else 0.0,
+                "preemptions": self._preemptions,
+                # Speculative decoding (all zero on a non-spec engine).
+                "spec_ticks": self._spec_ticks,
+                "spec_proposed_tokens": self._spec_proposed,
+                "spec_accepted_tokens": self._spec_accepted,
+                "spec_accept_rate": round(
+                    self._spec_accepted / self._spec_proposed, 4)
+                    if self._spec_proposed else 0.0,
+                "spec_tokens_per_tick": round(
+                    (self._spec_accepted + self._spec_ticks)
+                    / self._spec_ticks, 4)
+                    if self._spec_ticks else 0.0,
             }
             # Multi-tenant LoRA: flat aggregates plus per-name counters
             # ("adapter/<name>/<counter>" — slash-pathed like tracker keys;

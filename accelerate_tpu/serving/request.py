@@ -105,6 +105,23 @@ class Request:
         self._adapter_row = 0
         self._adapter_pinned = False
 
+        # Paged-engine bookkeeping (engine thread only). ``_serve_ids`` is
+        # the token sequence admission actually prefills — the prompt, or
+        # prompt + tokens-emitted-so-far after a pool-exhaustion
+        # preemption (the same resume-as-longer-prompt trick the router's
+        # failover uses: for greedy decoding the resumed prefill's
+        # first-token pick IS the interrupted decode step, bit-exact).
+        self._serve_ids = None
+        self._preempted = 0  # times evicted by pool exhaustion
+        # Host mirror of the device write position: after prefill the
+        # engine sets this so that ``_pos_base + len(tokens)`` is always
+        # the slot's next KV write position (page-coverage checks).
+        self._pos_base = 0
+        # Lowest table index that may still be live: sliding-window page
+        # freeing advances it so re-coverage never re-allocates pages the
+        # window already retired (reset to 0 on every (re)admission).
+        self._page_floor = 0
+
     # -- caller API -----------------------------------------------------
     def cancel(self):
         """Request cancellation: a queued request is dropped before it ever

@@ -566,6 +566,12 @@ class ReplicaSet:
                 "fleet_free_slots": sum(
                     r.engine.free_slots for r in self._replicas
                     if r.state is ReplicaState.HEALTHY and r.engine.healthy),
+                # Paged-KV headroom across the healthy fleet (0 when every
+                # replica is dense). Page pressure already steers routing
+                # through ``engine.load``; this is the operator's view.
+                "fleet_free_pages": sum(
+                    r.engine.free_pages for r in self._replicas
+                    if r.state is ReplicaState.HEALTHY and r.engine.healthy),
             })
         return out
 

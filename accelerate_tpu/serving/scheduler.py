@@ -18,6 +18,12 @@ Three small host-side structures, deliberately independent of jax:
   here (device-array pytrees the engine's ``restore_prefix`` program
   copies back into a slot); the caller supplies each entry's byte size so
   this module stays jax-free.
+* :class:`PagePool` — a free-list + refcount table over the paged
+  engine's global KV page pool. Pages are plain integers indexing the
+  device-side page arrays; refcounts exist because prefix-cache aliasing
+  lets one physical page appear in several slots' page tables (and in
+  the cache itself) at once. Engine-thread only, like
+  :class:`SlotScheduler`.
 """
 
 from __future__ import annotations
@@ -92,6 +98,20 @@ class AdmissionQueue:
             raise QueueFull(
                 f"admission queue full ({self.max_queued} requests queued); "
                 "retry later or submit with block=True")
+
+    def putleft(self, request: Request):
+        """Requeue at the FRONT, bypassing the bound — the paged engine's
+        preemption path: a request evicted from its slot on pool
+        exhaustion goes back ahead of everything younger (it was admitted
+        first; FCFS order is preserved, not reset), and it must never
+        bounce off a momentarily-full queue it already passed through."""
+        with self._lock:
+            if self._closed:
+                raise QueueClosed(
+                    "serving engine stopped; the admission queue is "
+                    "closed and will never drain")
+            self._items.appendleft(request)
+            self._not_empty.notify()
 
     def get(self, timeout: Optional[float] = None) -> Optional[Request]:
         """Pop the oldest request, or None after ``timeout`` (engine poll).
@@ -176,6 +196,69 @@ class SlotScheduler:
         return sorted(self._occupant.items())
 
 
+class PagePool:
+    """Free-list + refcounts over the paged engine's fixed-size KV pages.
+
+    Page ids are ``1..num_pages``; page ``0`` is the engine's reserved
+    scratch page (never allocated — the compiled programs route writes of
+    released or not-yet-allocated slots there, so it holds garbage by
+    design and is excluded from accounting here). A page's refcount is
+    the number of owners keeping it alive: each slot whose page table
+    holds it counts one, and a prefix-cache alias entry counts one more —
+    the page returns to the free list only when the LAST owner drops it.
+    Engine-thread only (no lock), like :class:`SlotScheduler`.
+    """
+
+    def __init__(self, num_pages: int):
+        if num_pages < 1:
+            raise ValueError(f"num_pages must be >= 1 (got {num_pages})")
+        self.num_pages = int(num_pages)
+        self._free: collections.deque[int] = collections.deque(
+            range(1, self.num_pages + 1))
+        self._ref = [0] * (self.num_pages + 1)
+        self.allocations = 0
+        self.preemptions = 0  # billed by the engine when exhaustion preempts
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_pages(self) -> int:
+        return self.num_pages - len(self._free)
+
+    def alloc(self) -> Optional[int]:
+        """Pop one free page (refcount 1), or None when the pool is
+        exhausted — the engine then reclaims alias-held pages or preempts
+        a slot; allocation itself never blocks or raises."""
+        if not self._free:
+            return None
+        page = self._free.popleft()
+        self._ref[page] = 1
+        self.allocations += 1
+        return page
+
+    def incref(self, page: int):
+        """One more owner for an allocated page (prefix aliasing: a cache
+        entry, or a second slot's table row, now also points at it)."""
+        if page <= 0 or self._ref[page] <= 0:
+            raise ValueError(f"incref of unallocated page {page}")
+        self._ref[page] += 1
+
+    def decref(self, page: int) -> bool:
+        """Drop one owner; returns True when this freed the page."""
+        if page <= 0 or self._ref[page] <= 0:
+            raise ValueError(f"decref of unallocated page {page}")
+        self._ref[page] -= 1
+        if self._ref[page] == 0:
+            self._free.append(page)
+            return True
+        return False
+
+    def refcount(self, page: int) -> int:
+        return self._ref[page]
+
+
 class PrefixCache:
     """Byte-bounded LRU of chunk-aligned prefix KV blocks.
 
@@ -197,7 +280,7 @@ class PrefixCache:
     inserted.
     """
 
-    def __init__(self, capacity_bytes: int):
+    def __init__(self, capacity_bytes: int, on_evict=None):
         if capacity_bytes < 1:
             raise ValueError(
                 f"capacity_bytes must be >= 1 (got {capacity_bytes}); "
@@ -211,6 +294,11 @@ class PrefixCache:
         self.insertions = 0
         self.evictions = 0
         self.oversize_rejects = 0
+        #: ``on_evict(key, block)`` fires (lock held) whenever an entry
+        #: leaves the cache — eviction, reclaim, or clear. The paged engine
+        #: uses it to drop the PagePool refs its alias entries hold; the
+        #: default copy-block cache needs no hook.
+        self._on_evict = on_evict
 
     def __len__(self) -> int:
         return len(self._entries)
@@ -233,32 +321,60 @@ class PrefixCache:
                 out.append(entry[0])
         return out
 
-    def put(self, key, block, nbytes: int):
+    def put(self, key, block, nbytes: int) -> bool:
         """Insert one chunk's block (touch if already present), then evict
         least-recently-used entries until within capacity. A block larger
         than the whole capacity is rejected outright — admitting it would
         evict EVERY resident entry and still not fit, so the cache keeps
-        what it has and counts the reject instead."""
+        what it has and counts the reject instead. Returns True only when
+        the block was actually inserted (the paged engine pins page refs
+        per INSERTED entry, so touch/reject must be distinguishable)."""
         with self._lock:
             if key in self._entries:
                 self._entries.move_to_end(key)
-                return
+                return False
             nbytes = int(nbytes)
             if nbytes > self.capacity_bytes:
                 self.oversize_rejects += 1
-                return
+                return False
             self._entries[key] = (block, nbytes)
             self._bytes += nbytes
             self.insertions += 1
             while self._bytes > self.capacity_bytes:
-                _, (_, nb) = self._entries.popitem(last=False)
-                self._bytes -= nb
-                self.evictions += 1
+                self._pop_lru_locked()
+            return True
+
+    def _pop_lru_locked(self):
+        key, (block, nb) = self._entries.popitem(last=False)
+        self._bytes -= nb
+        self.evictions += 1
+        if self._on_evict is not None:
+            self._on_evict(key, block)
+
+    def evict_lru(self) -> bool:
+        """Force out the least-recently-used entry (False when empty) —
+        the paged engine's reclaim path: alias-held pages are freed
+        cache-entry by cache-entry until an allocation succeeds, BEFORE
+        any running request gets preempted."""
+        with self._lock:
+            if not self._entries:
+                return False
+            self._pop_lru_locked()
+            return True
+
+    def entries(self) -> list:
+        """(key, block) snapshot in LRU order (reclaimability accounting:
+        the paged engine counts pages whose only owner is the cache)."""
+        with self._lock:
+            return [(k, b) for k, (b, _) in self._entries.items()]
 
     def clear(self):
         """Drop every entry (engine warmup runs dummy prompts through the
         normal path; their blocks must not linger as phantom prefixes)."""
         with self._lock:
+            if self._on_evict is not None:
+                for key, (block, _) in self._entries.items():
+                    self._on_evict(key, block)
             self._entries.clear()
             self._bytes = 0
             self.insertions = 0

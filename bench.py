@@ -972,15 +972,146 @@ def serving_tp_bench(n_requests: int = 3, prompt_len: int = 6,
     }
 
 
+def paged_capacity_bench(dense_slots: int = 2, max_len: int = 64,
+                         page_size: int = 8, prompt_len: int = 4,
+                         new_tokens: int = 12, step_ms: float = 2.0) -> dict:
+    """Slots-at-equal-KV-HBM A/B — the paged tentpole's capacity claim.
+
+    The dense engine reserves ``max_len`` tokens of KV per slot, so
+    ``dense_slots`` slots cost ``dense_slots * max_len`` tokens of HBM and
+    cap concurrency at ``dense_slots`` no matter how short the traffic is.
+    The paged engine gets a pool of the SAME total tokens
+    (``dense_slots * max_len / page_size`` pages) and as many slots as
+    that pool can cover at the benchmark's actual sequence length
+    (``prompt + new`` tokens = a couple of pages). Both engines then serve
+    one burst of that many requests on the same deterministic-sleep model;
+    ``peak_concurrency`` is the maximum number of overlapping
+    admitted->finished intervals — what each layout actually sustained.
+    Greedy tokens must be identical (paging is a memory layout, not a
+    semantic change) and the paged run must not preempt (the pool really
+    fits the advertised concurrency)."""
+    import jax
+    import numpy as np
+
+    from accelerate_tpu.models.llama import LlamaConfig
+    from accelerate_tpu.serving import ServingEngine
+
+    pool_pages = dense_slots * max_len // page_size
+    pages_per_req = -(-(prompt_len + new_tokens) // page_size)
+    paged_slots = pool_pages // pages_per_req
+
+    model = _sleepy_llama_cls(step_ms)(LlamaConfig.tiny())
+    params = model.init_params(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    prompts = rng.integers(1, 200,
+                           size=(paged_slots, prompt_len)).astype(np.int32)
+
+    def serve(**kw):
+        engine = ServingEngine(model, params, max_len=max_len,
+                               prefill_chunk=page_size, eos_token_id=None,
+                               **kw)
+        try:
+            kv_bytes = engine.kv_cache_per_chip_bytes()
+            reqs = [engine.submit(prompts[i:i + 1], max_new_tokens=new_tokens,
+                                  ignore_eos=True, block=True)
+                    for i in range(paged_slots)]
+            toks = [np.asarray(r.result(timeout=300)) for r in reqs]
+            # Peak concurrency = max overlap of slot-residency intervals.
+            events = sorted([(r.admitted_at, 1) for r in reqs]
+                            + [(r.finished_at, -1) for r in reqs])
+            peak = cur = 0
+            for _, d in events:
+                cur += d
+                peak = max(peak, cur)
+            stats = engine.serving_metrics()
+        finally:
+            engine.shutdown()
+        return toks, peak, kv_bytes, stats
+
+    d_toks, d_peak, d_kv, _ = serve(max_slots=dense_slots, paged=False)
+    p_toks, p_peak, p_kv, p_stats = serve(max_slots=paged_slots,
+                                          max_pages=pool_pages)
+    tokens_equal = all(np.array_equal(a, b) for a, b in zip(d_toks, p_toks))
+    return {
+        "dense_slots": dense_slots,
+        "paged_slots": paged_slots,
+        "max_len": max_len,
+        "page_size": page_size,
+        "pool_pages": pool_pages,
+        "request_tokens": prompt_len + new_tokens,
+        "kv_bytes": {"dense": d_kv, "paged": p_kv},
+        "peak_concurrency": {"dense": d_peak, "paged": p_peak},
+        "slots_ratio": round(p_peak / max(d_peak, 1), 3),
+        "tokens_equal": bool(tokens_equal),
+        "preemptions": p_stats["preemptions"],
+        "page_utilization": p_stats["page_utilization"],
+    }
+
+
+def speculative_bench(prompt_len: int = 5, new_tokens: int = 24,
+                      spec_tokens: int = 4, n_requests: int = 3) -> dict:
+    """Speculative-decoding A/B on the deterministic draft (the draft IS
+    the target model, so every divergence is bf16 near-tie noise, not
+    draft quality): the same greedy requests through a plain paged engine
+    and a speculative one. The payload is ``accepted_tokens_per_step``
+    (committed tokens per verify tick — 1.0 means speculation never
+    helps) and the tick count each engine needed for identical output;
+    wall-clock is not reported (on CPU the K-step draft scan costs more
+    host time than it saves — the win is device steps, which is what
+    ticks count)."""
+    import jax
+    import numpy as np
+
+    from accelerate_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from accelerate_tpu.serving import ServingEngine
+
+    model = LlamaForCausalLM(LlamaConfig.tiny())
+    params = model.init_params(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(1)
+    prompts = rng.integers(1, 200,
+                           size=(n_requests, prompt_len)).astype(np.int32)
+
+    def serve(**kw):
+        engine = ServingEngine(model, params, max_slots=2, max_len=64,
+                               prefill_chunk=8, eos_token_id=None, **kw)
+        try:
+            toks = [np.asarray(
+                engine.submit(prompts[i:i + 1], max_new_tokens=new_tokens,
+                              ignore_eos=True, block=True).result(timeout=300))
+                for i in range(n_requests)]
+            stats = engine.serving_metrics()
+        finally:
+            engine.shutdown()
+        return toks, stats
+
+    b_toks, b_stats = serve()
+    s_toks, s_stats = serve(draft_model=model, draft_params=params,
+                            spec_tokens=spec_tokens)
+    tokens_equal = all(np.array_equal(a, b) for a, b in zip(b_toks, s_toks))
+    return {
+        "spec_tokens": spec_tokens,
+        "n_requests": n_requests,
+        "new_tokens": new_tokens,
+        "tokens_equal": bool(tokens_equal),
+        "ticks": {"baseline": b_stats["decode_ticks"],
+                  "speculative": s_stats["decode_ticks"]},
+        "tick_ratio": round(b_stats["decode_ticks"]
+                            / max(s_stats["decode_ticks"], 1), 3),
+        "accepted_tokens_per_step": s_stats["spec_tokens_per_tick"],
+        "accept_rate": s_stats["spec_accept_rate"],
+    }
+
+
 def serving_extra(on_tpu: bool) -> dict:
     """The ``extra.serving`` payload: on CPU the offered-load sweep, the
     continuous-vs-static staggered-arrival comparison, the
     chunked-prefill pair — admission-interference A/B plus the
-    prefix-cache hit check — and the gateway pair — HTTP-overhead-vs-
-    direct-submit plus the replica-kill failover drill (cheap, tiny
-    model); on TPU skipped — serving the tier-1 model is its own
-    benchmark, not a rider on the training run (no extra compiles over
-    the tunnel)."""
+    prefix-cache hit check — the gateway pair — HTTP-overhead-vs-
+    direct-submit plus the replica-kill failover drill — and the paged
+    pair — slots-at-equal-HBM capacity A/B plus the speculative-decoding
+    accepted-tokens/step A/B (cheap, tiny model); on TPU skipped —
+    serving the tier-1 model is its own benchmark, not a rider on the
+    training run (no extra compiles over the tunnel)."""
     if on_tpu:
         return {}
     return {
@@ -995,6 +1126,8 @@ def serving_extra(on_tpu: bool) -> dict:
             "failover": replica_failover_bench(),
         },
         "tp": serving_tp_bench(),
+        "paged": paged_capacity_bench(),
+        "speculative": speculative_bench(),
     }
 
 

@@ -463,7 +463,10 @@ class TestZeroRecompileAdapters:
             f"XLA recompiled after warmup: {compiles} — adapter membership "
             "must be data (bank rows), never program shapes")
         assert eng._prefill_chunk._cache_size() == 1
-        assert eng._restore_prefix._cache_size() == 1
+        # Paged + private alias cache restores by host page-table writes —
+        # no compiled restore program exists to pin.
+        if eng._restore_prefix is not None:
+            assert eng._restore_prefix._cache_size() == 1
         assert eng._decode._cache_size() == 1
         assert counters["evictions"] >= 1  # the churn actually happened
 

@@ -208,6 +208,28 @@ class TestCLISubprocess:
         assert "0.0% of weights sharded" in out.stdout
         assert "REPLICATED" in out.stdout
 
+    def test_estimate_memory_page_sizing(self):
+        out = _run_cli("estimate-memory", "llama-tiny", "--dtypes", "bfloat16",
+                       "--page-size", "16", "--max-pages", "256",
+                       "--seq-lens", "32", "128")
+        assert out.returncode == 0, out.stderr
+        assert "Paged KV pool (page_size=16" in out.stdout
+        # tiny llama is 256 B/token (see test_estimate_memory_tp), so a
+        # 16-token page is 4 KiB and 256 pages are 1 MiB.
+        assert "bytes per page  : 4.00 KiB" in out.stdout
+        assert "pool (256 pages): 1.00 MiB" in out.stdout
+        # 32 tokens need ceil(32/16) = 2 pages; the pool fits 128 such.
+        assert "2 pages" in out.stdout
+        assert "32tok x 128" in out.stdout
+
+    def test_estimate_memory_page_sizing_tp(self):
+        out = _run_cli("estimate-memory", "llama-tiny", "--dtypes", "bfloat16",
+                       "--page-size", "16", "--tp", "2")
+        assert out.returncode == 0, out.stderr
+        # Pool pages shard on kv-heads exactly like the dense cache:
+        # half the page bytes land on each of the two chips.
+        assert "(2.00 KiB/chip at tp=2)" in out.stdout
+
     def test_estimate_memory_unknown_model(self):
         out = _run_cli("estimate-memory", "not-a-model")
         assert out.returncode == 2
@@ -336,7 +358,8 @@ class TestCLISubprocess:
     def test_serve_help(self):
         out = _run_cli("serve", "--help")
         assert out.returncode == 0, out.stderr
-        for flag in ["--model", "--replicas", "--port", "--max-slots", "--tp"]:
+        for flag in ["--model", "--replicas", "--port", "--max-slots", "--tp",
+                     "--page-size", "--max-pages", "--no-paged"]:
             assert flag in out.stdout
 
     @pytest.mark.slow

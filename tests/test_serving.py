@@ -391,7 +391,12 @@ class TestZeroRecompileChunked:
             f"XLA recompiled after warmup: {compiles} — chunked prefill must "
             "serve every prompt length with the one fixed-shape executable")
         assert eng._prefill_chunk._cache_size() == 1
-        assert eng._restore_prefix._cache_size() == 1
+        # The paged engine's private prefix cache restores by page-table
+        # aliasing on the host — it compiles NO restore program (steady
+        # state is two warm executables). The dense engine (and a paged
+        # engine sharing an external cache) still pins the third.
+        if eng._restore_prefix is not None:
+            assert eng._restore_prefix._cache_size() == 1
         assert eng._decode._cache_size() == 1
 
 

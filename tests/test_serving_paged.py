@@ -1,0 +1,465 @@
+"""Paged KV-cache memory manager + speculative decoding (serving engine).
+
+The acceptance-critical properties pinned here:
+
+* PAGED == DENSE — the paged engine changes WHERE KV rows live (a global
+  page pool indexed through a per-slot page table), never what is read
+  or written: every cell of the greedy/sampled/eos/adapter/failover
+  matrix must be token-identical to the dense engine and to offline
+  ``generation.generate``.
+* ZERO RECOMPILES — page allocation, frees, preemption and prefix
+  aliasing are HOST work (the table is traced integer data), so a
+  warmed paged engine serves a staggered prompt-length mix with the
+  compile listener silent and exactly TWO warm executables (chunk +
+  decode; its private alias cache restores by page-table writes and
+  compiles NO restore program).  A speculative engine adds exactly one
+  more (`_spec`) and stays silent too.
+* POOL EXHAUSTION — when live streams outgrow the pool, the newest
+  victim is preempted back to the queue and later resumes FROM SCRATCH
+  as a longer prompt; its final stream is still bit-identical.
+* ALIAS PREFIX CACHE — a repeat prompt admits by bumping page refcounts
+  (``prefix_alias_chunks``), never by copying KV.
+* SLIDING WINDOW — pages wholly behind the attention window are freed
+  mid-stream (page-lifetime policy), with no effect on the tokens.
+* VALIDATION — impossible requests and incoherent constructor combos
+  fail fast with actionable errors, not deadlocks or silent fallbacks.
+"""
+
+import os
+import sys
+import time
+
+import jax
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from accelerate_tpu import generation  # noqa: E402
+from accelerate_tpu.adapters import (  # noqa: E402
+    AdapterBank,
+    LoRAConfig,
+    init_lora_params,
+    merge_adapter,
+)
+from accelerate_tpu.adapters.lora import (  # noqa: E402
+    adapter_module_paths,
+    _get_path,
+)
+from accelerate_tpu.models.llama import LlamaConfig, LlamaForCausalLM  # noqa: E402
+from accelerate_tpu.serving import (  # noqa: E402
+    PrefixCache,
+    ReplicaSet,
+    RequestStatus,
+    ServingEngine,
+)
+
+EOS = 7
+
+PROMPTS = [
+    np.array([[3, 5, 7, 11, 2]], np.int32),
+    np.array([[1, 4, 9]], np.int32),
+    np.array([[8, 6, 4, 2, 10, 12, 14]], np.int32),
+    np.array([[42]], np.int32),
+]
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = LlamaConfig.tiny(use_flash_attention=False)
+    m = LlamaForCausalLM(cfg)
+    params = m.init_params(jax.random.PRNGKey(0), batch_size=2, seq_len=8)
+    return cfg, m, params
+
+
+def _offline(m, params, prompt, n, seed=None, eos=EOS, **kw):
+    """Offline reference; ``eos=None`` mirrors the engine's ignore_eos."""
+    rng = None if seed is None else jax.random.PRNGKey(seed)
+    out = generation.generate(m, params, prompt, max_new_tokens=n,
+                              eos_token_id=eos, rng=rng, **kw)
+    return np.asarray(out)[0, prompt.shape[1]:]
+
+
+def _assert_matches_offline(got, ref, n):
+    """Engine stops AT eos; offline keeps the shape and pads with eos."""
+    got = np.asarray(got)
+    assert np.array_equal(got, ref[: len(got)]), (got, ref)
+    if len(got) < n:
+        assert got[-1] == EOS and np.all(ref[len(got):] == EOS), (got, ref)
+
+
+def _nonzero_adapter(params, rank, seed):
+    ad = init_lora_params(jax.random.PRNGKey(seed), params,
+                          LoRAConfig(rank=rank))
+    for i, dotted in enumerate(adapter_module_paths(ad)):
+        mod = _get_path(ad, dotted)
+        k = jax.random.fold_in(jax.random.PRNGKey(seed + 997), i)
+        mod["b"] = 0.05 * jax.random.normal(k, mod["b"].shape, mod["b"].dtype)
+    return ad
+
+
+class TestPagedVsDenseExactness:
+    """Greedy and sampled streams from the paged engine must be
+    bit-identical to the dense (``paged=False``) engine and offline."""
+
+    N = 24
+
+    @pytest.fixture(scope="class")
+    def engines(self, tiny):
+        _, m, params = tiny
+        kw = dict(max_slots=3, max_len=64, eos_token_id=EOS,
+                  prefill_chunk=8, prefix_cache_mb=0.0)
+        engs = {"paged": ServingEngine(m, params, **kw),  # paged=None -> True
+                "dense": ServingEngine(m, params, paged=False, **kw)}
+        assert engs["paged"].paged and not engs["dense"].paged
+        yield engs
+        for e in engs.values():
+            if e.running:
+                e.shutdown(drain=False)
+
+    @pytest.mark.parametrize("seed", [None, 11])
+    def test_matrix_matches_dense_and_offline(self, tiny, engines, seed):
+        _, m, params = tiny
+        refs = [_offline(m, params, p, self.N, seed=seed) for p in PROMPTS]
+        outs = {}
+        for name, eng in engines.items():
+            reqs = []
+            for p in PROMPTS:  # staggered: joins exercise the page table
+                reqs.append(eng.submit(p, max_new_tokens=self.N, seed=seed))
+                time.sleep(0.01)
+            outs[name] = [np.asarray(r.result(timeout=120)) for r in reqs]
+        for got_p, got_d, ref in zip(outs["paged"], outs["dense"], refs):
+            assert np.array_equal(got_p, got_d), (got_p, got_d)
+            _assert_matches_offline(got_p, ref, self.N)
+
+    def test_eos_latch_paged(self, tiny, engines):
+        """A stream that hits EOS mid-flight stops exactly where offline
+        latches, with the request's pages released back to the pool."""
+        _, m, params = tiny
+        eng = engines["paged"]
+        free0 = eng.free_pages
+        prompt = np.array([[EOS, 3, EOS, 5]], np.int32)
+        r = eng.submit(prompt, max_new_tokens=self.N)
+        got = r.result(timeout=120)
+        _assert_matches_offline(got, _offline(m, params, prompt, self.N),
+                                self.N)
+        deadline = time.monotonic() + 10
+        while eng.free_pages < free0 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert eng.free_pages == free0, "retired request leaked pages"
+
+    def test_adapters_on_paged_engine(self, tiny):
+        """Multi-tenant LoRA over the paged pool: each stream matches
+        offline generate under its tenant's MERGED weights."""
+        _, m, params = tiny
+        ad = _nonzero_adapter(params, rank=4, seed=5)
+        bank = AdapterBank(params, config=LoRAConfig(rank=4), max_adapters=3)
+        bank.register("a", ad)
+        eng = ServingEngine(m, params, max_slots=2, max_len=64,
+                            eos_token_id=EOS, prefill_chunk=8, adapters=bank)
+        assert eng.paged
+        try:
+            n = 16
+            refs = {"a": merge_adapter(params, ad), None: params}
+            reqs = [(name, eng.submit(p, max_new_tokens=n, adapter=name))
+                    for name, p in zip(["a", None, "a"], PROMPTS)]
+            for (name, r), p in zip(reqs, PROMPTS):
+                _assert_matches_offline(r.result(timeout=120),
+                                        _offline(m, refs[name], p, n), n)
+        finally:
+            eng.shutdown(drain=False)
+
+    def test_failover_streams_stay_token_exact(self, tiny):
+        """Killing a replica mid-stream: survivors re-serve the moved
+        requests from scratch on their own page pools, bit-identically."""
+        _, m, params = tiny
+        import bench
+
+        sleepy = bench._sleepy_llama_cls(step_ms=15.0)(LlamaConfig.tiny(
+            use_flash_attention=False))
+        rs = ReplicaSet.from_factory(
+            lambda: ServingEngine(sleepy, params, max_slots=4, max_len=64,
+                                  eos_token_id=EOS, prefill_chunk=16), 2)
+        assert all(r.engine.paged for r in rs._replicas)
+        n = 24
+        refs = [_offline(sleepy, params, p, n) for p in PROMPTS]
+        try:
+            reqs = [rs.submit(p, max_new_tokens=n) for p in PROMPTS]
+            deadline = time.monotonic() + 60
+            while (min(len(r.tokens) for r in reqs) < 3
+                   and time.monotonic() < deadline):
+                time.sleep(0.005)
+            assert min(len(r.tokens) for r in reqs) >= 3, "streams stalled"
+            victim = reqs[0].replica_trail[0]
+            rs.kill_replica(victim)
+            for r in reqs:
+                assert r.wait(timeout=120)
+            for r, ref in zip(reqs, refs):
+                assert r.status is RequestStatus.COMPLETED
+                _assert_matches_offline(r.tokens, ref, n)
+            assert any(r.replica_trail[0] == victim for r in reqs)
+        finally:
+            rs.shutdown()
+
+
+class TestZeroRecompilePaged:
+    def test_paged_steady_state_is_two_executables(self, tiny):
+        """Admitting/retiring a staggered prompt-length mix — including a
+        repeat prompt restored by page-table ALIASING — must run only
+        the warm chunk + decode executables: page allocation is host
+        work, and the private paged prefix cache compiles no restore
+        program at all."""
+        _, m, params = tiny
+        eng = ServingEngine(m, params, max_slots=3, max_len=64,
+                            eos_token_id=EOS, prefill_chunk=8,
+                            prefix_cache_mb=4.0)
+        compiles = []
+
+        def listener(event, duration, **kw):
+            if "compile" in event or "trace" in event:
+                compiles.append(event)
+
+        rng = np.random.default_rng(9)
+        long = rng.integers(0, 256, size=(1, 33)).astype(np.int32)
+        jax.monitoring.register_event_duration_secs_listener(listener)
+        try:
+            reqs = []
+            # tail repeat of the multi-chunk prompt -> alias restore
+            for p in PROMPTS + [long, long]:
+                reqs.append(eng.submit(p, max_new_tokens=6, seed=3))
+                time.sleep(0.01)
+            for r in reqs:
+                r.result(timeout=120)
+        finally:
+            from jax._src import monitoring as _mon
+
+            _mon._unregister_event_duration_listener_by_callback(listener)
+            eng.shutdown(drain=False)
+        assert not compiles, (
+            f"XLA recompiled after warmup: {compiles} — paging must move "
+            "page-table CONTENTS, never program shapes")
+        assert eng._prefill_chunk._cache_size() == 1
+        assert eng._restore_prefix is None  # alias restores are host writes
+        assert eng._decode._cache_size() == 1
+        assert eng.stats.summary()["prefix_alias_chunks"] >= 1
+
+    def test_speculative_adds_exactly_one_executable(self, tiny):
+        _, m, params = tiny
+        eng = ServingEngine(m, params, max_slots=2, max_len=64,
+                            eos_token_id=EOS, prefill_chunk=8,
+                            prefix_cache_mb=0.0,
+                            draft_model=m, draft_params=params,
+                            spec_tokens=4)
+        compiles = []
+
+        def listener(event, duration, **kw):
+            if "compile" in event or "trace" in event:
+                compiles.append(event)
+
+        jax.monitoring.register_event_duration_secs_listener(listener)
+        try:
+            reqs = []
+            for p in PROMPTS:
+                reqs.append(eng.submit(p, max_new_tokens=8))
+                time.sleep(0.01)
+            for r in reqs:
+                r.result(timeout=120)
+        finally:
+            from jax._src import monitoring as _mon
+
+            _mon._unregister_event_duration_listener_by_callback(listener)
+            eng.shutdown(drain=False)
+        assert not compiles, (
+            f"XLA recompiled after warmup: {compiles} — draft length and "
+            "acceptance count are data, not shapes")
+        assert eng._prefill_chunk._cache_size() == 1
+        assert eng._spec._cache_size() == 1
+        # a spec engine never runs the plain decode tick — every decode
+        # goes through _spec, so _decode stays cold (<= 1 from warmup).
+        assert eng._decode._cache_size() <= 1
+
+
+class TestPoolExhaustionPreemption:
+    def test_preempted_stream_resumes_token_exact(self, tiny):
+        """Two streams whose worst-case footprints each fit the pool but
+        together exceed it: the engine must preempt (not deadlock, not
+        corrupt) and the loser's final stream — re-served from scratch
+        as a longer prompt — must stay bit-identical to offline."""
+        _, m, params = tiny
+        eng = ServingEngine(m, params, max_slots=2, max_len=64,
+                            eos_token_id=EOS, prefill_chunk=8,
+                            prefix_cache_mb=0.0, max_pages=10)
+        n = 40
+        try:
+            assert eng.total_pages == 10
+            refs = [_offline(m, params, p, n, eos=None)
+                    for p in PROMPTS[:2]]
+            reqs = [eng.submit(p, max_new_tokens=n, ignore_eos=True)
+                    for p in PROMPTS[:2]]
+            for r, ref in zip(reqs, refs):
+                got = np.asarray(r.result(timeout=180))
+                assert np.array_equal(got, ref), (got, ref)
+            s = eng.stats.summary()
+            assert s["preemptions"] >= 1, (
+                "10 pages cannot hold two 6-page streams; the engine must "
+                f"have preempted (stats: {s})")
+            assert eng.page_pool_metrics()["preemptions"] >= 1
+        finally:
+            eng.shutdown(drain=False)
+
+
+class TestAliasPrefixCache:
+    def test_repeat_prompt_admits_by_refcount(self, tiny):
+        """Paged prefix hits bump page refcounts instead of copying KV:
+        the repeat admission reports alias chunks and the two streams
+        are bit-identical."""
+        _, m, params = tiny
+        eng = ServingEngine(m, params, max_slots=2, max_len=96,
+                            eos_token_id=EOS, prefill_chunk=8,
+                            prefix_cache_mb=4.0)
+        rng = np.random.default_rng(0)
+        prompt = rng.integers(0, 256, size=(1, 33)).astype(np.int32)
+        try:
+            a = np.asarray(eng.submit(prompt, max_new_tokens=8,
+                                      ignore_eos=True).result(timeout=120))
+            b = np.asarray(eng.submit(prompt, max_new_tokens=8,
+                                      ignore_eos=True).result(timeout=120))
+            assert np.array_equal(a, b)
+            s = eng.stats.summary()
+            # 33 tokens = 4 full chunks of 8; all restorable by aliasing.
+            assert s["prefix_alias_chunks"] >= 2, s
+            assert s["prefix_cache_hit_chunks"] >= 2, s
+        finally:
+            eng.shutdown(drain=False)
+
+    def test_external_cache_keeps_host_copy_path(self, tiny):
+        """An EXTERNAL (fleet-shared) PrefixCache still stores host-copy
+        blocks — slice-portable — and the paged engine compiles the
+        restore executable for it."""
+        _, m, params = tiny
+        shared = PrefixCache(4 * 1024 * 1024)
+        eng = ServingEngine(m, params, max_slots=2, max_len=96,
+                            eos_token_id=EOS, prefill_chunk=8,
+                            prefix_cache=shared)
+        rng = np.random.default_rng(1)
+        prompt = rng.integers(0, 256, size=(1, 24)).astype(np.int32)
+        try:
+            a = np.asarray(eng.submit(prompt, max_new_tokens=8,
+                                      ignore_eos=True).result(timeout=120))
+            b = np.asarray(eng.submit(prompt, max_new_tokens=8,
+                                      ignore_eos=True).result(timeout=120))
+            assert np.array_equal(a, b)
+            assert eng._restore_prefix is not None
+            assert eng.stats.summary()["prefix_cache_hit_chunks"] >= 2
+        finally:
+            eng.shutdown(drain=False)
+
+
+class TestSlidingWindowPageLifetime:
+    def test_windowed_model_frees_dead_pages(self, tiny):
+        """With a uniform sliding window, a page whose last position falls
+        wholly behind the window can never be attended again — the
+        engine drops it mid-stream.  Tokens must still match offline
+        (the window MASK, not page residency, defines the math)."""
+        _, _, params = tiny
+        cfg = LlamaConfig.tiny(use_flash_attention=False, sliding_window=16)
+        m = LlamaForCausalLM(cfg)
+        eng = ServingEngine(m, params, max_slots=2, max_len=64,
+                            eos_token_id=EOS, prefill_chunk=8,
+                            prefix_cache_mb=0.0)
+        assert eng._page_window == 16
+        n = 40
+        prompt = np.array([[3, 5, 7, 11, 2, 8, 6, 4]], np.int32)
+        peak = []
+        try:
+            r = eng.submit(prompt, max_new_tokens=n, ignore_eos=True,
+                           on_token=lambda t: peak.append(
+                               eng.page_pool_metrics()["pages_used"]))
+            got = np.asarray(r.result(timeout=120))
+            ref = _offline(m, params, prompt, n, eos=None)
+            assert np.array_equal(got, ref), (got, ref)
+            # 8 + 40 = 48 positions = 6 pages of 8 if nothing were freed;
+            # a 16-token window keeps at most 3 live (+1 being written).
+            assert max(peak) <= 4, peak
+        finally:
+            eng.shutdown(drain=False)
+
+
+class TestSpeculativeDecoding:
+    def test_spec_streams_are_token_identical(self, tiny):
+        """Greedy speculative output must be bit-identical to the plain
+        engine and offline — acceptance only SKIPS ticks, never changes
+        tokens — including the eos latch, and must actually accept."""
+        _, m, params = tiny
+        eng = ServingEngine(m, params, max_slots=3, max_len=64,
+                            eos_token_id=EOS, prefill_chunk=8,
+                            prefix_cache_mb=0.0,
+                            draft_model=m, draft_params=params,
+                            spec_tokens=4)
+        n = 24
+        try:
+            refs = [_offline(m, params, p, n) for p in PROMPTS]
+            reqs = []
+            for p in PROMPTS:
+                reqs.append(eng.submit(p, max_new_tokens=n))
+                time.sleep(0.01)
+            for r, ref in zip(reqs, refs):
+                _assert_matches_offline(r.result(timeout=120), ref, n)
+            s = eng.stats.summary()
+            assert s["spec_ticks"] > 0 and s["spec_accepted_tokens"] > 0, s
+            assert s["spec_tokens_per_tick"] > 1.0, (
+                "speculation must commit more than one token per verify "
+                f"on average (stats: {s})")
+        finally:
+            eng.shutdown(drain=False)
+
+    def test_spec_validation(self, tiny):
+        _, m, params = tiny
+        spec = dict(draft_model=m, draft_params=params)
+        with pytest.raises(NotImplementedError, match="paged"):
+            ServingEngine(m, params, paged=False, prefill_chunk=8,
+                          autostart=False, warmup=False, **spec)
+        with pytest.raises(NotImplementedError, match="greedy"):
+            ServingEngine(m, params, prefill_chunk=8, do_sample=True,
+                          autostart=False, warmup=False, **spec)
+        bank = AdapterBank(params, config=LoRAConfig(rank=4), max_adapters=2)
+        with pytest.raises(NotImplementedError, match="adapter"):
+            ServingEngine(m, params, prefill_chunk=8, adapters=bank,
+                          autostart=False, warmup=False, **spec)
+        with pytest.raises(ValueError, match="prefix cache"):
+            ServingEngine(m, params, prefill_chunk=8,
+                          prefix_cache=PrefixCache(1024 * 1024),
+                          autostart=False, warmup=False, **spec)
+        with pytest.raises(ValueError, match="spec_tokens"):
+            ServingEngine(m, params, prefill_chunk=8, spec_tokens=0,
+                          autostart=False, warmup=False, **spec)
+
+
+class TestPagedValidation:
+    def test_constructor_combos(self, tiny):
+        _, m, params = tiny
+        with pytest.raises(ValueError, match="chunked prefill"):
+            ServingEngine(m, params, paged=True, prefill_chunk=None,
+                          autostart=False, warmup=False)
+        with pytest.raises(ValueError, match="divide"):
+            ServingEngine(m, params, prefill_chunk=8, page_size=3,
+                          autostart=False, warmup=False)
+        with pytest.raises(ValueError, match="paged"):
+            ServingEngine(m, params, paged=False, prefill_chunk=8,
+                          page_size=8, autostart=False, warmup=False)
+        with pytest.raises(ValueError, match="max_pages"):
+            ServingEngine(m, params, prefill_chunk=8, max_pages=0,
+                          autostart=False, warmup=False)
+
+    def test_submit_rejects_unsatisfiable_footprint(self, tiny):
+        """A lone request whose worst case exceeds the whole pool could
+        never be scheduled — submit must refuse it synchronously."""
+        _, m, params = tiny
+        eng = ServingEngine(m, params, max_slots=2, max_len=64,
+                            eos_token_id=EOS, prefill_chunk=8, max_pages=4,
+                            warmup=False)
+        try:
+            with pytest.raises(ValueError, match="KV pages"):
+                eng.submit(PROMPTS[0], max_new_tokens=40)
+        finally:
+            eng.shutdown(drain=False)
