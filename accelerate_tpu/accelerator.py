@@ -533,9 +533,22 @@ class Accelerator:
             use_loss_scaling=self._use_loss_scaling,
             mesh=self.mesh,
             offload_to_host=offload,
+            zero_sharding=self.zero_sharding,
         )
         self._optimizers.append(opt)
         return opt
+
+    @property
+    def zero_sharding(self) -> bool:
+        """Whether optimizer state is ZeRO-sharded over the dp/fsdp axis —
+        set on :class:`MeshConfig`, the FSDP plugin, or via DeepSpeed
+        ``zero_stage >= 1`` (utils/dataclasses.py)."""
+        mesh_cfg = getattr(self.state, "mesh_config", None)
+        fsdp = self.state.fsdp_plugin
+        return bool(
+            getattr(mesh_cfg, "zero_sharding", False)
+            or (fsdp is not None and getattr(fsdp, "zero_sharding", False))
+        )
 
     def prepare_scheduler(self, scheduler):
         wrapped = AcceleratedScheduler(
@@ -964,6 +977,24 @@ class Accelerator:
                 loss, grads = loss_and_grads(params, batch, rng, scale)
             return grads, loss
 
+        # ZeRO (optimizer.zero_sharding): the update pins its outputs with
+        # sharding constraints. The constraint on params is load-bearing:
+        # without it GSPMD propagates the moments' dp sharding onto the
+        # updated params, breaking the donation alias; with it the update
+        # lowers to reduce-scatter(grads) -> shard-local Adam ->
+        # all-gather(params), and per-replica opt-state bytes are 1/dp.
+        # (Constraints inside the traced function, not jit in/out_shardings:
+        # explicitly-sharded jits segfault after a persistent-compile-cache
+        # round-trip on the CPU backend, and the inputs are already committed
+        # to these layouts at init_state time.)
+        zero_sh = optimizer.opt_state_shardings
+        zero_p_sh = None
+        if zero_sh is not None:
+            zero_p_sh = model.param_shardings
+            if zero_p_sh is None:
+                repl = replicated_sharding(self.mesh)
+                zero_p_sh = jax.tree_util.tree_map(lambda _: repl, model.params)
+
         def update_phase(params, opt_state, loss_scale, grads, loss):
             import optax
 
@@ -1021,6 +1052,9 @@ class Accelerator:
             if has_scale:
                 metrics["loss_scale"] = new_scale.scale
                 metrics["finite"] = finite
+            if zero_sh is not None:
+                new_params = jax.lax.with_sharding_constraint(new_params, zero_p_sh)
+                new_opt_state = jax.lax.with_sharding_constraint(new_opt_state, zero_sh)
             return new_params, new_opt_state, new_scale, metrics
 
         def train_step(params, opt_state, loss_scale, batch, rng):
@@ -1051,14 +1085,26 @@ class Accelerator:
                 optimizer._steps_applied += 1
             return metrics
 
+        # ZeRO steps stay out of the persistent compile cache on the CPU
+        # backend (sharding.py zero_step_compile_cache_guard). The in-memory
+        # jit cache still holds the executable after the first call, so only
+        # compiles (first call and any new batch shape) pay the toggle.
+        _zero_nocache = zero_sh is not None and jax.default_backend() == "cpu"
+
+        def _call_uncached(fn, *args):
+            from .parallel.sharding import zero_step_compile_cache_guard
+
+            with zero_step_compile_cache_guard(_zero_nocache):
+                return fn(*args)
+
         if not offload:
             jitted = jax.jit(train_step, donate_argnums=(0, 1) if donate else ())
 
             def step(batch):
                 _check_accum_shape(batch)
                 rng = self.next_rng_key()
-                new_params, new_opt_state, new_scale, metrics = jitted(
-                    model.params, optimizer.opt_state, optimizer.loss_scale, batch, rng
+                new_params, new_opt_state, new_scale, metrics = _call_uncached(
+                    jitted, model.params, optimizer.opt_state, optimizer.loss_scale, batch, rng
                 )
                 model.params = new_params
                 optimizer.opt_state = new_opt_state
@@ -1084,8 +1130,8 @@ class Accelerator:
             rng = self.next_rng_key()
             grads, loss = jitted_grads(model.params, optimizer.loss_scale, batch, rng)
             opt_in = to_device(optimizer.opt_state, self.mesh)
-            new_params, new_opt_state, new_scale, metrics = jitted_update(
-                model.params, opt_in, optimizer.loss_scale, grads, loss
+            new_params, new_opt_state, new_scale, metrics = _call_uncached(
+                jitted_update, model.params, opt_in, optimizer.loss_scale, grads, loss
             )
             model.params = new_params
             optimizer.opt_state = to_host(new_opt_state, self.mesh)

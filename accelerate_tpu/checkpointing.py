@@ -414,8 +414,18 @@ def save_accelerator_state(accelerator, output_dir: Optional[str] = None,
     return str(out)
 
 
-def load_accelerator_state(accelerator, input_dir: Optional[str] = None, load_kwargs: Optional[dict] = None):
-    """Restore the whole training state (reference: load_state :3081)."""
+def load_accelerator_state(accelerator, input_dir: Optional[str] = None, load_kwargs: Optional[dict] = None,
+                           via_host: Optional[bool] = None):
+    """Restore the whole training state (reference: load_state :3081).
+
+    ``via_host`` forces (True) or suppresses (False) the host-memory
+    resharding restore; the default (None) decides from world.json — host
+    restore exactly when the restoring world differs from the saving one.
+    Pass ``via_host=True`` when only the *mesh shape* changed within the
+    same world (e.g. a ZeRO-sharded optimizer saved under dp=2 resumed
+    under dp=4): every leaf is read as numpy and rebuilt shard-by-shard
+    onto the target's current shardings.
+    """
     wait_for_saves()  # an in-flight async save must be durable before reads
     src = _checkpoint_dir(accelerator, input_dir, for_load=True)
     if not Path(src).exists():
@@ -425,8 +435,9 @@ def load_accelerator_state(accelerator, input_dir: Optional[str] = None, load_kw
     import jax
 
     world_path = src / "world.json"
-    via_host = False
-    if world_path.exists():
+    forced = via_host
+    via_host = bool(via_host)
+    if world_path.exists() and forced is None:
         saved_world = json.loads(world_path.read_text())
         via_host = (
             saved_world.get("process_count") != state.num_processes

@@ -147,6 +147,32 @@ def _tp_param_split(abstract, tp: int):
     return counts["per_chip"], counts["sharded"], counts["total"]
 
 
+def _zero_opt_split(abstract, n: int, min_size: int = 2**11):
+    """(per_chip_elems, sharded_elems, total_elems, fallback_leaves) for the
+    fp32 Adam moments under ZeRO-``n`` — the same per-leaf predicate
+    ``infer_opt_state_shardings`` compiles (parallel/sharding.py): a moment
+    shards 1/n exactly when some dimension divides by ``n`` and the leaf is
+    at least ``min_size`` elements; anything else replicates (the printed
+    fallback count)."""
+    import numpy as np
+    from jax.tree_util import tree_leaves
+
+    per_chip = sharded = total = fallback = 0
+    for leaf in tree_leaves(abstract):
+        shape = tuple(getattr(leaf, "shape", ()) or ())
+        size = int(np.prod(shape)) if shape else 1
+        total += size
+        divisible = any(d % n == 0 and d >= n for d in shape)
+        if size >= min_size and divisible:
+            per_chip += size // n
+            sharded += size
+        else:
+            per_chip += size
+            if size >= min_size:
+                fallback += 1
+    return per_chip, sharded, total, fallback
+
+
 def _kv_geometry(module):
     """(layers, kv_heads, head_dim) from the module's config, or None when
     the abstract tree came from bare safetensors headers (no config)."""
@@ -215,10 +241,26 @@ def estimate_command(args) -> int:
 
     dtypes = {"float32": jnp.float32, "bfloat16": jnp.bfloat16, "int8": "int8", "int4": "int4"}
     selected = [d for d in args.dtypes if d in dtypes]
+    # --zero N: per-chip fp32 Adam-moment share under ZeRO optimizer-state
+    # sharding. N=0 ("--zero" bare) is dp-aware: the launcher's mesh dp if
+    # set, else the device count.
+    zero = getattr(args, "zero", None)
+    if zero == 0:
+        import os
+
+        import jax
+
+        from ..utils.environment import env_var
+
+        env_dp = os.environ.get(env_var("MESH_DP"))
+        zero = int(env_dp) if env_dp and int(env_dp) > 0 else jax.device_count()
+    zero_split = _zero_opt_split(abstract, zero) if zero and zero > 1 else None
     print(f"Model: {args.model_name}  ({n_params / 1e9:.2f} B params)")
     header = f"{'dtype':>9} | {'largest layer':>14} | {'total size':>11} | {'training (Adam)':>16}"
     if args.fsdp > 1:
         header += f" | per-chip (fsdp={args.fsdp})"
+    if zero_split is not None:
+        header += f" | opt state/chip (zero={zero})"
     print(header)
     print("-" * len(header))
     for name in selected:
@@ -236,7 +278,20 @@ def estimate_command(args) -> int:
         row += f"{_fmt(training):>16}" if training == training else f"{'n/a (inference)':>16}"
         if args.fsdp > 1 and training == training:
             row += f" | {_fmt(training / args.fsdp):>14}"
+        if zero_split is not None and training == training:
+            # 2 fp32 moments on the per-chip element count (non-divisible
+            # leaves replicated, matching infer_opt_state_shardings).
+            row += f" | {_fmt(zero_split[0] * 4 * 2):>14}"
         print(row)
+    if zero_split is not None:
+        per_chip_e, sharded_e, total_e, n_fallback = zero_split
+        print(f"ZeRO-{zero} optimizer state: {_fmt(total_e * 4 * 2)} fp32 moments "
+              f"-> {_fmt(per_chip_e * 4 * 2)}/replica "
+              f"({100.0 * sharded_e / max(total_e, 1):.1f}% of elements sharded)")
+        if n_fallback:
+            print(f"  {n_fallback} leaves have no dimension divisible by "
+                  f"{zero}: REPLICATED (per-chip share above includes them "
+                  f"in full)")
     if args.lora_rank is not None:
         from ..adapters.lora import LoRAConfig, count_lora_params
 
@@ -361,6 +416,13 @@ def estimate_command_parser(subparsers=None):
     parser.add_argument("--dtypes", nargs="+", default=["float32", "bfloat16", "int8", "int4"])
     parser.add_argument("--fsdp", type=int, default=1,
                         help="Also print the per-chip share under this FSDP axis size")
+    parser.add_argument("--zero", type=int, nargs="?", const=0, default=None,
+                        help="Per-chip fp32 Adam-moment column under ZeRO "
+                             "optimizer-state sharding across this many "
+                             "replicas; bare --zero uses the launcher mesh "
+                             "dp (ACCELERATE_TPU_MESH_DP) or the device "
+                             "count. Leaves with no divisible dimension "
+                             "replicate (reported).")
     parser.add_argument("--lora-rank", type=int, default=None,
                         help="Also print the LoRA trainable-parameter count and "
                              "adapter checkpoint size at this rank")

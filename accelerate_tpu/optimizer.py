@@ -50,12 +50,23 @@ class AcceleratedOptimizer:
         use_loss_scaling: bool = False,
         mesh=None,
         offload_to_host: bool = False,
+        zero_sharding: bool = False,
+        zero_min_size_to_shard: int = 2**11,
     ):
         self.tx = tx
         self.gradient_state = GradientState()
         self.mesh = mesh
         self.param_shardings = param_shardings
         self.offload_to_host = offload_to_host
+        #: ZeRO-1/2: partition moment tensors over the dp (or fsdp) axis so
+        #: each replica stores/updates 1/dp of the state (sharding.py
+        #: infer_opt_state_shardings). Populated into opt_state_shardings at
+        #: init_state time; the jitted update then carries explicit in/out
+        #: shardings so GSPMD reduce-scatters grads, updates the local shard,
+        #: and all-gathers params.
+        self.zero_sharding = zero_sharding
+        self.zero_min_size_to_shard = zero_min_size_to_shard
+        self.opt_state_shardings = None
         self.opt_state = None
         self.acc_grads = None
         self._accumulated = 0
@@ -97,12 +108,35 @@ class AcceleratedOptimizer:
             self.opt_state = init(params)
         else:
             self.opt_state = self.tx.init(params)
+        if self.zero_sharding and self.mesh is not None and (
+            self.mesh.shape.get("dp", 1) > 1 or self.mesh.shape.get("fsdp", 1) > 1
+        ):
+            from .parallel.sharding import infer_opt_state_shardings
+
+            self.opt_state_shardings = infer_opt_state_shardings(
+                self.opt_state,
+                self.mesh,
+                params=params,
+                param_shardings=self._current_param_shardings(),
+                min_size_to_shard=self.zero_min_size_to_shard,
+            )
+            # Committed placement: the 1/dp layout is established once here;
+            # every jitted step after this reads/writes the local shard only.
+            self.opt_state = jax.tree_util.tree_map(
+                jax.device_put, self.opt_state, self.opt_state_shardings
+            )
         if self.offload_to_host:
             from .parallel.host_offload import to_host
 
             self.opt_state = to_host(self.opt_state, self.mesh)
         self.acc_grads = None
         self._accumulated = 0
+
+    def _current_param_shardings(self):
+        """Param shardings from the bound model (preferred) or construction."""
+        if self._model is not None and getattr(self._model, "param_shardings", None) is not None:
+            return self._model.param_shardings
+        return self.param_shardings
 
     # -- parity surface -------------------------------------------------
     @property
@@ -174,6 +208,25 @@ class AcceleratedOptimizer:
                 new_params = optax.apply_updates(params, updates)
                 return new_params, new_opt_state, loss_scale, jnp.asarray(True)
 
+        if self.opt_state_shardings is not None:
+            # ZeRO: pin params and opt_state in/out. Without the explicit
+            # params out-sharding GSPMD would propagate the moments' dp
+            # sharding onto the updated params (breaking the donation alias
+            # and leaving params partitioned); with it, the update lowers to
+            # reduce-scatter(grads) -> 1/dp Adam -> all-gather(params).
+            from .parallel.sharding import replicated_sharding
+
+            p_sh = self._current_param_shardings()
+            if p_sh is None:
+                repl = replicated_sharding(self.mesh)
+                p_sh = jax.tree_util.tree_map(lambda _: repl, self._model.params)
+            o_sh = self.opt_state_shardings
+            return jax.jit(
+                _apply,
+                donate_argnums=(0, 1, 2),
+                in_shardings=(p_sh, o_sh, None, None, None),
+                out_shardings=(p_sh, o_sh, None, None),
+            )
         return jax.jit(_apply, donate_argnums=(0, 1, 2))
 
     def step(self, closure=None):
@@ -205,9 +258,14 @@ class AcceleratedOptimizer:
             opt_in = to_device(self.opt_state, self.mesh)
         else:
             opt_in = self.opt_state
-        params, opt_state, new_scale, finite = self._apply_jit(
-            self._model.params, opt_in, self.acc_grads, self.loss_scale, inv_scale
-        )
+        from .parallel.sharding import zero_step_compile_cache_guard
+
+        with zero_step_compile_cache_guard(
+            self.opt_state_shardings is not None and jax.default_backend() == "cpu"
+        ):
+            params, opt_state, new_scale, finite = self._apply_jit(
+                self._model.params, opt_in, self.acc_grads, self.loss_scale, inv_scale
+            )
         if self.offload_to_host:
             opt_state = to_host(opt_state, self.mesh)
         self._grads_already_unscaled = False

@@ -44,6 +44,13 @@ class MeshConfig:
     dcn_axis: str = "dp"
     devices: Optional[Sequence] = None       # explicit device list (tests)
     allow_split_physical_axes: bool = True
+    #: ZeRO-1/2-style cross-replica optimizer-state sharding: Adam moments
+    #: are partitioned over the dp (or, failing that, fsdp) axis so each
+    #: replica holds 1/dp of the optimizer state. Consumed by
+    #: ``Accelerator.prepare`` (parallel/sharding.py
+    #: ``infer_opt_state_shardings``); also settable per-run via the FSDP
+    #: plugin or ACCELERATE_TPU_MESH_ZERO_SHARDING=1.
+    zero_sharding: bool = False
 
     @classmethod
     def from_env(cls) -> "MeshConfig":
@@ -55,6 +62,9 @@ class MeshConfig:
                 kwargs[ax] = int(v)
         if env_var("MESH_DCN_AXIS") in os.environ:
             kwargs["dcn_axis"] = os.environ[env_var("MESH_DCN_AXIS")]
+        v = os.environ.get(env_var("MESH_ZERO_SHARDING"))
+        if v is not None:
+            kwargs["zero_sharding"] = v.lower() not in ("0", "false", "")
         return cls(**kwargs)
 
     def axis_sizes(self, num_devices: int) -> dict[str, int]:

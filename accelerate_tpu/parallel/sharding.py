@@ -18,6 +18,7 @@ Policies:
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import re
 from typing import Any, Callable, Optional
@@ -202,6 +203,137 @@ def infer_param_shardings(
         return NamedSharding(mesh, spec)
 
     return jax.tree_util.tree_map_with_path(_leaf_spec, params)
+
+
+def infer_opt_state_shardings(
+    opt_state,
+    mesh,
+    params=None,
+    param_shardings=None,
+    axis: Optional[str] = None,
+    min_size_to_shard: int = 2**11,
+):
+    """Pytree of NamedSharding for every optimizer-state leaf (ZeRO-1/2).
+
+    The cross-replica weight-update sharding of arXiv:2004.13336, expressed
+    declaratively (SimpleFSDP-style): moment tensors get the data-parallel
+    axis on their largest divisible dimension, so each replica stores and
+    updates 1/dp of the Adam state and GSPMD lowers the step to
+    reduce-scatter(grads) -> shard-local update -> all-gather(params).
+
+    Policy per leaf:
+      * scalars / counts / leaves below ``min_size_to_shard`` -> replicated
+        (beyond their param's own sharding, which is always inherited);
+      * leaves that mirror a parameter (optax ``mu``/``nu`` subtrees carry
+        the param path as a suffix) first inherit that param's spec, so
+        tp/fsdp layouts compose;
+      * the ``axis`` ("dp" by default, "fsdp" when the mesh has no dp) then
+        claims the largest still-unclaimed dimension divisible by its size;
+      * no such dimension -> the leaf keeps its inherited spec (replicated
+        over the zero axis), counted in a one-line report.
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    if axis is None:
+        axis = "dp" if mesh.shape.get("dp", 1) > 1 else "fsdp"
+    axis_size = mesh.shape.get(axis, 1)
+
+    # param path suffix -> (shape, spec): optax state trees (mu/nu, masked
+    # chains, ...) wrap the param tree, so a state leaf's path ends with its
+    # param's path. Longest suffix with a matching shape wins.
+    suffix_specs: dict[tuple, tuple] = {}
+    if params is not None and param_shardings is not None:
+        s_leaves = jax.tree_util.tree_leaves(
+            param_shardings, is_leaf=lambda x: hasattr(x, "spec")
+        )
+        p_leaves = jax.tree_util.tree_leaves_with_path(params)
+        if len(p_leaves) == len(s_leaves):
+            for (path, leaf), sh in zip(p_leaves, s_leaves):
+                key = tuple(_leaf_path_str((k,)) for k in path)
+                suffix_specs[key] = (tuple(getattr(leaf, "shape", ()) or ()), sh.spec)
+    suffix_lens = sorted({len(k) for k in suffix_specs}, reverse=True)
+
+    stats = {"sharded": 0, "inherited": 0, "small": 0, "indivisible": 0}
+    fallbacks: list[str] = []
+
+    def _leaf_spec(path, leaf):
+        shape = tuple(getattr(leaf, "shape", ()) or ())
+        ndim = len(shape)
+        pkey = tuple(_leaf_path_str((k,)) for k in path)
+        base: list = [None] * ndim
+        for k in suffix_lens:
+            if k <= len(pkey):
+                hit = suffix_specs.get(pkey[-k:])
+                if hit is not None and hit[0] == shape:
+                    for d, ax in enumerate(hit[1][:ndim]):
+                        base[d] = ax
+                    break
+        size = int(np.prod(shape)) if ndim else 1
+        if ndim == 0 or size < min_size_to_shard or axis_size <= 1:
+            stats["small"] += 1
+            return NamedSharding(mesh, PartitionSpec(*_trim(base)))
+        claimed = {a for e in base if e is not None
+                   for a in (e if isinstance(e, tuple) else (e,))}
+        if axis in claimed:
+            stats["inherited"] += 1  # param already sharded over the zero axis
+            return NamedSharding(mesh, PartitionSpec(*_trim(base)))
+        candidates = [
+            d for d in range(ndim)
+            if base[d] is None and shape[d] % axis_size == 0 and shape[d] >= axis_size
+        ]
+        if not candidates:
+            stats["indivisible"] += 1
+            fallbacks.append(_leaf_path_str(path))
+            return NamedSharding(mesh, PartitionSpec(*_trim(base)))
+        best = max(candidates, key=lambda d: (shape[d], -d))
+        base[best] = axis
+        stats["sharded"] += 1
+        return NamedSharding(mesh, PartitionSpec(*_trim(base)))
+
+    def _trim(spec: list) -> list:
+        out = list(spec)
+        while out and out[-1] is None:
+            out.pop()
+        return out
+
+    shardings = jax.tree_util.tree_map_with_path(_leaf_spec, opt_state)
+    logger.info(
+        "opt-state zero sharding over %r (size %d): %d sharded, %d inherited, "
+        "%d scalar/small replicated, %d non-divisible replicated%s",
+        axis, axis_size, stats["sharded"], stats["inherited"], stats["small"],
+        stats["indivisible"],
+        (" (" + ", ".join(fallbacks[:4])
+         + (", ..." if len(fallbacks) > 4 else "") + ")") if fallbacks else "",
+    )
+    return shardings
+
+
+@contextlib.contextmanager
+def zero_step_compile_cache_guard(active: bool = True):
+    """Keep ZeRO update executables out of the persistent compile cache.
+
+    The reduce-scatter -> shard-local-update -> all-gather program the ZeRO
+    step lowers to crashes the CPU runtime after an executable
+    serialize/deserialize round-trip (jaxlib 0.4.37; TPU round-trips fine),
+    so compiles under this context skip the on-disk cache. ``reset_cache()``
+    on both edges is load-bearing: jax latches the is-cache-used decision
+    once per process, so a bare config flip is silently ignored.
+    """
+    if not active:
+        yield
+        return
+    import jax
+    from jax._src import compilation_cache as _cc
+
+    cache_was = jax.config.jax_enable_compilation_cache
+    jax.config.update("jax_enable_compilation_cache", False)
+    _cc.reset_cache()
+    try:
+        yield
+    finally:
+        jax.config.update("jax_enable_compilation_cache", cache_was)
+        _cc.reset_cache()
 
 
 def replicated_sharding(mesh):

@@ -1289,6 +1289,18 @@ class ServingEngine:
         capacity is slots, which ``free_slots`` already reports)."""
         return self._pool.free_pages if self._paged else 0
 
+    def page_deficit(self, total_tokens: int) -> int:
+        """How many pages this engine is SHORT for a request of
+        ``total_tokens`` (prompt + max_new): 0 means the pool can hold it
+        right now, >0 means admitting it would lean on preemption. Dense
+        engines reserve a full max_len row per slot, so they are never
+        page-starved (0). The router folds this into its least-loaded
+        score so long prompts route to replicas with free pages."""
+        if not self._paged or total_tokens <= 0:
+            return 0
+        needed = -(-int(total_tokens) // self._page)
+        return max(0, needed - self._pool.free_pages)
+
     @property
     def load(self) -> float:
         """Occupancy fraction over the engine's whole admission capacity:

@@ -368,24 +368,36 @@ class ReplicaSet:
         self._replicas[index].engine.kill(error)
 
     # -- routing ---------------------------------------------------------
-    def _candidates(self, adapter: Optional[str] = None) -> list[_Replica]:
+    def _candidates(self, adapter: Optional[str] = None,
+                    total_tokens: int = 0) -> list[_Replica]:
         """Healthy replicas, best-first: most free decode slots, then
-        lowest total occupancy, then index (stable). When the request
-        names a LoRA adapter, replicas with that adapter already RESIDENT
-        in their device bank rank first (routing affinity saves a host→
+        lowest total occupancy, then KV-page headroom, then index
+        (stable). ``total_tokens`` (prompt + max_new) folds the paged
+        pool into the score: a replica whose pool is short pages for THIS
+        request (``engine.page_deficit``) loses the tie-break to one with
+        room, and among un-starved replicas more ``free_pages`` wins — so
+        long prompts route to replicas with free pages instead of forcing
+        preemption (``fleet_free_pages`` is the same signal summed
+        fleet-wide in :meth:`fleet_metrics`). When the request names a
+        LoRA adapter, replicas with that adapter already RESIDENT in
+        their device bank rank first (routing affinity saves a host→
         device row upload), engines built without a bank drop out
-        entirely, and load order breaks ties as usual."""
+        entirely, and the same order breaks ties."""
         self.refresh_health()
         cands = [r for r in self._replicas
                  if r.state is ReplicaState.HEALTHY and r.engine.healthy
                  and (adapter is None or r.engine.adapters is not None)]
+
+        def _pages_key(r):
+            return (r.engine.page_deficit(total_tokens), -r.engine.free_pages)
+
         if adapter is None:
             cands.sort(key=lambda r: (-r.engine.free_slots, r.engine.load,
-                                      r.index))
+                                      *_pages_key(r), r.index))
         else:
             cands.sort(key=lambda r: (not r.engine.adapter_resident(adapter),
                                       -r.engine.free_slots, r.engine.load,
-                                      r.index))
+                                      *_pages_key(r), r.index))
         return cands
 
     def submit(self, prompt_ids=None, *, max_new_tokens: int = 20,
@@ -421,8 +433,12 @@ class ReplicaSet:
         thread) failures finish the fleet request instead of raising."""
         last_exc: Optional[BaseException] = None
         saturated = False
+        # Page-aware score input: tokens this request will occupy (prompt +
+        # already-generated on failover resume + remaining decode budget).
+        total_tokens = (int(fleet.prompt_ids.shape[1]) + len(fleet.tokens)
+                        + int(fleet.max_new_tokens))
         for attempt in range(2):
-            for r in self._candidates(fleet.adapter):
+            for r in self._candidates(fleet.adapter, total_tokens=total_tokens):
                 inner = self._make_inner(fleet, r)
                 if inner is None:  # cancelled or deadline passed meanwhile
                     return

@@ -391,6 +391,11 @@ class FullyShardedDataParallelPlugin(KwargsHandler):
     remat_policy: str = "dots"
     min_weight_size_to_shard: int = 2**14       # small tensors stay replicated
     shard_largest_dim: bool = True              # shard dim with max size divisible by axis
+    #: ZeRO-1/2: shard optimizer state (Adam moments) over the dp axis so
+    #: each replica holds 1/dp of it (parallel/sharding.py
+    #: infer_opt_state_shardings). Orthogonal to sharding_strategy, which
+    #: governs params/grads over the fsdp axis.
+    zero_sharding: bool = False
     use_orig_params: bool = True                # parity no-op (params are always "orig" pytrees)
     sync_module_states: bool = True             # parity no-op (GSPMD arrays are globally consistent)
     forward_prefetch: bool = True               # parity no-op (XLA overlaps automatically)
@@ -406,6 +411,8 @@ class FullyShardedDataParallelPlugin(KwargsHandler):
             self.cpu_offload = parse_flag_from_env("FSDP_OFFLOAD_PARAMS")
         if "FSDP_ACTIVATION_CHECKPOINTING" in env:
             self.activation_checkpointing = parse_flag_from_env("FSDP_ACTIVATION_CHECKPOINTING")
+        if "FSDP_ZERO_SHARDING" in env:
+            self.zero_sharding = parse_flag_from_env("FSDP_ZERO_SHARDING")
         if "FSDP_MIN_NUM_PARAMS" in env:
             # Reference parity (utils/dataclasses.py size_based_auto_wrap):
             # the smallest tensor worth sharding, as a param count.
@@ -640,6 +647,9 @@ class DeepSpeedPlugin(KwargsHandler):
         return FullyShardedDataParallelPlugin(
             sharding_strategy=strategy,
             cpu_offload=(self.offload_optimizer_device == "cpu" or self.offload_param_device == "cpu"),
+            # ZeRO stage >= 1 is, definitionally, optimizer-state sharding:
+            # partition the moments over the dp axis.
+            zero_sharding=self.zero_stage >= 1,
         )
 
 

@@ -45,6 +45,7 @@ LANES: dict[str, tuple[int, list[str]]] = {
         "test_ring_attention.py",
         "test_state.py",
         "test_tracking.py",
+        "test_zero_sharding.py",
     ]),
     "models": (12, [
         "test_adapters.py",
@@ -59,6 +60,8 @@ LANES: dict[str, tuple[int, list[str]]] = {
         "test_quantization.py",
         "test_serving.py",
         "test_serving_gateway.py",
+        "test_serving_mesh.py",
+        "test_serving_paged.py",
     ]),
     "subproc": (12, [
         "test_cli.py",

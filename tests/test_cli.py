@@ -208,6 +208,35 @@ class TestCLISubprocess:
         assert "0.0% of weights sharded" in out.stdout
         assert "REPLICATED" in out.stdout
 
+    def test_estimate_memory_zero(self):
+        out = _run_cli("estimate-memory", "llama-tiny",
+                       "--dtypes", "float32", "--zero", "8")
+        assert out.returncode == 0, out.stderr
+        assert "opt state/chip (zero=8)" in out.stdout
+        # tiny llama: 834.50 KiB of fp32 Adam moments; everything but the
+        # norm scales (99.7% of elements) has a dim divisible by 8.
+        assert "ZeRO-8 optimizer state" in out.stdout
+        assert "106.50 KiB/replica" in out.stdout
+        assert "99.7% of elements sharded" in out.stdout
+
+    def test_estimate_memory_zero_defaults_to_world_size(self):
+        # bare --zero resolves the replica count from the (8-device
+        # virtual) world instead of making the user repeat it.
+        out = _run_cli("estimate-memory", "llama-tiny",
+                       "--dtypes", "float32", "--zero")
+        assert out.returncode == 0, out.stderr
+        assert "opt state/chip (zero=8)" in out.stdout
+
+    def test_estimate_memory_zero_not_divisible_replicates(self):
+        out = _run_cli("estimate-memory", "llama-tiny",
+                       "--dtypes", "float32", "--zero", "7")
+        assert out.returncode == 0, out.stderr
+        # No tensor in the tiny model has a dim divisible by 7: the
+        # estimate must say so and charge every chip the full state.
+        assert "0.0% of elements sharded" in out.stdout
+        assert "no dimension divisible by 7: REPLICATED" in out.stdout
+        assert "834.50 KiB/replica" in out.stdout
+
     def test_estimate_memory_page_sizing(self):
         out = _run_cli("estimate-memory", "llama-tiny", "--dtypes", "bfloat16",
                        "--page-size", "16", "--max-pages", "256",

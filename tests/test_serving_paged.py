@@ -463,3 +463,54 @@ class TestPagedValidation:
                 eng.submit(PROMPTS[0], max_new_tokens=40)
         finally:
             eng.shutdown(drain=False)
+
+
+class TestPageAwareRouting:
+    """The router folds KV-page headroom into the least-loaded score
+    (``ReplicaSet._candidates`` via ``engine.page_deficit``): with slots
+    and load equal, a replica whose pool cannot cover a request's worst-
+    case footprint loses the tie-break — long prompts route around page
+    pressure instead of forcing a preemption on arrival."""
+
+    def _paged_fleet(self, tiny, n=2):
+        _, m, params = tiny
+        return ReplicaSet.from_factory(
+            lambda: ServingEngine(m, params, max_slots=2, max_len=64,
+                                  eos_token_id=EOS, prefill_chunk=8,
+                                  prefix_cache_mb=0.0, max_pages=10), n)
+
+    def test_page_starved_replica_loses_tie_break(self, tiny):
+        rs = self._paged_fleet(tiny)
+        taken = []
+        try:
+            e0 = rs.engine(0)
+            # Both replicas idle: equal free slots, equal load. Starve
+            # replica 0's pool down to one page (held from the test
+            # thread; the idle engine allocates nothing meanwhile).
+            while e0._pool.free_pages > 1:
+                taken.append(e0._pool.alloc())
+
+            total = int(PROMPTS[2].shape[1]) + 30  # 37 tokens -> 5 pages
+            assert e0.page_deficit(total) > 0
+            assert rs.engine(1).page_deficit(total) == 0
+            order = [r.index for r in rs._candidates(total_tokens=total)]
+            assert order == [1, 0], order
+
+            # Un-starve: with page headroom equal again, the stable index
+            # tie-break puts replica 0 back in front.
+            while taken:
+                e0._pool.decref(taken.pop())
+            order = [r.index for r in rs._candidates(total_tokens=total)]
+            assert order == [0, 1], order
+
+            # End to end: re-starve and submit the long request — it must
+            # land on (and stay on) the page-rich replica.
+            while e0._pool.free_pages > 1:
+                taken.append(e0._pool.alloc())
+            req = rs.submit(PROMPTS[2], max_new_tokens=30, ignore_eos=True)
+            req.wait(timeout=120)
+            assert req.replica_trail == [1], req.replica_trail
+        finally:
+            while taken:
+                rs.engine(0)._pool.decref(taken.pop())
+            rs.shutdown(drain=False)

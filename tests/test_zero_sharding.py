@@ -1,0 +1,279 @@
+"""ZeRO-style cross-replica sharded optimizer states (arXiv:2004.13336).
+
+The properties pinned here:
+
+* POLICY — ``infer_opt_state_shardings`` replicates scalars/counts and
+  small leaves, puts the zero axis on the largest divisible dimension of
+  each moment tensor, inherits the param's own tp/fsdp layout (composing
+  rather than clobbering), and falls back to replicated for tensors with
+  no divisible dimension.
+* MEMORY — under ``zero_sharding=True`` each dp replica stores 1/dp of
+  the shardable moment bytes (measured on the live arrays' shards).
+* TRAJECTORY — the sharded update (reduce-scatter grads -> 1/dp-shard
+  Adam -> all-gather params) tracks the replicated optimizer for >= 20
+  steps. Drift comes only from fp32 reduce-scatter reassociation vs a
+  full all-reduce, bounded here at 1e-5 relative (observed: often
+  bitwise 0 on this model).
+* PORTABILITY — a dp=2 ZeRO checkpoint resumes loss-identical under
+  dp=1 and dp=4 via the ``load_state(via_host=True)`` reshard path.
+* LoRA COMPOSITION — ``wrap_optimizer``'s masked chain composes: the
+  frozen base contributes NO moment arrays (optax MaskedNode), the
+  adapter trains, the base stays bit-identical.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from accelerate_tpu import Accelerator, MeshConfig, Model
+from accelerate_tpu.data_loader import make_global_batch
+from accelerate_tpu.parallel.sharding import infer_opt_state_shardings
+from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+
+def _reset():
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+
+
+def mlp_apply(params, x):
+    h = jnp.tanh(x @ params["w1"] + params["b1"])
+    return h @ params["w2"] + params["b2"]
+
+
+def init_mlp(seed=0, din=4, dh=512, dout=1):
+    k1, k2 = jax.random.split(jax.random.PRNGKey(seed))
+    return {
+        "w1": jax.random.normal(k1, (din, dh)) * 0.3,
+        "b1": jnp.zeros((dh,)),
+        "w2": jax.random.normal(k2, (dh, dout)) * 0.3,
+        "b2": jnp.zeros((dout,)),
+    }
+
+
+def mse_loss(params, batch):
+    return jnp.mean((mlp_apply(params, batch["x"]) - batch["y"]) ** 2)
+
+
+def make_batch(n=32, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 4)).astype(np.float32)
+    w = np.array([[1.0], [-2.0], [0.5], [3.0]], np.float32)
+    y = (x @ w).astype(np.float32)
+    return {"x": x, "y": y}
+
+
+def _opt_bytes_on_device(opt_state, dev):
+    """Bytes of optimizer state resident on one device (its shard only)."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(opt_state):
+        for s in getattr(leaf, "addressable_shards", ()):
+            if s.device == dev:
+                total += s.data.nbytes
+    return total
+
+
+# ---------------------------------------------------------------------------
+# policy: infer_opt_state_shardings unit tests (no Accelerator)
+# ---------------------------------------------------------------------------
+class TestShardingPolicy:
+    def _specs(self, params, mesh, param_shardings=None, **kw):
+        opt_state = optax.adam(1e-3).init(params)
+        sh = infer_opt_state_shardings(opt_state, mesh, params=params,
+                                       param_shardings=param_shardings, **kw)
+        # adam state = (ScaleByAdamState(count, mu, nu), EmptyState)
+        return sh[0].count.spec, sh[0].mu, sh[0].nu
+
+    def test_scalars_and_small_leaves_replicated(self):
+        from jax.sharding import PartitionSpec
+
+        mesh = MeshConfig(dp=2, devices=jax.devices()[:2]).build()
+        params = {"w": jnp.zeros((8, 4096)), "b": jnp.zeros((16,))}
+        count_spec, mu, _ = self._specs(params, mesh)
+        assert count_spec == PartitionSpec()          # step count: replicated
+        assert mu["b"].spec == PartitionSpec()        # 16 elems < min size
+        assert "dp" in tuple(mu["w"].spec)            # big moment: sharded
+
+    def test_largest_divisible_dim_gets_zero_axis(self):
+        from jax.sharding import PartitionSpec
+
+        mesh = MeshConfig(dp=2, devices=jax.devices()[:2]).build()
+        params = {"w": jnp.zeros((8, 4096))}  # both dims divisible by 2
+        _, mu, nu = self._specs(params, mesh)
+        assert mu["w"].spec == PartitionSpec(None, "dp")  # 4096 > 8
+        assert nu["w"].spec == PartitionSpec(None, "dp")
+
+    def test_inherits_param_tp_layout_and_shards_remaining_dim(self):
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        mesh = MeshConfig(dp=2, tp=2, devices=jax.devices()[:4]).build()
+        params = {"w": jnp.zeros((8, 4096))}
+        p_sh = {"w": NamedSharding(mesh, PartitionSpec(None, "tp"))}
+        _, mu, _ = self._specs(params, mesh, param_shardings=p_sh)
+        # tp stays where the param put it; dp claims the other (divisible) dim.
+        assert mu["w"].spec == PartitionSpec("dp", "tp")
+
+    def test_param_already_on_zero_axis_not_double_sharded(self):
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        mesh = MeshConfig(dp=1, fsdp=2, devices=jax.devices()[:2]).build()
+        params = {"w": jnp.zeros((8, 4096))}
+        p_sh = {"w": NamedSharding(mesh, PartitionSpec(None, "fsdp"))}
+        # no dp axis -> zero axis is fsdp, which the param already claims.
+        _, mu, _ = self._specs(params, mesh, param_shardings=p_sh)
+        assert mu["w"].spec == PartitionSpec(None, "fsdp")
+
+    def test_indivisible_tensor_falls_back_replicated(self):
+        from jax.sharding import PartitionSpec
+
+        mesh = MeshConfig(dp=2, devices=jax.devices()[:2]).build()
+        params = {"odd": jnp.zeros((3, 1025))}  # 3075 elems, no even dim
+        _, mu, _ = self._specs(params, mesh)
+        assert mu["odd"].spec == PartitionSpec()
+
+    def test_single_replica_mesh_is_noop(self):
+        from jax.sharding import PartitionSpec
+
+        mesh = MeshConfig(dp=1, devices=jax.devices()[:1]).build()
+        params = {"w": jnp.zeros((8, 4096))}
+        _, mu, _ = self._specs(params, mesh)
+        assert mu["w"].spec == PartitionSpec()
+
+
+# ---------------------------------------------------------------------------
+# end to end: memory + trajectory under the real prepare path
+# ---------------------------------------------------------------------------
+def _train(zero, steps, dp=2, dh=512, seed=0):
+    """Build a dp-replica accelerator and run ``steps`` fused train steps
+    on a fixed global batch; returns (losses, model, opt)."""
+    _reset()
+    acc = Accelerator(mesh_config=MeshConfig(
+        dp=dp, devices=jax.devices()[:dp], zero_sharding=zero))
+    model, opt = acc.prepare(Model(mlp_apply, init_mlp(seed, dh=dh)),
+                             optax.adamw(0.05))
+    step = acc.compile_train_step(mse_loss, max_grad_norm=1.0)
+    batch = make_global_batch(make_batch(), acc.mesh)
+    losses = [float(step(batch)["loss"]) for _ in range(steps)]
+    return losses, model, opt
+
+
+class TestZeroEndToEnd:
+    def test_per_replica_moment_bytes_shrink(self):
+        _, _, opt_r = _train(zero=False, steps=1)
+        bytes_r = _opt_bytes_on_device(opt_r.opt_state, jax.devices()[0])
+        _, _, opt_z = _train(zero=True, steps=1)
+        assert opt_z.opt_state_shardings is not None
+        bytes_z = _opt_bytes_on_device(opt_z.opt_state, jax.devices()[0])
+        # w1/b1 moments (the bulk) split 2 ways; small leaves replicate.
+        assert bytes_z <= 0.75 * bytes_r, (bytes_z, bytes_r)
+
+    def test_trajectory_matches_replicated_20_steps(self):
+        """fp32 drift bound: the only arithmetic difference vs the
+        replicated step is reduce-scatter + shard-local update vs
+        all-reduce + full update — a reassociation of the same fp32 sums.
+        Observed drift on this model: 0.0 (bitwise) to ~1e-7."""
+        ref, model_r, _ = _train(zero=False, steps=24)
+        got, model_z, _ = _train(zero=True, steps=24)
+        for i, (a, b) in enumerate(zip(ref, got)):
+            assert abs(a - b) <= 1e-5 * max(1.0, abs(a)), (i, a, b)
+        for pr, pz in zip(jax.tree_util.tree_leaves(model_r.params),
+                          jax.tree_util.tree_leaves(model_z.params)):
+            np.testing.assert_allclose(np.asarray(pr), np.asarray(pz),
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_moments_actually_sharded_not_just_declared(self):
+        _, _, opt = _train(zero=True, steps=1)
+        mu_w1 = opt.opt_state[0].mu["w1"]
+        # one distinct shard per replica, each half the global array
+        assert len(mu_w1.sharding.device_set) == 2
+        shard = mu_w1.addressable_shards[0]
+        assert shard.data.size == mu_w1.size // 2
+
+
+# ---------------------------------------------------------------------------
+# checkpoint portability: dp=2 ZeRO save -> dp=1 / dp=4 resume
+# ---------------------------------------------------------------------------
+class TestCheckpointPortability:
+    @pytest.mark.parametrize("resume_dp", [1, 4])
+    def test_dp2_save_resumes_loss_identical(self, tmp_path, resume_dp):
+        steps_before, steps_after = 3, 6
+
+        # train dp=2 with zero sharding, checkpoint, keep training (reference
+        # trajectory for the post-resume steps)
+        _reset()
+        acc = Accelerator(mesh_config=MeshConfig(
+            dp=2, devices=jax.devices()[:2], zero_sharding=True))
+        model, opt = acc.prepare(Model(mlp_apply, init_mlp()), optax.adamw(0.05))
+        step = acc.compile_train_step(mse_loss, max_grad_norm=1.0)
+        batch = make_global_batch(make_batch(), acc.mesh)
+        for _ in range(steps_before):
+            step(batch)
+        ckpt = acc.save_state(str(tmp_path / "ck"))
+        ref = [float(step(batch)["loss"]) for _ in range(steps_after)]
+
+        # resume under a different replica count; the saved opt state was
+        # laid out for dp=2, so force the via_host reshard path.
+        _reset()
+        acc2 = Accelerator(mesh_config=MeshConfig(
+            dp=resume_dp, devices=jax.devices()[:resume_dp],
+            zero_sharding=True))
+        model2, opt2 = acc2.prepare(Model(mlp_apply, init_mlp(seed=7)),
+                                    optax.adamw(0.05))
+        acc2.load_state(ckpt, via_host=True)
+        step2 = acc2.compile_train_step(mse_loss, max_grad_norm=1.0)
+        batch2 = make_global_batch(make_batch(), acc2.mesh)
+        got = [float(step2(batch2)["loss"]) for _ in range(steps_after)]
+
+        for i, (a, b) in enumerate(zip(ref, got)):
+            assert abs(a - b) <= 2e-5 * max(1.0, abs(a)), (resume_dp, i, ref, got)
+
+
+# ---------------------------------------------------------------------------
+# composition with LoRA's masked optimizer chain
+# ---------------------------------------------------------------------------
+class TestLoRAComposition:
+    def test_frozen_base_has_no_moments_and_adapter_trains(self):
+        from accelerate_tpu.adapters import LoRAConfig, prepare_lora
+        from accelerate_tpu.adapters.lora import lora_delta
+
+        base = {"q_proj": {"kernel": jax.random.normal(
+            jax.random.PRNGKey(0), (4, 512)) * 0.3}}
+        ts = prepare_lora(None, base, LoRAConfig(rank=4,
+                                                 target_modules=("q_proj",)))
+
+        def apply(train, x):
+            mod = train["lora"]["q_proj"]
+            return x @ train["base"]["q_proj"]["kernel"] + lora_delta(x, mod)
+
+        def loss(train, batch):
+            out = apply(train, batch["x"])
+            return jnp.mean((out[:, :1] - batch["y"]) ** 2)
+
+        _reset()
+        acc = Accelerator(mesh_config=MeshConfig(
+            dp=2, devices=jax.devices()[:2], zero_sharding=True))
+        model, opt = acc.prepare(Model(apply, ts.train_params()),
+                                 ts.wrap_optimizer(optax.adamw(1e-2)))
+        assert opt.opt_state_shardings is not None  # zero path engaged
+
+        # frozen base leaves are optax MaskedNodes: zero moment arrays, so
+        # ZeRO has nothing to shard OR replicate for them on any replica.
+        moment_paths = [
+            jax.tree_util.keystr(p)
+            for p, leaf in jax.tree_util.tree_leaves_with_path(opt.opt_state)
+            if hasattr(leaf, "shape")
+        ]
+        assert not any("'base'" in p for p in moment_paths), moment_paths
+
+        base_before = jax.tree_util.tree_map(np.asarray,
+                                             model.params["base"])
+        step = acc.compile_train_step(loss, max_grad_norm=1.0)
+        batch = make_global_batch(make_batch(), acc.mesh)
+        losses = [float(step(batch)["loss"]) for _ in range(4)]
+        assert losses[-1] < losses[0]  # adapter-only training works
+        for old, new in zip(jax.tree_util.tree_leaves(base_before),
+                            jax.tree_util.tree_leaves(model.params["base"])):
+            assert np.array_equal(old, np.asarray(new))  # base bit-identical
