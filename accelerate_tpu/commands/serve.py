@@ -90,7 +90,7 @@ def serve_command(args) -> int:
             max_queued=args.max_queued, eos_token_id=args.eos_token_id,
             prefill_chunk=args.prefill_chunk,
             prefix_cache_mb=args.prefix_cache_mb,
-            adapters=make_bank(), **paging)
+            adapters=make_bank(), trace_dir=args.trace_dir, **paging)
 
     print(f"warming up {args.replicas} replica(s) "
           f"(slots={args.max_slots}, max_len={args.max_len}, "
@@ -107,7 +107,8 @@ def serve_command(args) -> int:
             max_slots=args.max_slots, max_len=args.max_len,
             max_queued=args.max_queued, eos_token_id=args.eos_token_id,
             prefill_chunk=args.prefill_chunk,
-            prefix_cache_mb=args.prefix_cache_mb, **paging)
+            prefix_cache_mb=args.prefix_cache_mb,
+            trace_dir=args.trace_dir, **paging)
     else:
         replica_set = ReplicaSet.from_factory(factory, args.replicas)
     if adapter_specs:
@@ -126,7 +127,8 @@ def serve_command(args) -> int:
     gateway.start()
     gateway.install_signal_handlers()
     print(f"serving on {gateway.url}  "
-          "(POST /v1/completions, GET /healthz /readyz /metrics)",
+          "(POST /v1/completions, GET /healthz /readyz /metrics "
+          "/debug/trace)",
           flush=True)
     print("press Ctrl-C (or send SIGTERM) to drain and exit",
           flush=True)
@@ -202,6 +204,12 @@ def serve_command_parser(subparsers=None):
                         help="Preload a saved adapter (save_adapter dir) "
                              "under NAME on every replica; repeatable. "
                              "Implies an adapter bank sized to fit")
+    parser.add_argument("--trace-dir", default=None,
+                        help="Directory each replica dumps its Chrome-trace "
+                             "span buffer and flight-recorder events into on "
+                             "shutdown (and automatically on a fatal engine "
+                             "error); live traces are also at GET "
+                             "/debug/trace?id=<trace_id>")
     if subparsers is not None:
         parser.set_defaults(func=serve_command)
     return parser

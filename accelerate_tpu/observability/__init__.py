@@ -1,0 +1,43 @@
+"""Low-overhead observability for the serving and training stack.
+
+Three pieces, one timeline format:
+
+* :mod:`~accelerate_tpu.observability.tracing` — request-scoped spans in
+  lock-light per-thread ring buffers, exported as Chrome-trace/Perfetto
+  JSON. A ``trace_id`` minted at the gateway (or taken from
+  ``X-Request-Id``) follows a request through queue wait, prefill
+  chunks, decode ticks, preemptions and failover hops across replicas.
+* :mod:`~accelerate_tpu.observability.flight_recorder` — the last N
+  structured events per replica (admissions, preemptions, pool
+  exhaustion, adapter loads, compile events, fatals), auto-dumped on
+  engine death so failover reports carry a postmortem.
+* :mod:`~accelerate_tpu.observability.promlint` — a small Prometheus
+  text-exposition validator used to keep ``/metrics`` scrape-clean.
+
+The compile-event counterpart, ``CompileWatcher``, lives in
+:mod:`accelerate_tpu.utils.profiling` next to ``ProfileSession`` (which
+emits the same span format for training steps).
+"""
+
+from .flight_recorder import FlightRecorder
+from .promlint import lint_prometheus_text, parse_sample_line
+from .tracing import (
+    Tracer,
+    TraceSpan,
+    clean_trace_id,
+    merge_chrome_traces,
+    new_trace_id,
+    validate_chrome_trace,
+)
+
+__all__ = [
+    "FlightRecorder",
+    "Tracer",
+    "TraceSpan",
+    "clean_trace_id",
+    "merge_chrome_traces",
+    "new_trace_id",
+    "validate_chrome_trace",
+    "lint_prometheus_text",
+    "parse_sample_line",
+]

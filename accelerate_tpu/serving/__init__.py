@@ -35,8 +35,19 @@ Public surface:
 * :class:`ServingGateway` / :class:`GatewayConfig` /
   :class:`GatewayStats` — stdlib-only HTTP front end: ``POST
   /v1/completions`` (JSON + SSE streaming), ``/healthz`` / ``/readyz`` /
-  ``/metrics`` (Prometheus text), backpressure mapped to HTTP status
-  codes, graceful drain on SIGTERM.
+  ``/metrics`` (Prometheus text with latency histograms) /
+  ``/debug/trace`` (Chrome-trace JSON), backpressure mapped to HTTP
+  status codes, graceful drain on SIGTERM.
+
+Every request carries a ``trace_id`` (gateway-minted or the client's
+``X-Request-Id``): engines drop per-edge spans — queue wait, prefill
+chunks, decode-tick ITL, preemptions, failover hops — into bounded
+lock-light ring buffers (``accelerate_tpu.observability``), exported as
+Chrome-trace/Perfetto JSON via ``engine.dump_trace``, ``GET
+/debug/trace?id=``, or ``accelerate-tpu serve --trace-dir``; a
+per-replica flight recorder keeps the last N structured events and
+auto-dumps a postmortem into ``ReplicaSet.failover_reports`` when a
+replica dies. See ``docs/usage_guides/observability.md``.
 
 Multi-tenant LoRA serving (``accelerate_tpu.adapters``) plugs in through
 the same surface: construct the engine with an

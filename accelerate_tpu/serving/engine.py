@@ -112,6 +112,8 @@ from __future__ import annotations
 
 import collections
 import hashlib
+import itertools
+import os
 import threading
 import time
 from typing import Optional
@@ -129,6 +131,7 @@ from ..generation import (
     _next_token,
 )
 from ..inference import resolve_model_source
+from ..observability import FlightRecorder, Tracer, new_trace_id
 from .metrics import ServingStats
 from .request import Request, RequestStatus
 from .scheduler import (
@@ -141,6 +144,9 @@ from .scheduler import (
 )
 
 __all__ = ["ServingEngine"]
+
+#: distinct tracer/flight-recorder identities per engine in one process.
+_ENGINE_SEQ = itertools.count()
 
 
 class ServingEngine:
@@ -225,9 +231,19 @@ class ServingEngine:
         adapter bank; the engine's private prefix cache is disabled
         (cached target blocks carry no draft KV).
       spec_tokens: draft proposals per speculative tick (default 4).
+      tracing: keep the request-scoped span tracer enabled (the default —
+        the hot path is a lock-free ring append, guarded ≤5% decode
+        overhead). ``False`` turns every emit into an early return; the
+        flight recorder stays on either way (its events are rare).
+      trace_capacity: spans kept per emitting thread (drop-oldest).
+      flight_capacity: structured events the flight recorder retains.
+      trace_dir: when set, the engine writes ``<name>-trace.json`` /
+        ``<name>-flight.json`` here on shutdown or death (the
+        ``accelerate-tpu serve --trace-dir`` plumbing).
       autostart: spawn the engine thread (and warm up) in the constructor.
       warmup: run dummy requests through every program at start so the
-        first real request never pays a compile; stats reset afterwards.
+        first real request never pays a compile; stats, spans, and
+        flight events reset afterwards.
     """
 
     def __init__(self, model, params=None, *, max_slots: int = 4,
@@ -246,6 +262,9 @@ class ServingEngine:
                  tp: Optional[int] = None, mesh=None, devices=None,
                  prefix_cache: Optional[PrefixCache] = None,
                  accelerator=None, stats: Optional[ServingStats] = None,
+                 tracing: bool = True, trace_capacity: int = 4096,
+                 flight_capacity: int = 256,
+                 trace_dir: Optional[str] = None,
                  autostart: bool = True, warmup: bool = True,
                  idle_poll_s: float = 0.005):
         from ..big_modeling import cache_factory_for
@@ -618,6 +637,18 @@ class ServingEngine:
         self._stats = stats if stats is not None else ServingStats()
         self._queue = AdmissionQueue(max_queued)
         self._slots = SlotScheduler(self.max_slots)
+
+        # Observability: per-engine span tracer + flight recorder (black
+        # box). Both are host-only — no device work, no traced arguments —
+        # so enabling them cannot change the compiled programs.
+        name = f"engine-{next(_ENGINE_SEQ)}"
+        self._tracer = Tracer(capacity=int(trace_capacity),
+                              enabled=bool(tracing), name=name)
+        self._flight = FlightRecorder(capacity=int(flight_capacity),
+                                      name=name, tracer=self._tracer)
+        self._trace_dir = trace_dir
+        self._compile_watcher = None
+        self._postmortem: Optional[dict] = None
 
         self._accepting = False
         self._stop = False          # hard stop: cancel everything, exit now
@@ -1169,6 +1200,18 @@ class ServingEngine:
         """Spawn the engine thread (idempotent) and run warmup traffic."""
         if self._thread is not None:
             return
+        if self._compile_watcher is None:
+            # Black-box compile accounting: any XLA compile while this
+            # replica serves is a flight event (a steady-state compile is
+            # the zero-recompile invariant breaking in production).
+            # Unregistered in shutdown() AND the run loop's finally, so a
+            # killed engine never leaks its process-global listener.
+            from ..utils.profiling import CompileWatcher
+
+            self._compile_watcher = CompileWatcher(
+                on_event=lambda event, duration_s: self._flight.record(
+                    "compile", event=event, duration_s=duration_s))
+            self._compile_watcher.start()
         self._accepting = True
         self._thread = threading.Thread(target=self._run,
                                         name="serving-engine", daemon=True)
@@ -1204,6 +1247,12 @@ class ServingEngine:
         self._stats.reset()
         if self._prefix_cache is not None:
             self._prefix_cache.clear()
+        # Warmup traffic (and its compiles) must not pollute traces,
+        # postmortems, or the compile counters, same as the stats reset.
+        self._tracer.clear()
+        self._flight.clear()
+        if self._compile_watcher is not None:
+            self._compile_watcher.reset()
 
     @staticmethod
     def _raise_if_failed(req):
@@ -1231,6 +1280,9 @@ class ServingEngine:
         # covers an engine that was never started (autostart=False), so a
         # blocked submit can never outlive the engine either way.
         self._queue.close()
+        self._stop_compile_watcher()
+        if self._trace_dir is not None and self._error is None:
+            self._dump_debug_files()
         checkpointing.wait_for_saves()
         if self._error is not None:
             raise RuntimeError("serving engine died") from self._error
@@ -1322,8 +1374,10 @@ class ServingEngine:
         and queued request, and exits. Used by the failover tests/benches
         and by operators fencing a suspect replica hard (prefer
         :meth:`shutdown` for anything gentler)."""
-        self._fail_injection = error if error is not None else RuntimeError(
+        err = error if error is not None else RuntimeError(
             "replica killed by fault injection")
+        self._flight.record("kill", error=repr(err))
+        self._fail_injection = err
 
     # ------------------------------------------------------------------
     # submission
@@ -1332,7 +1386,7 @@ class ServingEngine:
                max_new_tokens: int = 20, seed: Optional[int] = None,
                rng=None, timeout: Optional[float] = None, on_token=None,
                ignore_eos: bool = False, adapter: Optional[str] = None,
-               block: bool = False,
+               trace_id: Optional[str] = None, block: bool = False,
                block_timeout: Optional[float] = None) -> Request:
         """Enqueue one request; returns its :class:`Request` handle
         immediately. Raises :class:`scheduler.QueueFull` under backpressure
@@ -1346,7 +1400,7 @@ class ServingEngine:
             request = Request(prompt_ids, max_new_tokens=max_new_tokens,
                               rng=rng, seed=seed, timeout=timeout,
                               on_token=on_token, ignore_eos=ignore_eos,
-                              adapter=adapter)
+                              adapter=adapter, trace_id=trace_id)
         elif (request.status is not RequestStatus.QUEUED
                 or request.submitted_at is not None):
             raise ValueError(
@@ -1394,6 +1448,10 @@ class ServingEngine:
                                   S + request.max_new_tokens + K - 1)
         else:
             _check_position_bound(self.module, S + request.max_new_tokens)
+        if request.trace_id is None:
+            # Engine-direct submissions get an id too, so dump_trace can
+            # always filter per request (the gateway mints upstream).
+            request.trace_id = new_trace_id()
         request.submitted_at = time.monotonic()
         try:
             self._queue.put(request, block=block, timeout=block_timeout)
@@ -1408,6 +1466,9 @@ class ServingEngine:
                 "serving engine is not accepting requests "
                 "(not started, shutting down, or preempted)") from e
         self._stats.record_submit(len(self._queue))
+        self._tracer.instant(
+            "submit", trace_id=request.trace_id,
+            args={"prompt_len": S, "queue_depth": len(self._queue)})
         return request
 
     def serving_metrics(self) -> dict:
@@ -1422,6 +1483,60 @@ class ServingEngine:
     @property
     def prefix_cache(self) -> Optional[PrefixCache]:
         return self._prefix_cache
+
+    # -- observability ---------------------------------------------------
+    @property
+    def tracer(self) -> Tracer:
+        """This engine's span tracer (request-scoped timeline sink)."""
+        return self._tracer
+
+    @property
+    def flight_recorder(self) -> FlightRecorder:
+        """This engine's black box (last-N structured lifecycle events)."""
+        return self._flight
+
+    @property
+    def compile_watcher(self):
+        """The engine's :class:`~accelerate_tpu.utils.profiling.
+        CompileWatcher` (None before :meth:`start`). Its counters answer
+        "did serving compile anything after warmup" — 0 at steady state
+        is the zero-recompile invariant, now observable in production."""
+        return self._compile_watcher
+
+    def trace_events(self, trace_id: Optional[str] = None) -> list:
+        """Snapshot of buffered span records (see :meth:`Tracer.events`)."""
+        return self._tracer.events(trace_id)
+
+    def chrome_trace(self, trace_id: Optional[str] = None) -> dict:
+        """Chrome-trace/Perfetto JSON dict of the buffered spans."""
+        return self._tracer.chrome_trace(trace_id)
+
+    def dump_trace(self, path: str, trace_id: Optional[str] = None) -> str:
+        """Write the Chrome-trace JSON to ``path``; returns ``path``.
+        Load it at ``chrome://tracing`` or https://ui.perfetto.dev."""
+        return self._tracer.dump(path, trace_id)
+
+    def postmortem(self) -> Optional[dict]:
+        """The flight-recorder dump auto-captured when the run loop died
+        (None while the engine is healthy). The router attaches this to
+        its failover report for the dead replica."""
+        return self._postmortem
+
+    def _stop_compile_watcher(self):
+        watcher = self._compile_watcher
+        if watcher is not None:
+            watcher.stop()  # idempotent; shutdown() + run-loop finally race
+
+    def _dump_debug_files(self):
+        """Best-effort trace/flight dump into ``trace_dir`` (death or
+        shutdown must never be masked by a full disk)."""
+        try:
+            os.makedirs(self._trace_dir, exist_ok=True)
+            base = os.path.join(self._trace_dir, self._tracer.name)
+            self._tracer.dump(base + "-trace.json")
+            self._flight.dump_json(base + "-flight.json")
+        except OSError:
+            pass
 
     @property
     def adapters(self) -> Optional[AdapterBank]:
@@ -1584,7 +1699,16 @@ class ServingEngine:
                             self._begin_prefill(req, self._chunks_per_tick)
         except BaseException as e:  # engine-fatal: fail everything loudly
             self._error = e
+            # Black-box capture at the moment of death: the fatal event
+            # plus the last N lifecycle events, frozen BEFORE the retire
+            # sweep below — this dump is what the router attaches to its
+            # failover report.
+            self._flight.record("fatal", error=repr(e))
+            self._postmortem = self._flight.dump()
+            if self._trace_dir is not None:
+                self._dump_debug_files()
         finally:
+            self._stop_compile_watcher()
             self._accepting = False
             # Close BEFORE the final drain: wakes producers blocked in
             # put(block=True) with QueueClosed and guarantees nothing can
@@ -1632,6 +1756,10 @@ class ServingEngine:
         req._adapter_row = row
         req._adapter_pinned = True
         self._stats.record_adapter_admit(req.adapter, hit=hit, evicted=evicted)
+        if not hit:
+            self._flight.record("adapter_load", adapter=req.adapter,
+                                row=row, evicted=evicted,
+                                trace_id=req.trace_id)
         return True
 
     def _adapter_args(self, req: Request) -> tuple:
@@ -1736,6 +1864,9 @@ class ServingEngine:
         victim._preempted += 1
         self._pool.preemptions += 1
         self._stats.record_preemption()
+        self._flight.record("preemption", trace_id=victim.trace_id,
+                            tokens=len(victim.tokens),
+                            free_pages=self._pool.free_pages)
         try:
             self._queue.putleft(victim)
         except QueueClosed:
@@ -1769,6 +1900,9 @@ class ServingEngine:
             return
         req.admitted_at = time.monotonic()
         slot = self._slots.assign(req)
+        self._flight.record("admission", trace_id=req.trace_id, slot=slot,
+                            prompt_len=req.prompt_ids.shape[1],
+                            adapter=req.adapter)
         req._serve_ids = req.prompt_ids
         S = req.prompt_ids.shape[1]
         P = self._bucket(S)
@@ -1807,6 +1941,9 @@ class ServingEngine:
         if self._paged:
             need = -(-S // self._page)
             if need > self._pool.free_pages + self._reclaimable_pages():
+                self._flight.record(
+                    "pool_exhausted", trace_id=req.trace_id,
+                    need_pages=need, free_pages=self._pool.free_pages)
                 try:
                     self._queue.putleft(req)
                 except QueueClosed:
@@ -1817,6 +1954,9 @@ class ServingEngine:
             return budget
         req.admitted_at = time.monotonic()
         slot = self._slots.assign(req)
+        self._flight.record("admission", trace_id=req.trace_id, slot=slot,
+                            prompt_len=S, adapter=req.adapter,
+                            resumed=bool(req.tokens))
         req.status = RequestStatus.PREFILLING
         req._rng_key = req.rng if req.rng is not None else jax.random.PRNGKey(
             req.seed if req.seed is not None else 0)
@@ -1869,6 +2009,11 @@ class ServingEngine:
                                           hit=len(blocks),
                                           bytes_restored=restored_bytes,
                                           aliased=aliased)
+                if blocks:
+                    self._tracer.instant(
+                        "prefix_hit", trace_id=req.trace_id,
+                        args={"chunks": len(blocks), "aliased": aliased,
+                              "bytes": restored_bytes})
                 req._next_chunk = len(blocks)
         self._prefilling.append(req)
         self._run_chunk(req)
@@ -1955,6 +2100,10 @@ class ServingEngine:
         backlog = sum(1 for r in self._prefilling
                       if r.status is RequestStatus.PREFILLING)
         self._stats.record_prefill_chunk(dt_ms, backlog=backlog)
+        self._tracer.emit(
+            "prefill_chunk", t0, dt_ms / 1e3, trace_id=req.trace_id,
+            args={"chunk": i, "of": req._chunks_total, "offset": offset,
+                  "slot": req.slot, "backlog": backlog})
         if (self._prefix_cache is not None and req._chunk_keys is not None
                 and offset == i * C and offset + C <= S):
             if self._alias_cache:
@@ -2002,6 +2151,13 @@ class ServingEngine:
             self._stats.record_admit(
                 queue_wait_ms=(req.admitted_at - req.submitted_at) * 1e3,
                 ttft_ms=(now - req.submitted_at) * 1e3)
+            self._tracer.emit(
+                "queue_wait", req.submitted_at,
+                req.admitted_at - req.submitted_at,
+                trace_id=req.trace_id, args={"slot": req.slot})
+            self._tracer.instant(
+                "first_token", trace_id=req.trace_id,
+                args={"ttft_ms": round((now - req.submitted_at) * 1e3, 3)})
         # Host mirror of the device write position: after this commit,
         # pos = serve length + 0 more; each committed token adds one.
         req._pos_base = req._serve_ids.shape[1] - len(req.tokens) - 1
@@ -2059,6 +2215,13 @@ class ServingEngine:
         self._stats.record_tick(active_slots=len(running),
                                 committed_tokens=committed,
                                 max_slots=self.max_slots, seconds=dt)
+        tracer = self._tracer
+        if tracer.enabled:
+            tracer.emit("decode_tick", t0, dt,
+                        args={"active": len(running), "committed": committed})
+            for slot, req in running:
+                tracer.emit("itl", t0, dt, trace_id=req.trace_id,
+                            args={"slot": slot, "token": len(req.tokens)})
         if self._paged:
             self._stats.record_pages(self._pool.free_pages,
                                      self._pool.used_pages,
@@ -2125,6 +2288,15 @@ class ServingEngine:
         self._stats.record_tick(active_slots=len(running),
                                 committed_tokens=committed,
                                 max_slots=self.max_slots, seconds=dt)
+        tracer = self._tracer
+        if tracer.enabled:
+            tracer.emit("decode_tick", t0, dt,
+                        args={"active": len(running), "committed": committed,
+                              "spec_accepted": accepted})
+            for slot, req in running:
+                tracer.emit("itl", t0, dt, trace_id=req.trace_id,
+                            args={"slot": slot, "token": len(req.tokens),
+                                  "accepted": int(ns[slot]) - 1})
         self._stats.record_pages(self._pool.free_pages,
                                  self._pool.used_pages,
                                  self._pool.num_pages)
@@ -2155,3 +2327,11 @@ class ServingEngine:
             self._stats.record_adapter_tokens(req.adapter, len(req.tokens))
         req._finish(status, error)
         self._stats.record_finish(req.status)
+        self._tracer.instant("retire", trace_id=req.trace_id,
+                             args={"status": req.status.value,
+                                   "tokens": len(req.tokens)})
+        if req.status is RequestStatus.FAILED and error is not self._error:
+            # Engine-fatal retirements are already covered by the single
+            # "fatal" event; request-level failures get their own.
+            self._flight.record("request_failed", trace_id=req.trace_id,
+                                error=repr(error))

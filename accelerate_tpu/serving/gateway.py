@@ -14,8 +14,13 @@ and sockets, all model work stays on the engine threads) exposing:
   AND at least one replica is healthy and warm; 503 otherwise. Wire this
   one into the load balancer.
 * ``GET /metrics`` — Prometheus text exposition: the fleet-merged engine
-  counters (``ServingStats.merge`` across replicas), router health/
-  failover counters, and the gateway's own HTTP counters.
+  counters (``ServingStats.merge`` across replicas) plus real
+  cumulative-bucket latency histograms, router health/failover counters,
+  process-wide XLA compile counters, and the gateway's own HTTP counters.
+* ``GET /debug/trace?id=<trace_id>`` — the fleet's buffered spans as
+  Chrome-trace/Perfetto JSON (``id`` narrows to one request; the id is
+  minted per request — or taken from the client's ``X-Request-Id``
+  header — and echoed in every response body and header).
 
 Backpressure and failure map onto HTTP status codes instead of queues
 growing without bound: every healthy replica's admission queue full →
@@ -44,12 +49,14 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
+from urllib.parse import parse_qs, urlparse
 
 import numpy as np
 
 from ..adapters.registry import AdapterBankFull
+from ..observability import clean_trace_id, new_trace_id
 from .engine import ServingEngine
-from .metrics import GatewayStats
+from .metrics import HISTOGRAM_NAMES, GatewayStats
 from .request import RequestStatus
 from .router import ReplicaSet
 from .scheduler import QueueFull
@@ -112,6 +119,29 @@ _STATUS_HTTP = {
 }
 
 
+#: Curated ``# HELP`` strings for the best-known /metrics families;
+#: anything unlisted gets a generic description (promlint only requires
+#: that every family HAS one).
+_METRIC_HELP = {
+    "accelerate_tpu_serving_ttft_ms":
+        "Mean time-to-first-token over retired requests (ms).",
+    "accelerate_tpu_serving_itl_ms":
+        "Mean inter-token latency over decode ticks (ms).",
+    "accelerate_tpu_serving_queue_wait_ms":
+        "Mean admission-queue wait over admitted requests (ms).",
+    "accelerate_tpu_serving_decode_tokens_per_sec":
+        "Committed decode tokens per second of decode-tick wall time.",
+    "accelerate_tpu_serving_fleet_failovers":
+        "Requests resubmitted to a survivor after their replica died.",
+    "accelerate_tpu_serving_fleet_fences":
+        "Replicas demoted to FAILED and taken out of rotation.",
+    "accelerate_tpu_gateway_http_requests":
+        "HTTP requests accepted past the connection cap.",
+    "accelerate_tpu_gateway_http_inflight":
+        "HTTP exchanges currently in flight.",
+}
+
+
 class _BadRequest(ValueError):
     """Client error carrying the 400 payload message."""
 
@@ -148,6 +178,12 @@ class ServingGateway:
         self._shutdown_lock = threading.Lock()
         self._conn_slots = threading.BoundedSemaphore(
             self.config.max_connections)
+        # One process-wide compile accounting for /metrics. jax.monitoring
+        # events are process-global, so the GATEWAY owns the single
+        # watcher — summing per-engine watchers would count every compile
+        # once per replica. Registered in start(), not here, so a gateway
+        # that is constructed but never served leaks no listeners.
+        self.compile_watcher = None
 
     # -- lifecycle --------------------------------------------------------
     def start(self):
@@ -156,6 +192,10 @@ class ServingGateway:
         :attr:`port` / :attr:`url`."""
         if self._server is not None:
             return
+        if self.compile_watcher is None:
+            from ..utils.profiling import CompileWatcher
+
+            self.compile_watcher = CompileWatcher().start()
         handler = type("GatewayHandler", (_Handler,), {"gateway": self})
         self._server = ThreadingHTTPServer(
             (self.config.host, self.config.port), handler)
@@ -208,6 +248,8 @@ class ServingGateway:
                 self._server.server_close()
                 self._server = None
                 self._thread = None
+            if self.compile_watcher is not None:
+                self.compile_watcher.stop()
             self.replica_set.shutdown(drain=drain, timeout=timeout)
 
     def install_signal_handlers(self, signals=(signal.SIGTERM,
@@ -239,11 +281,17 @@ class ServingGateway:
     # -- metrics ----------------------------------------------------------
     def metrics_text(self) -> str:
         """The ``/metrics`` body: Prometheus text exposition (version
-        0.0.4) of fleet-merged engine counters, router health/failover
-        counters, and the gateway's HTTP counters."""
+        0.0.4) of fleet-merged engine counters (gauges PLUS real
+        cumulative-bucket latency histograms), router health/failover
+        counters, process-wide XLA compile counters, and the gateway's
+        HTTP counters. Every family carries ``# HELP``/``# TYPE`` —
+        ``observability.promlint`` keeps this scrape-clean in tests."""
         lines = []
 
-        def emit(name, value, mtype="gauge"):
+        def emit(name, value, mtype="gauge", help_=None):
+            lines.append(f"# HELP {name} "
+                         + (help_ or _METRIC_HELP.get(
+                             name, f"accelerate-tpu serving-stack {mtype}.")))
             lines.append(f"# TYPE {name} {mtype}")
             v = float(value)
             lines.append(f"{name} {int(v) if v == int(v) else v}")
@@ -253,18 +301,52 @@ class ServingGateway:
             if k.startswith("adapter/"):
                 continue  # re-emitted below as properly labeled series
             emit(f"accelerate_tpu_serving_{k}", v)
+        # Latency distributions: the *_ms summary gauges above keep their
+        # names; the histogram twin gets a _hist-suffixed family so the
+        # two never collide in one exposition.
+        for hname, snap in sorted(merged.histograms().items()):
+            fam = f"accelerate_tpu_serving_{hname}_hist"
+            lines.append(f"# HELP {fam} Fleet-wide distribution of "
+                         f"{hname} (cumulative buckets, ms).")
+            lines.append(f"# TYPE {fam} histogram")
+            for bound, cum in snap["cumulative"]:
+                le = "+Inf" if bound == "+Inf" else str(float(bound))
+                lines.append(f'{fam}_bucket{{le="{le}"}} {cum}')
+            s = float(snap["sum"])
+            lines.append(f"{fam}_sum {int(s) if s == int(s) else s}")
+            lines.append(f"{fam}_count {snap['count']}")
         per_adapter = merged.per_adapter()
         if per_adapter:
             counters = sorted(next(iter(per_adapter.values())))
             for c in counters:
+                lines.append(
+                    f"# HELP accelerate_tpu_serving_adapter_{c} "
+                    f"Per-adapter {c} across the fleet.")
                 lines.append(
                     f"# TYPE accelerate_tpu_serving_adapter_{c} counter")
                 for name in sorted(per_adapter):
                     lines.append(
                         f'accelerate_tpu_serving_adapter_{c}'
                         f'{{adapter="{name}"}} {per_adapter[name][c]}')
+        if self.compile_watcher is not None:
+            cs = self.compile_watcher.summary()
+            emit("accelerate_tpu_xla_compile_events_total",
+                 cs["compile_events"], "counter",
+                 help_="XLA compile/trace events observed in-process since "
+                       "the gateway started (0 growth = zero-recompile "
+                       "steady state).")
+            emit("accelerate_tpu_xla_compile_seconds_total",
+                 cs["compile_secs"], "counter",
+                 help_="Wall seconds spent in observed XLA compiles.")
+            emit("accelerate_tpu_xla_compilation_cache_hits_total",
+                 cs["compilation_cache_hits"], "counter",
+                 help_="XLA compilation-cache hit events observed "
+                       "in-process.")
         for k, v in self.stats.summary().items():
             emit(f"accelerate_tpu_gateway_{k}", v)
+        lines.append(
+            "# HELP accelerate_tpu_gateway_responses_total "
+            "HTTP responses by route and status code.")
         lines.append(
             "# TYPE accelerate_tpu_gateway_responses_total counter")
         for (route, code), n in sorted(self.stats.by_route().items()):
@@ -288,11 +370,18 @@ class _Handler(BaseHTTPRequestHandler):
     # -- plumbing ---------------------------------------------------------
     def _send_json(self, code: int, payload: dict, route: str,
                    extra_headers: Optional[dict] = None,
-                   body_bytes_in: int = 0):
+                   body_bytes_in: int = 0,
+                   trace_id: Optional[str] = None):
+        if trace_id is not None:
+            # Correlation id rides both channels: the JSON body (clients
+            # that log payloads) and the X-Request-Id header (proxies).
+            payload.setdefault("trace_id", trace_id)
         body = json.dumps(payload).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        if trace_id is not None:
+            self.send_header("X-Request-Id", trace_id)
         for k, v in (extra_headers or {}).items():
             self.send_header(k, str(v))
         self.end_headers()
@@ -319,12 +408,14 @@ class _Handler(BaseHTTPRequestHandler):
     # -- GET --------------------------------------------------------------
     def do_GET(self):  # noqa: N802 (http.server naming)
         gw = self.gateway
-        if not self._conn_enter(self.path):
+        parsed = urlparse(self.path)
+        path = parsed.path
+        if not self._conn_enter(path):
             return
         try:
-            if self.path == "/healthz":
+            if path == "/healthz":
                 self._send_text(200, "ok\n", "/healthz")
-            elif self.path == "/readyz":
+            elif path == "/readyz":
                 if gw.ready:
                     self._send_text(200, "ready\n", "/readyz")
                 else:
@@ -332,14 +423,37 @@ class _Handler(BaseHTTPRequestHandler):
                                     "draining\n" if gw.draining
                                     else "no healthy replica\n",
                                     "/readyz", extra_headers=self._retry_after())
-            elif self.path == "/metrics":
+            elif path == "/metrics":
                 self._send_text(200, gw.metrics_text(), "/metrics",
                                 content_type="text/plain; version=0.0.4; "
                                              "charset=utf-8")
+            elif path == "/debug/trace":
+                self._debug_trace(parse_qs(parsed.query))
             else:
-                self._send_json(404, {"error": "not found"}, self.path)
+                self._send_json(404, {"error": "not found"}, path)
         finally:
             self._conn_exit()
+
+    def _debug_trace(self, query: dict):
+        """``GET /debug/trace`` — the whole fleet's buffered spans as one
+        Chrome-trace JSON; ``?id=<trace_id>`` narrows to one request's
+        timeline (404 when no replica buffered a span for that id)."""
+        route = "/debug/trace"
+        raw = (query.get("id") or [None])[0]
+        tid = None
+        if raw is not None:
+            tid = clean_trace_id(raw)
+            if tid is None:
+                self._send_json(400, {"error": "invalid trace id"}, route)
+                return
+        trace = self.gateway.replica_set.chrome_trace(tid)
+        if tid is not None and not any(
+                ev.get("ph") != "M" for ev in trace["traceEvents"]):
+            self._send_json(404, {"error": "trace not found",
+                                  "trace_id": tid}, route)
+            return
+        self._send_text(200, json.dumps(trace), route,
+                        content_type="application/json")
 
     # -- POST -------------------------------------------------------------
     def do_POST(self):  # noqa: N802
@@ -350,19 +464,26 @@ class _Handler(BaseHTTPRequestHandler):
         route = "/v1/completions"
         if not self._conn_enter(route):
             return
+        # Minted before anything can fail so even a 4xx/5xx body carries
+        # a correlation id (the client's own X-Request-Id when it sent a
+        # well-formed one).
+        trace_id = (clean_trace_id(self.headers.get("X-Request-Id"))
+                    or new_trace_id())
         try:
             if gw.draining:
                 self._send_json(503, {"error": "gateway draining"}, route,
-                                extra_headers=self._retry_after())
+                                extra_headers=self._retry_after(),
+                                trace_id=trace_id)
                 return
             try:
                 body, nbytes = self._read_body()
                 spec = self._parse_completion(body)
             except _BadRequest as e:
                 code = 413 if "max_body_bytes" in str(e) else 400
-                self._send_json(code, {"error": str(e)}, route)
+                self._send_json(code, {"error": str(e)}, route,
+                                trace_id=trace_id)
                 return
-            self._run_completion(spec, route, nbytes)
+            self._run_completion(spec, route, nbytes, trace_id)
         finally:
             self._conn_exit()
 
@@ -429,7 +550,8 @@ class _Handler(BaseHTTPRequestHandler):
             "stream": bool(body.get("stream", False)),
         }
 
-    def _run_completion(self, spec: dict, route: str, nbytes: int):
+    def _run_completion(self, spec: dict, route: str, nbytes: int,
+                        trace_id: str):
         gw = self.gateway
         stream = spec.pop("stream")
         token_q: Optional[queue.Queue] = queue.Queue() if stream else None
@@ -440,26 +562,27 @@ class _Handler(BaseHTTPRequestHandler):
                 seed=spec["seed"], timeout=spec["timeout"],
                 ignore_eos=spec["ignore_eos"],
                 adapter=spec["adapter"],
+                trace_id=trace_id,
                 on_token=token_q.put if stream else None)
         except QueueFull:
             self._send_json(429, {"error": "all replicas saturated; "
                                            "retry later"},
                             route, extra_headers=self._retry_after(),
-                            body_bytes_in=nbytes)
+                            body_bytes_in=nbytes, trace_id=trace_id)
             return
         except LookupError as e:
             self._send_json(404, {"error": "unknown_adapter",
                                   "detail": str(e)},
-                            route, body_bytes_in=nbytes)
+                            route, body_bytes_in=nbytes, trace_id=trace_id)
             return
         except RuntimeError as e:
             self._send_json(503, {"error": f"no healthy replica: {e}"},
                             route, extra_headers=self._retry_after(),
-                            body_bytes_in=nbytes)
+                            body_bytes_in=nbytes, trace_id=trace_id)
             return
         except ValueError as e:
             self._send_json(400, {"error": str(e)}, route,
-                            body_bytes_in=nbytes)
+                            body_bytes_in=nbytes, trace_id=trace_id)
             return
         if stream:
             self._stream_sse(fleet, token_q, route, nbytes)
@@ -475,23 +598,28 @@ class _Handler(BaseHTTPRequestHandler):
                 payload["detail"] = str(fleet.error)
                 self._send_json(503, payload, route,
                                 extra_headers=self._retry_after(),
-                                body_bytes_in=nbytes)
+                                body_bytes_in=nbytes, trace_id=trace_id)
                 return
             code, status = _STATUS_HTTP[fleet.status]
             payload = self._summary_payload(fleet, status)
             if code != 200:
                 payload["error"] = (str(fleet.error)
                                     if fleet.error is not None else status)
-            self._send_json(code, payload, route, body_bytes_in=nbytes)
+            self._send_json(code, payload, route, body_bytes_in=nbytes,
+                            trace_id=trace_id)
 
     @staticmethod
     def _summary_payload(fleet, status: str) -> dict:
+        # The single summary shape for JSON responses AND the SSE final
+        # done-event: trace_id here is what lets a client hand the id
+        # straight to GET /debug/trace.
         return {
             "status": status,
             "tokens": [int(t) for t in fleet.tokens],
             "prompt_len": int(fleet.prompt_ids.shape[1]),
             "failovers": fleet.failovers,
             "replica_trail": list(fleet.replica_trail),
+            "trace_id": fleet.trace_id,
         }
 
     def _stream_sse(self, fleet, token_q: queue.Queue, route: str,
@@ -505,6 +633,7 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Content-Type", "text/event-stream")
         self.send_header("Cache-Control", "no-cache")
         self.send_header("Connection", "close")
+        self.send_header("X-Request-Id", fleet.trace_id)
         self.end_headers()
         self.close_connection = True
         sent = 0

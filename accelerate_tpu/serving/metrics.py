@@ -27,6 +27,75 @@ the engine thread.
 from __future__ import annotations
 
 import threading
+from bisect import bisect_left
+
+#: Shared latency bucket bounds (milliseconds) for the exported
+#: histograms — wide enough to cover sub-ms CPU ticks and multi-second
+#: TPU prefills with one fixed layout, so fleet merges are a plain
+#: element-wise add.
+LATENCY_BUCKETS_MS = (1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
+                      500.0, 1000.0, 2500.0, 5000.0, 10000.0)
+
+#: The per-request phase latencies exported as Prometheus histograms.
+HISTOGRAM_NAMES = ("ttft_ms", "itl_ms", "queue_wait_ms", "prefill_chunk_ms")
+
+
+class LatencyHistogram:
+    """Fixed-bucket latency histogram (Prometheus-shaped).
+
+    Buckets are stored NON-cumulative internally (one ``observe`` is a
+    single bisect + increment); :meth:`cumulative` renders the
+    Prometheus view (running totals ending at the implicit ``+Inf``
+    bucket). Fixed shared bounds make :meth:`merge` an element-wise add,
+    which keeps fleet aggregation monotone under repeated merges. NOT
+    self-locking — the owner (:class:`ServingStats`) already serializes
+    access under its lock."""
+
+    __slots__ = ("bounds", "counts", "count", "sum")
+
+    def __init__(self, bounds: tuple = LATENCY_BUCKETS_MS):
+        self.bounds = tuple(float(b) for b in bounds)
+        if list(self.bounds) != sorted(set(self.bounds)):
+            raise ValueError(f"bucket bounds must be strictly increasing: {bounds}")
+        self.counts = [0] * (len(self.bounds) + 1)  # last = +Inf overflow
+        self.count = 0
+        self.sum = 0.0
+
+    def observe(self, value_ms: float) -> None:
+        self.counts[bisect_left(self.bounds, value_ms)] += 1
+        self.count += 1
+        self.sum += value_ms
+
+    def merge(self, other: "LatencyHistogram") -> "LatencyHistogram":
+        if other.bounds != self.bounds:
+            raise ValueError(
+                f"cannot merge histograms with different bounds: "
+                f"{self.bounds} vs {other.bounds}")
+        for i, c in enumerate(other.counts):
+            self.counts[i] += c
+        self.count += other.count
+        self.sum += other.sum
+        return self
+
+    def copy(self) -> "LatencyHistogram":
+        out = LatencyHistogram(self.bounds)
+        out.counts = list(self.counts)
+        out.count = self.count
+        out.sum = self.sum
+        return out
+
+    def cumulative(self) -> list:
+        """``[(le, cumulative_count)]`` ending at ``("+Inf", count)``."""
+        out, running = [], 0
+        for bound, c in zip(self.bounds, self.counts):
+            running += c
+            out.append((bound, running))
+        out.append(("+Inf", self.count))
+        return out
+
+    def snapshot(self) -> dict:
+        return {"bounds": self.bounds, "cumulative": self.cumulative(),
+                "sum": round(self.sum, 3), "count": self.count}
 
 
 class ServingStats:
@@ -85,6 +154,10 @@ class ServingStats:
             # Per-adapter (multi-tenant LoRA) counters:
             # name -> {requests, tokens, hits, misses, loads, evictions}.
             self._adapter: dict = {}
+            # Prometheus-shaped phase-latency histograms (fixed shared
+            # buckets; itl_ms observes each decode tick's wall time).
+            self._hists = {name: LatencyHistogram()
+                           for name in HISTOGRAM_NAMES}
 
     # -- caller side ----------------------------------------------------
     def record_submit(self, queue_depth: int):
@@ -111,6 +184,8 @@ class ServingStats:
             if len(self._ttft_samples) > self.MAX_TTFT_SAMPLES:
                 del self._ttft_samples[: len(self._ttft_samples) // 2]
             self._prefill_tokens += 1
+            self._hists["queue_wait_ms"].observe(queue_wait_ms)
+            self._hists["ttft_ms"].observe(ttft_ms)
 
     def record_tick(self, active_slots: int, committed_tokens: int,
                     max_slots: int, seconds: float):
@@ -121,6 +196,7 @@ class ServingStats:
             self._active_slot_sum += int(active_slots)
             self._slot_capacity_sum += int(max_slots)
             self._decode_tokens += int(committed_tokens)
+            self._hists["itl_ms"].observe(seconds * 1e3)
 
     def record_prefill_chunk(self, ms: float, backlog: int = 0):
         """One ``prefill_chunk`` execution; ``backlog`` is the number of
@@ -129,6 +205,7 @@ class ServingStats:
         with self._lock:
             self._prefill_chunks += 1
             self._prefill_ms_sum += ms
+            self._hists["prefill_chunk_ms"].observe(ms)
             self._prefill_backlog_last = int(backlog)
             self._prefill_backlog_max = max(self._prefill_backlog_max,
                                             int(backlog))
@@ -240,7 +317,14 @@ class ServingStats:
             o = dict(other.__dict__)
             o_samples = list(other._ttft_samples)
             o_adapter = {name: dict(e) for name, e in other._adapter.items()}
+            o_hists = {name: h.copy() for name, h in other._hists.items()}
         with self._lock:
+            for name, hist in o_hists.items():
+                mine = self._hists.get(name)
+                if mine is None:
+                    self._hists[name] = hist
+                else:
+                    mine.merge(hist)
             for name, entry in o_adapter.items():
                 mine = self._adapter_entry(name)
                 for k, v in entry.items():
@@ -269,6 +353,13 @@ class ServingStats:
         return self
 
     # -- reporting ------------------------------------------------------
+    def histograms(self) -> dict:
+        """``name -> {bounds, cumulative, sum, count}`` snapshot of the
+        phase-latency histograms — the gateway renders these as
+        Prometheus histogram families next to the scalar gauges."""
+        with self._lock:
+            return {name: h.snapshot() for name, h in self._hists.items()}
+
     @staticmethod
     def _percentile(samples: list[float], q: float) -> float:
         if not samples:

@@ -48,7 +48,8 @@ class Request:
                  timeout: Optional[float] = None,
                  on_token: Optional[Callable[[int], None]] = None,
                  ignore_eos: bool = False,
-                 adapter: Optional[str] = None):
+                 adapter: Optional[str] = None,
+                 trace_id: Optional[str] = None):
         ids = np.asarray(prompt_ids, np.int32)
         if ids.ndim == 1:
             ids = ids[None, :]
@@ -72,6 +73,12 @@ class Request:
                 f"adapter must be a non-empty string or None (got {adapter!r})")
         #: named LoRA adapter this request decodes under (None = base model).
         self.adapter = adapter
+        if trace_id is not None and (not isinstance(trace_id, str) or not trace_id):
+            raise ValueError(
+                f"trace_id must be a non-empty string or None (got {trace_id!r})")
+        #: correlation id carried through every lifecycle edge (gateway-minted
+        #: or client-supplied); engine spans and the SSE done-summary tag it.
+        self.trace_id = trace_id
 
         self.tokens: list[int] = []        # committed tokens, streamed order
         self.status = RequestStatus.QUEUED

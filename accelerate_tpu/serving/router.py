@@ -47,6 +47,7 @@ from typing import Callable, Optional, Sequence
 
 import numpy as np
 
+from ..observability import new_trace_id
 from .engine import ServingEngine
 from .metrics import ServingStats
 from .request import Request, RequestStatus
@@ -90,11 +91,12 @@ class FleetRequest:
                  timeout: Optional[float] = None,
                  on_token: Optional[Callable[[int], None]] = None,
                  ignore_eos: bool = False,
-                 adapter: Optional[str] = None):
+                 adapter: Optional[str] = None,
+                 trace_id: Optional[str] = None):
         # Reuse Request's prompt validation (shape + max_new bounds +
-        # adapter name form).
+        # adapter/trace id form).
         proto = Request(prompt_ids, max_new_tokens=max_new_tokens,
-                        adapter=adapter)
+                        adapter=adapter, trace_id=trace_id)
         self.prompt_ids = proto.prompt_ids
         self.max_new_tokens = proto.max_new_tokens
         self.rng = rng
@@ -104,6 +106,11 @@ class FleetRequest:
         self.ignore_eos = ignore_eos
         #: named LoRA adapter, preserved across failovers (None = base).
         self.adapter = proto.adapter
+        #: correlation id shared by every flight this request takes —
+        #: minted here (when the gateway didn't) so the spans a failover
+        #: leaves on replica A and the resumed spans on replica B carry
+        #: the SAME id and merge into one timeline.
+        self.trace_id = proto.trace_id or new_trace_id()
 
         self.tokens: list[int] = []
         self.status = RequestStatus.QUEUED
@@ -252,6 +259,9 @@ class ReplicaSet:
         self._failovers = 0      # fence-and-resubmit events (per request)
         self._fences = 0         # replicas demoted to FAILED
         self._failover_failed = 0  # resubmissions that found no home
+        # Bounded postmortem log: one entry per failover hop, carrying
+        # the dead replica's flight-recorder dump (see failover_reports).
+        self._failover_reports: list[dict] = []
 
     @classmethod
     def from_factory(cls, factory: Callable[[], ServingEngine],
@@ -404,6 +414,7 @@ class ReplicaSet:
                seed: Optional[int] = None, rng=None,
                timeout: Optional[float] = None, on_token=None,
                ignore_eos: bool = False, adapter: Optional[str] = None,
+               trace_id: Optional[str] = None,
                block: bool = False,
                block_timeout: Optional[float] = None) -> FleetRequest:
         """Route one request to the least-loaded healthy replica; returns
@@ -418,7 +429,7 @@ class ReplicaSet:
         fleet = FleetRequest(prompt_ids, max_new_tokens=max_new_tokens,
                              rng=rng, seed=seed, timeout=timeout,
                              on_token=on_token, ignore_eos=ignore_eos,
-                             adapter=adapter)
+                             adapter=adapter, trace_id=trace_id)
         fleet.submitted_at = time.monotonic()
         with self._lock:
             self._submitted += 1
@@ -502,7 +513,8 @@ class ReplicaSet:
                         rng=fleet.rng, seed=fleet.seed,
                         timeout=remaining_t, on_token=fleet._emit,
                         ignore_eos=fleet.ignore_eos,
-                        adapter=fleet.adapter)
+                        adapter=fleet.adapter,
+                        trace_id=fleet.trace_id)
         inner._on_finish = lambda req: self._on_inner_finish(
             fleet, replica, req)
         return inner
@@ -537,6 +549,20 @@ class ReplicaSet:
                 and replica.engine.error is not None \
                 and not fleet.cancel_requested:
             self._fence(replica)
+            # Attach the dead replica's postmortem (its engine froze the
+            # flight-recorder dump — fatal event included — before this
+            # retire sweep started) so the hop is debuggable after the
+            # fact without the replica.
+            report = {
+                "trace_id": fleet.trace_id,
+                "replica": replica.index,
+                "error": repr(replica.engine.error),
+                "tokens_at_failover": len(fleet.tokens),
+                "flight_recorder": replica.engine.postmortem(),
+            }
+            with self._lock:
+                self._failover_reports.append(report)
+                del self._failover_reports[:-32]  # keep the last 32 hops
             if fleet.failovers >= self._max_failovers:
                 fleet._finish(RequestStatus.FAILED, RuntimeError(
                     f"request failed over {fleet.failovers} times "
@@ -550,6 +576,26 @@ class ReplicaSet:
                            _raise=False)
             return
         fleet._finish(inner.status, inner.error)
+
+    @property
+    def failover_reports(self) -> list[dict]:
+        """Postmortems for the most recent failover hops (newest last):
+        ``{trace_id, replica, error, tokens_at_failover, flight_recorder}``
+        where ``flight_recorder`` is the dead engine's frozen event dump
+        (fatal event included). Bounded to the last 32 hops."""
+        with self._lock:
+            return list(self._failover_reports)
+
+    def chrome_trace(self, trace_id: Optional[str] = None) -> dict:
+        """One fleet-wide Chrome-trace dict: every replica's buffered
+        spans (optionally filtered to one ``trace_id``) merged onto the
+        shared monotonic timeline — a failed-over request shows its
+        replica-A spans next to its replica-B continuation. Backs the
+        gateway's ``GET /debug/trace``."""
+        from ..observability import merge_chrome_traces
+
+        return merge_chrome_traces(
+            r.engine.chrome_trace(trace_id) for r in self._replicas)
 
     # -- metrics ----------------------------------------------------------
     def merged_stats(self) -> ServingStats:

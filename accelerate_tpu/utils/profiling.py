@@ -18,6 +18,155 @@ if TYPE_CHECKING:  # pragma: no cover
     from .dataclasses import ProfileKwargs
 
 
+class CompileWatcher:
+    """Counts XLA compilations (and compilation-cache hits) in-process.
+
+    Wraps the ``jax.monitoring`` listener pair the zero-recompile test
+    suites used inline: the event-duration listener fires once per
+    compile/trace, the plain event listener carries compilation-cache
+    hits. Promoted here so the serving engine's flight recorder, the
+    gateway's ``/metrics`` endpoint, and the tests all share one
+    accounting of "did anything recompile".
+
+    ``events`` lists ONLY duration-listener matches — exactly what the
+    old inline listeners collected — so a zero-recompile pin is simply
+    ``assert not watcher.events``. Cache hits are counted separately
+    (a hit is the healthy steady state, not a recompile).
+
+    Thread-safe; ``start``/``stop`` are idempotent and ``stop`` always
+    unregisters (context-manager protocol supported)::
+
+        with CompileWatcher() as w:
+            serve_a_round()
+        assert not w.events, f"recompiled: {w.events}"
+
+    ``on_event(event_name, duration_s_or_None)`` is invoked outside the
+    lock for every recorded event (compiles with their duration, cache
+    hits with ``None``) — the engine uses it to mirror compile events
+    into its flight recorder. Callback exceptions are swallowed: the
+    listener runs inside XLA's compile path.
+    """
+
+    def __init__(self, include=("compile", "trace"), on_event=None):
+        self._include = tuple(include)
+        self._on_event = on_event
+        self._lock = threading.Lock()
+        self._events: list[tuple] = []   # (name, duration_s) compiles only
+        self._cache_hits = 0
+        self._registered = False
+        self._dur_listener = None
+        self._evt_listener = None
+
+    def _matches(self, event: str) -> bool:
+        return any(s in event for s in self._include)
+
+    def _record(self, event: str, duration_s: Optional[float]) -> None:
+        with self._lock:
+            if duration_s is None:
+                self._cache_hits += 1
+            else:
+                self._events.append((event, duration_s))
+        cb = self._on_event
+        if cb is not None:
+            try:
+                cb(event, duration_s)
+            except Exception:
+                pass
+
+    def start(self) -> "CompileWatcher":
+        """Register the listeners (no-op if already registered)."""
+        with self._lock:
+            if self._registered:
+                return self
+            self._registered = True
+
+            def on_duration(event, duration_s, **kw):
+                if self._matches(event):
+                    self._record(event, float(duration_s))
+
+            def on_plain(event, **kw):
+                if "cache_hit" in event:
+                    self._record(event, None)
+
+            self._dur_listener = on_duration
+            self._evt_listener = on_plain
+        import jax.monitoring
+
+        jax.monitoring.register_event_duration_secs_listener(on_duration)
+        jax.monitoring.register_event_listener(on_plain)
+        return self
+
+    def stop(self) -> None:
+        """Unregister the listeners (no-op if not registered)."""
+        with self._lock:
+            if not self._registered:
+                return
+            self._registered = False
+            dur, evt = self._dur_listener, self._evt_listener
+            self._dur_listener = self._evt_listener = None
+        # There is no public unregister API; the tests this class
+        # replaces used the same private hooks.
+        from jax._src import monitoring as _mon
+
+        _mon._unregister_event_duration_listener_by_callback(dur)
+        _mon._unregister_event_listener_by_callback(evt)
+
+    def __enter__(self) -> "CompileWatcher":
+        return self.start()
+
+    def __exit__(self, *exc) -> bool:
+        self.stop()
+        return False
+
+    def reset(self) -> None:
+        """Zero the counters without unregistering (post-warmup baseline)."""
+        with self._lock:
+            self._events = []
+            self._cache_hits = 0
+
+    @property
+    def events(self) -> list:
+        """Names of compile/trace events seen, in order (empty = no
+        recompiles since ``start``/``reset``)."""
+        with self._lock:
+            return [name for name, _ in self._events]
+
+    @property
+    def durations(self) -> list:
+        """``(event_name, duration_s)`` pairs for every compile seen."""
+        with self._lock:
+            return list(self._events)
+
+    @property
+    def total(self) -> int:
+        """Number of compile/trace events seen."""
+        with self._lock:
+            return len(self._events)
+
+    @property
+    def cache_hits(self) -> int:
+        """Compilation-cache hit events seen (plain-event listener)."""
+        with self._lock:
+            return self._cache_hits
+
+    def counts(self) -> dict:
+        """Per-event-name compile counts (``/metrics`` export)."""
+        out: dict = {}
+        with self._lock:
+            for name, _ in self._events:
+                out[name] = out.get(name, 0) + 1
+        return out
+
+    def summary(self) -> dict:
+        """Scalar snapshot: total compiles, total compile seconds, hits."""
+        with self._lock:
+            return {
+                "compile_events": len(self._events),
+                "compile_secs": round(sum(d for _, d in self._events), 6),
+                "compilation_cache_hits": self._cache_hits,
+            }
+
+
 class PipelineStats:
     """Step-time breakdown counters for the host input pipeline.
 
@@ -146,7 +295,7 @@ class ProfileSession:
 
     def __init__(self, kwargs: "ProfileKwargs", log_dir: Optional[str] = None,
                  pipeline_stats: Optional[PipelineStats] = None,
-                 serving_stats=None, gateway_stats=None):
+                 serving_stats=None, gateway_stats=None, tracer=None):
         self.kwargs = kwargs
         self.log_dir = log_dir or kwargs.output_trace_dir or "./jax_trace"
         sched = kwargs.schedule_option or {}
@@ -163,6 +312,12 @@ class ProfileSession:
         self.serving_stats = serving_stats
         self.gateway_stats = gateway_stats
         self._step_breakdowns: list[dict] = []
+        # Host-side span sink (observability.Tracer): each step() emits a
+        # "train_step" span in the same Chrome-trace format the serving
+        # engine uses, so a training timeline and a serving timeline can
+        # be merged into one Perfetto view.
+        self.tracer = tracer
+        self._last_step_t: Optional[float] = None
 
     def _should_trace(self) -> bool:
         if self.active is None:
@@ -193,6 +348,7 @@ class ProfileSession:
     def __enter__(self):
         if self._should_trace():
             self._start()
+        self._last_step_t = time.monotonic()
         return self
 
     def attach_pipeline_stats(self, stats: PipelineStats):
@@ -212,8 +368,28 @@ class ProfileSession:
         self.gateway_stats = stats
         return self
 
+    def attach_tracer(self, tracer):
+        """Attach an ``observability.Tracer`` so every ``step()`` emits a
+        ``train_step`` span (step-to-step wall time, with the input
+        pipeline's data-wait breakdown in ``args``)."""
+        self.tracer = tracer
+        self._last_step_t = time.monotonic()
+        return self
+
     def step(self):
         """Advance the schedule (reference: torch profiler .step())."""
+        if self.tracer is not None:
+            now = time.monotonic()
+            if self._last_step_t is not None:
+                args: dict = {"step": self._step}
+                if self.pipeline_stats is not None:
+                    s = self.pipeline_stats.summary()
+                    args["data_wait_ms"] = s["data_wait_ms_last"]
+                    args["stage_ms"] = s["stage_ms_last"]
+                self.tracer.emit("train_step", self._last_step_t,
+                                 now - self._last_step_t, cat="training",
+                                 args=args)
+            self._last_step_t = now
         if (self.pipeline_stats is not None or self.serving_stats is not None
                 or self.gateway_stats is not None):
             snap = {"step": self._step}

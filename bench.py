@@ -1102,6 +1102,61 @@ def speculative_bench(prompt_len: int = 5, new_tokens: int = 24,
     }
 
 
+def tracing_overhead_bench(n_requests: int = 10, prompt_len: int = 4,
+                           max_new_tokens: int = 16, repeats: int = 3) -> dict:
+    """Tracing on/off A/B: identical traffic through two warmed tiny-model
+    engines, one with the span tracer enabled (the default) and one with
+    ``tracing=False``. Reports each arm's best decode tokens/sec over
+    ``repeats`` windows (best-of damps host scheduler noise) and their
+    ratio — the acceptance budget for always-on tracing is ratio >= 0.95
+    (tracing must cost host-side tuple appends, never device work)."""
+    import numpy as np
+
+    def run(tracing: bool) -> dict:
+        engine, _, _, _ = _serving_test_engine(max_slots=4, tracing=tracing)
+        rng = np.random.default_rng(0)
+        prompts = rng.integers(1, 200,
+                               size=(n_requests, prompt_len)).astype(np.int32)
+        try:
+            best = 0.0
+            for _ in range(repeats):
+                engine.stats.reset()
+                reqs = [engine.submit(prompts[i:i + 1],
+                                      max_new_tokens=max_new_tokens,
+                                      seed=i, block=True)
+                        for i in range(n_requests)]
+                for r in reqs:
+                    r.wait(timeout=120)
+                best = max(best,
+                           engine.serving_metrics()["decode_tokens_per_sec"])
+            spans = len(engine.tracer)
+        finally:
+            engine.shutdown()
+        return {"decode_tokens_per_sec": best, "spans_buffered": spans}
+
+    off = run(tracing=False)
+    on = run(tracing=True)
+    return {
+        "n_requests": n_requests,
+        "max_new_tokens": max_new_tokens,
+        "repeats": repeats,
+        "tracing_off": off,
+        "tracing_on": on,
+        "overhead_ratio": round(
+            on["decode_tokens_per_sec"]
+            / max(off["decode_tokens_per_sec"], 1e-9), 4),
+    }
+
+
+def observability_extra(on_tpu: bool) -> dict:
+    """The ``extra.observability`` payload: the tracing on/off decode-
+    throughput A/B on the tiny model (CPU only; on TPU tracing rides the
+    tier-1 serving story, not an extra compile over the tunnel)."""
+    if on_tpu:
+        return {}
+    return {"tracing_overhead": tracing_overhead_bench()}
+
+
 def zero_sharding_bench(steps: int = 30, warmup: int = 5, dp: int = 2,
                         hidden: int = 512, ffn: int = 2048,
                         batch: int = 32) -> dict:
@@ -1394,6 +1449,15 @@ def run_bench(on_tpu: bool) -> dict:
                 result["extra"]["adapters"] = adapters
         except Exception as e:  # noqa: BLE001 - observability must not kill the result
             result["extra"]["adapters_error"] = f"{type(e).__name__}: {e}"
+        # Observability rider: tracing on/off decode-throughput A/B on the
+        # tiny serving model (CPU only; see observability_extra) — pins the
+        # <=5% budget for always-on request tracing next to the MFU story.
+        try:
+            obs = observability_extra(on_tpu)
+            if obs:
+                result["extra"]["observability"] = obs
+        except Exception as e:  # noqa: BLE001 - observability must not kill the result
+            result["extra"]["observability_error"] = f"{type(e).__name__}: {e}"
         # ZeRO optimizer-state sharding A/B: per-replica moment bytes and
         # step-time ratio, replicated vs dp-sharded (CPU only — the
         # multi-device A/B compiles four extra programs; on TPU that story

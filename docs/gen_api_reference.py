@@ -47,6 +47,13 @@ GROUPS = [
      "mesh-sliced tensor-parallel execution (one replica = a multi-chip "
      "slice), the multi-replica router (health states, fault-tolerant "
      "failover) and the stdlib HTTP gateway in front of it."),
+    ("observability", "Observability",
+     ["accelerate_tpu.observability.tracing",
+      "accelerate_tpu.observability.flight_recorder",
+      "accelerate_tpu.observability.promlint"],
+     "Request-scoped tracing (trace ids, per-thread span rings, "
+     "Chrome-trace export), the per-replica flight recorder behind "
+     "failover postmortems, and the Prometheus exposition linter."),
     ("adapters", "LoRA adapters",
      ["accelerate_tpu.adapters.lora", "accelerate_tpu.adapters.registry"],
      "Multi-tenant LoRA: config/init/merge and the frozen-base training "

@@ -56,6 +56,7 @@ LANES: dict[str, tuple[int, list[str]]] = {
         "test_host_offload.py",
         "test_memory_properties.py",
         "test_models.py",
+        "test_observability.py",
         "test_pipeline.py",
         "test_quantization.py",
         "test_serving.py",

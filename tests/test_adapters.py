@@ -62,6 +62,7 @@ from accelerate_tpu.adapters.lora import (  # noqa: E402
 )
 from accelerate_tpu.models.llama import LlamaConfig, LlamaForCausalLM  # noqa: E402
 from accelerate_tpu.serving import ServingEngine  # noqa: E402
+from accelerate_tpu.utils.profiling import CompileWatcher  # noqa: E402
 
 EOS = 7
 
@@ -435,33 +436,25 @@ class TestZeroRecompileAdapters:
                             prefix_cache_mb=4.0, adapters=bank)
         eng.register_adapter("a", _nonzero_adapter(params, 4, seed=31))
         eng.register_adapter("b", _nonzero_adapter(params, 4, seed=32))
-        compiles = []
-
-        def listener(event, duration, **kw):
-            if "compile" in event or "trace" in event:
-                compiles.append(event)
-
         prompt = np.array([[3, 5, 2, 9]], np.int32)
-        jax.monitoring.register_event_duration_secs_listener(listener)
         try:
-            # Fill both rows, then hot-register "c" and serve it — its
-            # load must evict the LRU resident with zero compiles.
-            for name in ("a", "b"):
-                eng.submit(prompt, max_new_tokens=4,
-                           adapter=name).result(timeout=120)
-            eng.register_adapter("c", _nonzero_adapter(params, 4, seed=33))
-            for name in ("c", "a", None, "b"):
-                eng.submit(prompt, max_new_tokens=4,
-                           adapter=name).result(timeout=120)
+            with CompileWatcher() as watcher:
+                # Fill both rows, then hot-register "c" and serve it — its
+                # load must evict the LRU resident with zero compiles.
+                for name in ("a", "b"):
+                    eng.submit(prompt, max_new_tokens=4,
+                               adapter=name).result(timeout=120)
+                eng.register_adapter("c", _nonzero_adapter(params, 4,
+                                                           seed=33))
+                for name in ("c", "a", None, "b"):
+                    eng.submit(prompt, max_new_tokens=4,
+                               adapter=name).result(timeout=120)
         finally:
-            from jax._src import monitoring as _mon
-
-            _mon._unregister_event_duration_listener_by_callback(listener)
             counters = bank.counters()
             eng.shutdown(drain=False)
-        assert not compiles, (
-            f"XLA recompiled after warmup: {compiles} — adapter membership "
-            "must be data (bank rows), never program shapes")
+        assert not watcher.events, (
+            f"XLA recompiled after warmup: {watcher.events} — adapter "
+            "membership must be data (bank rows), never program shapes")
         assert eng._prefill_chunk._cache_size() == 1
         # Paged + private alias cache restores by host page-table writes —
         # no compiled restore program exists to pin.

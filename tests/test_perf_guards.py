@@ -380,6 +380,39 @@ class TestGatewayOverhead:
         self._retry_once(attempt)
 
 
+class TestObservabilityOverhead:
+    """CPU guard for always-on tracing (bench.tracing_overhead_bench): with
+    the span tracer enabled the engine must keep >=95% of its untraced
+    decode throughput on identical traffic — the acceptance budget that
+    lets tracing default ON in production. The tracer is host-side tuple
+    appends into per-thread rings; if this ratio regresses, someone put
+    work (or a lock) on the decode hot path. Timing-driven and retried
+    once, same as the other guards."""
+
+    @staticmethod
+    def _retry_once(attempt):
+        try:
+            attempt()
+        except AssertionError:
+            attempt()
+
+    def test_tracing_keeps_95_percent_decode_throughput(self):
+        def attempt():
+            out = bench.tracing_overhead_bench()
+            assert out["overhead_ratio"] >= 0.95, (
+                f"tracing-on decode throughput is only "
+                f"{out['overhead_ratio']:.3f}x of tracing-off "
+                f"({out['tracing_on']['decode_tokens_per_sec']:.0f} vs "
+                f"{out['tracing_off']['decode_tokens_per_sec']:.0f} tok/s): "
+                "the span tracer is adding hot-path cost beyond ring appends")
+            # the traced arm must actually have traced something, and the
+            # untraced arm must be a true zero-overhead no-op
+            assert out["tracing_on"]["spans_buffered"] > 0
+            assert out["tracing_off"]["spans_buffered"] == 0
+
+        self._retry_once(attempt)
+
+
 class TestMultiTenantAdapters:
     """CPU guard for the adapter bank's serving win
     (bench.multi_tenant_adapter_bench): at 4 tenants, batching per-slot

@@ -41,6 +41,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from accelerate_tpu import generation  # noqa: E402
 from accelerate_tpu.models.llama import LlamaConfig, LlamaForCausalLM  # noqa: E402
+from accelerate_tpu.utils.profiling import CompileWatcher  # noqa: E402
 from accelerate_tpu.serving import (  # noqa: E402
     AdmissionQueue,
     PrefixCache,
@@ -259,27 +260,16 @@ class TestZeroRecompile:
         DIFFERENT prompt lengths into different slots runs only the two
         existing executables — jax.monitoring's per-compile events must
         stay silent across a full staggered round."""
-        compiles = []
-
-        def listener(event, duration, **kw):
-            if "compile" in event or "trace" in event:
-                compiles.append(event)
-
-        jax.monitoring.register_event_duration_secs_listener(listener)
-        try:
+        with CompileWatcher() as watcher:
             reqs = []
             for i, p in enumerate(PROMPTS):
                 reqs.append(engine.submit(p, max_new_tokens=6, seed=7 + i))
                 time.sleep(0.01)
             for r in reqs:
                 r.result(timeout=120)
-        finally:
-            from jax._src import monitoring as _mon
-
-            _mon._unregister_event_duration_listener_by_callback(listener)
-        assert not compiles, (
-            f"XLA recompiled after warmup: {compiles} — continuous batching "
-            "must change mask/state contents, never program shapes")
+        assert not watcher.events, (
+            f"XLA recompiled after warmup: {watcher.events} — continuous "
+            "batching must change mask/state contents, never program shapes")
 
 
 class TestChunkedExactness:
@@ -366,30 +356,22 @@ class TestZeroRecompileChunked:
         eng = ServingEngine(m, params, max_slots=2, max_len=384,
                             eos_token_id=EOS, prefill_chunk=128,
                             prefix_cache_mb=4.0)
-        compiles = []
-
-        def listener(event, duration, **kw):
-            if "compile" in event or "trace" in event:
-                compiles.append(event)
-
         rng = np.random.default_rng(3)
-        jax.monitoring.register_event_duration_secs_listener(listener)
         try:
-            reqs = []
-            for i, S in enumerate((3, 9, 140, 260, 300)):
-                p = rng.integers(0, 256, size=(1, S)).astype(np.int32)
-                reqs.append(eng.submit(p, max_new_tokens=6, seed=i))
-                time.sleep(0.01)
-            for r in reqs:
-                r.result(timeout=300)
+            with CompileWatcher() as watcher:
+                reqs = []
+                for i, S in enumerate((3, 9, 140, 260, 300)):
+                    p = rng.integers(0, 256, size=(1, S)).astype(np.int32)
+                    reqs.append(eng.submit(p, max_new_tokens=6, seed=i))
+                    time.sleep(0.01)
+                for r in reqs:
+                    r.result(timeout=300)
         finally:
-            from jax._src import monitoring as _mon
-
-            _mon._unregister_event_duration_listener_by_callback(listener)
             eng.shutdown(drain=False)
-        assert not compiles, (
-            f"XLA recompiled after warmup: {compiles} — chunked prefill must "
-            "serve every prompt length with the one fixed-shape executable")
+        assert not watcher.events, (
+            f"XLA recompiled after warmup: {watcher.events} — chunked "
+            "prefill must serve every prompt length with the one "
+            "fixed-shape executable")
         assert eng._prefill_chunk._cache_size() == 1
         # The paged engine's private prefix cache restores by page-table
         # aliasing on the host — it compiles NO restore program (steady

@@ -63,6 +63,10 @@ from accelerate_tpu.serving import (  # noqa: E402
     ServingGateway,
     ServingStats,
 )
+from accelerate_tpu.observability import (  # noqa: E402
+    lint_prometheus_text,
+    validate_chrome_trace,
+)
 
 EOS = 7
 
@@ -115,10 +119,10 @@ def _fleet(m, params, n=2, **kw):
 
 
 # -- HTTP helpers ------------------------------------------------------
-def _post(url, payload, timeout=60):
+def _post(url, payload, timeout=60, headers=None):
     req = urllib.request.Request(
         url + "/v1/completions", data=json.dumps(payload).encode(),
-        headers={"Content-Type": "application/json"})
+        headers={"Content-Type": "application/json", **(headers or {})})
     try:
         with urllib.request.urlopen(req, timeout=timeout) as resp:
             return resp.status, json.loads(resp.read()), dict(resp.headers)
@@ -134,12 +138,12 @@ def _get(url, path, timeout=10):
         return e.code, e.read().decode()
 
 
-def _sse(url, payload, timeout=60):
+def _sse(url, payload, timeout=60, headers=None):
     """(streamed tokens, final summary event)."""
     req = urllib.request.Request(
         url + "/v1/completions",
         data=json.dumps(dict(payload, stream=True)).encode(),
-        headers={"Content-Type": "application/json"})
+        headers={"Content-Type": "application/json", **(headers or {})})
     tokens, final = [], None
     with urllib.request.urlopen(req, timeout=timeout) as resp:
         assert resp.headers["Content-Type"].startswith("text/event-stream")
@@ -404,6 +408,7 @@ class TestGatewayHTTP:
         _assert_matches_offline(tokens, _offline(m, params, p, n), n)
         assert final["done"] and final["status"] == "completed"
         assert final["tokens"] == tokens  # summary == stream, no dup/loss
+        assert final["trace_id"]  # done-summary carries the correlation id
 
     def test_nested_prompt_and_default_max_new(self, gateway):
         code, out, _ = _post(gateway.url,
@@ -441,6 +446,15 @@ class TestGatewayHTTP:
         assert any(l.startswith(
             'accelerate_tpu_gateway_responses_total{route="/v1/completions"')
             for l in lines)
+        # The whole exposition is scrape-clean (HELP/TYPE per family,
+        # cumulative buckets ending at +Inf, no duplicate series).
+        assert lint_prometheus_text(text) == []
+        for hist in ("ttft_ms", "itl_ms", "queue_wait_ms",
+                     "prefill_chunk_ms"):
+            fam = f"accelerate_tpu_serving_{hist}_hist"
+            assert f"# TYPE {fam} histogram" in text
+            assert f'{fam}_bucket{{le="+Inf"}}' in text
+        assert "accelerate_tpu_xla_compile_events_total" in text
 
     def test_bad_requests_get_400(self, gateway):
         for payload in ({}, {"prompt": []}, {"prompt": "text"},
@@ -475,6 +489,7 @@ class TestGatewayHTTP:
         try:
             code, out, _ = _post(gw.url, {"prompt": [1] * 500})
             assert code == 413 and "max_body_bytes" in out["error"]
+            assert out["trace_id"]
         finally:
             gw.shutdown()
 
@@ -505,6 +520,7 @@ class TestGatewayBackpressure:
                                                 "max_new_tokens": 2})
             assert code == 429
             assert "Retry-After" in headers
+            assert out["trace_id"] == headers["X-Request-Id"]
             for b in blockers:
                 b.cancel()
             for b in blockers:
@@ -528,6 +544,7 @@ class TestGatewayBackpressure:
                                           "max_new_tokens": 2,
                                           "timeout": 0.1})
             assert code == 408 and out["status"] == "timed_out"
+            assert out["trace_id"]
             blocker.cancel()
             blocker.wait(timeout=120)
         finally:
@@ -550,6 +567,145 @@ class TestGatewayBackpressure:
             gw.shutdown()
 
 
+class TestGatewayTracing:
+    def test_trace_id_minted_and_echoed(self, gateway):
+        # No header -> the gateway mints one and echoes it body + header.
+        code, out, headers = _post(gateway.url, {
+            "prompt": [1, 2, 3], "max_new_tokens": 2, "seed": 0})
+        assert code == 200 and out["trace_id"]
+        assert headers["X-Request-Id"] == out["trace_id"]
+        # Well-formed client id -> carried through verbatim.
+        code, out, headers = _post(
+            gateway.url, {"prompt": [1, 2, 3], "max_new_tokens": 2,
+                          "seed": 0},
+            headers={"X-Request-Id": "client-id_1.2:3"})
+        assert out["trace_id"] == "client-id_1.2:3"
+        assert headers["X-Request-Id"] == "client-id_1.2:3"
+        # Garbage client id -> sanitized away, fresh id minted.
+        code, out, _ = _post(
+            gateway.url, {"prompt": [1, 2, 3], "max_new_tokens": 2,
+                          "seed": 0},
+            headers={"X-Request-Id": "bad id\twith junk"})
+        assert out["trace_id"] and out["trace_id"] != "bad id\twith junk"
+
+    def test_error_bodies_carry_trace_id(self, gateway):
+        # 400 (malformed) and 404-adapter-style errors happen before a
+        # FleetRequest exists; the minted id must still be in the body.
+        code, out, headers = _post(gateway.url, {"prompt": "text"},
+                                   headers={"X-Request-Id": "err-path-1"})
+        assert code == 400 and out["trace_id"] == "err-path-1"
+        assert headers["X-Request-Id"] == "err-path-1"
+
+    def test_debug_trace_endpoint(self, gateway):
+        tid = "debug-trace-probe-1"
+        code, out, _ = _post(gateway.url,
+                             {"prompt": [2, 4, 6], "max_new_tokens": 3,
+                              "seed": 0},
+                             headers={"X-Request-Id": tid})
+        assert code == 200 and out["trace_id"] == tid
+        code, body = _get(gateway.url, f"/debug/trace?id={tid}")
+        assert code == 200
+        trace = json.loads(body)
+        assert validate_chrome_trace(trace) == []
+        evs = [e for e in trace["traceEvents"] if e.get("ph") != "M"]
+        names = {e["name"] for e in evs}
+        assert {"submit", "queue_wait", "first_token",
+                "prefill_chunk", "itl", "retire"} <= names
+        assert all(e["args"]["trace_id"] == tid for e in evs
+                   if "args" in e and "trace_id" in e.get("args", {}))
+        # Unfiltered dump is the whole fleet timeline, still valid.
+        code, body = _get(gateway.url, "/debug/trace")
+        assert code == 200
+        assert validate_chrome_trace(json.loads(body)) == []
+        # Unknown id -> 404, malformed id -> 400.
+        assert _get(gateway.url, "/debug/trace?id=nosuchtrace0000")[0] == 404
+        assert _get(gateway.url, "/debug/trace?id=bad%20id%09junk")[0] == 400
+
+    @pytest.mark.slow
+    def test_failover_trace_spans_both_replicas(self, sleepy):
+        """The e2e observability acceptance test: an SSE stream survives a
+        replica kill; the final done-summary carries the client's trace
+        id, /debug/trace?id= returns ONE valid Chrome trace whose spans
+        cover the dead replica's prefill/decode AND the survivor's
+        resumed continuation, and the failover report carries the dead
+        replica's flight-recorder postmortem with the fatal event."""
+        m, params = sleepy
+        rs = _fleet(m, params, n=2, max_slots=4, prefill_chunk=16,
+                    prefix_cache_mb=4.0)
+        gw = ServingGateway(rs, config=GatewayConfig(port=0))
+        gw.start()
+        tid = "failover-e2e-trace"
+        n = 24
+        ref = _offline(m, params, PROMPTS[0], n)
+        try:
+            # Keep both replicas occupied so the kill has streams on each.
+            ballast = [rs.submit(p, max_new_tokens=n, seed=0)
+                       for p in PROMPTS[1:3]]
+            got = {}
+
+            def client():
+                got["tokens"], got["final"] = _sse(
+                    gw.url, {"prompt": PROMPTS[0][0].tolist(),
+                             "max_new_tokens": n, "seed": 0},
+                    timeout=120, headers={"X-Request-Id": tid})
+
+            t = threading.Thread(target=client, daemon=True)
+            t.start()
+            # Wait until the traced stream is decoding, then kill its host.
+            deadline = time.monotonic() + 60
+            victim = None
+            while time.monotonic() < deadline:
+                evs = rs.chrome_trace(tid)["traceEvents"]
+                itl = [e for e in evs if e["name"] == "itl"]
+                if len(itl) >= 3:
+                    victim = next(i for i, r in enumerate(rs.replicas)
+                                  if r.engine.tracer.pid == itl[0]["pid"])
+                    break
+                time.sleep(0.005)
+            assert victim is not None, "traced stream never started decoding"
+            rs.kill_replica(victim)
+            t.join(timeout=120)
+            assert not t.is_alive(), "SSE client did not finish"
+            # Stream resumed exactly; the done-summary carries OUR id.
+            _assert_matches_offline(got["tokens"], ref, n)
+            final = got["final"]
+            assert final["trace_id"] == tid
+            assert final["failovers"] == 1
+            assert final["replica_trail"] == [victim, 1 - victim]
+            for b in ballast:
+                b.wait(timeout=120)
+            # One valid Chrome trace spanning both replicas' pid lanes.
+            code, body = _get(gw.url, f"/debug/trace?id={tid}")
+            assert code == 200
+            trace = json.loads(body)
+            assert validate_chrome_trace(trace) == []
+            span_pids = {e["pid"] for e in trace["traceEvents"]
+                         if e.get("ph") != "M"}
+            pid_a = rs.engine(victim).tracer.pid
+            pid_b = rs.engine(1 - victim).tracer.pid
+            assert {pid_a, pid_b} <= span_pids
+            by_pid = {}
+            for e in trace["traceEvents"]:
+                if e.get("ph") != "M":
+                    by_pid.setdefault(e["pid"], set()).add(e["name"])
+            # Replica A saw the original queue->prefill->decode spans ...
+            assert {"queue_wait", "prefill_chunk", "itl"} <= by_pid[pid_a]
+            # ... and the survivor re-admitted + decoded the continuation.
+            assert {"queue_wait", "prefill_chunk", "itl"} <= by_pid[pid_b]
+            # The failover report attaches the dead replica's postmortem.
+            reports = [r for r in rs.failover_reports
+                       if r["trace_id"] == tid]
+            assert len(reports) == 1
+            rep = reports[0]
+            assert rep["replica"] == victim
+            pm = rep["flight_recorder"]
+            assert pm is not None and pm["events"]
+            kinds = [e["kind"] for e in pm["events"]]
+            assert "fatal" in kinds and "kill" in kinds
+        finally:
+            gw.shutdown(drain=False)
+
+
 class TestDrainSemantics:
     @pytest.mark.slow
     def test_drain_stops_admission_finishes_inflight(self, sleepy):
@@ -570,6 +726,7 @@ class TestDrainSemantics:
             code, out, headers = _post(gw.url, {"prompt": [1, 2],
                                                 "max_new_tokens": 2})
             assert code == 503 and "Retry-After" in headers
+            assert out["trace_id"]  # every error body carries the id
             # ...but liveness holds and the in-flight stream completes.
             assert _get(gw.url, "/healthz")[0] == 200
             assert inflight.wait(timeout=120)

@@ -49,6 +49,7 @@ from accelerate_tpu.serving.mesh_exec import (  # noqa: E402
     SlicePlan,
     validate_serving_mesh,
 )
+from accelerate_tpu.utils.profiling import CompileWatcher  # noqa: E402
 
 pytestmark = pytest.mark.skipif(
     jax.device_count() < 4,
@@ -247,14 +248,7 @@ class TestZeroRecompileMesh:
         """After warmup a tp=2 slice serves a mixed-length round (one- and
         multi-chunk prompts, a repeat prompt for the restore path) through
         EXACTLY the three warm executables with zero new XLA compiles."""
-        compiles = []
-
-        def listener(event, *_a, **_k):
-            if "compile" in event or "trace" in event:
-                compiles.append(event)
-
-        jax.monitoring.register_event_duration_secs_listener(listener)
-        try:
+        with CompileWatcher() as watcher:
             reqs = []
             for i, p in enumerate(PROMPTS + [LONG_PROMPT, LONG_PROMPT]):
                 reqs.append(tp2_engine.submit(p, max_new_tokens=8,
@@ -262,13 +256,9 @@ class TestZeroRecompileMesh:
                 time.sleep(0.002 * i)
             for r in reqs:
                 r.result(timeout=120)
-        finally:
-            from jax._src import monitoring as _mon
-
-            _mon._unregister_event_duration_listener_by_callback(listener)
-        assert not compiles, (
-            f"XLA recompiled after warmup: {compiles} — mesh slicing must "
-            "shard the three warm programs, not multiply them")
+        assert not watcher.events, (
+            f"XLA recompiled after warmup: {watcher.events} — mesh slicing "
+            "must shard the three warm programs, not multiply them")
         assert tp2_engine._prefill_chunk._cache_size() == 1
         assert tp2_engine._decode._cache_size() == 1
         # Paged + private alias cache: prefix restores are host page-table

@@ -53,6 +53,7 @@ from accelerate_tpu.serving import (  # noqa: E402
     RequestStatus,
     ServingEngine,
 )
+from accelerate_tpu.utils.profiling import CompileWatcher  # noqa: E402
 
 EOS = 7
 
@@ -213,31 +214,22 @@ class TestZeroRecompilePaged:
         eng = ServingEngine(m, params, max_slots=3, max_len=64,
                             eos_token_id=EOS, prefill_chunk=8,
                             prefix_cache_mb=4.0)
-        compiles = []
-
-        def listener(event, duration, **kw):
-            if "compile" in event or "trace" in event:
-                compiles.append(event)
-
         rng = np.random.default_rng(9)
         long = rng.integers(0, 256, size=(1, 33)).astype(np.int32)
-        jax.monitoring.register_event_duration_secs_listener(listener)
         try:
-            reqs = []
-            # tail repeat of the multi-chunk prompt -> alias restore
-            for p in PROMPTS + [long, long]:
-                reqs.append(eng.submit(p, max_new_tokens=6, seed=3))
-                time.sleep(0.01)
-            for r in reqs:
-                r.result(timeout=120)
+            with CompileWatcher() as watcher:
+                reqs = []
+                # tail repeat of the multi-chunk prompt -> alias restore
+                for p in PROMPTS + [long, long]:
+                    reqs.append(eng.submit(p, max_new_tokens=6, seed=3))
+                    time.sleep(0.01)
+                for r in reqs:
+                    r.result(timeout=120)
         finally:
-            from jax._src import monitoring as _mon
-
-            _mon._unregister_event_duration_listener_by_callback(listener)
             eng.shutdown(drain=False)
-        assert not compiles, (
-            f"XLA recompiled after warmup: {compiles} — paging must move "
-            "page-table CONTENTS, never program shapes")
+        assert not watcher.events, (
+            f"XLA recompiled after warmup: {watcher.events} — paging must "
+            "move page-table CONTENTS, never program shapes")
         assert eng._prefill_chunk._cache_size() == 1
         assert eng._restore_prefix is None  # alias restores are host writes
         assert eng._decode._cache_size() == 1
@@ -250,28 +242,19 @@ class TestZeroRecompilePaged:
                             prefix_cache_mb=0.0,
                             draft_model=m, draft_params=params,
                             spec_tokens=4)
-        compiles = []
-
-        def listener(event, duration, **kw):
-            if "compile" in event or "trace" in event:
-                compiles.append(event)
-
-        jax.monitoring.register_event_duration_secs_listener(listener)
         try:
-            reqs = []
-            for p in PROMPTS:
-                reqs.append(eng.submit(p, max_new_tokens=8))
-                time.sleep(0.01)
-            for r in reqs:
-                r.result(timeout=120)
+            with CompileWatcher() as watcher:
+                reqs = []
+                for p in PROMPTS:
+                    reqs.append(eng.submit(p, max_new_tokens=8))
+                    time.sleep(0.01)
+                for r in reqs:
+                    r.result(timeout=120)
         finally:
-            from jax._src import monitoring as _mon
-
-            _mon._unregister_event_duration_listener_by_callback(listener)
             eng.shutdown(drain=False)
-        assert not compiles, (
-            f"XLA recompiled after warmup: {compiles} — draft length and "
-            "acceptance count are data, not shapes")
+        assert not watcher.events, (
+            f"XLA recompiled after warmup: {watcher.events} — draft length "
+            "and acceptance count are data, not shapes")
         assert eng._prefill_chunk._cache_size() == 1
         assert eng._spec._cache_size() == 1
         # a spec engine never runs the plain decode tick — every decode
