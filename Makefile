@@ -7,6 +7,7 @@
 #   make test-multiprocess  real jax.distributed  (~8 min)
 #   make test-all           default suite, no -x (one flake can't hide the rest)
 #   make test-nightly       + exhaustive nightly variants (-m "")
+#   make chaos              self-healing drill: supervisor + chaos tests, slow incl.
 #
 # Dev loop: run test-fast after every change; the others before a commit
 # that touches their area; test-all before shipping. Exhaustive
@@ -14,7 +15,7 @@
 
 PYTHON ?= python
 
-.PHONY: test-fast test-models test-subproc test-multiprocess test-all test-nightly quality serve-demo
+.PHONY: test-fast test-models test-subproc test-multiprocess test-all test-nightly chaos quality serve-demo
 
 test-fast:
 	$(PYTHON) -m pytest -q $$($(PYTHON) tests/lanes.py fast)
@@ -33,6 +34,11 @@ test-all:
 
 test-nightly:
 	$(PYTHON) -m pytest -q -m "" tests/
+
+# The full chaos drill: supervisor watchdog/restart/breaker units plus the
+# slow self-healing scenarios (hang fence, mid-prefill kill, soak).
+chaos:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest -q -m "" tests/test_serving_supervisor.py
 
 quality:
 	$(PYTHON) -m compileall -q accelerate_tpu bench.py bench_watch.py __graft_entry__.py

@@ -59,6 +59,7 @@ def _parse_adapter_specs(specs):
 
 def serve_command(args) -> int:
     from ..serving import (
+        FleetSupervisor,
         GatewayConfig,
         ReplicaSet,
         ServingEngine,
@@ -126,6 +127,15 @@ def serve_command(args) -> int:
                              max_connections=args.max_connections))
     gateway.start()
     gateway.install_signal_handlers()
+    supervisor = None
+    if args.supervise:
+        supervisor = FleetSupervisor(
+            replica_set, hang_timeout_s=args.hang_timeout,
+            max_restarts=args.max_restarts)
+        supervisor.start()
+        print(f"supervisor on (hang_timeout={args.hang_timeout:g}s, "
+              f"max_restarts={args.max_restarts} before the circuit "
+              "breaker parks a replica)", flush=True)
     print(f"serving on {gateway.url}  "
           "(POST /v1/completions, GET /healthz /readyz /metrics "
           "/debug/trace)",
@@ -137,6 +147,8 @@ def serve_command(args) -> int:
             time.sleep(0.2)
     except KeyboardInterrupt:
         pass
+    if supervisor is not None:
+        supervisor.stop()  # before replica shutdown: no restarts of drained engines
     gateway.shutdown(drain=True)  # idempotent; covers the no-signal path
     print("gateway drained; bye", flush=True)
     return 0
@@ -204,6 +216,20 @@ def serve_command_parser(subparsers=None):
                         help="Preload a saved adapter (save_adapter dir) "
                              "under NAME on every replica; repeatable. "
                              "Implies an adapter bank sized to fit")
+    parser.add_argument("--supervise", action="store_true",
+                        help="Run a FleetSupervisor over the replicas: "
+                             "heartbeat watchdog fencing hung engines, "
+                             "auto-restart of failed replicas (rebuild + "
+                             "re-warm + adapter re-registration), and a "
+                             "crash-loop circuit breaker")
+    parser.add_argument("--hang-timeout", type=float, default=10.0,
+                        help="Supervisor watchdog: heartbeat silence (s) "
+                             "past which a live, error-less replica is "
+                             "fenced as hung")
+    parser.add_argument("--max-restarts", type=int, default=3,
+                        help="Supervisor circuit breaker: restart attempts "
+                             "per replica within the window before it is "
+                             "parked in CRASH_LOOP")
     parser.add_argument("--trace-dir", default=None,
                         help="Directory each replica dumps its Chrome-trace "
                              "span buffer and flight-recorder events into on "

@@ -37,7 +37,20 @@ Public surface:
   /v1/completions`` (JSON + SSE streaming), ``/healthz`` / ``/readyz`` /
   ``/metrics`` (Prometheus text with latency histograms) /
   ``/debug/trace`` (Chrome-trace JSON), backpressure mapped to HTTP
-  status codes, graceful drain on SIGTERM.
+  status codes — including 429 load shedding on *projected* KV-page
+  pressure with a drain-rate-derived ``Retry-After`` — and graceful
+  drain on SIGTERM.
+* :class:`FleetSupervisor` / :class:`HungReplicaError` — the
+  self-healing control loop: a heartbeat watchdog that fences replicas
+  hung without an error, auto-restart of FAILED replicas through the
+  fleet's retained engine factories (re-warm, adapter re-registration,
+  exponential backoff), and a crash-loop circuit breaker that parks
+  flapping replicas in ``CRASH_LOOP``. See
+  ``docs/usage_guides/fault_tolerance.md``.
+* :class:`ChaosSchedule` / :class:`ChaosKilled` — deterministic fault
+  injection keyed on decode ticks (scripted kill / hang / slow-tick),
+  the harness the fault-tolerance tests and ``bench.py
+  extra.serving.chaos`` drive the supervisor with.
 
 Every request carries a ``trace_id`` (gateway-minted or the client's
 ``X-Request-Id``): engines drop per-edge spans — queue wait, prefill
@@ -59,6 +72,7 @@ pass ``adapter="name"`` to ``submit`` / the gateway's JSON body. See
 See ``docs/usage_guides/serving.md``.
 """
 
+from .chaos import ChaosKilled, ChaosSchedule
 from .engine import ServingEngine
 from .gateway import GatewayConfig, ServingGateway
 from .mesh_exec import SliceExec, SlicePlan
@@ -72,6 +86,7 @@ from .scheduler import (
     QueueFull,
     SlotScheduler,
 )
+from .supervisor import FleetSupervisor, HungReplicaError
 
 __all__ = [
     "ServingEngine",
@@ -91,4 +106,8 @@ __all__ = [
     "SliceExec",
     "ServingGateway",
     "GatewayConfig",
+    "FleetSupervisor",
+    "HungReplicaError",
+    "ChaosSchedule",
+    "ChaosKilled",
 ]

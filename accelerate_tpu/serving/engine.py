@@ -240,6 +240,10 @@ class ServingEngine:
       trace_dir: when set, the engine writes ``<name>-trace.json`` /
         ``<name>-flight.json`` here on shutdown or death (the
         ``accelerate-tpu serve --trace-dir`` plumbing).
+      chaos: an optional :class:`~.chaos.ChaosSchedule` of scripted
+        faults (kill at decode tick T, hang via heartbeat suppression,
+        slow ticks) applied from the run loop — the deterministic
+        fault-injection harness behind the self-healing tests.
       autostart: spawn the engine thread (and warm up) in the constructor.
       warmup: run dummy requests through every program at start so the
         first real request never pays a compile; stats, spans, and
@@ -265,6 +269,7 @@ class ServingEngine:
                  tracing: bool = True, trace_capacity: int = 4096,
                  flight_capacity: int = 256,
                  trace_dir: Optional[str] = None,
+                 chaos=None,
                  autostart: bool = True, warmup: bool = True,
                  idle_poll_s: float = 0.005):
         from ..big_modeling import cache_factory_for
@@ -658,6 +663,24 @@ class ServingEngine:
         self._fail_injection: Optional[BaseException] = None
         self._thread: Optional[threading.Thread] = None
         self._warmup_on_start = bool(warmup)
+
+        # Liveness + fault-injection hooks (see serving/supervisor.py and
+        # serving/chaos.py): the run loop publishes a monotonic heartbeat
+        # every iteration so a watchdog can tell a HUNG engine (stalled
+        # loop, error still None) from a dead one; a ChaosSchedule, when
+        # attached, injects scripted faults keyed on the decode-tick
+        # counter. ``_heartbeat_frozen`` is the chaos harness's hang mode:
+        # the loop keeps running but stops publishing, which to a watchdog
+        # is indistinguishable from a wedged compiled call.
+        self._chaos = chaos
+        self._loop_iters = 0
+        self._decode_ticks = 0
+        self._heartbeat = (0, time.monotonic())
+        self._heartbeat_frozen = False
+        # Page-drain samples (wall time, cumulative pool frees) the shed
+        # path turns into a pages/s rate; engine-thread writes, any-thread
+        # reads of an immutable tuple snapshot.
+        self._drain_samples: collections.deque = collections.deque(maxlen=256)
         if autostart:
             self.start()
 
@@ -1213,6 +1236,8 @@ class ServingEngine:
                     "compile", event=event, duration_s=duration_s))
             self._compile_watcher.start()
         self._accepting = True
+        self._heartbeat = (self._loop_iters, time.monotonic())
+        self._heartbeat_frozen = False
         self._thread = threading.Thread(target=self._run,
                                         name="serving-engine", daemon=True)
         self._thread.start()
@@ -1352,6 +1377,58 @@ class ServingEngine:
             return 0
         needed = -(-int(total_tokens) // self._page)
         return max(0, needed - self._pool.free_pages)
+
+    @property
+    def heartbeat(self) -> tuple:
+        """``(loop_iterations, wall_time)`` published by the run loop at
+        the top of EVERY iteration (idle iterations included — the loop
+        polls the queue at ``idle_poll_s``, so a live engine republishes
+        many times a second). A watchdog that sees the wall time stall
+        while :attr:`error` stays None is looking at a HUNG engine — e.g.
+        a compiled call that never returned — which lazy health checks
+        can never catch (see :class:`~.supervisor.FleetSupervisor`)."""
+        return self._heartbeat
+
+    @property
+    def decode_ticks(self) -> int:
+        """Decode ticks executed since construction — the deterministic
+        clock :class:`~.chaos.ChaosSchedule` keys scripted faults on
+        (ticks advance with token progress, unlike wall time)."""
+        return self._decode_ticks
+
+    def page_drain_rate(self, window_s: float = 15.0) -> float:
+        """Observed pool page-free rate (pages/second) over the last
+        ``window_s`` of decode ticks, 0.0 when dense or not yet observed.
+        The gateway divides a projected page deficit by this to derive
+        Retry-After for a pressure shed — "the pool frees ~N pages/s, so
+        your M-page deficit clears in about M/N seconds"."""
+        if not self._paged:
+            return 0.0
+        samples = list(self._drain_samples)
+        if len(samples) < 2:
+            return 0.0
+        now = time.monotonic()
+        recent = [s for s in samples if now - s[0] <= window_s]
+        if len(recent) < 2:
+            recent = samples[-2:]
+        (t0, f0), (t1, f1) = recent[0], recent[-1]
+        if t1 <= t0 or f1 <= f0:
+            return 0.0
+        return (f1 - f0) / (t1 - t0)
+
+    def projected_page_deficit(self, total_tokens: int) -> int:
+        """Pages the pool is short if this request is admitted BEHIND the
+        work already queued: ``ceil(total_tokens / page) + ceil(queued
+        footprint / page) - free_pages``, floored at 0 (dense engines are
+        never short). Unlike :meth:`page_deficit` this counts the
+        admission queue's projected demand too — the signal behind the
+        gateway's projected-pressure 429 (ROADMAP's "429 on projected
+        pool pressure rather than queue depth")."""
+        if not self._paged or total_tokens <= 0:
+            return 0
+        needed = -(-int(total_tokens) // self._page)
+        queued = -(-int(self._queue.pending_tokens) // self._page)
+        return max(0, needed + queued - self._pool.free_pages)
 
     @property
     def load(self) -> float:
@@ -1614,6 +1691,16 @@ class ServingEngine:
     def _run(self):
         try:
             while not self._stop:
+                # Liveness first: apply any scripted chaos (which may set
+                # the fail injection we check next), then publish the
+                # heartbeat — unless a chaos hang suppresses it, in which
+                # case a watchdog sees exactly what a wedged compiled call
+                # looks like while the loop itself keeps serving.
+                self._loop_iters += 1
+                if self._chaos is not None:
+                    self._chaos.apply(self)
+                if not self._heartbeat_frozen:
+                    self._heartbeat = (self._loop_iters, time.monotonic())
                 if self._fail_injection is not None:
                     # Routed through the normal engine-fatal path below, so
                     # an injected fault is indistinguishable from a real one
@@ -2212,6 +2299,7 @@ class ServingEngine:
                 self._retire(req, RequestStatus.COMPLETED)
             elif self._page_window is not None:
                 self._free_window_pages(req)
+        self._decode_ticks += 1
         self._stats.record_tick(active_slots=len(running),
                                 committed_tokens=committed,
                                 max_slots=self.max_slots, seconds=dt)
@@ -2223,9 +2311,11 @@ class ServingEngine:
                 tracer.emit("itl", t0, dt, trace_id=req.trace_id,
                             args={"slot": slot, "token": len(req.tokens)})
         if self._paged:
+            self._drain_samples.append((time.monotonic(), self._pool.frees))
             self._stats.record_pages(self._pool.free_pages,
                                      self._pool.used_pages,
-                                     self._pool.num_pages)
+                                     self._pool.num_pages,
+                                     freed_total=self._pool.frees)
 
     def _tick_spec(self, running):
         """One speculative tick: up to ``spec_tokens + 1`` tokens per slot
@@ -2285,6 +2375,7 @@ class ServingEngine:
             if not retired and self._page_window is not None:
                 self._free_window_pages(req)
         self._stats.record_spec(proposed=K * len(running), accepted=accepted)
+        self._decode_ticks += 1
         self._stats.record_tick(active_slots=len(running),
                                 committed_tokens=committed,
                                 max_slots=self.max_slots, seconds=dt)
@@ -2297,9 +2388,11 @@ class ServingEngine:
                 tracer.emit("itl", t0, dt, trace_id=req.trace_id,
                             args={"slot": slot, "token": len(req.tokens),
                                   "accepted": int(ns[slot]) - 1})
+        self._drain_samples.append((time.monotonic(), self._pool.frees))
         self._stats.record_pages(self._pool.free_pages,
                                  self._pool.used_pages,
-                                 self._pool.num_pages)
+                                 self._pool.num_pages,
+                                 freed_total=self._pool.frees)
 
     def _commit_token(self, req: Request, token: int) -> bool:
         """Append + stream one token. A raising ``on_token`` callback fails

@@ -24,7 +24,12 @@ and sockets, all model work stays on the engine threads) exposing:
 
 Backpressure and failure map onto HTTP status codes instead of queues
 growing without bound: every healthy replica's admission queue full →
-**429** with ``Retry-After``; per-request deadline expired → **408**;
+**429** with ``Retry-After``; projected KV-page demand of admitted +
+queued work past the paged pools' headroom (and not clearing within
+``shed_wait_s`` at the observed page-drain rate) → **429** whose
+``Retry-After`` is *derived from that drain rate*, shedding work the
+queues would accept and then time out on; per-request deadline expired
+→ **408**;
 request body over the cap → **413**; connection cap hit, gateway
 draining, or no healthy replica → **503**; malformed request → **400**.
 Multi-tenant LoRA maps the same way: ``"adapter"`` naming an adapter no
@@ -85,9 +90,22 @@ class GatewayConfig:
         ``None`` defers entirely to the engines' ``max_len`` check.
       default_timeout_s: per-request deadline applied when the body
         omits ``timeout``; ``None`` means no deadline.
-      retry_after_s: value of the ``Retry-After`` header on 429/503.
+      retry_after_s: floor for the ``Retry-After`` header on 429/503
+        (queue-full and drain refusals use it as-is).
       drain_grace_s: how long ``shutdown(drain=True)`` waits for
         in-flight HTTP exchanges before proceeding anyway.
+      shed_projected_pressure: refuse (429) a completion whose projected
+        KV-page demand — together with everything already admitted and
+        queued — cannot be covered by the paged pools within
+        ``shed_wait_s`` at the fleet's *observed* page-drain rate.
+        This sheds load the queues would otherwise accept and then time
+        out on. With no observed drain yet (cold start) or on dense
+        engines nothing is shed.
+      shed_wait_s: the pressure-shed horizon: admit as long as the
+        projected page deficit clears within this many seconds of
+        observed drain.
+      retry_after_max_s: cap on the drain-rate-derived ``Retry-After``
+        of a pressure shed (the floor is ``retry_after_s``).
     """
 
     def __init__(self, *, host: str = "127.0.0.1", port: int = 0,
@@ -96,9 +114,14 @@ class GatewayConfig:
                  max_new_tokens_cap: Optional[int] = None,
                  default_timeout_s: Optional[float] = None,
                  retry_after_s: float = 1.0,
-                 drain_grace_s: float = 30.0):
+                 drain_grace_s: float = 30.0,
+                 shed_projected_pressure: bool = True,
+                 shed_wait_s: float = 5.0,
+                 retry_after_max_s: float = 60.0):
         if max_body_bytes < 1 or max_connections < 1:
             raise ValueError("max_body_bytes and max_connections must be >= 1")
+        if shed_wait_s <= 0 or retry_after_max_s <= 0:
+            raise ValueError("shed_wait_s and retry_after_max_s must be > 0")
         self.host = host
         self.port = int(port)
         self.max_body_bytes = int(max_body_bytes)
@@ -108,6 +131,9 @@ class GatewayConfig:
         self.default_timeout_s = default_timeout_s
         self.retry_after_s = float(retry_after_s)
         self.drain_grace_s = float(drain_grace_s)
+        self.shed_projected_pressure = bool(shed_projected_pressure)
+        self.shed_wait_s = float(shed_wait_s)
+        self.retry_after_max_s = float(retry_after_max_s)
 
 
 #: request terminal status -> (HTTP code, wire status string)
@@ -135,6 +161,20 @@ _METRIC_HELP = {
         "Requests resubmitted to a survivor after their replica died.",
     "accelerate_tpu_serving_fleet_fences":
         "Replicas demoted to FAILED and taken out of rotation.",
+    "accelerate_tpu_serving_fleet_restarts":
+        "Fenced replicas rebuilt, re-warmed, and returned to rotation.",
+    "accelerate_tpu_serving_fleet_hang_fences":
+        "Replicas fenced by the supervisor watchdog on heartbeat stall "
+        "(engine alive but silent past hang_timeout).",
+    "accelerate_tpu_serving_fleet_crash_loops":
+        "Replicas parked in CRASH_LOOP by the restart circuit breaker.",
+    "accelerate_tpu_serving_replicas_crash_loop":
+        "Replicas currently parked in CRASH_LOOP awaiting operator reset.",
+    "accelerate_tpu_serving_fleet_page_drain_rate":
+        "Observed KV pages freed per second across healthy replicas.",
+    "accelerate_tpu_gateway_pressure_sheds":
+        "Completions refused (429) on projected KV-page pressure rather "
+        "than queue depth.",
     "accelerate_tpu_gateway_http_requests":
         "HTTP requests accepted past the connection cap.",
     "accelerate_tpu_gateway_http_inflight":
@@ -405,6 +445,32 @@ class _Handler(BaseHTTPRequestHandler):
     def _retry_after(self) -> dict:
         return {"Retry-After": f"{self.gateway.config.retry_after_s:g}"}
 
+    def _pressure_retry_after(self, spec: dict) -> Optional[float]:
+        """Projected-pressure shed decision: a ``Retry-After`` in seconds
+        when this completion should be 429'd, else None (admit).
+
+        Sheds only when (a) the fleet's least-loaded paged pool cannot
+        cover this request's worst-case page demand on top of what is
+        already admitted + queued, AND (b) pages have been *observed*
+        draining but too slowly to clear that deficit within
+        ``shed_wait_s``. Rule (b) means a cold fleet (nothing freed yet)
+        or a dense fleet never sheds — queue-depth 429s and deadline
+        408s keep covering those.
+        """
+        cfg = self.gateway.config
+        if not cfg.shed_projected_pressure:
+            return None
+        rs = self.gateway.replica_set
+        total = int(spec["prompt_ids"].shape[-1]) + int(spec["max_new_tokens"])
+        deficit = rs.projected_page_deficit(total)
+        if deficit <= 0:
+            return None
+        rate = rs.page_drain_rate()
+        if rate <= 0 or deficit <= rate * cfg.shed_wait_s:
+            return None
+        return min(max(deficit / rate, cfg.retry_after_s),
+                   cfg.retry_after_max_s)
+
     # -- GET --------------------------------------------------------------
     def do_GET(self):  # noqa: N802 (http.server naming)
         gw = self.gateway
@@ -419,10 +485,16 @@ class _Handler(BaseHTTPRequestHandler):
                 if gw.ready:
                     self._send_text(200, "ready\n", "/readyz")
                 else:
-                    self._send_text(503,
-                                    "draining\n" if gw.draining
-                                    else "no healthy replica\n",
-                                    "/readyz", extra_headers=self._retry_after())
+                    if gw.draining:
+                        body = "draining\n"
+                    else:
+                        fm = gw.replica_set.fleet_metrics()
+                        looped = int(fm.get("replicas_crash_loop", 0))
+                        body = ("no healthy replica"
+                                + (f" ({looped} crash-looped)" if looped
+                                   else "") + "\n")
+                    self._send_text(503, body, "/readyz",
+                                    extra_headers=self._retry_after())
             elif path == "/metrics":
                 self._send_text(200, gw.metrics_text(), "/metrics",
                                 content_type="text/plain; version=0.0.4; "
@@ -554,6 +626,16 @@ class _Handler(BaseHTTPRequestHandler):
                         trace_id: str):
         gw = self.gateway
         stream = spec.pop("stream")
+        retry_in = self._pressure_retry_after(spec)
+        if retry_in is not None:
+            gw.stats.record_pressure_shed()
+            self._send_json(
+                429, {"error": "projected KV page pressure: admitted and "
+                               "queued work exceeds pool headroom; "
+                               "retry later"},
+                route, extra_headers={"Retry-After": f"{retry_in:g}"},
+                body_bytes_in=nbytes, trace_id=trace_id)
+            return
         token_q: Optional[queue.Queue] = queue.Queue() if stream else None
         try:
             fleet = gw.replica_set.submit(

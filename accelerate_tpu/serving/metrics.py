@@ -146,6 +146,7 @@ class ServingStats:
             self._pages_free = 0
             self._pages_used = 0
             self._pages_total = 0
+            self._pages_freed = 0
             self._preemptions = 0
             # Speculative decoding: draft proposals vs target acceptances.
             self._spec_ticks = 0
@@ -223,12 +224,16 @@ class ServingStats:
             self._prefix_alias_chunks += int(aliased)
             self._prefix_restored_bytes += int(bytes_restored)
 
-    def record_pages(self, free: int, used: int, total: int):
-        """Gauge: paged-KV pool occupancy after a tick (page counts)."""
+    def record_pages(self, free: int, used: int, total: int,
+                     freed_total: int = 0):
+        """Gauge: paged-KV pool occupancy after a tick (page counts).
+        ``freed_total`` mirrors the pool's cumulative free count — the
+        page-drain observable behind the gateway's pressure Retry-After."""
         with self._lock:
             self._pages_free = int(free)
             self._pages_used = int(used)
             self._pages_total = int(total)
+            self._pages_freed = int(freed_total)
 
     def record_preemption(self):
         """A running request was evicted at a chunk/tick boundary because
@@ -340,6 +345,7 @@ class ServingStats:
                       "_queue_depth_last", "_prefill_backlog_last",
                       "_prefix_cache_bytes", "_prefix_cache_entries",
                       "_pages_free", "_pages_used", "_pages_total",
+                      "_pages_freed",
                       "_preemptions", "_spec_ticks", "_spec_proposed",
                       "_spec_accepted"):
                 setattr(self, k, getattr(self, k) + o[k])
@@ -424,6 +430,7 @@ class ServingStats:
                 "page_utilization": round(
                     self._pages_used / self._pages_total, 4)
                     if self._pages_total else 0.0,
+                "pages_freed": self._pages_freed,
                 "preemptions": self._preemptions,
                 # Speculative decoding (all zero on a non-spec engine).
                 "spec_ticks": self._spec_ticks,
@@ -481,6 +488,7 @@ class GatewayStats:
             self._streams = 0
             self._tokens_streamed = 0
             self._bytes_in = 0
+            self._pressure_sheds = 0
 
     def record_response(self, route: str, code: int, body_bytes: int = 0):
         """One finished HTTP exchange on ``route`` with status ``code``."""
@@ -488,6 +496,14 @@ class GatewayStats:
             key = (str(route), int(code))
             self._responses[key] = self._responses.get(key, 0) + 1
             self._bytes_in += int(body_bytes)
+
+    def record_pressure_shed(self):
+        """One 429 issued on PROJECTED KV-page pressure (pool headroom
+        short for admitted + queued demand) rather than queue depth —
+        distinguishes proactive sheds from queue-full backpressure in the
+        overall 429 count."""
+        with self._lock:
+            self._pressure_sheds += 1
 
     def record_stream(self, tokens: int):
         """One SSE stream that delivered ``tokens`` token events."""
@@ -536,4 +552,5 @@ class GatewayStats:
                 "streams": self._streams,
                 "tokens_streamed": self._tokens_streamed,
                 "request_bytes_in": self._bytes_in,
+                "pressure_sheds": self._pressure_sheds,
             }

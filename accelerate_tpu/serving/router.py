@@ -18,7 +18,12 @@ independently failing engine replicas behind one submit surface:
   streams — operator-initiated via :meth:`ReplicaSet.drain_replica`) →
   FAILED (fenced). Health is refreshed lazily on every routing decision
   and metrics read — an engine whose run loop recorded a fatal error is
-  demoted without any monitor thread.
+  demoted without any monitor thread. A
+  :class:`~.supervisor.FleetSupervisor` layers ACTIVE health on top:
+  heartbeat-watchdog fencing of hung (error-less) replicas, factory
+  rebuilds of FAILED ones (RESTARTING → HEALTHY via
+  :meth:`ReplicaSet.restart_replica`), and a circuit breaker parking a
+  replica that keeps dying in CRASH_LOOP.
 * **Failover** — a replica whose run loop raises fails every request it
   held (the engine's own cleanup path). The router hooks each request's
   terminal transition: when the cause of death was the ENGINE (not the
@@ -57,9 +62,11 @@ __all__ = ["ReplicaSet", "ReplicaState", "FleetRequest"]
 
 
 class ReplicaState(enum.Enum):
-    HEALTHY = "healthy"     # in rotation, taking new requests
-    DRAINING = "draining"   # out of rotation, finishing in-flight streams
-    FAILED = "failed"       # fenced: run loop died or operator killed it
+    HEALTHY = "healthy"         # in rotation, taking new requests
+    DRAINING = "draining"       # out of rotation, finishing in-flight streams
+    FAILED = "failed"           # fenced: run loop died or operator killed it
+    RESTARTING = "restarting"   # fenced, replacement engine being built
+    CRASH_LOOP = "crash_loop"   # circuit open: too many restarts in a window
 
 
 class _Replica:
@@ -70,6 +77,7 @@ class _Replica:
         self.engine = engine
         self.state = ReplicaState.HEALTHY
         self.failures = 0  # requests this replica failed over FROM
+        self.restarts = 0  # successful engine rebuilds (supervisor)
 
     def __repr__(self):
         return (f"_Replica({self.index}, {self.state.value}, "
@@ -127,6 +135,13 @@ class FleetRequest:
         self._done = threading.Event()
         self._lock = threading.Lock()
         self._inner: Optional[Request] = None
+        #: the most recently BUILT inner flight — the only one whose
+        #: tokens may reach :meth:`_emit_from`. Normally identical to
+        #: ``_inner``; it diverges exactly when a hung engine was
+        #: force-retired by the supervisor and later unwedged: its stale
+        #: flight keeps committing tokens, and this guard is what keeps
+        #: them out of a stream that already resumed elsewhere.
+        self._flight: Optional[Request] = None
 
     # -- caller API (mirrors Request) -----------------------------------
     def cancel(self):
@@ -173,10 +188,15 @@ class FleetRequest:
         return np.concatenate([self.prompt_ids, toks[None, :]], axis=1)
 
     # -- router internals ------------------------------------------------
-    def _emit(self, token: int):
+    def _emit_from(self, inner: "Request", token: int):
         """Inner on_token trampoline: runs on whichever engine thread owns
-        the current flight. Exceptions propagate so the engine applies its
-        normal callback-failure isolation (fail THIS request only)."""
+        the current flight. Tokens from a STALE flight (an abandoned hung
+        engine still committing after its requests were failed over) are
+        dropped — exactly-once emission must hold across force-retires
+        too. Callback exceptions propagate so the engine applies its
+        normal isolation (fail THIS request only)."""
+        if self._flight is not inner:
+            return
         if self.first_token_at is None:
             self.first_token_at = time.monotonic()
         self.tokens.append(token)
@@ -238,7 +258,8 @@ class ReplicaSet:
 
     def __init__(self, engines: Sequence[ServingEngine], *,
                  failover_block_s: float = 5.0,
-                 max_failovers: Optional[int] = None):
+                 max_failovers: Optional[int] = None,
+                 factories: Optional[Sequence[Optional[Callable]]] = None):
         engines = list(engines)
         if not engines:
             raise ValueError("ReplicaSet needs at least one engine")
@@ -254,11 +275,32 @@ class ReplicaSet:
         self._failover_block_s = float(failover_block_s)
         self._max_failovers = (len(engines) - 1 if max_failovers is None
                                else int(max_failovers))
+        # Per-replica zero-arg engine builders (None = this replica cannot
+        # be rebuilt). from_factory/from_mesh fill these in; a supervisor
+        # uses them through restart_replica to return FAILED replicas to
+        # rotation.
+        if factories is None:
+            self._factories: list[Optional[Callable]] = [None] * len(engines)
+        else:
+            self._factories = list(factories)
+            if len(self._factories) != len(engines):
+                raise ValueError(
+                    f"factories must match engines 1:1 "
+                    f"(got {len(self._factories)} for {len(engines)})")
+        # name -> (adapter, kwargs), in registration order — replayed onto
+        # a rebuilt replica's bank so restarts stay tenant-preserving.
+        self._adapter_registry: dict = {}
+        # Counters folded out of engines that were replaced: merged_stats
+        # adds this in so fleet totals stay MONOTONE across restarts.
+        self._retired_stats = ServingStats()
         self._lock = threading.Lock()
         self._submitted = 0
         self._failovers = 0      # fence-and-resubmit events (per request)
         self._fences = 0         # replicas demoted to FAILED
         self._failover_failed = 0  # resubmissions that found no home
+        self._restarts = 0       # replicas rebuilt back to HEALTHY
+        self._hang_fences = 0    # fences on heartbeat stall (watchdog)
+        self._crash_loops = 0    # circuit-breaker trips to CRASH_LOOP
         # Bounded postmortem log: one entry per failover hop, carrying
         # the dead replica's flight-recorder dump (see failover_reports).
         self._failover_reports: list[dict] = []
@@ -268,10 +310,13 @@ class ReplicaSet:
                      num_replicas: int, **kwargs) -> "ReplicaSet":
         """Build ``num_replicas`` engines by calling ``factory()`` that
         many times (each call should construct an independent engine —
-        sharing params between them is fine and saves host memory)."""
+        sharing params between them is fine and saves host memory). The
+        factory is RETAINED per replica, so a :class:`~.supervisor.
+        FleetSupervisor` can rebuild a dead replica from it."""
         if num_replicas < 1:
             raise ValueError(f"num_replicas must be >= 1 (got {num_replicas})")
-        return cls([factory() for _ in range(num_replicas)], **kwargs)
+        return cls([factory() for _ in range(num_replicas)],
+                   factories=[factory] * num_replicas, **kwargs)
 
     @classmethod
     def from_mesh(cls, model, params=None, *, tp: int,
@@ -309,8 +354,7 @@ class ReplicaSet:
         if (share_prefix_cache and cache_mb > 0
                 and engine_kwargs.get("prefill_chunk", 256) is not None):
             shared_cache = PrefixCache(int(cache_mb * 2 ** 20))
-        engines = []
-        for i in range(len(plan)):
+        def _build_slice(i: int) -> ServingEngine:
             kw = dict(engine_kwargs)
             if make_adapters is not None:
                 kw["adapters"] = make_adapters()
@@ -318,10 +362,17 @@ class ReplicaSet:
                 kw["prefix_cache"] = shared_cache
             else:
                 kw["prefix_cache_mb"] = cache_mb
-            engines.append(ServingEngine(model, params,
-                                         mesh=plan.build_mesh(i), **kw))
+            return ServingEngine(model, params,
+                                 mesh=plan.build_mesh(i), **kw)
+
+        engines = [_build_slice(i) for i in range(len(plan))]
+        # Per-slice rebuild closures: a restarted slice engine gets the
+        # SAME mesh, a fresh bank, and the fleet-shared prefix cache — so
+        # prefixes its predecessor inserted are warm hits immediately.
         fleet = cls(engines, failover_block_s=failover_block_s,
-                    max_failovers=max_failovers)
+                    max_failovers=max_failovers,
+                    factories=[(lambda i=i: _build_slice(i))
+                               for i in range(len(plan))])
         fleet.slice_plan = plan
         return fleet
 
@@ -347,17 +398,28 @@ class ReplicaSet:
         return self._replicas[index].engine
 
     # -- health ----------------------------------------------------------
+    #: states a fence/kill must leave alone: FAILED is already fenced
+    #: (double-fencing would double-count and, via kill, re-inject a fault
+    #: into a replacement engine), RESTARTING is mid-rebuild, and
+    #: CRASH_LOOP is deliberately parked — only restart_replica or
+    #: reset_circuit move a replica out of these.
+    _FENCED_STATES = (ReplicaState.FAILED, ReplicaState.RESTARTING,
+                      ReplicaState.CRASH_LOOP)
+
     def refresh_health(self):
         """Demote any replica whose engine died since the last look. Lazy —
         called on every routing decision and metrics read, so there is no
-        monitor thread to keep alive (or to crash)."""
+        monitor thread to keep alive (or to crash); a
+        :class:`~.supervisor.FleetSupervisor` adds the ACTIVE checks
+        (heartbeat watchdog, auto-restart) on top."""
         for r in self._replicas:
-            if r.state is not ReplicaState.FAILED and r.engine.error is not None:
+            if (r.state not in self._FENCED_STATES
+                    and r.engine.error is not None):
                 self._fence(r)
 
     def _fence(self, replica: _Replica):
         with self._lock:
-            if replica.state is ReplicaState.FAILED:
+            if replica.state in self._FENCED_STATES:
                 return
             replica.state = ReplicaState.FAILED
             self._fences += 1
@@ -374,8 +436,135 @@ class ReplicaSet:
                      error: Optional[BaseException] = None):
         """Fault injection / hard fencing: make replica ``index``'s run
         loop raise at its next iteration (see ``ServingEngine.kill``). Its
-        in-flight requests fail over to the surviving replicas."""
-        self._replicas[index].engine.kill(error)
+        in-flight requests fail over to the surviving replicas.
+        Idempotent: a replica already fenced (FAILED / RESTARTING /
+        CRASH_LOOP) is left alone — its requests were already resubmitted
+        once, and a second kill must not re-inject a fault into the
+        replacement engine a restart may have installed meanwhile."""
+        r = self._replicas[index]
+        with self._lock:
+            if r.state in self._FENCED_STATES:
+                return
+        r.engine.kill(error)
+
+    # -- self-healing (used by FleetSupervisor; callable manually) --------
+    def restart_replica(self, index: int, *,
+                        join_timeout: float = 5.0) -> ServingEngine:
+        """Rebuild a FAILED replica from its retained factory and return
+        it to HEALTHY rotation: wait for the dead engine's thread (a
+        truly wedged one is abandoned — it is a daemon thread whose
+        requests were already failed over), build + warm a replacement
+        (the factory runs the normal three-executable warmup), replay
+        every fleet adapter registration onto its bank, fold the dead
+        engine's counters into the retired-stats ledger (fleet totals
+        stay monotone), and only THEN swap it in. Raises ``RuntimeError``
+        when the replica has no factory or is not FAILED, and propagates
+        factory/warmup errors — the caller (supervisor) counts those as
+        failed attempts toward the circuit breaker."""
+        r = self._replicas[index]
+        factory = self._factories[index]
+        if factory is None:
+            raise RuntimeError(
+                f"replica {index} has no factory (build the fleet with "
+                "from_factory/from_mesh, or pass factories= to ReplicaSet)")
+        with self._lock:
+            if r.state is not ReplicaState.FAILED:
+                raise RuntimeError(
+                    f"replica {index} is {r.state.value}, not failed — "
+                    "only a fenced replica can be restarted")
+            r.state = ReplicaState.RESTARTING
+        old = r.engine
+        try:
+            # The old engine's thread must be DONE retiring its requests
+            # before the swap: _on_inner_finish closures read
+            # ``replica.engine.error`` to classify a failure as
+            # engine-death, and swapping early would make a late retire
+            # read the replacement's None error and skip failover.
+            thread = old._thread
+            if thread is not None and thread.is_alive():
+                old._stop = True
+                thread.join(join_timeout)
+            try:
+                old.shutdown(drain=False, timeout=1.0)
+            except Exception:
+                pass  # a dead engine re-raises its own fatal error here
+            new_engine = factory()
+            new_engine.start()  # no-op unless the factory used autostart=False
+            if not new_engine.healthy:
+                raise RuntimeError(
+                    "replacement engine came up unhealthy"
+                ) from new_engine.error
+            if (new_engine.eos_token_id != self.eos_token_id
+                    or new_engine._sampling != old._sampling):
+                raise ValueError(
+                    "factory built an engine whose eos/sampling config "
+                    "disagrees with the fleet — failover would change the "
+                    "stream's distribution")
+            with self._lock:
+                registry = list(self._adapter_registry.items())
+            for name, (adapter, kwargs) in registry:
+                new_engine.register_adapter(name, adapter, **kwargs)
+        except BaseException:
+            with self._lock:
+                r.state = ReplicaState.FAILED
+            raise
+        with self._lock:
+            self._retired_stats.merge(old.stats)
+            r.engine = new_engine
+            r.state = ReplicaState.HEALTHY
+            r.restarts += 1
+            self._restarts += 1
+        return new_engine
+
+    def trip_breaker(self, index: int):
+        """Park a FAILED replica in CRASH_LOOP: it leaves the restart
+        rotation entirely (no further rebuild attempts, excluded from
+        routing, kill_replica no-ops) until :meth:`reset_circuit`. The
+        supervisor calls this when restarts exceed its window budget."""
+        r = self._replicas[index]
+        with self._lock:
+            if r.state is ReplicaState.CRASH_LOOP:
+                return
+            r.state = ReplicaState.CRASH_LOOP
+            self._crash_loops += 1
+
+    def reset_circuit(self, index: int):
+        """Operator override: move a CRASH_LOOP replica back to FAILED so
+        the supervisor may try restarting it again (e.g. after the
+        poisoned host was actually fixed)."""
+        r = self._replicas[index]
+        with self._lock:
+            if r.state is ReplicaState.CRASH_LOOP:
+                r.state = ReplicaState.FAILED
+
+    def _note_hang_fence(self):
+        with self._lock:
+            self._hang_fences += 1
+
+    # -- projected pressure (gateway shed inputs) -------------------------
+    def projected_page_deficit(self, total_tokens: int) -> int:
+        """Fleet-level projected page shortfall for a ``total_tokens``
+        request: the MINIMUM over healthy replicas of
+        :meth:`~.engine.ServingEngine.projected_page_deficit` — one
+        replica with headroom means the request has a home, so only when
+        EVERY healthy replica is short does the gateway shed. 0 when any
+        replica is dense or has room (and when none is healthy — the
+        no-replica path 503s instead)."""
+        deficits = [r.engine.projected_page_deficit(total_tokens)
+                    for r in self._replicas
+                    if r.state is ReplicaState.HEALTHY and r.engine.healthy]
+        return min(deficits) if deficits else 0
+
+    def page_drain_rate(self) -> float:
+        """Observed pages/s freed across the healthy fleet (sum over
+        replicas) — the denominator of the shed path's Retry-After."""
+        return sum(r.engine.page_drain_rate() for r in self._replicas
+                   if r.state is ReplicaState.HEALTHY and r.engine.healthy)
+
+    @property
+    def eos_token_id(self):
+        """The fleet-shared eos id (validated identical across replicas)."""
+        return self._replicas[0].engine.eos_token_id
 
     # -- routing ---------------------------------------------------------
     def _candidates(self, adapter: Optional[str] = None,
@@ -511,12 +700,20 @@ class ReplicaSet:
         inner = Request(fleet._resume_prompt(),
                         max_new_tokens=fleet._remaining_new_tokens(),
                         rng=fleet.rng, seed=fleet.seed,
-                        timeout=remaining_t, on_token=fleet._emit,
+                        timeout=remaining_t, on_token=None,
                         ignore_eos=fleet.ignore_eos,
                         adapter=fleet.adapter,
                         trace_id=fleet.trace_id)
+        inner.on_token = lambda tok, _inner=inner: fleet._emit_from(
+            _inner, tok)
         inner._on_finish = lambda req: self._on_inner_finish(
             fleet, replica, req)
+        # Mark this as the live flight BEFORE submission: the engine may
+        # emit tokens before _dispatch gets around to recording _inner.
+        # Dispatch builds inners strictly one at a time (a candidate that
+        # rejected the submit never emitted), so latest-built == live.
+        with fleet._lock:
+            fleet._flight = inner
         return inner
 
     # -- adapters ---------------------------------------------------------
@@ -526,13 +723,19 @@ class ReplicaSet:
         decoding under adapter X can resume on any survivor, which loads
         X into its own bank at admission if it isn't already resident.
         Raises ``RuntimeError`` if any replica was built without an
-        :class:`~..adapters.registry.AdapterBank`."""
+        :class:`~..adapters.registry.AdapterBank`. Registrations are
+        RECORDED: a replica rebuilt by :meth:`restart_replica` replays
+        them onto its fresh bank, so restarts are tenant-preserving."""
         for r in self._replicas:
             r.engine.register_adapter(name, adapter, **kwargs)
+        with self._lock:
+            self._adapter_registry[name] = (adapter, dict(kwargs))
 
     def unregister_adapter(self, name: str):
         """Drop a named adapter from every replica that knows it (idle
         banks only free the device row lazily on the next eviction)."""
+        with self._lock:
+            self._adapter_registry.pop(name, None)
         for r in self._replicas:
             bank = r.engine.adapters
             if bank is not None and name in bank.names():
@@ -600,8 +803,13 @@ class ReplicaSet:
     # -- metrics ----------------------------------------------------------
     def merged_stats(self) -> ServingStats:
         """A fresh :class:`ServingStats` holding the fleet-wide fold of
-        every replica's counters (see ``ServingStats.merge``)."""
+        every replica's counters (see ``ServingStats.merge``), INCLUDING
+        the retired-stats ledger of engines replaced by
+        :meth:`restart_replica` — fleet totals are monotone across
+        restarts, not reset by them."""
         merged = ServingStats()
+        with self._lock:
+            merged.merge(self._retired_stats)
         for r in self._replicas:
             merged.merge(r.engine.stats)
         return merged
@@ -621,10 +829,17 @@ class ReplicaSet:
                     s is ReplicaState.DRAINING for s in states),
                 "replicas_failed": sum(
                     s is ReplicaState.FAILED for s in states),
+                "replicas_restarting": sum(
+                    s is ReplicaState.RESTARTING for s in states),
+                "replicas_crash_loop": sum(
+                    s is ReplicaState.CRASH_LOOP for s in states),
                 "fleet_submitted": self._submitted,
                 "fleet_failovers": self._failovers,
                 "fleet_fences": self._fences,
                 "fleet_failover_failed": self._failover_failed,
+                "fleet_restarts": self._restarts,
+                "fleet_hang_fences": self._hang_fences,
+                "fleet_crash_loops": self._crash_loops,
                 "fleet_free_slots": sum(
                     r.engine.free_slots for r in self._replicas
                     if r.state is ReplicaState.HEALTHY and r.engine.healthy),
@@ -634,6 +849,12 @@ class ReplicaSet:
                 "fleet_free_pages": sum(
                     r.engine.free_pages for r in self._replicas
                     if r.state is ReplicaState.HEALTHY and r.engine.healthy),
+                # Observed pages/s returning to the healthy fleet's pools
+                # — the drain rate behind shed Retry-After values.
+                "fleet_page_drain_rate": round(sum(
+                    r.engine.page_drain_rate() for r in self._replicas
+                    if r.state is ReplicaState.HEALTHY
+                    and r.engine.healthy), 4),
             })
         return out
 

@@ -66,10 +66,30 @@ class AdmissionQueue:
         self._not_full = threading.Condition(self._lock)
         self._not_empty = threading.Condition(self._lock)
         self._closed = False
+        self._pending_tokens = 0
 
     @property
     def closed(self) -> bool:
         return self._closed
+
+    @property
+    def pending_tokens(self) -> int:
+        """Projected token footprint (prompt + max_new) summed over every
+        queued request — the admission-side half of the gateway's
+        projected-pressure shed signal. For a preempted request requeued
+        by ``putleft`` this over-counts by the tokens it already emitted;
+        pressure estimates only need an upper bound."""
+        return self._pending_tokens
+
+    @staticmethod
+    def _footprint(request: Request) -> int:
+        # Tolerate non-Request items: lifecycle unit tests (and any future
+        # sentinel objects) flow through the queue without a footprint.
+        try:
+            return (int(request.prompt_ids.shape[1])
+                    + int(request.max_new_tokens))
+        except AttributeError:
+            return 0
 
     def put(self, request: Request, block: bool = True,
             timeout: Optional[float] = None):
@@ -86,6 +106,7 @@ class AdmissionQueue:
                         "closed and will never drain")
                 if len(self._items) < self.max_queued:
                     self._items.append(request)
+                    self._pending_tokens += self._footprint(request)
                     self._not_empty.notify()
                     return
                 if not block:
@@ -111,6 +132,7 @@ class AdmissionQueue:
                     "serving engine stopped; the admission queue is "
                     "closed and will never drain")
             self._items.appendleft(request)
+            self._pending_tokens += self._footprint(request)
             self._not_empty.notify()
 
     def get(self, timeout: Optional[float] = None) -> Optional[Request]:
@@ -123,6 +145,7 @@ class AdmissionQueue:
             if not self._items:
                 return None
             item = self._items.popleft()
+            self._pending_tokens -= self._footprint(item)
             self._not_full.notify()
             return item
 
@@ -218,6 +241,7 @@ class PagePool:
         self._ref = [0] * (self.num_pages + 1)
         self.allocations = 0
         self.preemptions = 0  # billed by the engine when exhaustion preempts
+        self.frees = 0  # pages returned to the free list (drain-rate input)
 
     @property
     def free_pages(self) -> int:
@@ -252,6 +276,7 @@ class PagePool:
         self._ref[page] -= 1
         if self._ref[page] == 0:
             self._free.append(page)
+            self.frees += 1
             return True
         return False
 

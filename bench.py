@@ -786,6 +786,96 @@ def replica_failover_bench(n_inflight: int = 4, step_ms: float = 20.0,
     }
 
 
+def chaos_recovery_bench(n_inflight: int = 4, step_ms: float = 20.0,
+                         prompt_len: int = 6, max_new_tokens: int = 24,
+                         kill_tick: int = 6) -> dict:
+    """The self-healing drill: a scripted chaos kill (deterministic, at
+    decode tick ``kill_tick``) under a running FleetSupervisor. Measures
+    the two recovery clocks — kill -> every stream finished on the
+    survivor (``recovery_s``) and kill -> dead replica rebuilt, re-warmed
+    and back HEALTHY (``rejoin_s``) — plus stream exactness across the
+    failover and the supervisor's restart accounting."""
+    import jax
+    import numpy as np
+
+    from accelerate_tpu import generation
+    from accelerate_tpu.models.llama import LlamaConfig
+    from accelerate_tpu.serving import (
+        ChaosSchedule,
+        FleetSupervisor,
+        ReplicaSet,
+        ReplicaState,
+        ServingEngine,
+    )
+
+    model = _sleepy_llama_cls(step_ms)(LlamaConfig.tiny())
+    params = model.init_params(jax.random.PRNGKey(0))
+
+    def factory():
+        return ServingEngine(model, params, max_slots=max(4, n_inflight),
+                             max_len=64, prefill_chunk=16,
+                             prefix_cache_mb=4.0)
+
+    chaos = ChaosSchedule().kill(at_tick=kill_tick)
+    chaos_engine = ServingEngine(model, params,
+                                 max_slots=max(4, n_inflight), max_len=64,
+                                 prefill_chunk=16, prefix_cache_mb=4.0,
+                                 chaos=chaos)
+    rs = ReplicaSet([chaos_engine, factory()], factories=[factory, factory])
+    rng = np.random.default_rng(0)
+    prompts = rng.integers(1, 200,
+                           size=(n_inflight, prompt_len)).astype(np.int32)
+    refs = [np.asarray(generation.generate(
+        model, params, prompts[i:i + 1], max_new_tokens=max_new_tokens)
+        )[0, prompt_len:] for i in range(n_inflight)]
+    sup = FleetSupervisor(rs, hang_timeout_s=5.0, poll_interval_s=0.02,
+                          restart_backoff_s=0.05)
+    try:
+        sup.start()
+        reqs = [rs.submit(prompts[i:i + 1], max_new_tokens=max_new_tokens,
+                          seed=i) for i in range(n_inflight)]
+        # t_kill = the moment the scripted fault actually fires (the
+        # chaos engine's error goes non-None); both clocks start there.
+        t_kill = None
+        deadline = time.perf_counter() + 120
+        while time.perf_counter() < deadline:
+            if t_kill is None and chaos_engine.error is not None:
+                t_kill = time.perf_counter()
+            if all(r.done for r in reqs):
+                break
+            time.sleep(0.005)
+        if t_kill is None:  # kill raced the final waits; pin it now
+            t_kill = time.perf_counter()
+        recovery_s = time.perf_counter() - t_kill
+        exact = all(
+            np.array_equal(np.asarray(r.tokens), refs[i][:len(r.tokens)])
+            for i, r in enumerate(reqs))
+        completed = all(r.status.value == "completed" for r in reqs)
+        deadline = time.perf_counter() + 120
+        while (rs.replicas[0].state is not ReplicaState.HEALTHY
+               and time.perf_counter() < deadline):
+            time.sleep(0.02)
+        rejoin_s = time.perf_counter() - t_kill
+        rejoined = rs.replicas[0].state is ReplicaState.HEALTHY
+        fleet = rs.fleet_metrics()
+    finally:
+        sup.stop()
+        rs.shutdown()
+    return {
+        "n_inflight": n_inflight,
+        "step_ms": step_ms,
+        "kill_tick": kill_tick,
+        "recovery_s": round(recovery_s, 4),
+        "rejoin_s": round(rejoin_s, 4),
+        "rejoined_healthy": bool(rejoined),
+        "all_completed": completed,
+        "tokens_exact": bool(exact),
+        "failovers": fleet["fleet_failovers"],
+        "restarts": fleet["fleet_restarts"],
+        "chaos_fired": chaos.fired(),
+    }
+
+
 def _test_lora_adapters(params, n_tenants: int, rank: int):
     """``n_tenants`` distinct rank-``rank`` adapters with nonzero B factors
     (a fresh ``init_lora_params`` is a zero delta — useless for telling
@@ -1278,6 +1368,7 @@ def serving_extra(on_tpu: bool) -> dict:
             "overhead": gateway_overhead_bench(),
             "failover": replica_failover_bench(),
         },
+        "chaos": chaos_recovery_bench(),
         "tp": serving_tp_bench(),
         "paged": paged_capacity_bench(),
         "speculative": speculative_bench(),

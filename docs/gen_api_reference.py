@@ -41,12 +41,16 @@ GROUPS = [
      ["accelerate_tpu.serving.engine", "accelerate_tpu.serving.request",
       "accelerate_tpu.serving.scheduler", "accelerate_tpu.serving.metrics",
       "accelerate_tpu.serving.mesh_exec",
-      "accelerate_tpu.serving.router", "accelerate_tpu.serving.gateway"],
+      "accelerate_tpu.serving.router", "accelerate_tpu.serving.gateway",
+      "accelerate_tpu.serving.supervisor", "accelerate_tpu.serving.chaos"],
      "Continuous-batching decode service: slot scheduler, fixed-shape "
      "prefill/decode programs, request handles, serving counters — plus "
      "mesh-sliced tensor-parallel execution (one replica = a multi-chip "
      "slice), the multi-replica router (health states, fault-tolerant "
-     "failover) and the stdlib HTTP gateway in front of it."),
+     "failover), the stdlib HTTP gateway in front of it, and the "
+     "self-healing layer: the fleet supervisor (hang watchdog, "
+     "auto-restart, crash-loop circuit breaker) with its deterministic "
+     "chaos-injection harness."),
     ("observability", "Observability",
      ["accelerate_tpu.observability.tracing",
       "accelerate_tpu.observability.flight_recorder",

@@ -63,6 +63,7 @@ LANES: dict[str, tuple[int, list[str]]] = {
         "test_serving_gateway.py",
         "test_serving_mesh.py",
         "test_serving_paged.py",
+        "test_serving_supervisor.py",
     ]),
     "subproc": (12, [
         "test_cli.py",
