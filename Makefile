@@ -15,7 +15,7 @@
 
 PYTHON ?= python
 
-.PHONY: test-fast test-models test-subproc test-multiprocess test-all test-nightly chaos quality serve-demo
+.PHONY: test-fast test-models test-subproc test-multiprocess test-all test-nightly chaos quality serve-demo bench-trajectory
 
 test-fast:
 	$(PYTHON) -m pytest -q $$($(PYTHON) tests/lanes.py fast)
@@ -42,6 +42,11 @@ chaos:
 
 quality:
 	$(PYTHON) -m compileall -q accelerate_tpu bench.py bench_watch.py __graft_entry__.py
+
+# Fold every BENCH_rNN.json round artifact into BENCH_TRAJECTORY.json
+# (guard keys only) so perf regressions across PRs diff in one file.
+bench-trajectory:
+	JAX_PLATFORMS=cpu $(PYTHON) bench.py --trajectory
 
 # HTTP gateway demo on a tiny random model (CPU): 2 replicas on :8000.
 # Try: curl -s localhost:8000/readyz; curl -s -XPOST localhost:8000/v1/completions \

@@ -310,6 +310,10 @@ def estimate_command(args) -> int:
         # Optimizer state only covers the trainable low-rank factors —
         # the base stays frozen, so Adam costs 2 fp32 moments on n_lora.
         print(f"  Adam moments (fp32)      : {_fmt(ckpt_bytes * 2)}")
+    if args.spec_tokens is not None and args.page_size is None:
+        print("--spec-tokens needs --page-size (speculative decoding "
+              "requires the paged engine)")
+        return 2
     if args.page_size is not None:
         geom = _kv_geometry(module)
         if geom is None:
@@ -350,6 +354,37 @@ def estimate_command(args) -> int:
                   + ", ".join(
                       f"{s}tok x {args.max_pages // max(1, -(-s // args.page_size))}"
                       for s in args.seq_lens))
+        if args.spec_tokens is not None:
+            K = args.spec_tokens
+            print(f"\nSpeculative decoding (--spec-tokens {K}):")
+            # Mirrors ServingEngine._spec_page_factor: draft KV pages come
+            # from the SAME pool via a second page-table column, so a
+            # draft-speculating request covers twice the pages and the
+            # admission/router math charges 2x.
+            print("  draft KV pages : same pool, second page-table column "
+                  "-> 2x pages per request:")
+            for s in args.seq_lens:
+                pages = 2 * -(-s // args.page_size)
+                print(f"    {s:>7} tokens: {pages:>6} pages"
+                      + (f"  (pool fits {args.max_pages // pages} "
+                         "concurrent)" if args.max_pages else ""))
+            vocab = getattr(getattr(module, "config", None),
+                            "vocab_size", None)
+            if vocab:
+                print("  verify activation delta: the verify forward "
+                      f"widens [1, 1] -> [1, {K + 1}]: logits "
+                      f"{_fmt(vocab * 2)} -> {_fmt((K + 1) * vocab * 2)}"
+                      "/slot (bf16)")
+            if args.draft_rank is not None:
+                # Rank proxy for a small draft: kv-heads x head-dim
+                # collapsed to --draft-rank per layer, k+v, bf16.
+                d_per_tok = 2 * layers * args.draft_rank * 2
+                d_page = d_per_tok * args.page_size
+                print(f"  draft KV (rank-{args.draft_rank} proxy, 2 x "
+                      f"{layers} layers x {args.draft_rank} x bf16): "
+                      f"{_fmt(d_per_tok)}/token, {_fmt(d_page)}/page"
+                      + (f", pool +{_fmt(args.max_pages * d_page)}"
+                         if args.max_pages is not None else ""))
     if args.tp > 1:
         per_chip, sharded, total_elems = _tp_param_split(abstract, args.tp)
         print(f"\nTensor-parallel slice (tp={args.tp}, Megatron "
@@ -440,6 +475,16 @@ def estimate_command_parser(subparsers=None):
     parser.add_argument("--seq-lens", type=int, nargs="+",
                         default=[128, 512, 2048, 8192],
                         help="Sequence lengths for the pages-per-request table")
+    parser.add_argument("--spec-tokens", type=int, default=None,
+                        help="With --page-size: speculative-decoding "
+                             "columns — draft KV pages (2x per request, "
+                             "same pool) and the [1, K+1] verify "
+                             "activation delta at K proposed tokens/step")
+    parser.add_argument("--draft-rank", type=int, default=None,
+                        help="With --spec-tokens: draft KV bytes per "
+                             "token/page for a small draft model, "
+                             "approximated as kv-heads x head-dim "
+                             "collapsed to this rank per layer")
     if subparsers is not None:
         parser.set_defaults(func=estimate_command)
     return parser

@@ -84,6 +84,14 @@ def serve_command(args) -> int:
 
     paging = dict(paged=(False if args.no_paged else None),
                   page_size=args.page_size, max_pages=args.max_pages)
+    spec = {}
+    if args.draft_model:
+        dmodel, dparams = _resolve_model(args.draft_model, args)
+        spec = dict(draft_model=dmodel, draft_params=dparams,
+                    spec_tokens=args.spec_tokens)
+    elif args.spec_lookup:
+        spec = dict(spec_lookup=args.spec_lookup,
+                    spec_tokens=args.spec_tokens)
 
     def factory():
         return ServingEngine(
@@ -91,13 +99,18 @@ def serve_command(args) -> int:
             max_queued=args.max_queued, eos_token_id=args.eos_token_id,
             prefill_chunk=args.prefill_chunk,
             prefix_cache_mb=args.prefix_cache_mb,
-            adapters=make_bank(), trace_dir=args.trace_dir, **paging)
+            adapters=make_bank(), trace_dir=args.trace_dir, **paging,
+            **spec)
 
     print(f"warming up {args.replicas} replica(s) "
           f"(slots={args.max_slots}, max_len={args.max_len}, "
           f"chunk={args.prefill_chunk}"
           + (f", tp={args.tp}" if args.tp > 1 else "")
           + (f", adapters={max_adapters - 1}" if max_adapters >= 2 else "")
+          + (f", spec=draft K={args.spec_tokens}" if args.draft_model
+             else "")
+          + (f", spec=lookup n={args.spec_lookup} K={args.spec_tokens}"
+             if args.spec_lookup else "")
           + ") ...", flush=True)
     if args.tp > 1:
         # One replica = one tp-wide mesh slice; the fleet shares a
@@ -109,7 +122,7 @@ def serve_command(args) -> int:
             max_queued=args.max_queued, eos_token_id=args.eos_token_id,
             prefill_chunk=args.prefill_chunk,
             prefix_cache_mb=args.prefix_cache_mb,
-            trace_dir=args.trace_dir, **paging)
+            trace_dir=args.trace_dir, **paging, **spec)
     else:
         replica_set = ReplicaSet.from_factory(factory, args.replicas)
     if adapter_specs:
@@ -230,6 +243,23 @@ def serve_command_parser(subparsers=None):
                         help="Supervisor circuit breaker: restart attempts "
                              "per replica within the window before it is "
                              "parked in CRASH_LOOP")
+    parser.add_argument("--draft-model", default=None,
+                        help="Speculative decoding draft: 'tiny' or "
+                             "'pkg.mod:factory' returning (model, params) "
+                             "with the SAME vocab as --model; every replica "
+                             "then decodes speculatively (paged engines "
+                             "only; composes with sampling, adapters, tp "
+                             "slices, and the prefix cache)")
+    parser.add_argument("--spec-tokens", type=int, default=4,
+                        help="Proposed tokens per speculative verify step "
+                             "(K); used with --draft-model or --spec-lookup")
+    parser.add_argument("--spec-lookup", type=int, default=None,
+                        help="Draft-FREE prompt-lookup speculation: n-gram "
+                             "width matched against each stream's "
+                             "prompt+output to propose the next K tokens "
+                             "(mutually exclusive with --draft-model; "
+                             "strongest on doc/RAG traffic that repeats "
+                             "its prompt)")
     parser.add_argument("--trace-dir", default=None,
                         help="Directory each replica dumps its Chrome-trace "
                              "span buffer and flight-recorder events into on "

@@ -145,6 +145,61 @@ def speculative_accept(warped_logits, draft, rng):
     return m, final
 
 
+def speculative_emit(logits, draft, rng, warp, eos_token_id, dtype,
+                     prior_done=None):
+    """One verification chunk -> the emitted token chain, shared by the
+    offline speculative decoders and the serving engine's ``_spec``
+    executable (the factored accept rule).
+
+    Args:
+      logits: [K+1, V] target logits over [last_committed, draft].
+      draft: [K] proposed tokens.
+      rng: PRNG key for the accept rule (unused when ``warp`` is None).
+      warp: warper from :func:`_make_warper`, or None for greedy.
+      eos_token_id: eos id or None.
+      dtype: emitted token dtype.
+      prior_done: scalar bool — True when the sequence already emitted eos
+        (engine slots running under ``ignore_eos``); the whole chunk then
+        emits eos, matching the decode latch.
+
+    Returns ``(m, emit)``: ``emit`` [K+1] is the token chain of which the
+    caller commits the first ``min(m + 1, remaining)``; ``m`` counts the
+    accepted draft tokens — greedy: the longest prefix of ``draft``
+    agreeing with the (eos-latched) target argmax chain; sampled: the
+    rejection-rule count from :func:`speculative_accept`, with ``emit[m]``
+    the residual-distribution resample. The eos latch is applied in-chunk:
+    every position after the first eos emits eos, so committing a prefix of
+    ``emit`` replays :func:`generate`'s ragged stop exactly.
+    """
+    K = draft.shape[0]
+    done0 = jnp.asarray(False) if prior_done is None else prior_done
+    if warp is None:
+        preds = jnp.argmax(logits, axis=-1).astype(dtype)          # [K+1]
+        if eos_token_id is not None:
+            eos = jnp.asarray(eos_token_id, dtype)
+
+            def latch(d, p):
+                t = jnp.where(d, eos, p)
+                return d | (t == eos), t
+
+            _, emit = jax.lax.scan(latch, done0, preds)
+        else:
+            emit = preds
+        m = jnp.sum(jnp.cumprod((draft == emit[:K]).astype(jnp.int32)))
+    else:
+        m, final = speculative_accept(warp(logits), draft, rng)
+        slots = jnp.arange(K + 1)
+        emit = jnp.where(slots < m, jnp.append(draft, 0)[slots],
+                         final).astype(dtype)
+        if eos_token_id is not None:
+            eos = jnp.asarray(eos_token_id, dtype)
+            emit = jnp.where(done0, eos, emit)
+            after = jnp.concatenate(
+                [jnp.zeros((1,), bool), jnp.cumsum(emit == eos)[:-1] > 0])
+            emit = jnp.where(after, eos, emit)
+    return m, emit
+
+
 def _freeze(obj):
     """Recursively convert dict/list config fields (e.g. rope_scaling) to
     hashable tuples so they can live in a cache key."""
@@ -550,24 +605,8 @@ def _compiled_lookup_generate(module, max_new_tokens: int, eos_token_id, cache_d
             chunk = jnp.concatenate([last, draft[None, :]], axis=1)    # [1, K+1]
             logits, cache = module.apply({"params": params}, chunk,
                                          cache=cache, cache_pos=cur - 1)
-            if sampling is None:
-                preds = jnp.argmax(logits[0], axis=-1).astype(buf.dtype)   # [K+1]
-                matches = draft == preds[:K]
-                m = jnp.sum(jnp.cumprod(matches.astype(jnp.int32)))    # accepted drafts
-                emit = preds                                           # m drafts + bonus
-            else:
-                m, final = speculative_accept(warp(logits[0]), draft, step_rng)
-                # emit = draft[:m] + final at slot m; slots past m are
-                # never committed (n_emit caps at m + 1) — fill with final.
-                slots = jnp.arange(K + 1)
-                emit = jnp.where(slots < m, jnp.append(draft, 0)[slots],
-                                 final).astype(buf.dtype)
-            if eos is not None:
-                # generate()'s ragged-stop contract: after EOS, keep
-                # emitting EOS.
-                after = jnp.concatenate(
-                    [jnp.zeros((1,), bool), jnp.cumsum(emit == eos)[:-1] > 0])
-                emit = jnp.where(after, eos, emit)
+            m, emit = speculative_emit(logits[0], draft, step_rng, warp,
+                                       eos, buf.dtype)
             n_emit = jnp.minimum(m + 1, max_new_tokens - n_gen)
             buf = jax.lax.dynamic_update_slice(buf, emit[None, :], (0, cur))
             if eos is not None:
@@ -760,20 +799,8 @@ def _compiled_assisted_generate(module, draft_module, max_new_tokens: int,
             chunk = jnp.concatenate([last, draft[None, :]], axis=1)    # [1, K+1]
             logits, cache = module.apply({"params": params}, chunk,
                                          cache=cache, cache_pos=cur - 1)
-            if sampling is None:
-                preds = jnp.argmax(logits[0], axis=-1).astype(buf.dtype)   # [K+1]
-                matches = draft == preds[:K]
-                m = jnp.sum(jnp.cumprod(matches.astype(jnp.int32)))
-                emit = preds
-            else:
-                m, final = speculative_accept(warp(logits[0]), draft, step_rng)
-                slots = jnp.arange(K + 1)
-                emit = jnp.where(slots < m, jnp.append(draft, 0)[slots],
-                                 final).astype(buf.dtype)
-            if eos is not None:
-                after = jnp.concatenate(
-                    [jnp.zeros((1,), bool), jnp.cumsum(emit == eos)[:-1] > 0])
-                emit = jnp.where(after, eos, emit)
+            m, emit = speculative_emit(logits[0], draft, step_rng, warp,
+                                       eos, buf.dtype)
             n_emit = jnp.minimum(m + 1, max_new_tokens - n_gen)
             buf = jax.lax.dynamic_update_slice(buf, emit[None, :], (0, cur))
             if eos is not None:

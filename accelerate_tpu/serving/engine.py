@@ -89,14 +89,21 @@ resume-as-longer-prompt trick). Sliding-window models serve under
 paging too: pages that fall wholly out of the attention window are freed
 (ring semantics as a page-lifetime policy, no new kernel).
 
-Speculative decoding (``draft_model=``, paged single-chip greedy): each
-tick runs a fourth warm executable that scans ``spec_tokens`` greedy
-draft proposals through a small dense draft cache, verifies them with
-ONE fixed-width ``[1, K+1]`` target forward against the paged view, and
-accepts the longest matching prefix — per-slot acceptance is traced
-data, and the emitted stream is token-identical to non-speculative
-greedy (the verify logits ARE the dense tick's logits; eos latching
-replays :func:`generation._next_token`'s after-chain).
+Speculative decoding (``draft_model=`` or ``spec_lookup=``, paged
+engines) is universal, not a special case: each tick runs ONE warm
+executable that obtains ``spec_tokens`` proposals — a compiled draft
+scan over draft KV paged from the SAME pool (separate table columns),
+or a host-side prompt-lookup n-gram match with no draft model at all —
+then verifies them with one fixed-width ``[1, K+1]`` target forward
+against the paged view. Greedy engines accept the longest matching
+prefix (streams bit-identical to non-speculative greedy: the verify
+logits ARE the dense tick's logits); sampled engines apply the exact
+rejection-sampling rule (:func:`generation.speculative_accept`) on the
+per-slot rng rows, so the emitted distribution is the dense sampled
+law. Adapter rows gather inside the same program (the draft stays
+base-weight), mesh slices compile the verify tp-sharded with the draft
+replicated, and prefix-cache hits rebuild draft KV via a draft-only
+chunk program — all under the same zero-recompile pin.
 
 Around the compiled programs: a bounded FCFS admission queue with
 backpressure, per-request ``max_new_tokens``/timeout/cancellation,
@@ -128,7 +135,9 @@ from ..generation import (
     _check_position_bound,
     _chunk_prefill_token,
     _make_selector,
+    _make_warper,
     _next_token,
+    speculative_emit,
 )
 from ..inference import resolve_model_source
 from ..observability import FlightRecorder, Tracer, new_trace_id
@@ -225,12 +234,23 @@ class ServingEngine:
         can never serve FEWER requests than dense; pass less to
         overcommit memory and lean on preemption.
       draft_model / draft_params: enable speculative decoding — a small
-        cache-threading draft module proposing ``spec_tokens`` greedy
-        tokens per tick, verified by one fixed-width target forward.
-        Requires ``paged=True``, greedy sampling, single chip, no
-        adapter bank; the engine's private prefix cache is disabled
-        (cached target blocks carry no draft KV).
+        cache-threading draft module proposing ``spec_tokens`` tokens per
+        tick, verified by one fixed-width target forward. Requires
+        ``paged=True`` (draft KV pages come from the same pool, so a
+        speculative slot costs roughly twice the pages); composes with
+        sampling (exact rejection-rule acceptance), adapter banks (the
+        target verify gathers the slot's row; the draft runs base
+        weights), mesh slices (draft replicated, verify tp-sharded), and
+        prefix caches (restored prefixes rebuild draft KV through a
+        dedicated draft-only chunk program).
       spec_tokens: draft proposals per speculative tick (default 4).
+      spec_lookup: n-gram width for DRAFT-FREE prompt-lookup speculation
+        (mutually exclusive with ``draft_model``): each tick proposes
+        ``spec_tokens`` tokens by matching the slot's last ``spec_lookup``
+        tokens against their most recent earlier occurrence in
+        prompt+output (host-side numpy; proposals ride into the verify
+        executable as traced data). No draft params, no draft KV, no
+        extra pages — the big win for self-repeating RAG/doc traffic.
       tracing: keep the request-scoped span tracer enabled (the default —
         the hot path is a lock-free ring append, guarded ≤5% decode
         overhead). ``False`` turns every emit into an early return; the
@@ -263,6 +283,7 @@ class ServingEngine:
                  page_size: Optional[int] = None,
                  max_pages: Optional[int] = None,
                  draft_model=None, draft_params=None, spec_tokens: int = 4,
+                 spec_lookup: Optional[int] = None,
                  tp: Optional[int] = None, mesh=None, devices=None,
                  prefix_cache: Optional[PrefixCache] = None,
                  accelerator=None, stats: Optional[ServingStats] = None,
@@ -375,26 +396,29 @@ class ServingEngine:
             self._page = None
 
         # -- speculative-decoding resolution ------------------------------
-        if draft_model is not None:
+        # Two drafting modes share one verify program shape: a DRAFT MODEL
+        # (paged draft KV alongside the target's) or host-side
+        # PROMPT-LOOKUP n-gram proposals (no draft state at all). Either
+        # composes with sampling, adapters, mesh slices, and prefix caches
+        # — speculation is no longer a special case.
+        if draft_model is not None and spec_lookup is not None:
+            raise ValueError(
+                "draft_model= and spec_lookup= are mutually exclusive — one "
+                "engine drafts either with a model or by prompt lookup")
+        if draft_model is not None or spec_lookup is not None:
             if not self._paged:
                 raise NotImplementedError(
                     "speculative decoding requires the paged engine "
                     "(paged=True)")
-            if self._exec is not None:
-                raise NotImplementedError(
-                    "speculative decoding is single-chip only for now")
-            if do_sample:
-                raise NotImplementedError(
-                    "speculative decoding is greedy-only (do_sample=False); "
-                    "sampled acceptance needs the rejection-sampling rule")
-            if adapters is not None:
-                raise NotImplementedError(
-                    "speculative decoding does not compose with an adapter "
-                    "bank yet")
             if int(spec_tokens) < 1:
                 raise ValueError(
                     f"spec_tokens must be >= 1 (got {spec_tokens})")
             self._spec_k: Optional[int] = int(spec_tokens)
+        else:
+            self._spec_k = None
+        self._spec_lookup: Optional[int] = None
+        if draft_model is not None:
+            self._spec_mode: Optional[str] = "draft"
             dmod, _, dparams, _, _ = resolve_model_source(
                 draft_model, params=draft_params)
             if dparams is None:
@@ -413,24 +437,35 @@ class ServingEngine:
                     "compares token ids, so the vocabularies must match")
             self._draft_module, self._draft_params = dmod, dparams
             self._draft_factory = dfactory
-        else:
-            self._spec_k = None
+        elif spec_lookup is not None:
+            if int(spec_lookup) < 1:
+                raise ValueError(
+                    f"spec_lookup (n-gram width) must be >= 1 "
+                    f"(got {spec_lookup})")
+            self._spec_mode = "lookup"
+            self._spec_lookup = int(spec_lookup)
             self._draft_module = self._draft_params = None
+            self._draft_factory = None
+        else:
+            self._spec_mode = None
+            self._draft_module = self._draft_params = None
+            self._draft_factory = None
+        #: the sampling-target warper, shared with the rejection-sampling
+        #: accept rule (sampled speculation must agree with the selector on
+        #: the warped distribution EXACTLY).
+        self._warp = (_make_warper(self._sampling)
+                      if self._sampling is not None else None)
+        self._dtable = None          # draft page-table (draft mode only)
+        self._draft_page_bytes = 0
 
         if prefix_cache is not None:
             if self._chunk is None:
                 raise ValueError(
                     "prefix_cache= requires chunked prefill "
                     "(prefill_chunk=None has no chunk-aligned blocks)")
-            if self._spec_k is not None:
-                raise ValueError(
-                    "speculative engines cannot use a prefix cache: cached "
-                    "target KV blocks carry no draft-model KV, so a restored "
-                    "prefix would leave the draft cache unfilled")
             self._prefix_cache: Optional[PrefixCache] = prefix_cache
             self._alias_cache = False   # external/shared cache: COPY restores
-        elif (self._chunk is not None and prefix_cache_mb > 0
-                and self._spec_k is None):
+        elif self._chunk is not None and prefix_cache_mb > 0:
             # A PRIVATE cache on a paged engine stores page-id tuples, not
             # KV blocks: a hit is a host table write + refcount (aliasing),
             # and eviction gives the pages back through the hook.
@@ -510,7 +545,7 @@ class ServingEngine:
                 "rng": jnp.zeros((self.max_slots, 2), jnp.uint32),
                 "done": jnp.zeros((self.max_slots,), bool),
             }
-            if self._spec_k is not None:
+            if self._spec_mode == "draft":
                 dshape = jax.eval_shape(lambda: self._draft_factory(
                     1, self.max_len + self._spec_k, self._dtype))
                 if any(isinstance(layer, dict) and "pos" in layer
@@ -518,12 +553,28 @@ class ServingEngine:
                     raise NotImplementedError(
                         "the draft model's KV cache must be linear at "
                         "max_len + spec_tokens (raise its sliding window)")
-                # Small dense per-slot draft cache (the draft is what makes
-                # speculation pay — its KV is not worth paging).
-                self._state["draft"] = jax.tree.map(
-                    lambda l: jnp.zeros((self.max_slots,) + l.shape, l.dtype),
-                    self._draft_factory(1, self.max_len + self._spec_k,
-                                        self._dtype))
+                # Draft KV pages come from the SAME pool as the target's —
+                # one id space, one refcount, honest page accounting — but
+                # live in their own ``dpool`` leaves (draft layer geometry)
+                # behind their own table columns.
+                dprobe = jax.eval_shape(
+                    lambda: self._draft_factory(1, 2, self._dtype))
+                self._draft_cache_struct = jax.tree.structure(dprobe)
+                self._draft_cache_axes = self._cache_length_axes(
+                    2, 1, factory=self._draft_factory)
+                dpool_leaves, self._draft_page_bytes = [], 0
+                for sh, ax in zip(jax.tree.leaves(dprobe),
+                                  self._draft_cache_axes):
+                    shape = list(sh.shape)
+                    shape[ax] = self._page
+                    dpool_leaves.append(
+                        jnp.zeros((usable + 1,) + tuple(shape), sh.dtype))
+                    self._draft_page_bytes += (int(np.prod(shape))
+                                               * np.dtype(sh.dtype).itemsize)
+                self._state["dpool"] = jax.tree.unflatten(
+                    self._draft_cache_struct, dpool_leaves)
+                self._dtable = np.zeros(
+                    (self.max_slots, self._pages_per_slot), np.int32)
         else:
             self._pool = None
             self._table = None
@@ -552,6 +603,7 @@ class ServingEngine:
         # all (steady state is TWO warm executables, not three).
         self._restore_prefix = None
         self._spec = None
+        self._draft_chunk = None
         if self._exec is None:
             if self._paged:
                 self._decode = jax.jit(self._paged_decode_fn,
@@ -565,10 +617,21 @@ class ServingEngine:
                     self._restore_prefix = jax.jit(
                         self._paged_restore_prefix_fn,
                         donate_argnums=(0,) if donate else ())
-                if self._spec_k is not None:
+                if self._spec_mode == "draft":
                     # state is positional arg 2 of the spec program.
                     self._spec = jax.jit(self._spec_fn,
                                          donate_argnums=(2,) if donate else ())
+                    if self._prefix_cache is not None:
+                        # Prefix restores rebuild draft KV lazily: a
+                        # draft-only chunk forward over the restored tokens
+                        # (state is its positional arg 1).
+                        self._draft_chunk = jax.jit(
+                            self._draft_chunk_fn,
+                            donate_argnums=(1,) if donate else ())
+                elif self._spec_mode == "lookup":
+                    # state is positional arg 1 (no draft params argument).
+                    self._spec = jax.jit(self._spec_lookup_fn,
+                                         donate_argnums=(1,) if donate else ())
             else:
                 self._decode = jax.jit(self._decode_fn, donate_argnums=donate)
                 if self._chunk is None:
@@ -626,6 +689,12 @@ class ServingEngine:
                 adapters.place(self._bank_sh)
                 decode_in.append(self._bank_sh)
                 chunk_in += [rep, self._bank_sh]
+            if self._spec_mode == "draft":
+                # Draft params and KV replicate onto every chip of the
+                # slice (see SliceExec.state_shardings): the draft scan is
+                # collective-free; only the target verify is tp-sharded.
+                self._draft_params = jax.device_put(self._draft_params, rep)
+                chunk_in += [rep, rep]      # dparams subtree, dpages row
             self._decode = exec_.jit(
                 decode_fn, tuple(decode_in),
                 (self._state_sh, rep, rep), donate_argnums=donate)
@@ -636,6 +705,27 @@ class ServingEngine:
                 self._restore_prefix = exec_.jit(
                     restore_fn, restore_in,
                     self._state_sh, donate_argnums=(0,) if donate else ())
+            if self._spec_mode == "draft":
+                spec_in = [self._param_sh, rep, self._state_sh,
+                           rep, rep, rep, rep]
+                if adapters is not None:
+                    spec_in.append(self._bank_sh)
+                self._spec = exec_.jit(
+                    self._spec_fn, tuple(spec_in), (self._state_sh, rep, rep),
+                    donate_argnums=(2,) if donate else ())
+                if self._prefix_cache is not None:
+                    self._draft_chunk = exec_.jit(
+                        self._draft_chunk_fn,
+                        (rep, self._state_sh, rep, rep, rep, rep),
+                        self._state_sh, donate_argnums=(1,) if donate else ())
+            elif self._spec_mode == "lookup":
+                spec_in = [self._param_sh, self._state_sh, rep, rep, rep, rep]
+                if adapters is not None:
+                    spec_in.append(self._bank_sh)
+                self._spec = exec_.jit(
+                    self._spec_lookup_fn, tuple(spec_in),
+                    (self._state_sh, rep, rep),
+                    donate_argnums=(1,) if donate else ())
 
         if stats is None and accelerator is not None:
             stats = getattr(accelerator, "serving_stats", None)
@@ -740,7 +830,8 @@ class ServingEngine:
         return None
 
     def _cache_length_axes(self, la: Optional[int] = None,
-                           lb: Optional[int] = None) -> list[int]:
+                           lb: Optional[int] = None,
+                           factory=None) -> list[int]:
         """Per-leaf sequence-length axis of the slot cache, detected by
         comparing ``eval_shape`` of the factory at two lengths (layouts are
         family-specific; llama is ``[1, L, n_kv, head]`` but nothing
@@ -753,10 +844,11 @@ class ServingEngine:
         order, the same order every tree op in the programs uses."""
         la = self.max_len if la is None else la
         lb = self.max_len - 1 if lb is None else lb
+        factory = self._factory if factory is None else factory
         a = jax.tree.leaves(jax.eval_shape(
-            lambda: self._factory(1, la, self._dtype)))
+            lambda: factory(1, la, self._dtype)))
         b = jax.tree.leaves(jax.eval_shape(
-            lambda: self._factory(1, lb, self._dtype)))
+            lambda: factory(1, lb, self._dtype)))
         if len(a) != len(b):
             raise NotImplementedError(
                 "the KV cache changes structure between probe lengths "
@@ -933,30 +1025,36 @@ class ServingEngine:
         return state, toks, dones
 
     # -- paged programs -------------------------------------------------
-    def _gather_view(self, pool, pages):
+    def _gather_view(self, pool, pages, axes=None, struct=None):
         """One slot's dense cache VIEW from the pool: gather its page rows
         (``pages`` [Np] i32 pool ids, 0 = scratch for unallocated entries)
         and merge the page axis into the length axis — each leaf becomes
         ``[1, Np * P, ...]``, exactly the linear cache the unchanged
         forward expects. Scratch garbage sits at positions the attention
-        mask (causal and/or sliding-window) already excludes."""
+        mask (causal and/or sliding-window) already excludes. ``axes`` /
+        ``struct`` default to the TARGET cache geometry; speculative
+        engines pass the draft pool's."""
+        axes = self._cache_axes if axes is None else axes
+        struct = self._cache_struct if struct is None else struct
         leaves = []
-        for l, ax in zip(jax.tree.leaves(pool), self._cache_axes):
+        for l, ax in zip(jax.tree.leaves(pool), axes):
             g = jnp.moveaxis(l[pages], 0, ax)
             shape = (list(g.shape[:ax]) + [g.shape[ax] * g.shape[ax + 1]]
                      + list(g.shape[ax + 2:]))
             leaves.append(g.reshape(shape))
-        return jax.tree.unflatten(self._cache_struct, leaves)
+        return jax.tree.unflatten(struct, leaves)
 
-    def _scatter_page(self, pool_leaves, view_leaves, src_page, tgt):
+    def _scatter_page(self, pool_leaves, view_leaves, src_page, tgt,
+                      axes=None):
         """Write view page ``src_page`` back into pool page ``tgt`` (both
         traced i32). ``tgt = 0`` discards into scratch; an out-of-range
         ``src_page`` clamps to the view's last page (jax dynamic_slice
         semantics), which callers pair with a scratch target — the two
         clamps together are what let a FIXED number of scatter steps cover
         a variable number of genuinely-written pages."""
+        axes = self._cache_axes if axes is None else axes
         out = []
-        for pl, vl, ax in zip(pool_leaves, view_leaves, self._cache_axes):
+        for pl, vl, ax in zip(pool_leaves, view_leaves, axes):
             start = [0] * vl.ndim
             start[ax] = src_page * self._page
             sizes = list(vl.shape)
@@ -966,19 +1064,42 @@ class ServingEngine:
                 pl, pb[None].astype(pl.dtype), (tgt,) + (0,) * pb.ndim))
         return out
 
+    def _scatter_chunk_pages(self, pool_leaves, view_leaves, axes, pages,
+                             offset, C):
+        """Scatter a chunk's writes (positions ``[offset, offset + C)``)
+        back into the pool: at most ``C/P + 1`` pages (the pulled-back
+        final chunk may start mid-page); the possibly-untouched trailing
+        step routes to scratch."""
+        p0 = offset // self._page
+        for pg in range(C // self._page + 1):
+            tid = jax.lax.dynamic_slice(pages, (p0 + pg,), (1,))[0]
+            touched = (p0 + pg) * self._page < offset + C
+            pool_leaves = self._scatter_page(
+                pool_leaves, view_leaves, p0 + pg,
+                jnp.where(touched, tid, 0), axes)
+        return pool_leaves
+
     def _paged_prefill_chunk_fn(self, params, state, ids_c, slot, pages,
-                                offset, true_len, rng, aidx=None, bank=None,
-                                dparams=None):
+                                offset, true_len, rng, *extra):
         """Paged twin of :meth:`_prefill_chunk_fn`: gather the slot's pages
         into a dense view, run the chunk at ``cache_pos=offset`` exactly as
         the dense program does, then scatter back only the pages the chunk
-        wrote. A chunk touches at most ``C/P + 1`` pages (the pulled-back
-        final chunk may start mid-page); the possibly-untouched trailing
-        step routes to scratch. The returned block is sliced from the view
-        — same bytes as the dense block, so external prefix caches stay
-        layout-compatible. With a draft model attached the SAME call also
-        prefills the slot's dense draft cache (``dparams`` kwarg), keeping
-        the warm-executable count unchanged."""
+        wrote. The returned block is sliced from the view — same bytes as
+        the dense block, so external prefix caches stay layout-compatible.
+
+        ``extra`` is positional (mesh in_shardings forbid kwargs) and holds
+        whatever this engine's config adds, in order: ``aidx, bank`` when
+        an adapter bank is attached, then ``dparams, dpages`` when a draft
+        model speculates — the SAME call also prefills the slot's paged
+        draft KV, keeping the warm-executable count unchanged."""
+        extra = list(extra)
+        aidx = bank = None
+        if self._adapters is not None:
+            aidx, bank = extra[0], extra[1]
+            del extra[:2]
+        dparams = dpages = None
+        if self._spec_mode == "draft":
+            dparams, dpages = extra
         C = ids_c.shape[1]
         view = self._gather_view(state["pool"], pages)
         logits, view = self.module.apply(
@@ -992,14 +1113,9 @@ class ServingEngine:
             self._cache_struct,
             [jax.lax.dynamic_slice_in_dim(l, offset, C, axis=ax)
              for l, ax in zip(view_leaves, self._cache_axes)])
-        pool_leaves = jax.tree.leaves(state["pool"])
-        p0 = offset // self._page
-        for pg in range(C // self._page + 1):
-            tid = jax.lax.dynamic_slice(pages, (p0 + pg,), (1,))[0]
-            touched = (p0 + pg) * self._page < offset + C
-            pool_leaves = self._scatter_page(
-                pool_leaves, view_leaves, p0 + pg,
-                jnp.where(touched, tid, 0))
+        pool_leaves = self._scatter_chunk_pages(
+            jax.tree.leaves(state["pool"]), view_leaves, self._cache_axes,
+            pages, offset, C)
         new_state = dict(
             state,
             pool=jax.tree.unflatten(self._cache_struct, pool_leaves),
@@ -1011,19 +1127,41 @@ class ServingEngine:
         if bank is not None:
             new_state["adapter_idx"] = state["adapter_idx"].at[slot].set(aidx)
         if dparams is not None:
-            dc = jax.tree.map(
-                lambda full: jax.lax.dynamic_slice(
-                    full, (slot,) + (0,) * (full.ndim - 1),
-                    (1,) + full.shape[1:])[0],
-                state["draft"])
-            _, dc = self._draft_module.apply(
-                {"params": dparams}, ids_c, cache=dc, cache_pos=offset)
-            new_state["draft"] = jax.tree.map(
-                lambda full, one: jax.lax.dynamic_update_slice(
-                    full, one[None].astype(full.dtype),
-                    (slot,) + (0,) * one.ndim),
-                state["draft"], dc)
+            # The draft stays base-weight even under an adapter bank: its
+            # proposals only steer acceptance, never the emitted law.
+            dview = self._gather_view(state["dpool"], dpages,
+                                      self._draft_cache_axes,
+                                      self._draft_cache_struct)
+            _, dview = self._draft_module.apply(
+                {"params": dparams}, ids_c, cache=dview, cache_pos=offset)
+            new_state["dpool"] = jax.tree.unflatten(
+                self._draft_cache_struct,
+                self._scatter_chunk_pages(
+                    jax.tree.leaves(state["dpool"]), jax.tree.leaves(dview),
+                    self._draft_cache_axes, dpages, offset, C))
         return new_state, tok[0], block
+
+    def _draft_chunk_fn(self, dparams, state, ids_c, slot, dpages, offset):
+        """Draft-only chunk forward: rebuild a prefix-restored slot's draft
+        KV for one already-committed chunk (the restored target pages carry
+        no draft KV). Runs the cheap draft model only — the target's
+        prefix-cache FLOP savings survive — and scatters the chunk's draft
+        pages exactly like the fused prefill. Compiled (and warmed) only on
+        draft-mode speculative engines with a prefix cache attached."""
+        del slot  # symmetry with the fused chunk program's signature
+        C = ids_c.shape[1]
+        dview = self._gather_view(state["dpool"], dpages,
+                                  self._draft_cache_axes,
+                                  self._draft_cache_struct)
+        _, dview = self._draft_module.apply(
+            {"params": dparams}, ids_c, cache=dview, cache_pos=offset)
+        return dict(
+            state,
+            dpool=jax.tree.unflatten(
+                self._draft_cache_struct,
+                self._scatter_chunk_pages(
+                    jax.tree.leaves(state["dpool"]), jax.tree.leaves(dview),
+                    self._draft_cache_axes, dpages, offset, C)))
 
     def _paged_restore_prefix_fn(self, state, block, pages_c, slot, true_len):
         """Copy-restore for paged engines with an EXTERNAL (fleet-shared)
@@ -1051,18 +1189,51 @@ class ServingEngine:
             pos=state["pos"].at[slot].set(true_len),
         )
 
-    def _gather_views_all_slots(self, pool, table):
+    def _gather_views_all_slots(self, pool, table, axes=None, struct=None):
         """Batched :meth:`_gather_view`: ``table`` [S, Np] → per-leaf
         ``[S, 1, Np*P, ...]`` dense views, slot axis leading so the decode
-        vmap runs over it unchanged."""
+        vmap runs over it unchanged. ``axes``/``struct`` default to the
+        target cache geometry (the draft pool passes its own)."""
+        axes = self._cache_axes if axes is None else axes
+        struct = self._cache_struct if struct is None else struct
         leaves = []
-        for l, ax in zip(jax.tree.leaves(pool), self._cache_axes):
+        for l, ax in zip(jax.tree.leaves(pool), axes):
             g = jnp.moveaxis(l[table], 1, ax + 1)
             shape = (list(g.shape[:ax + 1])
                      + [g.shape[ax + 1] * g.shape[ax + 2]]
                      + list(g.shape[ax + 3:]))
             leaves.append(g.reshape(shape))
-        return jax.tree.unflatten(self._cache_struct, leaves)
+        return jax.tree.unflatten(struct, leaves)
+
+    def _scatter_slot_pages(self, pool_leaves, nv_leaves, axes, table,
+                            active, pos, last_off, steps):
+        """Scatter every slot's speculative writes back into the pool: the
+        pages covering positions ``pos[s] .. pos[s] + last_off``, in a
+        FIXED ``steps`` scatter steps per slot. Steps past the touched
+        range, and every step of an inactive slot, route to scratch (page
+        0) — the same clamp pairing as :meth:`_scatter_page`."""
+        P = self._page
+        for s in range(self.max_slots):
+            p0 = pos[s] // P
+            for pg in range(steps):
+                tid = jax.lax.dynamic_slice(table[s], (p0 + pg,), (1,))[0]
+                touched = (p0 + pg) * P <= pos[s] + last_off
+                tgt = jnp.where(active[s] & touched, tid, 0)
+                new_pool = []
+                for pl, vl, ax in zip(pool_leaves, nv_leaves, axes):
+                    start = [0] * vl.ndim
+                    start[0] = s
+                    start[ax + 1] = (p0 + pg) * P
+                    sizes = list(vl.shape)
+                    sizes[0] = 1
+                    sizes[ax + 1] = P
+                    pb = jax.lax.dynamic_slice(vl, tuple(start),
+                                               tuple(sizes))[0]
+                    new_pool.append(jax.lax.dynamic_update_slice(
+                        pl, pb[None].astype(pl.dtype),
+                        (tgt,) + (0,) * pb.ndim))
+                pool_leaves = new_pool
+        return pool_leaves
 
     def _paged_decode_fn(self, params, state, active, table, bank=None):
         """Paged twin of :meth:`_decode_fn`: gather every slot's view, run
@@ -1120,98 +1291,146 @@ class ServingEngine:
         )
         return state, toks, dones
 
-    def _spec_fn(self, params, dparams, state, active, table, remaining):
-        """One SPECULATIVE tick (greedy, paged, single-chip): per slot, scan
-        K greedy draft steps through the slot's dense draft cache, verify
-        draft + carry token in ONE fixed ``[1, K+1]`` target forward
-        against the paged view, and accept the longest prefix where the
-        draft matches the target's emitted chain. The emitted chain
-        replays :func:`generation._next_token`'s eos latch (once eos, all
-        later emissions are eos), so committing its first ``n`` tokens is
-        token-identical to ``n`` dense greedy ticks. ``n = min(accepted +
-        1, remaining)`` — remaining is per-slot traced data, so a stream
-        never overruns its ``max_new_tokens``. The carry rng is untouched
-        (greedy selection never consumes it), keeping spec streams
-        comparable to dense greedy ones.
+    def _spec_accept(self, logits, drafts, done, rem, rng):
+        """Per-slot accept epilogue shared by BOTH speculative programs
+        (draft-model and prompt-lookup): run the factored accept rule
+        (:func:`generation.speculative_emit` — greedy longest-matching-
+        prefix, or the exact rejection-sampling rule when this engine
+        samples) and derive the slot's committed count, carry token, and
+        eos latch. Greedy engines pass the rng through UNTOUCHED (greedy
+        selection never consumes it — spec streams stay bit-comparable to
+        dense greedy ones); sampled engines split it once per tick, so a
+        slot's rng trajectory is one split per verify, mirroring one split
+        per dense tick."""
+        K = drafts.shape[0]
+        if self._sampling is not None:
+            rng, step_rng = jax.random.split(rng)
+        else:
+            step_rng = rng  # unused by the greedy rule
+        m, emit = speculative_emit(logits, drafts, step_rng, self._warp,
+                                   self.eos_token_id, drafts.dtype,
+                                   prior_done=done)
+        n = jnp.minimum(m + 1, rem)
+        new_tok = emit[jnp.clip(n - 1, 0, K)]
+        if self.eos_token_id is not None:
+            new_done = new_tok == jnp.asarray(self.eos_token_id,
+                                              drafts.dtype)
+        else:
+            new_done = done
+        return emit, n, new_tok, new_done, rng
 
-        Rejected-draft KV (positions past ``pos + n - 1``) is garbage, but
-        the NEXT verify rewrites positions ``pos+n .. pos+n+K`` before any
-        query can attend them — the same overwrite-before-attend argument
-        the chunked prefill pad relies on. Returns
-        ``(state, emitted [S, K+1], n [S])``."""
+    def _spec_fn(self, params, dparams, state, active, table, dtable,
+                 remaining, bank=None):
+        """One SPECULATIVE tick, draft-model mode: per slot, scan K draft
+        steps through the slot's PAGED draft view (drafts are the argmax
+        of the warped draft logits — a delta proposal, so the sampled
+        accept rule stays exact), verify draft + carry token in ONE fixed
+        ``[1, K+1]`` target forward against the paged target view (the
+        slot's adapter row gathered inside, like the dense tick), and
+        accept via :meth:`_spec_accept`. Committing the emitted chain's
+        first ``n = min(accepted + 1, remaining)`` tokens is
+        token-identical (greedy) / distribution-exact (sampled) to ``n``
+        dense ticks.
+
+        Rejected-draft KV (positions past ``pos + n - 1``) is garbage in
+        BOTH pools, but the next verify rewrites target positions
+        ``pos+n .. pos+n+K`` and the next draft scan rewrites draft
+        positions ``pos+n .. pos+n+K-1`` before any query can attend them
+        — the same overwrite-before-attend argument the chunked prefill
+        pad relies on. Returns ``(state, emitted [S, K+1], n [S])``."""
         P, K = self._page, self._spec_k
         views = self._gather_views_all_slots(state["pool"], table)
+        dviews = self._gather_views_all_slots(
+            state["dpool"], dtable, self._draft_cache_axes,
+            self._draft_cache_struct)
 
-        def one_slot(view, dcache, tok, pos, done, rem):
+        def one_slot(view, dview, tok, pos, done, rem, rng, aidx=None):
             def dstep(carry, _):
                 dc, cur, p = carry
                 dlog, dc = self._draft_module.apply(
                     {"params": dparams}, cur[None, None], cache=dc,
                     cache_pos=p)
-                nxt = jnp.argmax(dlog[0, -1], axis=-1).astype(tok.dtype)
+                row = dlog[0, -1][None]
+                if self._warp is not None:
+                    row = self._warp(row)
+                nxt = jnp.argmax(row[0], axis=-1).astype(tok.dtype)
                 return (dc, nxt, p + 1), nxt
-            (dcache, _, _), drafts = jax.lax.scan(
-                dstep, (dcache, tok, pos), None, length=K)
+            (dview, _, _), drafts = jax.lax.scan(
+                dstep, (dview, tok, pos), None, length=K)
             ids_v = jnp.concatenate([tok[None], drafts])[None]
             logits, view = self.module.apply(
-                {"params": params}, ids_v, cache=view, cache_pos=pos)
-            preds = jnp.argmax(logits[0], axis=-1).astype(tok.dtype)
-            if self.eos_token_id is not None:
-                eos = jnp.asarray(self.eos_token_id, tok.dtype)
+                {"params": params}, ids_v, cache=view, cache_pos=pos,
+                **self._lora_kwargs(bank, aidx))
+            emit, n, new_tok, new_done, rng = self._spec_accept(
+                logits[0], drafts, done, rem, rng)
+            return view, dview, new_tok, n, emit, new_done, rng
 
-                def latch(d0, p):
-                    t = jnp.where(d0, eos, p)
-                    return d0 | (t == eos), t
-                _, emit = jax.lax.scan(latch, done, preds)
-            else:
-                emit = preds
-            matches = (drafts == emit[:K]).astype(jnp.int32)
-            m = jnp.sum(jnp.cumprod(matches))
-            n = jnp.minimum(m + 1, rem)
-            new_tok = emit[jnp.clip(n - 1, 0, K)]
-            if self.eos_token_id is not None:
-                new_done = new_tok == jnp.asarray(self.eos_token_id,
-                                                  tok.dtype)
-            else:
-                new_done = done
-            return view, dcache, new_tok, n, emit, new_done
-
-        new_views, new_draft, toks, ns, emit, dones = jax.vmap(one_slot)(
-            views, state["draft"], state["tok"], state["pos"],
-            state["done"], remaining)
-        nv_leaves = jax.tree.leaves(new_views)
-        pool_leaves = jax.tree.leaves(state["pool"])
-        # A verify writes positions pos .. pos+K: at most K//P + 2 pages.
-        # Pages past the slot's allocated frontier (table entry 0, or the
+        vmap_args = [views, dviews, state["tok"], state["pos"],
+                     state["done"], remaining, state["rng"]]
+        if bank is not None:
+            vmap_args.append(state["adapter_idx"])
+        (new_views, new_dviews, toks, ns, emit, dones,
+         rngs) = jax.vmap(one_slot)(*vmap_args)
+        # A verify writes target positions pos .. pos+K (K//P + 2 scatter
+        # steps); the draft scan writes draft positions pos .. pos+K-1.
+        # Pages past the slot's allocated frontier (table entry 0, or an
         # untouched trailing step) land in scratch; their positions are
         # rewritten by the next verify before anything attends them.
-        for s in range(self.max_slots):
-            p0 = state["pos"][s] // P
-            for pg in range(K // P + 2):
-                tid = jax.lax.dynamic_slice(table[s], (p0 + pg,), (1,))[0]
-                touched = (p0 + pg) * P <= state["pos"][s] + K
-                tgt = jnp.where(active[s] & touched, tid, 0)
-                new_pool = []
-                for pl, vl, ax in zip(pool_leaves, nv_leaves,
-                                      self._cache_axes):
-                    start = [0] * vl.ndim
-                    start[0] = s
-                    start[ax + 1] = (p0 + pg) * P
-                    sizes = list(vl.shape)
-                    sizes[0] = 1
-                    sizes[ax + 1] = P
-                    pb = jax.lax.dynamic_slice(vl, tuple(start),
-                                               tuple(sizes))[0]
-                    new_pool.append(jax.lax.dynamic_update_slice(
-                        pl, pb[None].astype(pl.dtype),
-                        (tgt,) + (0,) * pb.ndim))
-                pool_leaves = new_pool
+        pool_leaves = self._scatter_slot_pages(
+            jax.tree.leaves(state["pool"]), jax.tree.leaves(new_views),
+            self._cache_axes, table, active, state["pos"], K, K // P + 2)
+        dpool_leaves = self._scatter_slot_pages(
+            jax.tree.leaves(state["dpool"]), jax.tree.leaves(new_dviews),
+            self._draft_cache_axes, dtable, active, state["pos"], K - 1,
+            (K - 1) // P + 2)
         state = dict(
             state,
             pool=jax.tree.unflatten(self._cache_struct, pool_leaves),
-            draft=new_draft,
+            dpool=jax.tree.unflatten(self._draft_cache_struct, dpool_leaves),
             pos=jnp.where(active, state["pos"] + ns, state["pos"]),
             tok=jnp.where(active, toks, state["tok"]),
+            rng=jnp.where(active[:, None], rngs, state["rng"]),
+            done=jnp.where(active, dones, state["done"]),
+        )
+        return state, emit, ns
+
+    def _spec_lookup_fn(self, params, state, active, table, remaining,
+                        proposals, bank=None):
+        """One SPECULATIVE tick, prompt-lookup mode: ``proposals`` [S, K]
+        arrive as traced host data (the n-gram matcher runs in numpy —
+        see :meth:`_lookup_proposals`), so the program is just the target
+        verify + accept: no draft model, no draft KV, no second pool. A
+        miss proposes garbage the verifier rejects at its first token —
+        correctness never depends on proposal quality. Returns
+        ``(state, emitted [S, K+1], n [S])`` like :meth:`_spec_fn`."""
+        P, K = self._page, self._spec_k
+        views = self._gather_views_all_slots(state["pool"], table)
+
+        def one_slot(view, tok, pos, done, rem, rng, drafts, aidx=None):
+            drafts = drafts.astype(tok.dtype)
+            ids_v = jnp.concatenate([tok[None], drafts])[None]
+            logits, view = self.module.apply(
+                {"params": params}, ids_v, cache=view, cache_pos=pos,
+                **self._lora_kwargs(bank, aidx))
+            emit, n, new_tok, new_done, rng = self._spec_accept(
+                logits[0], drafts, done, rem, rng)
+            return view, new_tok, n, emit, new_done, rng
+
+        vmap_args = [views, state["tok"], state["pos"], state["done"],
+                     remaining, state["rng"], proposals]
+        if bank is not None:
+            vmap_args.append(state["adapter_idx"])
+        new_views, toks, ns, emit, dones, rngs = jax.vmap(one_slot)(
+            *vmap_args)
+        pool_leaves = self._scatter_slot_pages(
+            jax.tree.leaves(state["pool"]), jax.tree.leaves(new_views),
+            self._cache_axes, table, active, state["pos"], K, K // P + 2)
+        state = dict(
+            state,
+            pool=jax.tree.unflatten(self._cache_struct, pool_leaves),
+            pos=jnp.where(active, state["pos"] + ns, state["pos"]),
+            tok=jnp.where(active, toks, state["tok"]),
+            rng=jnp.where(active[:, None], rngs, state["rng"]),
             done=jnp.where(active, dones, state["done"]),
         )
         return state, emit, ns
@@ -1366,16 +1585,29 @@ class ServingEngine:
         capacity is slots, which ``free_slots`` already reports)."""
         return self._pool.free_pages if self._paged else 0
 
+    @property
+    def _spec_page_factor(self) -> int:
+        """Pages-per-token multiplier for admission math: a draft-model
+        speculative engine allocates a DRAFT page alongside every target
+        page (same pool, same id space), so its real per-request footprint
+        is double the token count's page cost. Lookup-mode speculation
+        drafts from host data and costs nothing extra."""
+        return 2 if self._spec_mode == "draft" else 1
+
     def page_deficit(self, total_tokens: int) -> int:
         """How many pages this engine is SHORT for a request of
         ``total_tokens`` (prompt + max_new): 0 means the pool can hold it
         right now, >0 means admitting it would lean on preemption. Dense
         engines reserve a full max_len row per slot, so they are never
         page-starved (0). The router folds this into its least-loaded
-        score so long prompts route to replicas with free pages."""
+        score so long prompts route to replicas with free pages — and a
+        draft-speculating replica reports its doubled footprint
+        (:attr:`_spec_page_factor`), so the router never over-admits it
+        relative to its real pool pressure."""
         if not self._paged or total_tokens <= 0:
             return 0
-        needed = -(-int(total_tokens) // self._page)
+        needed = (-(-int(total_tokens) // self._page)
+                  * self._spec_page_factor)
         return max(0, needed - self._pool.free_pages)
 
     @property
@@ -1426,8 +1658,9 @@ class ServingEngine:
         pool pressure rather than queue depth")."""
         if not self._paged or total_tokens <= 0:
             return 0
-        needed = -(-int(total_tokens) // self._page)
-        queued = -(-int(self._queue.pending_tokens) // self._page)
+        factor = self._spec_page_factor
+        needed = -(-int(total_tokens) // self._page) * factor
+        queued = -(-int(self._queue.pending_tokens) // self._page) * factor
         return max(0, needed + queued - self._pool.free_pages)
 
     @property
@@ -1508,12 +1741,16 @@ class ServingEngine:
             # A lone request must always be satisfiable: with everyone else
             # preempted and the alias cache drained, its worst-case footprint
             # has to fit the pool, or admission could wedge forever.
-            need = -(-(S + request.max_new_tokens) // self._page)
+            need = (-(-(S + request.max_new_tokens) // self._page)
+                    * self._spec_page_factor)
             if need > self._pool.num_pages:
                 raise ValueError(
                     f"request needs up to {need} KV pages (prompt {S} + "
                     f"max_new_tokens {request.max_new_tokens} at page_size "
-                    f"{self._page}) but the pool only has "
+                    f"{self._page}"
+                    + (", doubled for draft KV pages"
+                       if self._spec_page_factor > 1 else "")
+                    + f") but the pool only has "
                     f"{self._pool.num_pages}; raise max_pages or shorten "
                     "the request")
         if self._spec_k is not None:
@@ -1521,8 +1758,9 @@ class ServingEngine:
             # (S + max_new - 1) + K; the draft scan stops one short.
             K = self._spec_k
             _check_position_bound(self.module, S + request.max_new_tokens + K)
-            _check_position_bound(self._draft_module,
-                                  S + request.max_new_tokens + K - 1)
+            if self._draft_module is not None:
+                _check_position_bound(self._draft_module,
+                                      S + request.max_new_tokens + K - 1)
         else:
             _check_position_bound(self.module, S + request.max_new_tokens)
         if request.trace_id is None:
@@ -1649,7 +1887,7 @@ class ServingEngine:
         totals, occupancy, allocation and preemption counters."""
         if not self._paged:
             return {}
-        return {
+        out = {
             "page_size": self._page,
             "pages_per_slot": self._pages_per_slot,
             "page_bytes": self._page_bytes,
@@ -1659,6 +1897,11 @@ class ServingEngine:
             "page_allocations": self._pool.allocations,
             "preemptions": self._pool.preemptions,
         }
+        if self._spec_mode == "draft":
+            # Draft pages share the pool's id space but are smaller bytes:
+            # capacity planning needs both figures.
+            out["draft_page_bytes"] = self._draft_page_bytes
+        return out
 
     def decode_memory_analysis(self):
         """``CompiledMemoryStats`` for the decode tick, compiled FRESH from
@@ -1867,26 +2110,33 @@ class ServingEngine:
             self._pool.decref(int(pid))
 
     def _release_slot_pages(self, slot: int):
-        """Drop the slot's reference on every table entry and clear the
-        row. Aliased pages shared with the prefix cache or other slots
-        stay allocated until their last reference goes."""
-        row = self._table[slot]
-        for idx in range(self._pages_per_slot):
-            if row[idx]:
-                self._pool.decref(int(row[idx]))
-        row[:] = 0
+        """Drop the slot's reference on every table entry (draft-table
+        entries too, when a draft model speculates) and clear the rows.
+        Aliased pages shared with the prefix cache or other slots stay
+        allocated until their last reference goes."""
+        rows = [self._table[slot]]
+        if self._dtable is not None:
+            rows.append(self._dtable[slot])
+        for row in rows:
+            for idx in range(self._pages_per_slot):
+                if row[idx]:
+                    self._pool.decref(int(row[idx]))
+            row[:] = 0
 
-    def _alloc_page_into(self, req: Request, idx: int) -> bool:
-        """Allocate one pool page into ``table[req.slot, idx]``. On
-        exhaustion, first reclaim alias-cache entries LRU-first (an entry
-        whose pages nobody else references frees real pages), then preempt
-        other streams. False only when the requester is alone and the pool
-        is still dry — which the submit-time page bound makes impossible,
-        so callers treat it as an engine invariant violation."""
+    def _alloc_page_into(self, req: Request, idx: int, table=None) -> bool:
+        """Allocate one pool page into ``table[req.slot, idx]`` (the
+        TARGET table by default; draft-mode callers pass ``self._dtable``
+        — one pool, one id space). On exhaustion, first reclaim
+        alias-cache entries LRU-first (an entry whose pages nobody else
+        references frees real pages), then preempt other streams. False
+        only when the requester is alone and the pool is still dry — which
+        the submit-time page bound makes impossible, so callers treat it
+        as an engine invariant violation."""
+        table = self._table if table is None else table
         while True:
             pid = self._pool.alloc()
             if pid is not None:
-                self._table[req.slot, idx] = pid
+                table[req.slot, idx] = pid
                 return True
             if (self._alias_cache and self._prefix_cache is not None
                     and self._prefix_cache.evict_lru()):
@@ -1903,6 +2153,17 @@ class ServingEngine:
         for idx in range(req._page_floor, upto_pos // self._page + 1):
             if not row[idx]:
                 if not self._alloc_page_into(req, idx):
+                    return False
+        return True
+
+    def _ensure_draft_pages(self, req: Request, upto_pos: int) -> bool:
+        """Draft-table twin of :meth:`_ensure_pages`. The draft cache is
+        linear (no window floor): draft pages live for the stream's whole
+        slot residency and are released with the target's."""
+        row = self._dtable[req.slot]
+        for idx in range(upto_pos // self._page + 1):
+            if not row[idx]:
+                if not self._alloc_page_into(req, idx, table=self._dtable):
                     return False
         return True
 
@@ -2026,7 +2287,7 @@ class ServingEngine:
         S = req._serve_ids.shape[1]
         C = self._chunk
         if self._paged:
-            need = -(-S // self._page)
+            need = -(-S // self._page) * self._spec_page_factor
             if need > self._pool.free_pages + self._reclaimable_pages():
                 self._flight.record(
                     "pool_exhausted", trace_id=req.trace_id,
@@ -2092,6 +2353,24 @@ class ServingEngine:
                             np.int32(S))
                     restored_bytes += sum(
                         l.nbytes for l in jax.tree.leaves(blk))
+                if blocks and self._spec_mode == "draft":
+                    # The cache holds TARGET KV only: draft KV is cheap to
+                    # recompute and caching it would double every entry.
+                    # Rebuild it for the restored span with the draft-only
+                    # chunk program so a prefix-hit slot enters speculation
+                    # with a warm draft cache.
+                    for i in range(len(blocks)):
+                        if not self._ensure_draft_pages(req, (i + 1) * C - 1):
+                            raise RuntimeError(
+                                "page pool exhausted during draft prefix "
+                                "rebuild — the admission gate's draft "
+                                "factor should make this impossible")
+                        ids_c = req._serve_ids[:, i * C:(i + 1) * C]
+                        self._state = self._draft_chunk(
+                            self._draft_params, self._state, ids_c,
+                            np.int32(slot),
+                            self._dtable[req.slot].copy(),
+                            np.int32(i * C))
                 self._stats.record_prefix(looked_up=restorable,
                                           hit=len(blocks),
                                           bytes_restored=restored_bytes,
@@ -2171,12 +2450,18 @@ class ServingEngine:
                     "page pool exhausted mid-prefill with no preemptable "
                     "stream — the submit page bound should make this "
                     "impossible")
-            kw = ({"dparams": self._draft_params}
-                  if self._spec_k is not None else {})
+            extra = self._adapter_args(req)
+            if self._spec_mode == "draft":
+                if not self._ensure_draft_pages(req, offset + C - 1):
+                    raise RuntimeError(
+                        "page pool exhausted mid-prefill for draft KV — "
+                        "the admission gate's draft factor should make "
+                        "this impossible")
+                extra += (self._draft_params, self._dtable[req.slot].copy())
             self._state, tok, block = self._prefill_chunk(
                 self.params, self._state, ids_c, np.int32(req.slot),
                 self._table[req.slot].copy(), np.int32(offset), np.int32(S),
-                req._rng_key, *self._adapter_args(req), **kw)
+                req._rng_key, *extra)
         else:
             self._state, tok, block = self._prefill_chunk(
                 self.params, self._state, ids_c, np.int32(req.slot),
@@ -2338,6 +2623,16 @@ class ServingEngine:
                     "page pool exhausted at a speculative tick with no "
                     "preemptable stream — the submit page bound should "
                     "make this impossible")
+            if self._spec_mode == "draft":
+                # Draft writes stop at pos + K - 1 <= cover mid-stream;
+                # near the remaining-budget end any overshoot routes to
+                # scratch inside the program (quality-only, never
+                # correctness), so target cover is enough here too.
+                if not self._ensure_draft_pages(req, cover):
+                    raise RuntimeError(
+                        "page pool exhausted for draft KV at a "
+                        "speculative tick — the admission gate's draft "
+                        "factor should make this impossible")
         running = [(s, r) for s, r in running
                    if r.status is RequestStatus.RUNNING]
         if not running:
@@ -2347,10 +2642,23 @@ class ServingEngine:
         for slot, req in running:
             mask[slot] = True
             remaining[slot] = max(req.max_new_tokens - len(req.tokens), 1)
+        bank = ((self._adapters.stacks,)
+                if self._adapters is not None else ())
+        lookup_hits = 0
         t0 = time.monotonic()
-        self._state, emit, ns = self._spec(
-            self.params, self._draft_params, self._state, jnp.asarray(mask),
-            self._table.copy(), remaining)
+        if self._spec_mode == "lookup":
+            proposals = np.zeros((self.max_slots, K), np.int32)
+            for slot, req in running:
+                proposals[slot], hit = self._lookup_proposals(req)
+                lookup_hits += int(hit)
+            self._state, emit, ns = self._spec(
+                self.params, self._state, jnp.asarray(mask),
+                self._table.copy(), remaining, proposals, *bank)
+        else:
+            self._state, emit, ns = self._spec(
+                self.params, self._draft_params, self._state,
+                jnp.asarray(mask), self._table.copy(), self._dtable.copy(),
+                remaining, *bank)
         emit = np.asarray(emit)
         ns = np.asarray(ns)
         dt = time.monotonic() - t0
@@ -2374,7 +2682,12 @@ class ServingEngine:
                     break
             if not retired and self._page_window is not None:
                 self._free_window_pages(req)
-        self._stats.record_spec(proposed=K * len(running), accepted=accepted)
+        self._stats.record_spec(
+            proposed=K * len(running), accepted=accepted,
+            lookup_hits=(lookup_hits if self._spec_mode == "lookup"
+                         else None),
+            lookup_slots=(len(running) if self._spec_mode == "lookup"
+                          else 0))
         self._decode_ticks += 1
         self._stats.record_tick(active_slots=len(running),
                                 committed_tokens=committed,
@@ -2393,6 +2706,35 @@ class ServingEngine:
                                  self._pool.used_pages,
                                  self._pool.num_pages,
                                  freed_total=self._pool.frees)
+
+    def _lookup_proposals(self, req: Request):
+        """Prompt-lookup drafting: propose the ``K`` tokens that followed
+        the most recent earlier occurrence of the stream's last ``n``
+        tokens (prompt + committed output), no draft model involved. On a
+        miss the proposal is the last token repeated — a deliberately weak
+        draft that still verifies correctly, so a miss costs acceptance
+        rate, never exactness. Returns ``(proposal[K] int32, hit bool)``.
+
+        This is pure host work on a few-KiB token array per slot per
+        tick; the device only ever sees the proposal as traced data."""
+        K = self._spec_k
+        n = self._spec_lookup
+        seq = np.concatenate([
+            np.asarray(req._serve_ids[0][:req._pos_base + 1], np.int32),
+            np.asarray(req.tokens, np.int32)])
+        if len(seq) > n:
+            pattern = seq[-n:]
+            windows = np.lib.stride_tricks.sliding_window_view(seq[:-1], n)
+            hits = np.nonzero((windows == pattern).all(axis=1))[0]
+            if hits.size:
+                start = int(hits[-1]) + n
+                prop = seq[start:start + K]
+                if prop.size < K:
+                    prop = np.concatenate(
+                        [prop, np.full((K - prop.size,), seq[-1],
+                                       np.int32)])
+                return prop, True
+        return np.full((K,), seq[-1], np.int32), False
 
     def _commit_token(self, req: Request, token: int) -> bool:
         """Append + stream one token. A raising ``on_token`` callback fails

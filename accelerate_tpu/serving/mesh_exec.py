@@ -218,7 +218,16 @@ class SliceExec:
         where a slot cache leaf is ``[max_slots, L, heads, hd]`` — the
         leading axis is just pages instead of slots (replicated either
         way; pages are data-parallel rows), and the heads axis sits at the
-        same template-relative offset."""
+        same template-relative offset.
+
+        A speculative engine's DRAFT page pool (``dpool``) deliberately
+        lands in the replicated bucket with the scalar rows: the draft is
+        small, its K-step scan is latency- not FLOP-bound, and keeping its
+        params and KV whole on every chip means the draft scan runs with
+        zero collectives — only the wide target verify pays (and benefits
+        from) the tp sharding. This is the GSPMD composition the
+        speculative ``_spec`` program relies on: replicated draft feeding
+        a tp-sharded verify needs no new communication machinery."""
         import jax
 
         kv_key = "pool" if "pool" in state else "cache"
@@ -226,7 +235,12 @@ class SliceExec:
             jax.tree.structure(state[kv_key]),
             self.cache_leaf_shardings(template_leaves, length_axes,
                                       with_slot_axis=True))
-        return {key: (kv_sh if key == kv_key else self.replicated)
+        # Non-KV entries expand to a full subtree of replicated shardings
+        # (not a prefix leaf): ``place`` tree-maps state against this
+        # strictly, and the draft pool is a pytree, not a row.
+        return {key: (kv_sh if key == kv_key
+                      else jax.tree.map(lambda _: self.replicated,
+                                        state[key]))
                 for key in state}
 
     def block_shardings(self, cache_structure, template_leaves, length_axes):

@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import threading
 from bisect import bisect_left
+from typing import Optional
 
 #: Shared latency bucket bounds (milliseconds) for the exported
 #: histograms — wide enough to cover sub-ms CPU ticks and multi-second
@@ -152,6 +153,9 @@ class ServingStats:
             self._spec_ticks = 0
             self._spec_proposed = 0
             self._spec_accepted = 0
+            # Prompt-lookup drafting: slots whose n-gram matcher hit.
+            self._spec_lookup_slots = 0
+            self._spec_lookup_hits = 0
             # Per-adapter (multi-tenant LoRA) counters:
             # name -> {requests, tokens, hits, misses, loads, evictions}.
             self._adapter: dict = {}
@@ -242,15 +246,24 @@ class ServingStats:
         with self._lock:
             self._preemptions += 1
 
-    def record_spec(self, proposed: int, accepted: int):
+    def record_spec(self, proposed: int, accepted: int,
+                    lookup_hits: Optional[int] = None,
+                    lookup_slots: int = 0):
         """One speculative tick: the draft proposed ``proposed`` tokens
         across active slots, the target verify accepted ``accepted``
         (committed tokens beyond the one-per-tick baseline count here too:
-        accepted / ticks is tokens-per-tick, the headline spec metric)."""
+        accepted / ticks is tokens-per-tick, the headline spec metric).
+        Prompt-lookup engines also report how many of the tick's
+        ``lookup_slots`` found an n-gram match (``lookup_hits``) — the
+        hit rate says whether the traffic shape suits draft-free
+        speculation at all."""
         with self._lock:
             self._spec_ticks += 1
             self._spec_proposed += int(proposed)
             self._spec_accepted += int(accepted)
+            if lookup_hits is not None:
+                self._spec_lookup_slots += int(lookup_slots)
+                self._spec_lookup_hits += int(lookup_hits)
 
     def record_prefix_cache_size(self, nbytes: int, entries: int):
         """Gauge: the prefix cache's current footprint after an insert or
@@ -347,7 +360,8 @@ class ServingStats:
                       "_pages_free", "_pages_used", "_pages_total",
                       "_pages_freed",
                       "_preemptions", "_spec_ticks", "_spec_proposed",
-                      "_spec_accepted"):
+                      "_spec_accepted", "_spec_lookup_slots",
+                      "_spec_lookup_hits"):
                 setattr(self, k, getattr(self, k) + o[k])
             for k in ("_queue_wait_ms_max", "_ttft_ms_max",
                       "_prefill_backlog_max"):
@@ -443,6 +457,9 @@ class ServingStats:
                     (self._spec_accepted + self._spec_ticks)
                     / self._spec_ticks, 4)
                     if self._spec_ticks else 0.0,
+                "spec_lookup_hit_rate": round(
+                    self._spec_lookup_hits / self._spec_lookup_slots, 4)
+                    if self._spec_lookup_slots else 0.0,
             }
             # Multi-tenant LoRA: flat aggregates plus per-name counters
             # ("adapter/<name>/<counter>" — slash-pathed like tracker keys;

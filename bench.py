@@ -367,6 +367,43 @@ def _sleepy_llama_cls(step_ms: float, per_token: bool = False):
     return _SleepyLlama
 
 
+def _biased_llama_cls(bias: float = 50.0, period: int = 6, lo: int = 9):
+    """A tiny-Llama subclass whose logits get a DETERMINISTIC next-token
+    bias: position ``i``'s logits are dominated by a ``bias``-sized
+    one-hot on ``(ids[i] + 1) % period + lo`` — a fixed permutation walk
+    over ``[lo, lo + period)``. The speculation accept-rate guards run on
+    this, not on a random tiny model, because a random model's near-tied
+    bf16 logits make draft-vs-target argmax agreement a coin flip (the
+    PR 7 flake): here the target chain is a closed token cycle, any
+    draft sharing the class proposes it exactly, a prompt-lookup matcher
+    re-finds it after one period, and temperature sampling concentrates
+    ~all mass on it (``exp(bias)`` dominance) so the rejection rule
+    accepts too. The real transformer still runs — its logits survive,
+    quantized to a coarse grid and scaled to 0.01 so they can never flip
+    the argmax (or the sampled law) yet keep XLA from eliding the
+    forward — and the walk avoids the test EOS id (7) by construction."""
+    import jax
+    import jax.numpy as jnp
+
+    from accelerate_tpu.models.llama import LlamaForCausalLM
+
+    class _BiasedLlama(LlamaForCausalLM):
+        def apply(self, variables, *args, **kwargs):
+            out = super().apply(variables, *args, **kwargs)
+            ids = args[0] if args else kwargs["input_ids"]
+            if isinstance(out, tuple):
+                logits, cache = out
+            else:
+                logits, cache = out, None
+            nxt = (ids + 1) % period + lo
+            hot = jax.nn.one_hot(nxt, logits.shape[-1], dtype=logits.dtype)
+            logits = (jnp.round(logits * 8.0) / 8.0 * 0.01
+                      + jnp.asarray(bias, logits.dtype) * hot)
+            return logits if cache is None else (logits, cache)
+
+    return _BiasedLlama
+
+
 def continuous_vs_static(n_short: int = 3, short_new_tokens: int = 8,
                          long_new_tokens: int = 48, arrival_ms: float = 5.0,
                          prompt_len: int = 4, max_slots: int = 4,
@@ -1004,8 +1041,9 @@ def serving_tp_bench(n_requests: int = 3, prompt_len: int = 6,
 
     * ``tokens_equal`` — tp=2 must be token-identical to tp=1 (GSPMD
       shards the math, never changes it);
-    * ``warm_executables`` — both engines hold exactly the three warm
-      programs (chunk / decode tick / restore), sharded or not;
+    * ``warm_executables`` — both engines hold exactly the warm
+      programs (chunk / decode tick; paged engines alias prefix
+      restores and compile no restore program), sharded or not;
     * ``kv_per_chip_ratio`` — live KV state bytes per chip ≈ 1/tp;
     * ``compiled_arg_bytes`` — ``memory_analysis()`` of a fresh decode
       compile, showing XLA itself plans ~1/tp the argument bytes.
@@ -1037,9 +1075,11 @@ def serving_tp_bench(n_requests: int = 3, prompt_len: int = 6,
                                   max_new_tokens=max_new_tokens,
                                   seed=i, block=True)
                 toks.append(np.asarray(r.result(timeout=120)))
-            warm = [engine._prefill_chunk._cache_size(),
-                    engine._decode._cache_size(),
-                    engine._restore_prefix._cache_size()]
+            # Paged engines alias prefix restores through the page table
+            # and have no compiled restore program (_restore_prefix None).
+            warm = [f._cache_size() for f in
+                    (engine._prefill_chunk, engine._decode,
+                     engine._restore_prefix) if f is not None]
             kv_pc = engine.kv_cache_per_chip_bytes()
             mem = engine.decode_memory_analysis()
             arg_bytes = getattr(mem, "argument_size_in_bytes", None)
@@ -1140,56 +1180,90 @@ def paged_capacity_bench(dense_slots: int = 2, max_len: int = 64,
 
 def speculative_bench(prompt_len: int = 5, new_tokens: int = 24,
                       spec_tokens: int = 4, n_requests: int = 3) -> dict:
-    """Speculative-decoding A/B on the deterministic draft (the draft IS
-    the target model, so every divergence is bf16 near-tie noise, not
-    draft quality): the same greedy requests through a plain paged engine
-    and a speculative one. The payload is ``accepted_tokens_per_step``
-    (committed tokens per verify tick — 1.0 means speculation never
-    helps) and the tick count each engine needed for identical output;
-    wall-clock is not reported (on CPU the K-step draft scan costs more
-    host time than it saves — the win is device steps, which is what
-    ticks count)."""
+    """Speculative-decoding A/B matrix on the deterministic biased-logits
+    fixture (:func:`_biased_llama_cls` — draft and target share the model
+    class, so every divergence is a verify/commit bug, never draft
+    quality or bf16 tie noise). The greedy base case keeps the legacy
+    top-level keys; ``modes`` adds the four configurations PR 7 rejected
+    and this engine now serves: temperature sampling (rejection-sampling
+    accept), an AdapterBank tenant, a tp=2 mesh slice (self-skips below
+    2 devices), and draft-free prompt-lookup. Each entry reports
+    ``accepted_tokens_per_step`` (committed tokens per verify tick — 1.0
+    means speculation never helps) and exactness vs its non-speculative
+    twin on the SAME traffic; wall-clock is not reported (on CPU the
+    K-step draft scan costs more host time than it saves — the win is
+    device steps, which is what ticks count)."""
     import jax
     import numpy as np
 
-    from accelerate_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from accelerate_tpu.adapters import (AdapterBank, LoRAConfig,
+                                         init_lora_params)
+    from accelerate_tpu.models.llama import LlamaConfig
     from accelerate_tpu.serving import ServingEngine
 
-    model = LlamaForCausalLM(LlamaConfig.tiny())
+    model = _biased_llama_cls()(LlamaConfig.tiny())
     params = model.init_params(jax.random.PRNGKey(0))
     rng = np.random.default_rng(1)
-    prompts = rng.integers(1, 200,
+    prompts = rng.integers(9, 15,
                            size=(n_requests, prompt_len)).astype(np.int32)
 
-    def serve(**kw):
+    def serve(adapter=None, seed=None, with_bank=False, **kw):
+        if with_bank:
+            bank = AdapterBank(params, config=LoRAConfig(rank=4),
+                               max_adapters=2)
+            bank.register("tenant", init_lora_params(
+                jax.random.PRNGKey(1), params, LoRAConfig(rank=4)))
+            kw["adapters"] = bank
         engine = ServingEngine(model, params, max_slots=2, max_len=64,
                                prefill_chunk=8, eos_token_id=None, **kw)
         try:
             toks = [np.asarray(
                 engine.submit(prompts[i:i + 1], max_new_tokens=new_tokens,
-                              ignore_eos=True, block=True).result(timeout=300))
+                              ignore_eos=True, block=True, adapter=adapter,
+                              seed=None if seed is None else seed + i)
+                .result(timeout=300))
                 for i in range(n_requests)]
             stats = engine.serving_metrics()
         finally:
             engine.shutdown()
         return toks, stats
 
-    b_toks, b_stats = serve()
-    s_toks, s_stats = serve(draft_model=model, draft_params=params,
-                            spec_tokens=spec_tokens)
-    tokens_equal = all(np.array_equal(a, b) for a, b in zip(b_toks, s_toks))
-    return {
-        "spec_tokens": spec_tokens,
-        "n_requests": n_requests,
-        "new_tokens": new_tokens,
-        "tokens_equal": bool(tokens_equal),
-        "ticks": {"baseline": b_stats["decode_ticks"],
-                  "speculative": s_stats["decode_ticks"]},
-        "tick_ratio": round(b_stats["decode_ticks"]
-                            / max(s_stats["decode_ticks"], 1), 3),
-        "accepted_tokens_per_step": s_stats["spec_tokens_per_tick"],
-        "accept_rate": s_stats["spec_accept_rate"],
+    def ab(spec_kw, base_kw=None, **traffic):
+        base_kw = base_kw or {}
+        b_toks, b_stats = serve(**base_kw, **traffic)
+        s_toks, s_stats = serve(**base_kw, **spec_kw, **traffic)
+        out = {
+            "tokens_equal": bool(all(np.array_equal(a, b)
+                                     for a, b in zip(b_toks, s_toks))),
+            "ticks": {"baseline": b_stats["decode_ticks"],
+                      "speculative": s_stats["decode_ticks"]},
+            "tick_ratio": round(b_stats["decode_ticks"]
+                                / max(s_stats["decode_ticks"], 1), 3),
+            "accepted_tokens_per_step": s_stats["spec_tokens_per_tick"],
+            "accept_rate": s_stats["spec_accept_rate"],
+        }
+        if "spec_lookup" in spec_kw:
+            out["lookup_hit_rate"] = s_stats["spec_lookup_hit_rate"]
+        return out
+
+    draft = dict(draft_model=model, draft_params=params,
+                 spec_tokens=spec_tokens)
+    out = ab(draft)
+    out.update(spec_tokens=spec_tokens, n_requests=n_requests,
+               new_tokens=new_tokens)
+    modes = {
+        "sampled": ab(draft, base_kw=dict(do_sample=True, temperature=0.8),
+                      seed=0),
+        "adapter": ab(draft, adapter="tenant", with_bank=True),
+        "lookup": ab(dict(spec_lookup=2, spec_tokens=spec_tokens)),
     }
+    if jax.device_count() >= 2:
+        modes["tp2"] = ab(draft, base_kw=dict(tp=2))
+    else:
+        modes["tp2"] = {"skipped": "needs >= 2 devices "
+                                   f"(have {jax.device_count()})"}
+    out["modes"] = modes
+    return out
 
 
 def tracing_overhead_bench(n_requests: int = 10, prompt_len: int = 4,
@@ -1969,7 +2043,66 @@ def _arg_value(flag: str) -> str | None:
     return sys.argv[idx + 1] if idx + 1 < len(sys.argv) else None
 
 
+# extra.* scalars the perf guards watch, plus the nested sections whose
+# sub-keys they assert on. Everything else in a round artifact (configs,
+# tails, probe transcripts) is noise for cross-PR diffing.
+_TRAJECTORY_GUARD_KEYS = ("mfu", "step_ms", "achieved_tflops", "cpu_smoke")
+_TRAJECTORY_GUARD_SECTIONS = ("serving", "training", "adapters",
+                              "input_pipeline")
+
+
+def _trajectory_main(root: str | None = None) -> int:
+    """``bench.py --trajectory``: fold every round artifact
+    (``BENCH_r*.json``, the ``{n, cmd, rc, tail, parsed}`` envelope) into
+    one ``BENCH_TRAJECTORY.json`` holding guard keys only, so perf
+    regressions across PRs show up as a one-file diff instead of a
+    side-by-side read of N artifacts."""
+    import glob
+    import os
+
+    root = root or os.path.dirname(os.path.abspath(__file__))
+    rounds = []
+    for path in sorted(glob.glob(os.path.join(root, "BENCH_r*.json"))):
+        name = os.path.basename(path)
+        try:
+            with open(path) as f:
+                raw = json.load(f)
+        except Exception as e:  # noqa: BLE001 - a corrupt round still rides along
+            rounds.append({"artifact": name, "error": f"unreadable: {e}"})
+            continue
+        parsed = raw.get("parsed") or {}
+        extra = parsed.get("extra") or {}
+        guards = {k: extra[k] for k in _TRAJECTORY_GUARD_KEYS if k in extra}
+        for section in _TRAJECTORY_GUARD_SECTIONS:
+            if section in extra:
+                guards[section] = extra[section]
+        if "serving_error" in extra:
+            guards["serving_error"] = extra["serving_error"]
+        row = {"round": raw.get("n"), "artifact": name, "rc": raw.get("rc"),
+               "metric": parsed.get("metric"), "value": parsed.get("value"),
+               "unit": parsed.get("unit"),
+               "vs_baseline": parsed.get("vs_baseline"), "guards": guards}
+        err = parsed.get("error") or raw.get("error")
+        if err:
+            row["error"] = err
+        rounds.append(row)
+    out_path = os.path.join(root, "BENCH_TRAJECTORY.json")
+    with open(out_path, "w") as f:
+        json.dump({"guard_keys": list(_TRAJECTORY_GUARD_KEYS),
+                   "guard_sections": list(_TRAJECTORY_GUARD_SECTIONS),
+                   "rounds": rounds}, f, indent=1, sort_keys=True)
+        f.write("\n")
+    for row in rounds:
+        print(f"  r{row.get('round')}: {row.get('metric')} = "
+              f"{row.get('value')} {row.get('unit') or ''}".rstrip()
+              + (f"  [{row['error']}]" if row.get("error") else ""))
+    print(f"wrote {out_path} ({len(rounds)} rounds)")
+    return 0
+
+
 def _cli() -> int:
+    if "--trajectory" in sys.argv:
+        return _trajectory_main()
     if "--tpu-run" in sys.argv:
         return _tpu_run_main()
     for flag, runner in (("--mesh-run", _mesh_run_main), ("--mesh", main_mesh)):

@@ -142,6 +142,55 @@ def test_worse_live_result_does_not_clobber_best(artifacts, monkeypatch, capsys)
     assert stored["value"] == FAKE_BEST["value"]  # best survives
 
 
+class TestTrajectory:
+    """`bench.py --trajectory` folds the BENCH_rNN round artifacts into one
+    guard-keys-only BENCH_TRAJECTORY.json (the `make bench-trajectory`
+    target), so perf regressions across PRs diff in a single file."""
+
+    def _round(self, n, value, extra, rc=0, error=None):
+        parsed = {"metric": "llama_train_tokens_per_sec_per_chip",
+                  "value": value, "unit": "tokens/s/chip",
+                  "vs_baseline": None, "extra": extra}
+        if error:
+            parsed["error"] = error
+        return {"n": n, "cmd": "python bench.py", "rc": rc,
+                "tail": json.dumps(parsed), "parsed": parsed}
+
+    def test_collects_guard_keys_only(self, tmp_path, capsys):
+        extra = {"mfu": 0.41, "step_ms": 70.0, "achieved_tflops": 81.0,
+                 "cpu_smoke": True,
+                 "serving": {"speculative": {"accepted_tokens_per_step": 4.6}},
+                 "config": {"hidden": 64}, "tunnel_availability": {"up": 0}}
+        (tmp_path / "BENCH_r01.json").write_text(
+            json.dumps(self._round(1, 100.0, extra)))
+        (tmp_path / "BENCH_r02.json").write_text(
+            json.dumps(self._round(2, 90.0, {"mfu": 0.40},
+                                   error="tpu attempt 1: timeout")))
+        assert bench._trajectory_main(root=str(tmp_path)) == 0
+        out = json.loads((tmp_path / "BENCH_TRAJECTORY.json").read_text())
+        assert [r["round"] for r in out["rounds"]] == [1, 2]
+        r1 = out["rounds"][0]
+        assert r1["value"] == 100.0 and r1["rc"] == 0
+        # Guard scalars and guarded sections ride along ...
+        assert r1["guards"]["mfu"] == 0.41
+        assert (r1["guards"]["serving"]["speculative"]
+                ["accepted_tokens_per_step"] == 4.6)
+        # ... but config/probe noise does not: the file must stay diffable.
+        assert "config" not in r1["guards"]
+        assert "tunnel_availability" not in r1["guards"]
+        assert out["rounds"][1]["error"] == "tpu attempt 1: timeout"
+        assert "wrote" in capsys.readouterr().out
+
+    def test_corrupt_artifact_still_rides_along(self, tmp_path, capsys):
+        (tmp_path / "BENCH_r03.json").write_text("{not json")
+        assert bench._trajectory_main(root=str(tmp_path)) == 0
+        out = json.loads((tmp_path / "BENCH_TRAJECTORY.json").read_text())
+        assert len(out["rounds"]) == 1
+        assert out["rounds"][0]["artifact"] == "BENCH_r03.json"
+        assert "unreadable" in out["rounds"][0]["error"]
+        capsys.readouterr()
+
+
 def test_sweep_block_defaults(artifacts):
     """Tier-1 picks up the on-chip sweep's best flash blocks; smoke/absent
     artifacts keep the safe 128/128."""

@@ -251,6 +251,41 @@ class TestCLISubprocess:
         assert "2 pages" in out.stdout
         assert "32tok x 128" in out.stdout
 
+    def test_estimate_memory_spec_tokens(self):
+        out = _run_cli("estimate-memory", "llama-tiny", "--dtypes", "bfloat16",
+                       "--page-size", "16", "--max-pages", "256",
+                       "--seq-lens", "32", "128", "--spec-tokens", "4")
+        assert out.returncode == 0, out.stderr
+        assert "Speculative decoding (--spec-tokens 4):" in out.stdout
+        # Draft KV rides the same pool through a second page-table column
+        # (ServingEngine._spec_page_factor == 2): a 32-token request
+        # covers 4 pages instead of 2, so the 256-page pool fits 64
+        # concurrent requests instead of 128.
+        assert "2x pages per request" in out.stdout
+        assert "32 tokens:      4 pages  (pool fits 64 concurrent)" \
+            in out.stdout
+        # Verify forward widens [1, 1] -> [1, K+1]: the bf16 logits row
+        # grows from vocab*2 = 512 B to (K+1)*vocab*2 = 2.5 KiB per slot
+        # (tiny llama vocab = 256).
+        assert "[1, 1] -> [1, 5]: logits 512 B -> 2.50 KiB/slot" \
+            in out.stdout
+
+    def test_estimate_memory_spec_draft_rank(self):
+        out = _run_cli("estimate-memory", "llama-tiny", "--dtypes", "bfloat16",
+                       "--page-size", "16", "--max-pages", "256",
+                       "--spec-tokens", "4", "--draft-rank", "8")
+        assert out.returncode == 0, out.stderr
+        # Rank-8 draft proxy: 2 (k+v) x 2 layers x 8 x 2 bytes =
+        # 64 B/token -> 1 KiB per 16-token page, +256 KiB over the pool.
+        assert ("draft KV (rank-8 proxy, 2 x 2 layers x 8 x bf16): "
+                "64 B/token, 1.00 KiB/page, pool +256.00 KiB") in out.stdout
+
+    def test_estimate_memory_spec_tokens_needs_page_size(self):
+        out = _run_cli("estimate-memory", "llama-tiny", "--dtypes", "bfloat16",
+                       "--spec-tokens", "4")
+        assert out.returncode == 2
+        assert "--spec-tokens needs --page-size" in out.stdout
+
     def test_estimate_memory_page_sizing_tp(self):
         out = _run_cli("estimate-memory", "llama-tiny", "--dtypes", "bfloat16",
                        "--page-size", "16", "--tp", "2")

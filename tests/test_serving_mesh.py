@@ -267,6 +267,59 @@ class TestZeroRecompileMesh:
             assert tp2_engine._restore_prefix._cache_size() == 1
 
 
+class TestSlicedSpeculation:
+    """tp=2 column of the universal-speculation exactness matrix: a
+    sliced engine speculates (replicated draft feeding the tp-sharded
+    verify) with streams bit-identical to the single-chip non-speculative
+    engine, under the same zero-recompile pin."""
+
+    def _run(self, eng, prompts=PROMPTS, n=24, **kw):
+        reqs = []
+        for p in prompts:
+            reqs.append(eng.submit(p, max_new_tokens=n, **kw))
+            time.sleep(0.01)
+        return [np.asarray(r.result(timeout=180)) for r in reqs]
+
+    def test_tp2_draft_spec_matches_tp1_and_pins_compiles(self, tiny,
+                                                          tp1_engine):
+        _, m, params = tiny
+        eng = ServingEngine(m, params, tp=2, max_slots=3, max_len=64,
+                            eos_token_id=EOS, prefill_chunk=8,
+                            prefix_cache_mb=0.0,
+                            draft_model=m, draft_params=params,
+                            spec_tokens=4)
+        try:
+            with CompileWatcher() as watcher:
+                a = self._run(eng)
+            b = self._run(tp1_engine)
+            s = eng.stats.summary()
+            assert s["spec_ticks"] > 0, s
+            assert eng._spec._cache_size() == 1
+            assert eng._prefill_chunk._cache_size() == 1
+        finally:
+            eng.shutdown(drain=False)
+        for x, y in zip(a, b):
+            assert np.array_equal(x, y), (x, y)
+        assert not watcher.events, (
+            f"XLA recompiled after warmup: {watcher.events} — the sliced "
+            "_spec program must treat draft pages and acceptance as data")
+
+    def test_tp2_lookup_spec_matches_tp1(self, tiny, tp1_engine):
+        _, m, params = tiny
+        eng = ServingEngine(m, params, tp=2, max_slots=3, max_len=64,
+                            eos_token_id=EOS, prefill_chunk=8,
+                            prefix_cache_mb=0.0, spec_lookup=2,
+                            spec_tokens=4)
+        try:
+            a = self._run(eng)
+            b = self._run(tp1_engine)
+            assert eng.stats.summary()["spec_ticks"] > 0
+        finally:
+            eng.shutdown(drain=False)
+        for x, y in zip(a, b):
+            assert np.array_equal(x, y), (x, y)
+
+
 class TestPerChipFootprint:
     def test_kv_per_chip_halved(self, tp1_engine, tp2_engine):
         kv1 = tp1_engine.kv_cache_per_chip_bytes()

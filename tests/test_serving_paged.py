@@ -397,25 +397,194 @@ class TestSpeculativeDecoding:
             eng.shutdown(drain=False)
 
     def test_spec_validation(self, tiny):
+        """Only structural impossibilities reject now: the sampled /
+        adapter / prefix-cache / mesh gates of PR 7 are gone (that lift
+        is this PR's point) and must NOT raise."""
         _, m, params = tiny
         spec = dict(draft_model=m, draft_params=params)
         with pytest.raises(NotImplementedError, match="paged"):
             ServingEngine(m, params, paged=False, prefill_chunk=8,
                           autostart=False, warmup=False, **spec)
-        with pytest.raises(NotImplementedError, match="greedy"):
-            ServingEngine(m, params, prefill_chunk=8, do_sample=True,
-                          autostart=False, warmup=False, **spec)
-        bank = AdapterBank(params, config=LoRAConfig(rank=4), max_adapters=2)
-        with pytest.raises(NotImplementedError, match="adapter"):
-            ServingEngine(m, params, prefill_chunk=8, adapters=bank,
-                          autostart=False, warmup=False, **spec)
-        with pytest.raises(ValueError, match="prefix cache"):
-            ServingEngine(m, params, prefill_chunk=8,
-                          prefix_cache=PrefixCache(1024 * 1024),
-                          autostart=False, warmup=False, **spec)
         with pytest.raises(ValueError, match="spec_tokens"):
             ServingEngine(m, params, prefill_chunk=8, spec_tokens=0,
                           autostart=False, warmup=False, **spec)
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            ServingEngine(m, params, prefill_chunk=8, spec_lookup=3,
+                          autostart=False, warmup=False, **spec)
+        with pytest.raises(ValueError, match="spec_lookup"):
+            ServingEngine(m, params, prefill_chunk=8, spec_lookup=0,
+                          autostart=False, warmup=False)
+        # Previously-rejected configurations now construct cleanly.
+        bank = AdapterBank(params, config=LoRAConfig(rank=4), max_adapters=2)
+        for kw in (dict(do_sample=True, temperature=0.8),
+                   dict(adapters=bank),
+                   dict(prefix_cache=PrefixCache(1024 * 1024))):
+            eng = ServingEngine(m, params, prefill_chunk=8, autostart=False,
+                                warmup=False, **spec, **kw)
+            assert eng._spec_mode == "draft"
+        eng = ServingEngine(m, params, prefill_chunk=8, spec_lookup=3,
+                            autostart=False, warmup=False)
+        assert eng._spec_mode == "lookup"
+
+
+class TestUniversalSpeculation:
+    """The exactness matrix for the universal ``_spec`` executable: each
+    previously-rejected mode (sampled, adapter tenant, prefix-cache,
+    draft-free prompt lookup — tp=2 lives in test_serving_mesh.py) must
+    emit exactly what its non-speculative twin emits, and the whole
+    matrix must run through ONE warm ``_spec`` program with the compile
+    listener silent."""
+
+    N = 24
+    BASE = dict(max_slots=3, max_len=64, eos_token_id=EOS, prefill_chunk=8,
+                prefix_cache_mb=0.0)
+    # Spans one-chunk and multi-chunk admission; avoids EOS.
+    LONG = np.arange(1, 20, dtype=np.int32)[None] % 6 + 8
+
+    def _run(self, eng, prompts=PROMPTS, **kw):
+        reqs = []
+        for p in prompts:
+            reqs.append(eng.submit(p, max_new_tokens=self.N, **kw))
+            time.sleep(0.01)
+        return [np.asarray(r.result(timeout=120)) for r in reqs]
+
+    def _pair(self, m, params, spec_kw, base_kw=None, **submit_kw):
+        """(spec streams, non-spec streams) over the same traffic."""
+        base_kw = dict(self.BASE, **(base_kw or {}))
+        prompts = submit_kw.pop("prompts", PROMPTS)
+        e1 = ServingEngine(m, params, **base_kw, **spec_kw)
+        e0 = ServingEngine(m, params, **base_kw)
+        try:
+            a = self._run(e1, prompts=prompts, **submit_kw)
+            b = self._run(e0, prompts=prompts, **submit_kw)
+            assert e1.stats.summary()["spec_ticks"] > 0
+        finally:
+            e1.shutdown(drain=False)
+            e0.shutdown(drain=False)
+        return a, b
+
+    def test_sampled_spec_is_exact_when_determinized(self, tiny):
+        """do_sample + top_k=1 concentrates the warped law on one token,
+        so the rejection-sampling accept path (the SAMPLED branch of
+        speculative_emit, not the greedy one) must reproduce the dense
+        sampled stream bit-exactly — any drift is an accept-rule or
+        rng-discipline bug that randomness would have hidden."""
+        _, m, params = tiny
+        a, b = self._pair(m, params,
+                          dict(draft_model=m, draft_params=params,
+                               spec_tokens=4),
+                          base_kw=dict(do_sample=True, top_k=1), seed=3)
+        for x, y in zip(a, b):
+            assert np.array_equal(x, y), (x, y)
+
+    def test_sampled_spec_is_seed_deterministic(self, tiny):
+        """With temperature the spec stream cannot be compared token-wise
+        to the dense one (same law, different rng consumption), but a
+        fixed per-request seed must still make it reproducible: the
+        per-slot rng rows split exactly once per verify tick."""
+        _, m, params = tiny
+        kw = dict(self.BASE, do_sample=True, temperature=0.8,
+                  draft_model=m, draft_params=params, spec_tokens=4)
+        outs = []
+        for _ in range(2):
+            eng = ServingEngine(m, params, **kw)
+            try:
+                outs.append(self._run(eng, seed=5))
+            finally:
+                eng.shutdown(drain=False)
+        for x, y in zip(*outs):
+            assert np.array_equal(x, y), (x, y)
+
+    def test_adapter_spec_matches_nonspec(self, tiny):
+        """A tenant's speculative stream equals its non-speculative one:
+        the per-slot adapter row gathers inside the verify while the
+        draft stays base-weight (proposals steer acceptance, never the
+        emitted law)."""
+        _, m, params = tiny
+        ad = _nonzero_adapter(params, rank=4, seed=1)
+        banks = []
+        for _ in range(2):
+            bank = AdapterBank(params, config=LoRAConfig(rank=4),
+                               max_adapters=2)
+            bank.register("t1", ad)
+            banks.append(bank)
+        e1 = ServingEngine(m, params, adapters=banks[0], **self.BASE,
+                           draft_model=m, draft_params=params, spec_tokens=4)
+        e0 = ServingEngine(m, params, adapters=banks[1], **self.BASE)
+        try:
+            a = self._run(e1, adapter="t1") + self._run(e1)  # tenant + base
+            b = self._run(e0, adapter="t1") + self._run(e0)
+        finally:
+            e1.shutdown(drain=False)
+            e0.shutdown(drain=False)
+        for x, y in zip(a, b):
+            assert np.array_equal(x, y), (x, y)
+
+    def test_prefix_hit_spec_matches_cold(self, tiny):
+        """A prefix-cache engine speculates: the alias-restored slot's
+        draft KV is rebuilt by the draft-only chunk program, and both the
+        cold and the hit stream equal the non-speculative stream."""
+        _, m, params = tiny
+        kw = dict(max_slots=3, max_len=64, eos_token_id=EOS,
+                  prefill_chunk=8)
+        e1 = ServingEngine(m, params, prefix_cache_mb=4.0, **kw,
+                           draft_model=m, draft_params=params, spec_tokens=4)
+        e0 = ServingEngine(m, params, prefix_cache_mb=0.0, **kw)
+        try:
+            cold = self._run(e1, prompts=[self.LONG])
+            hit = self._run(e1, prompts=[self.LONG])
+            ref = self._run(e0, prompts=[self.LONG])
+            s = e1.stats.summary()
+            assert s["prefix_alias_chunks"] >= 1, s
+        finally:
+            e1.shutdown(drain=False)
+            e0.shutdown(drain=False)
+        assert np.array_equal(cold[0], ref[0]), (cold, ref)
+        assert np.array_equal(hit[0], ref[0]), (hit, ref)
+
+    def test_lookup_spec_matches_nonspec(self, tiny):
+        """Draft-free prompt-lookup speculation: host n-gram proposals
+        through the verify-only program, token-identical to plain greedy
+        even when every proposal is a miss."""
+        _, m, params = tiny
+        rep = np.array([[4, 5, 6, 4, 5, 6, 4, 5, 6, 4, 5]], np.int32)
+        a, b = self._pair(m, params, dict(spec_lookup=2, spec_tokens=4),
+                          prompts=PROMPTS + [rep])
+        for x, y in zip(a, b):
+            assert np.array_equal(x, y), (x, y)
+
+    def test_universal_spec_zero_recompiles(self, tiny):
+        """One engine wearing EVERY lifted constraint at once — sampling
+        (top_k=1), an adapter bank, an alias prefix cache, paged draft KV
+        — serves mixed traffic (tenant + base, cold + prefix-hit) through
+        ONE warm ``_spec`` and ONE warm draft-rebuild program, compile
+        listener silent: adapter rows, page tables, proposals, and
+        acceptance counts are all data, never shapes."""
+        _, m, params = tiny
+        bank = AdapterBank(params, config=LoRAConfig(rank=4),
+                           max_adapters=2)
+        bank.register("t1", _nonzero_adapter(params, rank=4, seed=1))
+        eng = ServingEngine(m, params, max_slots=3, max_len=64,
+                            eos_token_id=EOS, prefill_chunk=8,
+                            prefix_cache_mb=4.0, adapters=bank,
+                            do_sample=True, top_k=1,
+                            draft_model=m, draft_params=params,
+                            spec_tokens=4)
+        try:
+            with CompileWatcher() as watcher:
+                self._run(eng, prompts=[self.LONG], seed=0)
+                self._run(eng, prompts=[self.LONG], seed=0)  # prefix hit
+                self._run(eng, adapter="t1", seed=1)
+            assert eng._spec._cache_size() == 1
+            assert eng._draft_chunk._cache_size() == 1
+            assert eng._prefill_chunk._cache_size() == 1
+            s = eng.stats.summary()
+            assert s["spec_ticks"] > 0 and s["prefix_alias_chunks"] >= 1, s
+        finally:
+            eng.shutdown(drain=False)
+        assert not watcher.events, (
+            f"XLA recompiled after warmup: {watcher.events} — adapter "
+            "rows, draft pages, and acceptance are data, not shapes")
 
 
 class TestPagedValidation:
@@ -497,3 +666,30 @@ class TestPageAwareRouting:
             while taken:
                 rs.engine(0)._pool.decref(taken.pop())
             rs.shutdown(drain=False)
+
+    def test_draft_spec_engine_reports_doubled_page_footprint(self, tiny):
+        """A draft-speculating replica holds TWO pages per covered page
+        span (target + draft columns of the same pool), so its
+        ``page_deficit`` must report the doubled footprint — otherwise
+        the router over-admits it and the admission gate preempts on
+        arrival. Lookup engines carry no draft KV and report 1x."""
+        _, m, params = tiny
+        kw = dict(max_slots=2, max_len=64, eos_token_id=EOS,
+                  prefill_chunk=8, prefix_cache_mb=0.0, max_pages=10,
+                  autostart=False, warmup=False)
+        plain = ServingEngine(m, params, **kw)
+        spec = ServingEngine(m, params, draft_model=m, draft_params=params,
+                             spec_tokens=4, **kw)
+        lookup = ServingEngine(m, params, spec_lookup=2, spec_tokens=4,
+                               **kw)
+        try:
+            total = 44  # -> 6 pages of 8; 12 with the draft factor
+            assert plain._spec_page_factor == 1
+            assert lookup._spec_page_factor == 1
+            assert spec._spec_page_factor == 2
+            assert plain.page_deficit(total) == 0
+            assert lookup.page_deficit(total) == 0
+            assert spec.page_deficit(total) == 2  # 12 needed, 10 free
+        finally:
+            for e in (plain, spec, lookup):
+                e.shutdown(drain=False)
