@@ -23,6 +23,14 @@ gives three primitives:
 * **slow** — between ticks T0 and T1, sleep ``delay_s`` per loop
   iteration: degraded-but-alive, the gray-failure mode that stresses
   deadline handling and drain-rate estimation without killing anything.
+* **wedge** — at tick T, stall the engine INSIDE the next reconcile
+  barrier of a DISPATCHED compiled call for ``duration_s``: unlike
+  ``hang`` (which fakes a stall by freezing the published heartbeat
+  while the loop serves on), a wedge genuinely stops the loop mid
+  device-wait — the case the async runtime's one-tick-ahead dispatch
+  makes interesting, because the heartbeat republished at the reconcile
+  barrier is what keeps a watchdog's detection latency within
+  ``hang_timeout_s`` there.
 
 Schedules are engine-thread only once attached (the engine calls
 :meth:`apply` from its run loop); build and attach them before
@@ -93,6 +101,18 @@ class ChaosSchedule:
                              "delay_s": float(delay_s), "fired": False})
         return self
 
+    def wedge(self, at_tick: int, duration_s: float) -> "ChaosSchedule":
+        """Script a genuine stall: at decode tick ``at_tick`` the engine
+        sleeps ``duration_s`` inside its next reconcile barrier — a
+        dispatched compiled call that "never returns" for that long. The
+        loop truly stops (no heartbeats, no commits), then resumes."""
+        if duration_s <= 0:
+            raise ValueError(f"duration_s must be > 0 (got {duration_s})")
+        self._events.append({"kind": "wedge", "at": int(at_tick),
+                             "duration_s": float(duration_s),
+                             "fired": False})
+        return self
+
     # -- introspection ---------------------------------------------------
     def fired(self) -> list[str]:
         """Kinds of the events that have fired, in script order."""
@@ -135,6 +155,12 @@ class ChaosSchedule:
                     e["until"] = None
                     engine._heartbeat_frozen = False
                     engine._flight.record("chaos_hang_end", tick=ticks)
+            elif kind == "wedge":
+                if not e["fired"] and ticks >= e["at"]:
+                    e["fired"] = True
+                    engine._wedge_s = e["duration_s"]
+                    engine._flight.record("chaos_wedge", tick=ticks,
+                                          duration_s=e["duration_s"])
             elif kind == "slow":
                 if e["at"] <= ticks < e["until_tick"]:
                     if not e["fired"]:

@@ -105,6 +105,40 @@ base-weight), mesh slices compile the verify tp-sharded with the draft
 replicated, and prefix-cache hits rebuild draft KV via a draft-only
 chunk program — all under the same zero-recompile pin.
 
+The ASYNC HOST RUNTIME (``async_ticks=True``, the default) takes the
+Python host off the device's critical path. JAX dispatch is
+asynchronous: a compiled call returns futures immediately, and chaining
+``self._state`` through successive calls fixes device execution order
+without the host ever waiting. The run loop exploits this by dispatching
+tick N+1 — page coverage, membership mask, admission work and all —
+against tick N's still-in-flight state futures, then reconciling N
+(materialize tokens, commit, retire) while N+1 runs. The dispatch uses a
+SPECULATIVE view of the batch: host state is stale by exactly the one
+in-flight tick, so a stream that retires at N wastes one masked lane at
+N+1 (its stray token is discarded by an epoch/validity check at
+reconcile — emission stays exactly once), streams within one token of
+``max_new_tokens`` are conservatively excluded (their stray write would
+exceed the position bound), and pages are pre-allocated one position
+ahead. Page-table snapshots (``.copy()`` per dispatch) double-buffer the
+host tables: reconcile-time frees/preemptions mutate the live table
+while the in-flight program reads its own generation, and device program
+order guarantees any write a stale snapshot routes into a
+since-recycled page happens BEFORE the page's new owner prefills it
+(overwrite-before-attend, again). Streaming callbacks move to a bounded
+per-request queue drained by an emitter thread, so a slow consumer
+flow-controls its own stream (skipped lanes, ``emission_stalls``) and
+never stalls the batch; a retiring stream's completion is deferred
+behind its buffered callbacks (drain-on-retire barrier). Token streams
+are identical to ``async_ticks=False`` across every path — dense,
+paged, adapters, mesh slices, speculative — with the same warm
+executables; what changes is that ``host_us_per_tick`` (scheduling +
+commit wall) hides under device time instead of adding to ITL. One
+carve-out: prompt-lookup engines reconcile before dispatching (no
+ahead tick) — their proposals anchor on the newest committed token,
+and a proposal drafted one variable-length tick behind verifies to
+zero accepts, which would trade all of lookup's acceptance for the
+overlap.
+
 Around the compiled programs: a bounded FCFS admission queue with
 backpressure, per-request ``max_new_tokens``/timeout/cancellation,
 streaming token callbacks, error isolation (a failing callback frees its
@@ -156,6 +190,104 @@ __all__ = ["ServingEngine"]
 
 #: distinct tracer/flight-recorder identities per engine in one process.
 _ENGINE_SEQ = itertools.count()
+
+
+class _TickFlight:
+    """One dispatched-but-unreconciled decode tick: the (slot, request,
+    preemption-epoch) entries the mask was built from, the un-materialized
+    device outputs, and the dispatch timestamp. Reconcile commits an
+    entry only if its request is still RUNNING *and* its preemption epoch
+    matches — a stream retired, failed, or preempted-and-readmitted after
+    dispatch must not absorb the stale in-flight token (exactly-once
+    emission)."""
+
+    __slots__ = ("entries", "toks", "dones", "emit", "ns", "lookup_hits",
+                 "t_dispatch")
+
+    def __init__(self, entries, t_dispatch, toks=None, dones=None,
+                 emit=None, ns=None, lookup_hits=0):
+        self.entries = entries          # [(slot, req, req._preempted)]
+        self.t_dispatch = t_dispatch
+        self.toks = toks                # dense/paged tick outputs
+        self.dones = dones
+        self.emit = emit                # speculative tick outputs
+        self.ns = ns
+        self.lookup_hits = lookup_hits
+
+
+class _TokenEmitter:
+    """Off-thread ``on_token`` delivery: the engine thread enqueues
+    (request, token) pairs — and a ``None``-token finish sentinel AFTER a
+    retiring request's last token, the drain-on-retire barrier — and one
+    daemon thread drains them in order. A raising callback is recorded on
+    the request (``_emit_error``); the engine's loop-top sweep turns that
+    into the same FAILED retirement an inline callback failure produces.
+    The queue is unbounded here; the ENGINE bounds it per request by
+    flow-controlling streams whose ``_emit_pending`` exceeds
+    ``max_pending`` (they are skipped from ticks, never stalled on).
+    ``close()`` drains everything already queued, then joins — shutdown
+    and failover never drop buffered tokens."""
+
+    def __init__(self, max_pending: int):
+        self.max_pending = int(max_pending)
+        self._q: collections.deque = collections.deque()
+        self._cv = threading.Condition()
+        self._closed = False
+        self._thread = threading.Thread(target=self._drain_loop,
+                                        name="serving-emitter", daemon=True)
+        self._thread.start()
+
+    @property
+    def alive(self) -> bool:
+        return self._thread.is_alive() and not self._closed
+
+    def backlogged(self, req) -> bool:
+        """Engine-side flow control: has this stream's consumer fallen
+        ``max_pending`` callbacks behind?"""
+        return req._emit_pending >= self.max_pending
+
+    def put(self, req, token: int):
+        req._emit_pending += 1
+        with self._cv:
+            self._q.append((req, token))
+            self._cv.notify()
+
+    def finish(self, req):
+        """Queue the completion sentinel — ``req._complete()`` runs only
+        after every callback queued before it has been delivered."""
+        with self._cv:
+            self._q.append((req, None))
+            self._cv.notify()
+
+    def close(self, timeout: Optional[float] = None):
+        """Stop accepting work, drain what is queued, join (idempotent)."""
+        with self._cv:
+            self._closed = True
+            self._cv.notify()
+        self._thread.join(timeout)
+
+    def _drain_loop(self):
+        while True:
+            with self._cv:
+                while not self._q and not self._closed:
+                    self._cv.wait(0.1)
+                if not self._q:
+                    return  # closed and fully drained
+                batch = list(self._q)
+                self._q.clear()
+            for req, token in batch:
+                if token is None:
+                    req._complete()
+                    continue
+                if req._emit_error is None and req.on_token is not None:
+                    try:
+                        req.on_token(token)
+                    except BaseException as e:
+                        # Recorded, not raised: error isolation — the
+                        # engine retires THIS request FAILED at its next
+                        # sweep; the emitter keeps serving other streams.
+                        req._emit_error = e
+                req._emit_pending -= 1
 
 
 class ServingEngine:
@@ -262,8 +394,27 @@ class ServingEngine:
         ``accelerate-tpu serve --trace-dir`` plumbing).
       chaos: an optional :class:`~.chaos.ChaosSchedule` of scripted
         faults (kill at decode tick T, hang via heartbeat suppression,
-        slow ticks) applied from the run loop — the deterministic
-        fault-injection harness behind the self-healing tests.
+        slow ticks, a wedge inside a dispatched call) applied from the
+        run loop — the deterministic fault-injection harness behind the
+        self-healing tests.
+      async_ticks: run the ASYNC host runtime (default): after
+        dispatching tick N the loop immediately schedules pages,
+        admission, and tick N+1 against the still-in-flight state
+        futures (JAX async dispatch), reconciling N's tokens when they
+        materialize — host scheduling/commit work overlaps device
+        compute, and per-token streaming callbacks move to a dedicated
+        emitter thread so a slow consumer can never stall the tick
+        loop. Token streams are identical to sync mode (a stream that
+        retires at tick N wastes one masked lane at N+1; the lane's
+        extra token is discarded host-side) and the compiled programs
+        are byte-identical — ``async_ticks=False`` is the strictly
+        tick-synchronous A/B fallback (dispatch, block, commit, inline
+        callbacks), the pre-async behavior.
+      emission_queue: per-request bound on emitter-queued ``on_token``
+        callbacks (async mode only). A stream whose consumer falls this
+        far behind is flow-controlled — skipped from decode ticks
+        (``emission_stalls`` counts them) until its queue drains —
+        instead of growing host memory or stalling the batch.
       autostart: spawn the engine thread (and warm up) in the constructor.
       warmup: run dummy requests through every program at start so the
         first real request never pays a compile; stats, spans, and
@@ -291,6 +442,8 @@ class ServingEngine:
                  flight_capacity: int = 256,
                  trace_dir: Optional[str] = None,
                  chaos=None,
+                 async_ticks: Optional[bool] = None,
+                 emission_queue: int = 256,
                  autostart: bool = True, warmup: bool = True,
                  idle_poll_s: float = 0.005):
         from ..big_modeling import cache_factory_for
@@ -767,6 +920,29 @@ class ServingEngine:
         self._decode_ticks = 0
         self._heartbeat = (0, time.monotonic())
         self._heartbeat_frozen = False
+        # Async host runtime: one-tick-ahead dispatch + off-thread token
+        # emission (see class docstring). ``_wedge_s`` is the chaos
+        # harness's dispatched-call wedge: the next reconcile sleeps it
+        # off INSIDE the barrier, so the stall is indistinguishable from
+        # a compiled call that never returns.
+        if async_ticks is None:
+            async_ticks = True
+        self._async = bool(async_ticks)
+        if int(emission_queue) < 1:
+            raise ValueError(
+                f"emission_queue must be >= 1 (got {emission_queue})")
+        self._emission_queue = int(emission_queue)
+        self._emitter: Optional[_TokenEmitter] = None
+        self._wedge_s = 0.0
+        # Host-blocked time (device waits) accumulated since the last
+        # reconcile — subtracting it from the device-complete interval
+        # is what isolates host_us_per_tick.
+        self._blocked_s = 0.0
+        self._last_complete_t: Optional[float] = None
+        # Next decode tick that emits a tick_profile flight event (the
+        # warmup reset re-arms it so a warmed engine still profiles its
+        # first real tick instead of waiting out the 128-tick cadence).
+        self._next_profile_tick = 1
         # Page-drain samples (wall time, cumulative pool frees) the shed
         # path turns into a pages/s rate; engine-thread writes, any-thread
         # reads of an immutable tuple snapshot.
@@ -1457,6 +1633,8 @@ class ServingEngine:
         self._accepting = True
         self._heartbeat = (self._loop_iters, time.monotonic())
         self._heartbeat_frozen = False
+        if self._async and (self._emitter is None or not self._emitter.alive):
+            self._emitter = _TokenEmitter(self._emission_queue)
         self._thread = threading.Thread(target=self._run,
                                         name="serving-engine", daemon=True)
         self._thread.start()
@@ -1495,6 +1673,7 @@ class ServingEngine:
         # postmortems, or the compile counters, same as the stats reset.
         self._tracer.clear()
         self._flight.clear()
+        self._next_profile_tick = self._decode_ticks + 1
         if self._compile_watcher is not None:
             self._compile_watcher.reset()
 
@@ -1524,6 +1703,11 @@ class ServingEngine:
         # covers an engine that was never started (autostart=False), so a
         # blocked submit can never outlive the engine either way.
         self._queue.close()
+        if self._emitter is not None:
+            # Drain-then-join (idempotent — the run loop's finally already
+            # closed it on a normal exit): buffered tokens and deferred
+            # completions are delivered, never dropped.
+            self._emitter.close(timeout)
         self._stop_compile_watcher()
         if self._trace_dir is not None and self._error is None:
             self._dump_debug_files()
@@ -1615,10 +1799,13 @@ class ServingEngine:
         """``(loop_iterations, wall_time)`` published by the run loop at
         the top of EVERY iteration (idle iterations included — the loop
         polls the queue at ``idle_poll_s``, so a live engine republishes
-        many times a second). A watchdog that sees the wall time stall
-        while :attr:`error` stays None is looking at a HUNG engine — e.g.
-        a compiled call that never returned — which lazy health checks
-        can never catch (see :class:`~.supervisor.FleetSupervisor`)."""
+        many times a second) AND at every reconcile barrier — so under
+        one-tick-ahead dispatch a wedge inside the dispatched call still
+        stalls the heartbeat within one tick. A watchdog that sees the
+        wall time stall while :attr:`error` stays None is looking at a
+        HUNG engine — e.g. a compiled call that never returned — which
+        lazy health checks can never catch (see
+        :class:`~.supervisor.FleetSupervisor`)."""
         return self._heartbeat
 
     @property
@@ -1932,6 +2119,11 @@ class ServingEngine:
     # engine thread
     # ------------------------------------------------------------------
     def _run(self):
+        # The one in-flight dispatched tick (async mode; always None in
+        # sync mode). Loop shape per iteration: sweeps → admission →
+        # DISPATCH tick N+1 → RECONCILE tick N — so every piece of host
+        # work between the two barriers overlaps tick N+1's device time.
+        flight: Optional[_TickFlight] = None
         try:
             while not self._stop:
                 # Liveness first: apply any scripted chaos (which may set
@@ -1959,13 +2151,19 @@ class ServingEngine:
                     self._abort_queue = True
                 now = time.monotonic()
                 for _, req in self._slots.active():
-                    if req.cancel_requested:
+                    if req._emit_error is not None:
+                        # A streaming callback raised on the emitter
+                        # thread: same FAILED retirement (slot freed,
+                        # batch untouched) an inline failure produces.
+                        self._retire(req, RequestStatus.FAILED,
+                                     req._emit_error)
+                    elif req.cancel_requested:
                         self._retire(req, RequestStatus.CANCELLED)
                     elif req._deadline_passed(now):
                         self._retire(req, RequestStatus.TIMED_OUT)
                 if self._abort_queue:
                     for req in self._queue.drain():
-                        req._finish(RequestStatus.CANCELLED)
+                        self._finish_req(req, RequestStatus.CANCELLED)
                         self._stats.record_finish(req.status)
                 # Bounded admission: spend at most chunks_per_tick chunk
                 # calls, ALTERNATING one continuation of the PREFILLING
@@ -2005,11 +2203,53 @@ class ServingEngine:
                 running = [(slot, req) for slot, req in self._slots.active()
                            if req.status is RequestStatus.RUNNING]
                 if running:
-                    if self._spec_k is not None:
-                        self._tick_spec(running)
+                    if self._async:
+                        if self._spec_mode == "lookup" and flight is not None:
+                            # Prompt-lookup proposals must anchor on the
+                            # NEWEST committed token: a proposal drafted
+                            # ahead is misaligned by the in-flight tick's
+                            # variable-length commit (1..K+1 tokens) and
+                            # verifies to zero accepts, collapsing lookup
+                            # speculation to dense decode. So lookup
+                            # engines settle tick N before drafting N+1 —
+                            # off-thread emission and the commit barrier
+                            # are unchanged; only dispatch/device overlap
+                            # is given up.
+                            self._reconcile(flight)
+                            flight = None
+                            continue
+                        # One tick ahead: dispatch N+1 against the
+                        # in-flight state futures (host view stale by
+                        # exactly the one unreconciled tick when
+                        # ``flight`` exists), THEN settle tick N.
+                        nxt = self._dispatch(running,
+                                             ahead=flight is not None)
+                        if flight is not None:
+                            self._reconcile(flight)
+                        flight = nxt
+                        if flight is None:
+                            # Nothing dispatched (every stream flow-
+                            # controlled or preempted) and nothing in
+                            # flight: yield so consumers can drain
+                            # instead of hot-spinning the loop.
+                            time.sleep(min(self._idle_poll_s, 0.001))
                     else:
-                        self._tick(running)
-                elif self._slots.active_slots:
+                        # Sync A/B fallback: dispatch and immediately
+                        # reconcile — the strictly tick-synchronous
+                        # pre-async behavior, same commit path.
+                        f = self._dispatch(running, ahead=False)
+                        if f is not None:
+                            self._reconcile(f)
+                    continue
+                if flight is not None:
+                    # The last running streams retired/preempted out from
+                    # under the in-flight tick — settle it (stray lanes
+                    # discard; pages/stats still reconcile).
+                    self._reconcile(flight)
+                    flight = None
+                    continue
+                self._last_complete_t = None   # ITL intervals restart
+                if self._slots.active_slots:
                     pass  # prefill-only batch: loop again without idling
                 elif self._drain and not len(self._queue):
                     break
@@ -2050,17 +2290,23 @@ class ServingEngine:
             for _, req in list(self._slots.active()):
                 self._retire(req, terminal, self._error)
             for req in self._queue.drain():
-                req._finish(terminal, self._error)
+                self._finish_req(req, terminal, self._error)
                 self._stats.record_finish(req.status)
+            if self._emitter is not None:
+                # AFTER the retire sweep queued its deferred completions:
+                # drain every buffered token and completion, then join —
+                # failover handlers (``_on_finish``) all fire before the
+                # engine thread exits.
+                self._emitter.close()
 
     def _screen(self, req: Request, now: float) -> bool:
         """The check-then-admit gate both pop paths share: a request whose
         cancellation or deadline fired while it queued is finished here,
         never admitted."""
         if req.cancel_requested:
-            req._finish(RequestStatus.CANCELLED)
+            self._finish_req(req, RequestStatus.CANCELLED)
         elif req._deadline_passed(now):
-            req._finish(RequestStatus.TIMED_OUT)
+            self._finish_req(req, RequestStatus.TIMED_OUT)
         else:
             return True
         self._stats.record_finish(req.status)
@@ -2080,7 +2326,7 @@ class ServingEngine:
         try:
             row, hit, evicted = self._adapters.acquire(req.adapter)
         except Exception as e:
-            req._finish(RequestStatus.FAILED, e)
+            self._finish_req(req, RequestStatus.FAILED, e)
             self._stats.record_finish(req.status)
             return False
         req._adapter_row = row
@@ -2467,7 +2713,11 @@ class ServingEngine:
                 self.params, self._state, ids_c, np.int32(req.slot),
                 np.int32(offset), np.int32(S), req._rng_key,
                 *self._adapter_args(req))
+        tb = time.monotonic()
         tok.block_until_ready()  # honest chunk timing, paced dispatch
+        # The wait is device time (this chunk, plus any in-flight tick it
+        # queued behind) — excluded from host_us_per_tick.
+        self._blocked_s += time.monotonic() - tb
         dt_ms = (time.monotonic() - t0) * 1e3
         backlog = sum(1 for r in self._prefilling
                       if r.status is RequestStatus.PREFILLING)
@@ -2539,30 +2789,60 @@ class ServingEngine:
                         and token == self.eos_token_id)):
                 self._retire(req, RequestStatus.COMPLETED)
 
-    def _tick(self, running):
-        """One ``decode_step_all_slots`` execution + host commit/retire.
-        ``running`` is the (slot, request) list in RUNNING — PREFILLING
-        slots ride along in the vmapped forward (fixed shape) but are
-        masked out of every state advance and commit no tokens. Paged
-        engines first guarantee every running slot's write position has a
-        page (allocating — and preempting on exhaustion — at this tick
-        boundary), then pass the page table as traced data."""
+    def _dispatch(self, running, ahead: bool) -> Optional[_TickFlight]:
+        """Dispatch one decode tick and return its flight WITHOUT waiting
+        for the device. ``running`` is the (slot, request) list in RUNNING
+        — PREFILLING slots ride along in the vmapped forward (fixed
+        shape) but are masked out of every state advance and commit no
+        tokens. Paged engines first guarantee every dispatched slot's
+        write position has a page (allocating — and preempting on
+        exhaustion — at this dispatch boundary), then pass a page-table
+        SNAPSHOT as traced data (the double buffer: reconcile-time frees
+        mutate the live table, never the in-flight copy).
+
+        ``ahead=True`` means one unreconciled tick is in flight, so host
+        state (``len(req.tokens)``, page frontier) is stale by exactly
+        one committed token per stream. The speculative view is made safe
+        by two conservative rules: a stream within one token of its
+        budget is EXCLUDED (it deterministically retires at the in-flight
+        tick; dispatching it would write at a position past its bound),
+        and page coverage extends one position past the stale frontier
+        (the in-flight commit's write). A stream that instead retires on
+        EOS at the in-flight tick stays masked in — its lane advances
+        once more and the stray token is discarded by the reconcile
+        validity check (exactly-once emission)."""
+        if self._spec_k is not None:
+            return self._dispatch_spec(running, ahead)
+        live = []
+        for slot, req in running:
+            if ahead and req.max_new_tokens - len(req.tokens) <= 1:
+                continue  # retires at the in-flight tick (position bound)
+            if (self._emitter is not None and req.on_token is not None
+                    and self._emitter.backlogged(req)):
+                # Flow control: the consumer is emission_queue callbacks
+                # behind — hold this stream back (its device state stays
+                # put; the stream resumes bit-exactly) rather than buffer
+                # without bound or stall the batch.
+                self._stats.record_emission_stall()
+                continue
+            live.append((slot, req))
         if self._paged:
-            for slot, req in running:
+            for slot, req in live:
                 if req.status is not RequestStatus.RUNNING:
                     continue  # preempted by an earlier slot's allocation
-                if not self._ensure_pages(req,
-                                          req._pos_base + len(req.tokens)):
+                upto = (req._pos_base + len(req.tokens)
+                        + (1 if ahead else 0))
+                if not self._ensure_pages(req, upto):
                     raise RuntimeError(
                         "page pool exhausted at a tick with no preemptable "
                         "stream — the submit page bound should make this "
                         "impossible")
-            running = [(s, r) for s, r in running
-                       if r.status is RequestStatus.RUNNING]
-            if not running:
-                return
+            live = [(s, r) for s, r in live
+                    if r.status is RequestStatus.RUNNING]
+        if not live:
+            return None
         mask = np.zeros((self.max_slots,), bool)
-        for slot, _ in running:
+        for slot, _ in live:
             mask[slot] = True
         t0 = time.monotonic()
         args = [self.params, self._state, jnp.asarray(mask)]
@@ -2571,30 +2851,117 @@ class ServingEngine:
         if self._adapters is not None:
             args.append(self._adapters.stacks)
         self._state, toks, dones = self._decode(*args)
-        toks = np.asarray(toks)     # sync point: the tick's device work
-        dones = np.asarray(dones)
-        dt = time.monotonic() - t0
-        committed = 0
-        for slot, req in running:
-            if not self._commit_token(req, int(toks[slot])):
-                continue  # callback failed; slot already freed
-            committed += 1
-            if (len(req.tokens) >= req.max_new_tokens
-                    or (not req.ignore_eos and bool(dones[slot]))):
-                self._retire(req, RequestStatus.COMPLETED)
-            elif self._page_window is not None:
-                self._free_window_pages(req)
+        return _TickFlight(
+            entries=[(slot, req, req._preempted) for slot, req in live],
+            t_dispatch=t0, toks=toks, dones=dones)
+
+    def _reconcile(self, flight: _TickFlight):
+        """Settle a dispatched tick: block until its tokens materialize
+        (the one device sync point), then commit/retire on the host. An
+        entry whose request is no longer RUNNING, or whose preemption
+        epoch moved, is a stray lane — its token is discarded, which is
+        what makes one-tick-ahead dispatch exactly-once.
+
+        Timing: ``itl`` is the device-complete→device-complete interval
+        (what a consumer experiences between tokens), and
+        ``host_us_per_tick`` is that interval minus every blocked device
+        wait since the previous reconcile — the host scheduling + commit
+        wall the async runtime hides under device time."""
+        if self._wedge_s:
+            # Chaos: wedge INSIDE the reconcile barrier of a dispatched
+            # call — the loop stops publishing heartbeats mid-"device
+            # wait", exactly what a hung collective looks like.
+            w, self._wedge_s = self._wedge_s, 0.0
+            time.sleep(w)
+        spec = flight.emit is not None
+        tb = time.monotonic()
+        if spec:
+            emit = np.asarray(flight.emit)
+            ns = np.asarray(flight.ns)
+        else:
+            toks = np.asarray(flight.toks)
+            dones = np.asarray(flight.dones)
+        t1 = time.monotonic()
+        self._blocked_s += t1 - tb
+        if not self._heartbeat_frozen:
+            # Reconcile-barrier heartbeat: between loop tops the engine
+            # may sit in this block for a whole device tick — republish
+            # so the watchdog clock tracks real liveness.
+            self._heartbeat = (self._loop_iters, t1)
+        prev = self._last_complete_t
+        interval = t1 - (prev if prev is not None else flight.t_dispatch)
+        self._last_complete_t = t1
+        host_s = max(0.0, interval - self._blocked_s)
+        self._blocked_s = 0.0
+        committed = accepted = n_valid = 0
+        for slot, req, epoch in flight.entries:
+            if (req.status is not RequestStatus.RUNNING
+                    or req._preempted != epoch):
+                continue  # stray lane: retired/preempted since dispatch
+            n_valid += 1
+            if spec:
+                n = int(ns[slot])
+                accepted += n - 1
+                retired = False
+                for j in range(n):
+                    token = int(emit[slot, j])
+                    if not self._commit_token(req, token):
+                        retired = True
+                        break
+                    committed += 1
+                    if (len(req.tokens) >= req.max_new_tokens
+                            or (not req.ignore_eos
+                                and self.eos_token_id is not None
+                                and token == self.eos_token_id)):
+                        self._retire(req, RequestStatus.COMPLETED)
+                        retired = True
+                        break
+                if not retired and self._page_window is not None:
+                    self._free_window_pages(req)
+            else:
+                if not self._commit_token(req, int(toks[slot])):
+                    continue  # callback failed; slot already freed
+                committed += 1
+                if (len(req.tokens) >= req.max_new_tokens
+                        or (not req.ignore_eos and bool(dones[slot]))):
+                    self._retire(req, RequestStatus.COMPLETED)
+                elif self._page_window is not None:
+                    self._free_window_pages(req)
+        if spec:
+            self._stats.record_spec(
+                proposed=self._spec_k * n_valid, accepted=accepted,
+                lookup_hits=(flight.lookup_hits
+                             if self._spec_mode == "lookup" else None),
+                lookup_slots=(n_valid if self._spec_mode == "lookup"
+                              else 0))
         self._decode_ticks += 1
-        self._stats.record_tick(active_slots=len(running),
+        self._stats.record_tick(active_slots=len(flight.entries),
                                 committed_tokens=committed,
-                                max_slots=self.max_slots, seconds=dt)
+                                max_slots=self.max_slots, seconds=interval,
+                                host_us=host_s * 1e6)
         tracer = self._tracer
         if tracer.enabled:
-            tracer.emit("decode_tick", t0, dt,
-                        args={"active": len(running), "committed": committed})
-            for slot, req in running:
-                tracer.emit("itl", t0, dt, trace_id=req.trace_id,
-                            args={"slot": slot, "token": len(req.tokens)})
+            targs = {"active": len(flight.entries), "committed": committed,
+                     "host_us": round(host_s * 1e6, 1)}
+            if spec:
+                targs["spec_accepted"] = accepted
+            tracer.emit("decode_tick", flight.t_dispatch,
+                        t1 - flight.t_dispatch, args=targs)
+            for slot, req, _ in flight.entries:
+                iargs = {"slot": slot, "token": len(req.tokens)}
+                if spec:
+                    iargs["accepted"] = int(ns[slot]) - 1
+                tracer.emit("itl", t1 - interval, interval,
+                            trace_id=req.trace_id, args=iargs)
+        if self._decode_ticks >= self._next_profile_tick:
+            # Black-box sample of the split ITL (cheap: one flight event
+            # per ~128 ticks) — postmortems show whether host overhead or
+            # device time dominated when things went sideways.
+            self._next_profile_tick = self._decode_ticks + 128
+            self._flight.record("tick_profile", tick=self._decode_ticks,
+                                itl_ms=round(interval * 1e3, 3),
+                                host_us=round(host_s * 1e6, 1),
+                                active=len(flight.entries))
         if self._paged:
             self._drain_samples.append((time.monotonic(), self._pool.frees))
             self._stats.record_pages(self._pool.free_pages,
@@ -2602,22 +2969,46 @@ class ServingEngine:
                                      self._pool.num_pages,
                                      freed_total=self._pool.frees)
 
-    def _tick_spec(self, running):
-        """One speculative tick: up to ``spec_tokens + 1`` tokens per slot
-        from a single draft-scan + verify executable. Page coverage is
-        guaranteed only up to the furthest position a slot can COMMIT this
-        tick (``pos + min(K+1, remaining) - 1``) — overshoot writes route
-        to scratch inside the program. The host commits the emitted chain
-        exactly like ``n`` dense ticks would: stop at ``max_new_tokens``
-        or at the first eos (later emissions are all eos, discarded with
-        the slot)."""
+    def _dispatch_spec(self, running, ahead: bool) -> Optional[_TickFlight]:
+        """Speculative twin of :meth:`_dispatch`: dispatch one draft-scan
+        + verify tick (up to ``spec_tokens + 1`` tokens per slot) without
+        waiting. Page coverage is guaranteed only up to the furthest
+        position a slot can COMMIT — overshoot writes route to scratch
+        inside the program. Reconcile commits the emitted chain exactly
+        like ``n`` dense ticks would: stop at ``max_new_tokens`` or the
+        first eos.
+
+        The ``ahead`` staleness rules: a stream with fewer than 2 budget
+        tokens is excluded (it deterministically retires at the in-flight
+        tick); page coverage extends to two chains' worth of commits
+        (``min(2*(K+1), remaining)``) because the in-flight tick may
+        advance the write frontier by a full chain before this one runs;
+        and ``remaining`` is passed STALE — safe because it is always >=
+        the true budget, and the device clamp only matters when it binds
+        BELOW a chain length, which stale-high values never spuriously do
+        (the host commit loop enforces the true budget; a retiring tick's
+        device over-advance is stray state that dies with the slot).
+        Lookup mode never dispatches ahead (the run loop reconciles
+        first): a proposal drafted one tick behind is misaligned by the
+        in-flight tick's variable-length commit and verifies to zero
+        accepts, so ahead lookup would be exact but never faster than
+        dense decode."""
         K = self._spec_k
+        live = []
         for slot, req in running:
+            if ahead and req.max_new_tokens - len(req.tokens) < 2:
+                continue  # retires at the in-flight tick (position bound)
+            if (self._emitter is not None and req.on_token is not None
+                    and self._emitter.backlogged(req)):
+                self._stats.record_emission_stall()
+                continue
+            live.append((slot, req))
+        for slot, req in live:
             if req.status is not RequestStatus.RUNNING:
                 continue
-            rem = req.max_new_tokens - len(req.tokens)
-            cover = (req._pos_base + len(req.tokens)
-                     + min(K + 1, max(rem, 1)) - 1)
+            rem = max(req.max_new_tokens - len(req.tokens), 1)
+            span = min((2 if ahead else 1) * (K + 1), rem)
+            cover = req._pos_base + len(req.tokens) + span - 1
             if not self._ensure_pages(req, cover):
                 raise RuntimeError(
                     "page pool exhausted at a speculative tick with no "
@@ -2633,13 +3024,13 @@ class ServingEngine:
                         "page pool exhausted for draft KV at a "
                         "speculative tick — the admission gate's draft "
                         "factor should make this impossible")
-        running = [(s, r) for s, r in running
-                   if r.status is RequestStatus.RUNNING]
-        if not running:
-            return
+        live = [(s, r) for s, r in live
+                if r.status is RequestStatus.RUNNING]
+        if not live:
+            return None
         mask = np.zeros((self.max_slots,), bool)
         remaining = np.ones((self.max_slots,), np.int32)
-        for slot, req in running:
+        for slot, req in live:
             mask[slot] = True
             remaining[slot] = max(req.max_new_tokens - len(req.tokens), 1)
         bank = ((self._adapters.stacks,)
@@ -2648,7 +3039,7 @@ class ServingEngine:
         t0 = time.monotonic()
         if self._spec_mode == "lookup":
             proposals = np.zeros((self.max_slots, K), np.int32)
-            for slot, req in running:
+            for slot, req in live:
                 proposals[slot], hit = self._lookup_proposals(req)
                 lookup_hits += int(hit)
             self._state, emit, ns = self._spec(
@@ -2659,53 +3050,9 @@ class ServingEngine:
                 self.params, self._draft_params, self._state,
                 jnp.asarray(mask), self._table.copy(), self._dtable.copy(),
                 remaining, *bank)
-        emit = np.asarray(emit)
-        ns = np.asarray(ns)
-        dt = time.monotonic() - t0
-        committed = accepted = 0
-        for slot, req in running:
-            n = int(ns[slot])
-            accepted += n - 1
-            retired = False
-            for j in range(n):
-                token = int(emit[slot, j])
-                if not self._commit_token(req, token):
-                    retired = True
-                    break
-                committed += 1
-                if (len(req.tokens) >= req.max_new_tokens
-                        or (not req.ignore_eos
-                            and self.eos_token_id is not None
-                            and token == self.eos_token_id)):
-                    self._retire(req, RequestStatus.COMPLETED)
-                    retired = True
-                    break
-            if not retired and self._page_window is not None:
-                self._free_window_pages(req)
-        self._stats.record_spec(
-            proposed=K * len(running), accepted=accepted,
-            lookup_hits=(lookup_hits if self._spec_mode == "lookup"
-                         else None),
-            lookup_slots=(len(running) if self._spec_mode == "lookup"
-                          else 0))
-        self._decode_ticks += 1
-        self._stats.record_tick(active_slots=len(running),
-                                committed_tokens=committed,
-                                max_slots=self.max_slots, seconds=dt)
-        tracer = self._tracer
-        if tracer.enabled:
-            tracer.emit("decode_tick", t0, dt,
-                        args={"active": len(running), "committed": committed,
-                              "spec_accepted": accepted})
-            for slot, req in running:
-                tracer.emit("itl", t0, dt, trace_id=req.trace_id,
-                            args={"slot": slot, "token": len(req.tokens),
-                                  "accepted": int(ns[slot]) - 1})
-        self._drain_samples.append((time.monotonic(), self._pool.frees))
-        self._stats.record_pages(self._pool.free_pages,
-                                 self._pool.used_pages,
-                                 self._pool.num_pages,
-                                 freed_total=self._pool.frees)
+        return _TickFlight(
+            entries=[(slot, req, req._preempted) for slot, req in live],
+            t_dispatch=t0, emit=emit, ns=ns, lookup_hits=lookup_hits)
 
     def _lookup_proposals(self, req: Request):
         """Prompt-lookup drafting: propose the ``K`` tokens that followed
@@ -2737,17 +3084,42 @@ class ServingEngine:
         return np.full((K,), seq[-1], np.int32), False
 
     def _commit_token(self, req: Request, token: int) -> bool:
-        """Append + stream one token. A raising ``on_token`` callback fails
-        ONLY its own request (slot freed, batch untouched); returns False
-        in that case."""
+        """Append + stream one token. With an emitter (async mode) the
+        callback is QUEUED, not run — the tick loop never waits on a
+        consumer — and a callback that already raised off-thread fails
+        the request here, before committing more. Inline mode (sync A/B)
+        keeps the original semantics: a raising ``on_token`` fails ONLY
+        its own request (slot freed, batch untouched). Returns False when
+        the request was retired instead of committed to."""
+        if req._emit_error is not None:
+            self._retire(req, RequestStatus.FAILED, req._emit_error)
+            return False
         req.tokens.append(token)
         if req.on_token is not None:
-            try:
-                req.on_token(token)
-            except Exception as e:
-                self._retire(req, RequestStatus.FAILED, e)
-                return False
+            if self._emitter is not None:
+                self._emitter.put(req, token)
+            else:
+                try:
+                    req.on_token(token)
+                except Exception as e:
+                    self._retire(req, RequestStatus.FAILED, e)
+                    return False
         return True
+
+    def _finish_req(self, req: Request, status: RequestStatus,
+                    error: Optional[BaseException] = None):
+        """Terminal transition, emitter-aware: status/error land NOW (the
+        engine thread's scheduling view stays consistent), while for a
+        streaming request in async mode the observable completion
+        (``_done``, ``_on_finish``) is queued BEHIND its buffered tokens
+        — the drain-on-retire barrier that keeps ``result()`` ordered
+        after the last ``on_token`` call and lets shutdown/failover drain
+        instead of drop."""
+        if self._emitter is not None and req.on_token is not None:
+            if req._finish(status, error, defer=True):
+                self._emitter.finish(req)
+        else:
+            req._finish(status, error)
 
     def _retire(self, req: Request, status: RequestStatus,
                 error: Optional[BaseException] = None):
@@ -2760,7 +3132,7 @@ class ServingEngine:
             self._adapters.release(req.adapter)
         if req.adapter is not None:
             self._stats.record_adapter_tokens(req.adapter, len(req.tokens))
-        req._finish(status, error)
+        self._finish_req(req, status, error)
         self._stats.record_finish(req.status)
         self._tracer.instant("retire", trace_id=req.trace_id,
                              args={"status": req.status.value,

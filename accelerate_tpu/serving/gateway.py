@@ -152,7 +152,16 @@ _METRIC_HELP = {
     "accelerate_tpu_serving_ttft_ms":
         "Mean time-to-first-token over retired requests (ms).",
     "accelerate_tpu_serving_itl_ms":
-        "Mean inter-token latency over decode ticks (ms).",
+        "Mean inter-token latency over decode ticks, device-complete to "
+        "device-complete (ms).",
+    "accelerate_tpu_serving_host_us_per_tick":
+        "Mean host scheduling+commit wall per decode tick (us) — the "
+        "non-device share of ITL the async host runtime overlaps.",
+    "accelerate_tpu_serving_host_us_per_tick_max":
+        "Worst observed host scheduling+commit wall for one tick (us).",
+    "accelerate_tpu_serving_emission_stalls":
+        "Decode-tick skips of streams whose bounded emission queue was "
+        "full (slow on_token consumer flow-controlled).",
     "accelerate_tpu_serving_queue_wait_ms":
         "Mean admission-queue wait over admitted requests (ms).",
     "accelerate_tpu_serving_decode_tokens_per_sec":

@@ -149,6 +149,12 @@ class ServingStats:
             self._pages_total = 0
             self._pages_freed = 0
             self._preemptions = 0
+            # Async host runtime: host scheduling/commit wall per tick
+            # (microseconds) and emitter backpressure events.
+            self._host_us_sum = 0.0
+            self._host_us_max = 0.0
+            self._host_us_ticks = 0
+            self._emission_stalls = 0
             # Speculative decoding: draft proposals vs target acceptances.
             self._spec_ticks = 0
             self._spec_proposed = 0
@@ -193,8 +199,17 @@ class ServingStats:
             self._hists["ttft_ms"].observe(ttft_ms)
 
     def record_tick(self, active_slots: int, committed_tokens: int,
-                    max_slots: int, seconds: float):
-        """One ``decode_step_all_slots`` execution."""
+                    max_slots: int, seconds: float,
+                    host_us: Optional[float] = None):
+        """One ``decode_step_all_slots`` execution.
+
+        ``seconds`` is the device-complete→device-complete interval for
+        this tick — what ``itl_ms`` observes. (Pre-async it was per-tick
+        wall time; under one-tick-ahead dispatch the two differ, and the
+        interval is the one a consumer actually experiences between
+        tokens.) ``host_us`` is the tick's host scheduling + commit wall
+        in microseconds — the part of the interval NOT spent waiting on
+        the device, i.e. the host overhead the async runtime hides."""
         with self._lock:
             self._ticks += 1
             self._tick_s_sum += seconds
@@ -202,6 +217,17 @@ class ServingStats:
             self._slot_capacity_sum += int(max_slots)
             self._decode_tokens += int(committed_tokens)
             self._hists["itl_ms"].observe(seconds * 1e3)
+            if host_us is not None:
+                self._host_us_sum += float(host_us)
+                self._host_us_max = max(self._host_us_max, float(host_us))
+                self._host_us_ticks += 1
+
+    def record_emission_stall(self):
+        """A stream was skipped for one tick because its bounded emission
+        queue was full (slow ``on_token`` consumer) — flow control held
+        the stream back rather than stalling the tick loop."""
+        with self._lock:
+            self._emission_stalls += 1
 
     def record_prefill_chunk(self, ms: float, backlog: int = 0):
         """One ``prefill_chunk`` execution; ``backlog`` is the number of
@@ -361,10 +387,11 @@ class ServingStats:
                       "_pages_freed",
                       "_preemptions", "_spec_ticks", "_spec_proposed",
                       "_spec_accepted", "_spec_lookup_slots",
-                      "_spec_lookup_hits"):
+                      "_spec_lookup_hits", "_host_us_sum",
+                      "_host_us_ticks", "_emission_stalls"):
                 setattr(self, k, getattr(self, k) + o[k])
             for k in ("_queue_wait_ms_max", "_ttft_ms_max",
-                      "_prefill_backlog_max"):
+                      "_prefill_backlog_max", "_host_us_max"):
                 setattr(self, k, max(getattr(self, k), o[k]))
             self._ttft_samples.extend(o_samples)
             if len(self._ttft_samples) > self.MAX_TTFT_SAMPLES:
@@ -460,6 +487,13 @@ class ServingStats:
                 "spec_lookup_hit_rate": round(
                     self._spec_lookup_hits / self._spec_lookup_slots, 4)
                     if self._spec_lookup_slots else 0.0,
+                # Async host runtime (zero when the engine never reported
+                # host timings, e.g. before its first reconcile).
+                "host_us_per_tick": round(
+                    self._host_us_sum / self._host_us_ticks, 3)
+                    if self._host_us_ticks else 0.0,
+                "host_us_per_tick_max": round(self._host_us_max, 3),
+                "emission_stalls": self._emission_stalls,
             }
             # Multi-tenant LoRA: flat aggregates plus per-name counters
             # ("adapter/<name>/<counter>" — slash-pathed like tracker keys;

@@ -92,6 +92,20 @@ class Request:
 
         self._cancel_requested = False
         self._done = threading.Event()
+        # True once the terminal transition ran (engine thread). Under the
+        # async host runtime the OBSERVABLE completion (``_done`` /
+        # ``_on_finish``) may lag this flag: the engine sets status/error
+        # synchronously via ``_finish(..., defer=True)`` and the emitter
+        # thread calls ``_complete()`` only after every buffered ``on_token``
+        # callback for this request has drained — the drain-on-retire
+        # barrier that keeps ``result()`` ordered after the last callback.
+        self._finished = False
+        # Off-thread emission bookkeeping (engine + emitter threads; the
+        # int is GIL-atomic enough for flow control): callbacks queued but
+        # not yet run, and the first exception an ``on_token`` raised on
+        # the emitter thread (the engine's loop-top sweep retires on it).
+        self._emit_pending = 0
+        self._emit_error: Optional[BaseException] = None
         # Internal completion hook (router layer): called ON THE ENGINE
         # THREAD exactly once, right after the terminal transition — the
         # ReplicaSet uses it to fail a dead replica's in-flight requests
@@ -177,11 +191,28 @@ class Request:
         return (now if now is not None else time.monotonic()) \
             > self.submitted_at + self.timeout
 
-    def _finish(self, status: RequestStatus, error: Optional[BaseException] = None):
-        if self.status in _TERMINAL:  # first terminal transition wins
-            return
+    def _finish(self, status: RequestStatus, error: Optional[BaseException] = None,
+                defer: bool = False):
+        """Terminal transition. ``defer=True`` (async engines, streaming
+        requests) records status/error immediately — so the engine thread
+        sees a consistent terminal state for scheduling — but leaves the
+        observable completion (:meth:`_complete`) to the emitter thread,
+        AFTER this request's buffered callbacks drain. Returns True when
+        this call performed the transition (callers that defer must queue
+        the completion exactly once)."""
+        if self._finished:  # first terminal transition wins
+            return False
+        self._finished = True
         self.status = status
         self.error = error
+        if not defer:
+            self._complete()
+        return True
+
+    def _complete(self):
+        """Second half of the terminal transition: stamp, wake waiters,
+        fire the router hook. Runs on the engine thread (sync path) or the
+        emitter thread (deferred path) — exactly once either way."""
         self.finished_at = time.monotonic()
         self._done.set()
         if self._on_finish is not None:
@@ -189,7 +220,7 @@ class Request:
                 self._on_finish(self)
             except Exception:
                 # The hook belongs to the router layer; a raising hook must
-                # not take down the engine thread finishing the request.
+                # not take down the thread finishing the request.
                 pass
 
     def __repr__(self):

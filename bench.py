@@ -1266,6 +1266,67 @@ def speculative_bench(prompt_len: int = 5, new_tokens: int = 24,
     return out
 
 
+def host_overlap_bench(n_streams: int = 2, new_tokens: int = 24,
+                       step_ms: float = 12.0, consume_ms: float = 4.0,
+                       prompt_len: int = 5, max_len: int = 64) -> dict:
+    """Async-host-runtime A/B: the same sleepy-model traffic (every
+    forward burns a deterministic ``step_ms``) with a ``consume_ms``
+    ``on_token`` consumer per stream, served once with
+    ``async_ticks=False`` and once with the async runtime.
+
+    The sync engine's ITL is additive — device step + host
+    schedule/commit + every consumer callback runs inline between ticks
+    — while the async engine dispatches tick N+1 before reconciling N
+    and drains callbacks on the emitter thread, so its ITL approaches
+    the device leg alone. ``itl_ratio`` (sync/async mean ITL) is the
+    overlap win the perf guard pins; ``host_us_per_tick`` from each mode
+    shows where the hidden time went."""
+    import jax
+    import numpy as np
+
+    from accelerate_tpu.models.llama import LlamaConfig
+    from accelerate_tpu.serving import ServingEngine
+
+    model = _sleepy_llama_cls(step_ms)(LlamaConfig.tiny())
+    params = model.init_params(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(3)
+    prompts = rng.integers(1, 200, size=(n_streams, prompt_len)).astype(np.int32)
+
+    def run(async_ticks: bool) -> dict:
+        engine = ServingEngine(model, params, max_slots=n_streams,
+                               max_len=max_len, async_ticks=async_ticks)
+        try:
+            engine.stats.reset()
+            reqs = [engine.submit(prompts[i:i + 1], max_new_tokens=new_tokens,
+                                  ignore_eos=True,
+                                  on_token=lambda t: time.sleep(consume_ms / 1e3))
+                    for i in range(n_streams)]
+            for r in reqs:
+                r.wait(timeout=300)
+            s = engine.stats.summary()
+            hist = engine.stats.histograms()["itl_ms"]
+        finally:
+            engine.shutdown(drain=False)
+        return {
+            "itl_mean_ms": round(hist["sum"] / max(hist["count"], 1), 3),
+            "decode_ticks": s["decode_ticks"],
+            "host_us_per_tick": s["host_us_per_tick"],
+            "emission_stalls": s["emission_stalls"],
+        }
+
+    sync, asyn = run(False), run(True)
+    return {
+        "n_streams": n_streams,
+        "new_tokens": new_tokens,
+        "step_ms": step_ms,
+        "consume_ms": consume_ms,
+        "sync": sync,
+        "async": asyn,
+        "itl_ratio": round(sync["itl_mean_ms"] / asyn["itl_mean_ms"], 3)
+        if asyn["itl_mean_ms"] else None,
+    }
+
+
 def tracing_overhead_bench(n_requests: int = 10, prompt_len: int = 4,
                            max_new_tokens: int = 16, repeats: int = 3) -> dict:
     """Tracing on/off A/B: identical traffic through two warmed tiny-model
@@ -1446,6 +1507,7 @@ def serving_extra(on_tpu: bool) -> dict:
         "tp": serving_tp_bench(),
         "paged": paged_capacity_bench(),
         "speculative": speculative_bench(),
+        "host_overlap": host_overlap_bench(),
     }
 
 

@@ -209,6 +209,28 @@ class TestTokenParity:
             e1.shutdown(drain=False)
             e2.shutdown(drain=False)
 
+    def test_async_matches_sync_at_tp2(self, tiny, tp2_engine):
+        """The async host runtime's one-tick-ahead dispatch must stay
+        bit-exact when the tick is a GSPMD-sliced executable: the shared
+        tp=2 engine (async by default) against an ``async_ticks=False``
+        twin over staggered mixed-length traffic."""
+        _, m, params = tiny
+        assert tp2_engine._async
+        es = ServingEngine(m, params, tp=2, max_slots=3, max_len=64,
+                           eos_token_id=EOS, prefill_chunk=8,
+                           async_ticks=False)
+        n = 16
+        try:
+            prompts = PROMPTS + [LONG_PROMPT]
+            ra = [tp2_engine.submit(p, max_new_tokens=n) for p in prompts]
+            rb = [es.submit(p, max_new_tokens=n) for p in prompts]
+            for a, b in zip(ra, rb):
+                ga = np.asarray(a.result(120))
+                gb = np.asarray(b.result(120))
+                assert np.array_equal(ga, gb), (ga, gb)
+        finally:
+            es.shutdown(drain=False)
+
     def test_multi_tenant_adapters_match(self, tiny):
         """Adapter and base streams through bank-equipped engines: tp=2
         == single-chip for both, and the adapter actually changes tokens

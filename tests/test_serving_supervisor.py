@@ -209,6 +209,20 @@ class TestHeartbeatAndChaos:
         assert not eng2._heartbeat_frozen
         assert eng2._flight.events == ["chaos_hang", "chaos_hang_end"]
 
+        # wedge arms the engine's reconcile-stall knob exactly once.
+        eng4 = StubEngine()
+        eng4._wedge_s = 0.0
+        chaos4 = ChaosSchedule().wedge(at_tick=2, duration_s=0.7)
+        chaos4.apply(eng4)
+        assert eng4._wedge_s == 0.0 and chaos4.fired() == []
+        eng4.decode_ticks = 2
+        chaos4.apply(eng4)
+        assert eng4._wedge_s == 0.7
+        eng4._wedge_s = 0.0  # the engine consumes it at its barrier
+        chaos4.apply(eng4)  # must not re-arm
+        assert eng4._wedge_s == 0.0 and chaos4.fired() == ["wedge"]
+        assert eng4._flight.events == ["chaos_wedge"]
+
         # slow delays only inside its window.
         eng3 = StubEngine()
         chaos3 = ChaosSchedule().slow(from_tick=2, until_tick=4, delay_s=0.04)
@@ -224,9 +238,45 @@ class TestHeartbeatAndChaos:
         chaos3.apply(eng3)
         assert time.monotonic() - t0 < 0.02, "must not delay past window"
 
+    def test_wedge_stalls_reconcile_then_stream_completes_exact(self, tiny):
+        """A wedge genuinely stops the loop inside a reconcile barrier
+        (no heartbeats while it sleeps — unlike ``hang``, which only
+        freezes the published value), then the engine resumes and the
+        stream is bit-identical: a stalled device wait must never skew
+        what gets committed."""
+        _, m, params = tiny
+        chaos = ChaosSchedule().wedge(at_tick=2, duration_s=0.5)
+        eng = ServingEngine(m, params, max_slots=2, max_len=64,
+                            eos_token_id=EOS, chaos=chaos)
+        n = 20
+        try:
+            ref = _offline(m, params, PROMPTS[0], n)
+            r = eng.submit(PROMPTS[0], max_new_tokens=n, ignore_eos=True)
+            max_gap, last = 0.0, eng.heartbeat[1]
+            deadline = time.monotonic() + 60
+            while not r.done and time.monotonic() < deadline:
+                hb = eng.heartbeat[1]
+                if hb != last:
+                    last = hb
+                max_gap = max(max_gap, time.monotonic() - last)
+                time.sleep(0.005)
+            assert r.wait(timeout=60)
+            assert np.array_equal(np.asarray(r.tokens), ref[: n])
+            assert "wedge" in chaos.fired()
+            assert max_gap >= 0.4, (
+                f"heartbeat gap {max_gap:.3f}s — a 0.5s wedge must "
+                "visibly stall the beat (it is republished only at the "
+                "reconcile barrier, after the stalled wait returns)")
+            kinds = [e["kind"] for e in eng.flight_recorder.snapshot()]
+            assert "chaos_wedge" in kinds
+        finally:
+            eng.shutdown(drain=False)
+
     def test_chaos_schedule_validation(self):
         with pytest.raises(ValueError, match="until_tick"):
             ChaosSchedule().slow(from_tick=5, until_tick=5, delay_s=0.01)
+        with pytest.raises(ValueError, match="duration_s"):
+            ChaosSchedule().wedge(at_tick=3, duration_s=0.0)
         rep = repr(ChaosSchedule().kill(at_tick=8).hang(at_tick=2))
         assert "kill@8" in rep and "hang@2" in rep
 
@@ -440,6 +490,61 @@ class TestSelfHealing:
                 assert any("HungReplicaError" in str(rep["error"])
                            for rep in reports), reports
                 # ...and the watchdogged replica heals without help.
+                assert _wait_state(rs, 0, ReplicaState.HEALTHY)
+                kinds = [e["kind"] for e in sup.events()]
+                assert "hang_fence" in kinds and "restart" in kinds
+                for b in ballast:
+                    b.wait(timeout=120)
+        finally:
+            rs.shutdown(drain=False)
+
+    @pytest.mark.slow
+    def test_wedged_dispatch_is_fenced_within_hang_timeout(self, sleepy):
+        """A genuinely wedged compiled call: the replica sleeps inside
+        the reconcile barrier of a DISPATCHED tick, so no heartbeats are
+        published at all (the async runtime republishes them exactly at
+        that barrier). The watchdog must fence on liveness within
+        ``hang_timeout_s`` — well before the wedge clears — and the
+        victim stream must finish on the survivor token-exact."""
+        m, params = sleepy
+        make = _factory(m, params, max_slots=2)
+        n = 30
+        ref = _offline(m, params, PROMPTS[0], n)
+        chaos = ChaosSchedule().wedge(at_tick=3, duration_s=2.5)
+        rs = ReplicaSet([ServingEngine(m, params, max_slots=2, max_len=64,
+                                       eos_token_id=EOS, chaos=chaos),
+                         make()],
+                        factories=[make, make])
+        try:
+            with FleetSupervisor(rs, hang_timeout_s=0.6,
+                                 poll_interval_s=0.02,
+                                 restart_backoff_s=0.05) as sup:
+                # Pin the victim stream to the chaos replica by filling
+                # the clean one first.
+                ballast = [rs.submit(PROMPTS[1], max_new_tokens=60,
+                                     ignore_eos=True) for _ in range(2)]
+                deadline = time.monotonic() + 60
+                while (ballast[0].replica_trail[0] == 0
+                       and time.monotonic() < deadline):
+                    time.sleep(0.005)
+                r = rs.submit(PROMPTS[0], max_new_tokens=n, ignore_eos=True)
+                t0 = time.monotonic()
+                deadline = t0 + 60
+                while sup.hang_fences < 1 and time.monotonic() < deadline:
+                    time.sleep(0.02)
+                assert sup.hang_fences >= 1, "watchdog never fenced"
+                assert "wedge" in chaos.fired()
+                assert time.monotonic() - t0 < 2.5, (
+                    "the fence must come from the stalled heartbeat, not "
+                    "from waiting out the wedge")
+                assert r.wait(timeout=120)
+                assert r.status is RequestStatus.COMPLETED
+                assert np.array_equal(np.asarray(r.tokens), ref)
+                reports = rs.failover_reports
+                assert any("HungReplicaError" in str(rep["error"])
+                           for rep in reports), reports
+                # The wedge clears on its own; the restart machinery then
+                # brings the killed replica back.
                 assert _wait_state(rs, 0, ReplicaState.HEALTHY)
                 kinds = [e["kind"] for e in sup.events()]
                 assert "hang_fence" in kinds and "restart" in kinds
