@@ -15,7 +15,7 @@
 
 PYTHON ?= python
 
-.PHONY: test-fast test-models test-subproc test-multiprocess test-all test-nightly chaos quality serve-demo bench-trajectory
+.PHONY: test-fast test-models test-subproc test-multiprocess test-all test-nightly chaos quality serve-demo bench-trajectory loadtest
 
 test-fast:
 	$(PYTHON) -m pytest -q $$($(PYTHON) tests/lanes.py fast)
@@ -47,6 +47,14 @@ quality:
 # (guard keys only) so perf regressions across PRs diff in one file.
 bench-trajectory:
 	JAX_PLATFORMS=cpu $(PYTHON) bench.py --trajectory
+
+# Open-loop SSE load against a self-hosted tiny fleet (asyncio front
+# end): heavy-tailed arrivals, goodput/TTFT/conformance JSON report,
+# non-zero exit on any overload-conformance violation.
+loadtest:
+	JAX_PLATFORMS=cpu $(PYTHON) -m accelerate_tpu.commands.accelerate_cli loadtest \
+		--n-streams 500 --rps 200 --out-tokens 8 --out-max 24 --prompt-len 8 \
+		--prompt-max 32 --wall-deadline 120 --check
 
 # HTTP gateway demo on a tiny random model (CPU): 2 replicas on :8000.
 # Try: curl -s localhost:8000/readyz; curl -s -XPOST localhost:8000/v1/completions \

@@ -27,6 +27,7 @@ def _subcommand_registrars():
         "env": _lazy(".env", "env_command_parser"),
         "estimate-memory": _lazy(".estimate", "estimate_command_parser"),
         "launch": _lazy(".launch", "launch_command_parser"),
+        "loadtest": _lazy(".loadtest", "loadtest_command_parser"),
         "merge-weights": _lazy(".merge", "merge_command_parser"),
         "serve": _lazy(".serve", "serve_command_parser"),
         "test": _lazy(".test", "test_command_parser"),

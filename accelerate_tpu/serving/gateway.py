@@ -1,8 +1,23 @@
 """Stdlib-only HTTP gateway over a :class:`~.router.ReplicaSet`.
 
-The network front door of the serving stack: a ``ThreadingHTTPServer``
-(one handler thread per connection — the handlers only wait on queues
-and sockets, all model work stays on the engine threads) exposing:
+The network front door of the serving stack, with TWO interchangeable
+front ends behind one :class:`ServingGateway` API
+(``GatewayConfig(server=...)``):
+
+* ``server="asyncio"`` (default) — a single-threaded :mod:`asyncio`
+  event loop (``gateway_aio``) multiplexing thousands of concurrent SSE
+  streams per process. The engine-facing side stays thread-based;
+  tokens cross from the engines' emitter threads onto the loop via
+  ``loop.call_soon_threadsafe`` into bounded per-stream queues, and
+  request completion rides :meth:`~.router.FleetRequest
+  .add_done_callback` — no handler thread ever parks in ``wait()``.
+* ``server="threading"`` — the original ``ThreadingHTTPServer`` (one
+  handler thread per connection; the handlers only wait on queues and
+  sockets, all model work stays on the engine threads). One OS thread
+  per open stream caps it at hundreds of connections — kept for A/B
+  comparison and for deployments where a proxy bounds concurrency.
+
+Both front ends expose the same routes and speak the same HTTP:
 
 * ``POST /v1/completions`` — JSON in, JSON out, or Server-Sent Events
   when ``"stream": true`` (one ``data:`` event per token as the engine
@@ -74,6 +89,10 @@ class GatewayConfig:
     engines themselves).
 
     Args:
+      server: which front end serves the HTTP: ``"asyncio"`` (default —
+        one event loop multiplexing every connection) or ``"threading"``
+        (one handler thread per connection). Same routes, same status
+        codes, same drain semantics either way.
       host: bind address (default loopback — put a real proxy in front
         before binding wider).
       port: TCP port; **0 asks the OS for an ephemeral port** (read it
@@ -83,7 +102,20 @@ class GatewayConfig:
         before being read into memory.
       max_connections: concurrent in-flight HTTP exchanges; past it new
         requests get 503 (the admission queues provide the real
-        backpressure — this cap only bounds handler threads).
+        backpressure — this cap only bounds front-end state). ``None``
+        picks a per-front-end default: 64 for threading (it is a THREAD
+        cap there) vs 8192 for asyncio (an open socket costs a few KB,
+        not a stack).
+      sse_heartbeat_s: emit an SSE comment frame (``: ping``) on any
+        stream that has written nothing for this many seconds (e.g.
+        sitting deep in a PREFILLING backlog) so proxies and LBs don't
+        sever long-queued streams as idle. ``None`` (default) disables
+        — tests compare byte-exact SSE bodies.
+      stream_queue_tokens: bound of the per-stream token queue between
+        the engine's emitter thread and the front end (tokens buffered
+        ahead of a slow client; overflow spills to an ordered side list
+        so no token is ever dropped — the engine's own bounded
+        ``emission_queue`` is the upstream flow control).
       default_max_new_tokens: used when a completion request omits
         ``max_new_tokens``.
       max_new_tokens_cap: hard per-request ceiling (400 past it);
@@ -108,8 +140,14 @@ class GatewayConfig:
         of a pressure shed (the floor is ``retry_after_s``).
     """
 
-    def __init__(self, *, host: str = "127.0.0.1", port: int = 0,
-                 max_body_bytes: int = 1 << 20, max_connections: int = 64,
+    #: per-front-end ``max_connections=None`` defaults (threads are the
+    #: scarce resource one way, sockets the other).
+    DEFAULT_MAX_CONNECTIONS = {"threading": 64, "asyncio": 8192}
+
+    def __init__(self, *, server: str = "asyncio",
+                 host: str = "127.0.0.1", port: int = 0,
+                 max_body_bytes: int = 1 << 20,
+                 max_connections: Optional[int] = None,
                  default_max_new_tokens: int = 32,
                  max_new_tokens_cap: Optional[int] = None,
                  default_timeout_s: Optional[float] = None,
@@ -117,15 +155,31 @@ class GatewayConfig:
                  drain_grace_s: float = 30.0,
                  shed_projected_pressure: bool = True,
                  shed_wait_s: float = 5.0,
-                 retry_after_max_s: float = 60.0):
+                 retry_after_max_s: float = 60.0,
+                 sse_heartbeat_s: Optional[float] = None,
+                 stream_queue_tokens: int = 256):
+        if server not in self.DEFAULT_MAX_CONNECTIONS:
+            raise ValueError(
+                f"server must be one of "
+                f"{sorted(self.DEFAULT_MAX_CONNECTIONS)} (got {server!r})")
+        if max_connections is None:
+            max_connections = self.DEFAULT_MAX_CONNECTIONS[server]
         if max_body_bytes < 1 or max_connections < 1:
             raise ValueError("max_body_bytes and max_connections must be >= 1")
         if shed_wait_s <= 0 or retry_after_max_s <= 0:
             raise ValueError("shed_wait_s and retry_after_max_s must be > 0")
+        if sse_heartbeat_s is not None and sse_heartbeat_s <= 0:
+            raise ValueError("sse_heartbeat_s must be > 0 or None")
+        if stream_queue_tokens < 1:
+            raise ValueError("stream_queue_tokens must be >= 1")
+        self.server = server
         self.host = host
         self.port = int(port)
         self.max_body_bytes = int(max_body_bytes)
         self.max_connections = int(max_connections)
+        self.sse_heartbeat_s = (None if sse_heartbeat_s is None
+                                else float(sse_heartbeat_s))
+        self.stream_queue_tokens = int(stream_queue_tokens)
         self.default_max_new_tokens = int(default_max_new_tokens)
         self.max_new_tokens_cap = max_new_tokens_cap
         self.default_timeout_s = default_timeout_s
@@ -188,11 +242,103 @@ _METRIC_HELP = {
         "HTTP requests accepted past the connection cap.",
     "accelerate_tpu_gateway_http_inflight":
         "HTTP exchanges currently in flight.",
+    "accelerate_tpu_gateway_open_sse_streams":
+        "SSE streams currently open (the front end's live concurrency).",
+    "accelerate_tpu_gateway_open_sse_streams_max":
+        "High-water mark of concurrently open SSE streams.",
+    "accelerate_tpu_gateway_conn_rejections":
+        "Requests refused (503) at the connection cap — front-end "
+        "saturation, distinct from queue-full 429s.",
 }
 
 
 class _BadRequest(ValueError):
     """Client error carrying the 400 payload message."""
+
+
+def parse_completion(body: dict, cfg: GatewayConfig) -> dict:
+    """Validate a ``POST /v1/completions`` JSON body into a submit spec.
+
+    Transport-independent — both the threading handler and the asyncio
+    front end funnel through here, so the 400-vs-413 surface cannot
+    drift between them. Raises :class:`_BadRequest` with the client-
+    facing message on any malformed field."""
+    prompt = body.get("prompt")
+    if prompt is None:
+        raise _BadRequest('missing "prompt" (a list of token ids — '
+                          "this gateway serves token ids, not text)")
+    try:
+        ids = np.asarray(prompt, np.int32)
+    except (ValueError, TypeError):
+        raise _BadRequest('"prompt" must be a list of token ids '
+                          "(optionally nested [[...]])") from None
+    if ids.ndim not in (1, 2) or ids.size < 1:
+        raise _BadRequest('"prompt" must be a non-empty [S] or [1, S] '
+                          "list of token ids")
+    max_new = body.get("max_new_tokens", cfg.default_max_new_tokens)
+    if not isinstance(max_new, int) or max_new < 1:
+        raise _BadRequest('"max_new_tokens" must be a positive integer')
+    if (cfg.max_new_tokens_cap is not None
+            and max_new > cfg.max_new_tokens_cap):
+        raise _BadRequest(
+            f'"max_new_tokens" {max_new} exceeds the gateway cap '
+            f"({cfg.max_new_tokens_cap})")
+    seed = body.get("seed")
+    if seed is not None and not isinstance(seed, int):
+        raise _BadRequest('"seed" must be an integer')
+    timeout = body.get("timeout", cfg.default_timeout_s)
+    if timeout is not None and (not isinstance(timeout, (int, float))
+                                or timeout <= 0):
+        raise _BadRequest('"timeout" must be a positive number')
+    adapter = body.get("adapter")
+    if adapter is not None and (not isinstance(adapter, str)
+                                or not adapter):
+        raise _BadRequest('"adapter" must be a non-empty string '
+                          "(a registered LoRA adapter name) or omitted")
+    return {
+        "prompt_ids": ids,
+        "max_new_tokens": max_new,
+        "seed": seed,
+        "timeout": None if timeout is None else float(timeout),
+        "ignore_eos": bool(body.get("ignore_eos", False)),
+        "adapter": adapter,
+        "stream": bool(body.get("stream", False)),
+    }
+
+
+def summary_payload(fleet, status: str) -> dict:
+    """The single summary shape for JSON responses AND the SSE final
+    done-event: ``trace_id`` here is what lets a client hand the id
+    straight to ``GET /debug/trace``."""
+    return {
+        "status": status,
+        "tokens": [int(t) for t in fleet.tokens],
+        "prompt_len": int(fleet.prompt_ids.shape[1]),
+        "failovers": fleet.failovers,
+        "replica_trail": list(fleet.replica_trail),
+        "trace_id": fleet.trace_id,
+    }
+
+
+def completion_result(fleet, retry_after_s: float):
+    """Terminal (code, payload, extra_headers) for a FINISHED
+    non-streaming completion — including the adapter-bank-full
+    residency-pressure 503 special case. Shared by both front ends."""
+    if (fleet.status is RequestStatus.FAILED
+            and isinstance(fleet.error, AdapterBankFull)):
+        # Residency pressure, not a server fault: every bank row was
+        # pinned by an in-flight stream at admission time. Structured
+        # 503 so clients can back off and retry.
+        payload = summary_payload(fleet, "failed")
+        payload["error"] = "adapter_bank_full"
+        payload["detail"] = str(fleet.error)
+        return 503, payload, {"Retry-After": f"{retry_after_s:g}"}
+    code, status = _STATUS_HTTP[fleet.status]
+    payload = summary_payload(fleet, status)
+    if code != 200:
+        payload["error"] = (str(fleet.error)
+                            if fleet.error is not None else status)
+    return code, payload, {}
 
 
 class ServingGateway:
@@ -238,13 +384,22 @@ class ServingGateway:
     def start(self):
         """Bind and serve in a daemon thread (idempotent). With
         ``config.port == 0`` the OS picks the port; read it back from
-        :attr:`port` / :attr:`url`."""
+        :attr:`port` / :attr:`url`. Which front end binds is
+        ``config.server``; either way :attr:`_server` duck-types the
+        ``shutdown()`` / ``server_close()`` / ``server_address`` surface
+        the lifecycle methods drive."""
         if self._server is not None:
             return
         if self.compile_watcher is None:
             from ..utils.profiling import CompileWatcher
 
             self.compile_watcher = CompileWatcher().start()
+        if self.config.server == "asyncio":
+            from .gateway_aio import AsyncioGatewayServer
+
+            self._server = AsyncioGatewayServer(self)
+            self._thread = self._server.thread
+            return
         handler = type("GatewayHandler", (_Handler,), {"gateway": self})
         self._server = ThreadingHTTPServer(
             (self.config.host, self.config.port), handler)
@@ -326,6 +481,71 @@ class ServingGateway:
 
     def __exit__(self, *exc):
         self.shutdown(drain=exc[0] is None)
+
+    # -- admission (shared by both front ends) ----------------------------
+    def pressure_retry_after(self, spec: dict) -> Optional[float]:
+        """Projected-pressure shed decision: a ``Retry-After`` in seconds
+        when this completion should be 429'd, else None (admit).
+
+        Sheds only when (a) the fleet's least-loaded paged pool cannot
+        cover this request's worst-case page demand on top of what is
+        already admitted + queued, AND (b) pages have been *observed*
+        draining but too slowly to clear that deficit within
+        ``shed_wait_s``. Rule (b) means a cold fleet (nothing freed yet)
+        or a dense fleet never sheds — queue-depth 429s and deadline
+        408s keep covering those.
+        """
+        cfg = self.config
+        if not cfg.shed_projected_pressure:
+            return None
+        rs = self.replica_set
+        total = int(spec["prompt_ids"].shape[-1]) + int(spec["max_new_tokens"])
+        deficit = rs.projected_page_deficit(total)
+        if deficit <= 0:
+            return None
+        rate = rs.page_drain_rate()
+        if rate <= 0 or deficit <= rate * cfg.shed_wait_s:
+            return None
+        return min(max(deficit / rate, cfg.retry_after_s),
+                   cfg.retry_after_max_s)
+
+    def submit_or_error(self, spec: dict, trace_id: str, on_token=None):
+        """Admit one parsed completion spec: ``(fleet, None)`` on success,
+        ``(None, (code, payload, extra_headers))`` on any refusal —
+        projected-pressure 429, queue-full 429, unknown-adapter 404,
+        no-healthy-replica 503, or invalid-parameter 400. The single
+        admission path both front ends share, so backpressure semantics
+        cannot drift between them."""
+        retry_headers = {"Retry-After": f"{self.config.retry_after_s:g}"}
+        retry_in = self.pressure_retry_after(spec)
+        if retry_in is not None:
+            self.stats.record_pressure_shed()
+            return None, (
+                429, {"error": "projected KV page pressure: admitted and "
+                               "queued work exceeds pool headroom; "
+                               "retry later"},
+                {"Retry-After": f"{retry_in:g}"})
+        try:
+            fleet = self.replica_set.submit(
+                spec["prompt_ids"],
+                max_new_tokens=spec["max_new_tokens"],
+                seed=spec["seed"], timeout=spec["timeout"],
+                ignore_eos=spec["ignore_eos"],
+                adapter=spec["adapter"],
+                trace_id=trace_id,
+                on_token=on_token)
+        except QueueFull:
+            return None, (429, {"error": "all replicas saturated; "
+                                         "retry later"}, retry_headers)
+        except LookupError as e:
+            return None, (404, {"error": "unknown_adapter",
+                                "detail": str(e)}, {})
+        except RuntimeError as e:
+            return None, (503, {"error": f"no healthy replica: {e}"},
+                          retry_headers)
+        except ValueError as e:
+            return None, (400, {"error": str(e)}, {})
+        return fleet, None
 
     # -- metrics ----------------------------------------------------------
     def metrics_text(self) -> str:
@@ -454,32 +674,6 @@ class _Handler(BaseHTTPRequestHandler):
     def _retry_after(self) -> dict:
         return {"Retry-After": f"{self.gateway.config.retry_after_s:g}"}
 
-    def _pressure_retry_after(self, spec: dict) -> Optional[float]:
-        """Projected-pressure shed decision: a ``Retry-After`` in seconds
-        when this completion should be 429'd, else None (admit).
-
-        Sheds only when (a) the fleet's least-loaded paged pool cannot
-        cover this request's worst-case page demand on top of what is
-        already admitted + queued, AND (b) pages have been *observed*
-        draining but too slowly to clear that deficit within
-        ``shed_wait_s``. Rule (b) means a cold fleet (nothing freed yet)
-        or a dense fleet never sheds — queue-depth 429s and deadline
-        408s keep covering those.
-        """
-        cfg = self.gateway.config
-        if not cfg.shed_projected_pressure:
-            return None
-        rs = self.gateway.replica_set
-        total = int(spec["prompt_ids"].shape[-1]) + int(spec["max_new_tokens"])
-        deficit = rs.projected_page_deficit(total)
-        if deficit <= 0:
-            return None
-        rate = rs.page_drain_rate()
-        if rate <= 0 or deficit <= rate * cfg.shed_wait_s:
-            return None
-        return min(max(deficit / rate, cfg.retry_after_s),
-                   cfg.retry_after_max_s)
-
     # -- GET --------------------------------------------------------------
     def do_GET(self):  # noqa: N802 (http.server naming)
         gw = self.gateway
@@ -588,130 +782,28 @@ class _Handler(BaseHTTPRequestHandler):
         return body, length
 
     def _parse_completion(self, body: dict) -> dict:
-        cfg = self.gateway.config
-        prompt = body.get("prompt")
-        if prompt is None:
-            raise _BadRequest('missing "prompt" (a list of token ids — '
-                              "this gateway serves token ids, not text)")
-        try:
-            ids = np.asarray(prompt, np.int32)
-        except (ValueError, TypeError):
-            raise _BadRequest('"prompt" must be a list of token ids '
-                              "(optionally nested [[...]])") from None
-        if ids.ndim not in (1, 2) or ids.size < 1:
-            raise _BadRequest('"prompt" must be a non-empty [S] or [1, S] '
-                              "list of token ids")
-        max_new = body.get("max_new_tokens", cfg.default_max_new_tokens)
-        if not isinstance(max_new, int) or max_new < 1:
-            raise _BadRequest('"max_new_tokens" must be a positive integer')
-        if (cfg.max_new_tokens_cap is not None
-                and max_new > cfg.max_new_tokens_cap):
-            raise _BadRequest(
-                f'"max_new_tokens" {max_new} exceeds the gateway cap '
-                f"({cfg.max_new_tokens_cap})")
-        seed = body.get("seed")
-        if seed is not None and not isinstance(seed, int):
-            raise _BadRequest('"seed" must be an integer')
-        timeout = body.get("timeout", cfg.default_timeout_s)
-        if timeout is not None and (not isinstance(timeout, (int, float))
-                                    or timeout <= 0):
-            raise _BadRequest('"timeout" must be a positive number')
-        adapter = body.get("adapter")
-        if adapter is not None and (not isinstance(adapter, str)
-                                    or not adapter):
-            raise _BadRequest('"adapter" must be a non-empty string '
-                              "(a registered LoRA adapter name) or omitted")
-        return {
-            "prompt_ids": ids,
-            "max_new_tokens": max_new,
-            "seed": seed,
-            "timeout": None if timeout is None else float(timeout),
-            "ignore_eos": bool(body.get("ignore_eos", False)),
-            "adapter": adapter,
-            "stream": bool(body.get("stream", False)),
-        }
+        return parse_completion(body, self.gateway.config)
 
     def _run_completion(self, spec: dict, route: str, nbytes: int,
                         trace_id: str):
         gw = self.gateway
         stream = spec.pop("stream")
-        retry_in = self._pressure_retry_after(spec)
-        if retry_in is not None:
-            gw.stats.record_pressure_shed()
-            self._send_json(
-                429, {"error": "projected KV page pressure: admitted and "
-                               "queued work exceeds pool headroom; "
-                               "retry later"},
-                route, extra_headers={"Retry-After": f"{retry_in:g}"},
-                body_bytes_in=nbytes, trace_id=trace_id)
-            return
         token_q: Optional[queue.Queue] = queue.Queue() if stream else None
-        try:
-            fleet = gw.replica_set.submit(
-                spec["prompt_ids"],
-                max_new_tokens=spec["max_new_tokens"],
-                seed=spec["seed"], timeout=spec["timeout"],
-                ignore_eos=spec["ignore_eos"],
-                adapter=spec["adapter"],
-                trace_id=trace_id,
-                on_token=token_q.put if stream else None)
-        except QueueFull:
-            self._send_json(429, {"error": "all replicas saturated; "
-                                           "retry later"},
-                            route, extra_headers=self._retry_after(),
-                            body_bytes_in=nbytes, trace_id=trace_id)
-            return
-        except LookupError as e:
-            self._send_json(404, {"error": "unknown_adapter",
-                                  "detail": str(e)},
-                            route, body_bytes_in=nbytes, trace_id=trace_id)
-            return
-        except RuntimeError as e:
-            self._send_json(503, {"error": f"no healthy replica: {e}"},
-                            route, extra_headers=self._retry_after(),
-                            body_bytes_in=nbytes, trace_id=trace_id)
-            return
-        except ValueError as e:
-            self._send_json(400, {"error": str(e)}, route,
+        fleet, err = gw.submit_or_error(
+            spec, trace_id, on_token=token_q.put if stream else None)
+        if err is not None:
+            code, payload, headers = err
+            self._send_json(code, payload, route, extra_headers=headers,
                             body_bytes_in=nbytes, trace_id=trace_id)
             return
         if stream:
             self._stream_sse(fleet, token_q, route, nbytes)
         else:
             fleet.wait()  # bounded by the per-request deadline when set
-            if (fleet.status is RequestStatus.FAILED
-                    and isinstance(fleet.error, AdapterBankFull)):
-                # Residency pressure, not a server fault: every bank row
-                # was pinned by an in-flight stream at admission time.
-                # Structured 503 so clients can back off and retry.
-                payload = self._summary_payload(fleet, "failed")
-                payload["error"] = "adapter_bank_full"
-                payload["detail"] = str(fleet.error)
-                self._send_json(503, payload, route,
-                                extra_headers=self._retry_after(),
-                                body_bytes_in=nbytes, trace_id=trace_id)
-                return
-            code, status = _STATUS_HTTP[fleet.status]
-            payload = self._summary_payload(fleet, status)
-            if code != 200:
-                payload["error"] = (str(fleet.error)
-                                    if fleet.error is not None else status)
-            self._send_json(code, payload, route, body_bytes_in=nbytes,
-                            trace_id=trace_id)
-
-    @staticmethod
-    def _summary_payload(fleet, status: str) -> dict:
-        # The single summary shape for JSON responses AND the SSE final
-        # done-event: trace_id here is what lets a client hand the id
-        # straight to GET /debug/trace.
-        return {
-            "status": status,
-            "tokens": [int(t) for t in fleet.tokens],
-            "prompt_len": int(fleet.prompt_ids.shape[1]),
-            "failovers": fleet.failovers,
-            "replica_trail": list(fleet.replica_trail),
-            "trace_id": fleet.trace_id,
-        }
+            code, payload, headers = completion_result(
+                fleet, gw.config.retry_after_s)
+            self._send_json(code, payload, route, extra_headers=headers,
+                            body_bytes_in=nbytes, trace_id=trace_id)
 
     def _stream_sse(self, fleet, token_q: queue.Queue, route: str,
                     nbytes: int):
@@ -719,7 +811,10 @@ class _Handler(BaseHTTPRequestHandler):
         summary event carries the terminal status (and failover count) so
         clients can tell a complete stream from a truncated one. A broken
         client socket cancels the request — its slot frees at the next
-        scheduler pass instead of decoding into the void."""
+        scheduler pass instead of decoding into the void. With
+        ``sse_heartbeat_s`` set, a ``: ping`` comment frame goes out on
+        any stream idle past it (deep PREFILLING backlogs) so
+        intermediaries don't sever long-queued streams."""
         self.send_response(200)
         self.send_header("Content-Type", "text/event-stream")
         self.send_header("Cache-Control", "no-cache")
@@ -727,7 +822,10 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("X-Request-Id", fleet.trace_id)
         self.end_headers()
         self.close_connection = True
+        heartbeat = self.gateway.config.sse_heartbeat_s
+        last_write = time.monotonic()
         sent = 0
+        self.gateway.stats.stream_enter()
         try:
             while True:
                 try:
@@ -735,13 +833,19 @@ class _Handler(BaseHTTPRequestHandler):
                 except queue.Empty:
                     if fleet.done and token_q.empty():
                         break
+                    if (heartbeat is not None
+                            and time.monotonic() - last_write >= heartbeat):
+                        self.wfile.write(b": ping\n\n")
+                        self.wfile.flush()
+                        last_write = time.monotonic()
                     continue
                 self.wfile.write(
                     f"data: {json.dumps({'token': int(tok)})}\n\n".encode())
                 self.wfile.flush()
+                last_write = time.monotonic()
                 sent += 1
             code, status = _STATUS_HTTP[fleet.status]
-            final = self._summary_payload(fleet, status)
+            final = summary_payload(fleet, status)
             final["done"] = True
             if fleet.status is not RequestStatus.COMPLETED:
                 final["error"] = (str(fleet.error)
@@ -751,6 +855,8 @@ class _Handler(BaseHTTPRequestHandler):
         except (BrokenPipeError, ConnectionResetError):
             fleet.cancel()
             code = 499  # client closed; nothing more can be written
+        finally:
+            self.gateway.stats.stream_exit()
         self.gateway.stats.record_response(route, code, body_bytes=nbytes)
         self.gateway.stats.record_stream(sent)
 
@@ -759,6 +865,7 @@ class _Handler(BaseHTTPRequestHandler):
         """Take an in-flight slot; refuse with 503 when the cap is hit
         (without blocking — the admission queues are the real wait)."""
         if not self.gateway._conn_slots.acquire(blocking=False):
+            self.gateway.stats.record_conn_rejection()
             try:
                 self._send_json(503, {"error": "connection limit reached"},
                                 route, extra_headers=self._retry_after())

@@ -540,6 +540,14 @@ class GatewayStats:
             self._tokens_streamed = 0
             self._bytes_in = 0
             self._pressure_sheds = 0
+            # SSE saturation observables: how many event-stream responses
+            # are OPEN right now (the front end's true concurrency — the
+            # number the asyncio refactor exists to scale) and how many
+            # requests bounced off the connection cap (503s the open-loop
+            # harness counts as refusals, distinct from queue-full 429s).
+            self._open_streams = 0
+            self._open_streams_max = 0
+            self._conn_rejections = 0
 
     def record_response(self, route: str, code: int, body_bytes: int = 0):
         """One finished HTTP exchange on ``route`` with status ``code``."""
@@ -561,6 +569,25 @@ class GatewayStats:
         with self._lock:
             self._streams += 1
             self._tokens_streamed += int(tokens)
+
+    def record_conn_rejection(self):
+        """One request refused (503) at the connection cap — saturation of
+        the FRONT END itself, visible on /metrics before any load harness
+        goes looking for it."""
+        with self._lock:
+            self._conn_rejections += 1
+
+    def stream_enter(self):
+        """An SSE response opened (headers sent, events may follow)."""
+        with self._lock:
+            self._open_streams += 1
+            self._open_streams_max = max(self._open_streams_max,
+                                         self._open_streams)
+
+    def stream_exit(self):
+        """An SSE response closed (final event written or socket broke)."""
+        with self._lock:
+            self._open_streams -= 1
 
     def inflight_enter(self):
         with self._lock:
@@ -604,4 +631,7 @@ class GatewayStats:
                 "tokens_streamed": self._tokens_streamed,
                 "request_bytes_in": self._bytes_in,
                 "pressure_sheds": self._pressure_sheds,
+                "open_sse_streams": self._open_streams,
+                "open_sse_streams_max": self._open_streams_max,
+                "conn_rejections": self._conn_rejections,
             }

@@ -133,6 +133,7 @@ class FleetRequest:
 
         self._cancel_requested = False
         self._done = threading.Event()
+        self._done_callbacks: list[Callable[["FleetRequest"], None]] = []
         self._lock = threading.Lock()
         self._inner: Optional[Request] = None
         #: the most recently BUILT inner flight — the only one whose
@@ -169,6 +170,21 @@ class FleetRequest:
 
     def wait(self, timeout: Optional[float] = None) -> bool:
         return self._done.wait(timeout)
+
+    def add_done_callback(self, fn: Callable[["FleetRequest"], None]):
+        """Call ``fn(self)`` exactly once when the request reaches a
+        terminal status — immediately (on the caller's thread) if it is
+        already done, otherwise from whichever engine/router thread drives
+        the terminal transition. This is the completion signal an event-
+        loop front end bridges onto (``loop.call_soon_threadsafe``)
+        instead of parking a thread in :meth:`wait`; callbacks must not
+        block. Exceptions propagate to the finishing thread, so keep the
+        callback a pure notification."""
+        with self._lock:
+            if not self._done.is_set():
+                self._done_callbacks.append(fn)
+                return
+        fn(self)
 
     def result(self, timeout: Optional[float] = None) -> np.ndarray:
         """Generated token ids [n] (prompt excluded), blocking until done;
@@ -230,6 +246,9 @@ class FleetRequest:
             self.error = error
             self.finished_at = time.monotonic()
             self._done.set()
+            callbacks, self._done_callbacks = self._done_callbacks, []
+        for fn in callbacks:  # outside the lock: fn may re-enter this object
+            fn(self)
 
     def __repr__(self):
         return (f"FleetRequest(S={self.prompt_ids.shape[1]}, "

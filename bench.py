@@ -265,49 +265,77 @@ def _serving_test_engine(max_slots: int = 4, max_len: int = 64,
 def serving_sweep(offered_loads=(20.0, 60.0, 200.0), n_requests: int = 12,
                   prompt_len: int = 4, max_new_tokens: int = 12,
                   max_slots: int = 4) -> dict:
-    """Offered-load sweep over one warmed ServingEngine: at each load
-    (requests/sec), submit ``n_requests`` at fixed inter-arrival spacing and
-    report end-to-end throughput, p50/p95 TTFT, and mean slot occupancy.
-    CPU-runnable (tiny model, both programs compiled once up front); the
-    shape of the curve — TTFT flat while slots are free, rising once the
-    queue forms — is the payload, not absolute numbers."""
+    """Offered-load sweep over one warmed ServingEngine, paced
+    OPEN-LOOP on a ``loadgen.ArrivalSchedule``: at each target load the
+    schedule fixes every arrival time up front and submissions fire on
+    that clock with ``block=False`` — a full admission queue sheds the
+    request instead of stalling the sender — so the reported
+    ``offered_rps`` is derived from the schedule and stays honest past
+    saturation. The shape of the curve (TTFT flat while slots are free,
+    rising once the queue forms, sheds appearing past the knee) is the
+    payload, not absolute numbers.
+
+    History note: through PR 16 this sweep reported ``offered_rps``
+    while pacing CLOSED-loop (``submit(block=True)`` — the next send
+    waited whenever the queue was full, silently sagging the realized
+    rate to whatever the engine absorbed). The old measurement is kept
+    under ``legacy_closed_loop`` with an explicit ``closed_loop: true``
+    marker so trajectory diffs across the methodology switch read as a
+    measurement change, not a perf change."""
     import numpy as np
+
+    from accelerate_tpu.loadgen import ArrivalSchedule
+    from accelerate_tpu.serving import QueueFull
 
     engine, _, _, _ = _serving_test_engine(max_slots=max_slots)
     rng = np.random.default_rng(0)
     prompts = rng.integers(1, 200, size=(n_requests, prompt_len)).astype(np.int32)
-    points = []
-    try:
-        for load in offered_loads:
-            engine.stats.reset()
-            gap_s = 1.0 / load
-            t0 = time.perf_counter()
-            reqs = []
-            for i in range(n_requests):
-                target = t0 + i * gap_s
-                delay = target - time.perf_counter()
-                if delay > 0:
-                    time.sleep(delay)
+
+    def _one_load(load: float, closed_loop: bool) -> dict:
+        engine.stats.reset()
+        sched = ArrivalSchedule(n_requests, 1.0 / load, dist="uniform",
+                                seed=0)
+        offsets = sched.offsets()
+        t0 = time.perf_counter()
+        reqs, shed = [], 0
+        for i in range(n_requests):
+            target = t0 + (i / load if closed_loop else offsets[i])
+            delay = target - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            try:
                 reqs.append(engine.submit(prompts[i:i + 1],
                                           max_new_tokens=max_new_tokens,
-                                          seed=i, block=True))
-            for r in reqs:
-                r.wait(timeout=120)
-            wall_s = time.perf_counter() - t0
-            s = engine.serving_metrics()
-            points.append({
-                "offered_rps": load,
-                "completed": s["requests_completed"],
-                "wall_s": round(wall_s, 4),
-                "throughput_tokens_per_sec": round(
-                    s["tokens_emitted"] / wall_s, 3) if wall_s else None,
-                "decode_tokens_per_sec": s["decode_tokens_per_sec"],
-                "ttft_ms_p50": s["ttft_ms_p50"],
-                "ttft_ms_p95": s["ttft_ms_p95"],
-                "queue_wait_ms": s["queue_wait_ms"],
-                "slot_occupancy": s["slot_occupancy"],
-                "batch_efficiency": s["batch_efficiency"],
-            })
+                                          seed=i, block=closed_loop))
+            except QueueFull:
+                shed += 1
+        for r in reqs:
+            r.wait(timeout=120)
+        wall_s = time.perf_counter() - t0
+        s = engine.serving_metrics()
+        point = {
+            "offered_rps": (load if closed_loop
+                            else round(sched.offered_rps, 3)),
+            "target_rps": load,
+            "shed": shed,
+            "completed": s["requests_completed"],
+            "wall_s": round(wall_s, 4),
+            "throughput_tokens_per_sec": round(
+                s["tokens_emitted"] / wall_s, 3) if wall_s else None,
+            "decode_tokens_per_sec": s["decode_tokens_per_sec"],
+            "ttft_ms_p50": s["ttft_ms_p50"],
+            "ttft_ms_p95": s["ttft_ms_p95"],
+            "queue_wait_ms": s["queue_wait_ms"],
+            "slot_occupancy": s["slot_occupancy"],
+            "batch_efficiency": s["batch_efficiency"],
+        }
+        return point
+
+    try:
+        points = [_one_load(load, closed_loop=False)
+                  for load in offered_loads]
+        legacy = [_one_load(load, closed_loop=True)
+                  for load in offered_loads]
     finally:
         engine.shutdown()
     return {
@@ -315,7 +343,9 @@ def serving_sweep(offered_loads=(20.0, 60.0, 200.0), n_requests: int = 12,
         "prompt_len": prompt_len,
         "max_new_tokens": max_new_tokens,
         "max_slots": max_slots,
+        "closed_loop": False,
         "loads": points,
+        "legacy_closed_loop": {"closed_loop": True, "loads": legacy},
     }
 
 
@@ -756,6 +786,81 @@ def gateway_overhead_bench(n_requests: int = 8, prompt_len: int = 4,
         "http_ttft_ms_p95": round(h95, 3),
         "overhead_ratio_p95": round(h95 / d95, 3) if d95 else None,
     }
+
+
+def open_loop_ab_bench(n_streams: int = 48,
+                       mean_interarrival_s: float = 0.005,
+                       step_ms: float = 2.0,
+                       threading_connections: int = 8,
+                       slo_ttft_s: float = 2.0,
+                       wall_deadline_s: float = 60.0) -> dict:
+    """Threading-vs-asyncio gateway front ends under IDENTICAL open-loop
+    offered load, deliberately past the threading front end's saturation
+    knee (its connection cap is pinned low so the knee is cheap to
+    reach): the same seeded ``loadgen`` schedule and traffic profile
+    drive both, so every difference in the two reports is the front end.
+    Past the knee the threading server refuses the excess at its
+    connection cap — those streams never start, so measured from their
+    *scheduled* arrival their TTFT is unbounded and the offered-load p99
+    (clamped at the wall deadline for a finite number) collapses, while
+    the asyncio front end keeps accepting: its event loop holds every
+    stream open for a few KB each and the engine's admission queue does
+    the real flow control. The perf guard pins the p99-TTFT ratio and
+    that the threading side actually hit its cap (otherwise the A/B
+    never left the flat region and proves nothing)."""
+    import jax
+
+    from accelerate_tpu.loadgen import (
+        ArrivalSchedule,
+        TrafficProfile,
+        build_report,
+        fetch_gateway_metrics,
+        run_open_loop,
+    )
+    from accelerate_tpu.models.llama import LlamaConfig
+    from accelerate_tpu.serving import (
+        GatewayConfig,
+        ReplicaSet,
+        ServingEngine,
+        ServingGateway,
+    )
+
+    cfg = LlamaConfig.tiny()
+    model = _sleepy_llama_cls(step_ms)(cfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+    out = {"n_streams": n_streams, "step_ms": step_ms,
+           "threading_connections": threading_connections}
+    for server in ("threading", "asyncio"):
+        rs = ReplicaSet.from_factory(
+            lambda: ServingEngine(model, params, max_slots=4, max_len=64,
+                                  prefill_chunk=16, prefix_cache_mb=0.0,
+                                  max_queued=2 * n_streams), 1)
+        gw_cfg = GatewayConfig(
+            server=server, port=0,
+            max_connections=(threading_connections
+                             if server == "threading" else None))
+        # Same seeds both sides: identical arrival times, identical
+        # request shapes — the offered load really is the control.
+        sched = ArrivalSchedule(n_streams, mean_interarrival_s,
+                                dist="lognormal", sigma=0.8, seed=0)
+        prof = TrafficProfile(
+            prompt_len_median=4, prompt_len_max=8, out_tokens_median=6,
+            out_tokens_max=10, sampled_fraction=0.5, seed=1)
+        with ServingGateway(rs, config=gw_cfg) as gw:
+            run = run_open_loop(gw.url, sched, prof,
+                                vocab_size=cfg.vocab_size,
+                                wall_deadline_s=wall_deadline_s)
+            metrics = fetch_gateway_metrics(gw.url)
+        out[server] = build_report(run, sched, prof, slo_ttft_s=slo_ttft_s,
+                                   clamp_s=wall_deadline_s,
+                                   server_metrics=metrics)
+    thr = out["threading"]["ttft_s"]["p99_clamped"]
+    aio = out["asyncio"]["ttft_s"]["p99_clamped"]
+    out["p99_ttft_ratio_threading_over_asyncio"] = (
+        round(thr / aio, 3) if thr and aio else None)
+    out["threading_conn_rejections"] = (
+        out["threading"].get("server_metrics", {}).get("conn_rejections"))
+    return out
 
 
 def replica_failover_bench(n_inflight: int = 4, step_ms: float = 20.0,
@@ -1503,6 +1608,7 @@ def serving_extra(on_tpu: bool) -> dict:
             "overhead": gateway_overhead_bench(),
             "failover": replica_failover_bench(),
         },
+        "open_loop": open_loop_ab_bench(),
         "chaos": chaos_recovery_bench(),
         "tp": serving_tp_bench(),
         "paged": paged_capacity_bench(),

@@ -42,6 +42,7 @@ GROUPS = [
       "accelerate_tpu.serving.scheduler", "accelerate_tpu.serving.metrics",
       "accelerate_tpu.serving.mesh_exec",
       "accelerate_tpu.serving.router", "accelerate_tpu.serving.gateway",
+      "accelerate_tpu.serving.gateway_aio",
       "accelerate_tpu.serving.supervisor", "accelerate_tpu.serving.chaos"],
      "Continuous-batching decode service: slot scheduler, fixed-shape "
      "prefill/decode programs, request handles, serving counters — plus "
@@ -50,7 +51,17 @@ GROUPS = [
      "failover), the stdlib HTTP gateway in front of it, and the "
      "self-healing layer: the fleet supervisor (hang watchdog, "
      "auto-restart, crash-loop circuit breaker) with its deterministic "
-     "chaos-injection harness."),
+     "chaos-injection harness. The gateway has two wire front ends: the "
+     "threading handler in `gateway` and the single-event-loop asyncio "
+     "front end in `gateway_aio` that multiplexes thousands of SSE "
+     "streams on one thread."),
+    ("loadgen", "Load generation",
+     ["accelerate_tpu.loadgen.generator", "accelerate_tpu.loadgen.report"],
+     "Open-loop serving load: seeded heavy-tailed arrival schedules and "
+     "traffic profiles, the single-event-loop SSE driver that measures "
+     "TTFT/ITL from *scheduled* arrival, and the goodput / overload-"
+     "conformance report behind `accelerate-tpu loadtest` and the "
+     "`extra.serving.open_loop` bench."),
     ("observability", "Observability",
      ["accelerate_tpu.observability.tracing",
       "accelerate_tpu.observability.flight_recorder",

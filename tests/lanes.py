@@ -54,6 +54,7 @@ LANES: dict[str, tuple[int, list[str]]] = {
         "test_generation.py",
         "test_hf_interop.py",
         "test_host_offload.py",
+        "test_loadgen.py",
         "test_memory_properties.py",
         "test_models.py",
         "test_observability.py",

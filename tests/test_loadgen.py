@@ -1,0 +1,413 @@
+"""Open-loop load harness (accelerate_tpu.loadgen) + gateway at scale.
+
+Pinned here:
+
+* SCHEDULE HONESTY — ``ArrivalSchedule`` is seeded-deterministic, its
+  offsets start at zero and ascend, the realized mean inter-arrival
+  tracks the target, and ``offered_rps`` is derived from the schedule
+  itself (fixed before the first byte is sent — the open-loop point).
+* REPORT CONVENTIONS — every stream lands in exactly one outcome
+  bucket (counters balance), TTFT percentiles are over OFFERED streams
+  with unbounded tails surfaced both honestly (None + fraction) and
+  clamped, and conformance counters flag unstructured refusals.
+* OVERLOAD CONFORMANCE at ~2x saturation — every non-2xx the gateway
+  returns is a structured 408/429/503 with a bounded Retry-After, zero
+  truncated SSE bodies, zero duplicated/lost tokens (streamed events
+  match the final summary exactly).
+* SCALE — the asyncio front end holds >= 1000 concurrently open SSE
+  streams in ONE process with ZERO new compiled programs, token-exact
+  against direct ``ReplicaSet.submit`` on the same engine; the
+  threading front end under the same kind of load refuses at its
+  connection cap with structured 503s (that asymmetry is the reason
+  the asyncio front end exists).
+* SSE KEEP-ALIVE — ``: ping`` comment frames appear on idle streams
+  when ``sse_heartbeat_s`` is set and never by default.
+"""
+
+import math
+import os
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench  # noqa: E402
+from accelerate_tpu.loadgen import (  # noqa: E402
+    ArrivalSchedule,
+    StreamResult,
+    TrafficProfile,
+    build_report,
+    fetch_gateway_metrics,
+    percentile,
+    run_open_loop,
+)
+from accelerate_tpu.models.llama import (  # noqa: E402
+    LlamaConfig,
+    LlamaForCausalLM,
+)
+from accelerate_tpu.serving import (  # noqa: E402
+    GatewayConfig,
+    ReplicaSet,
+    ServingEngine,
+    ServingGateway,
+)
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = LlamaConfig.tiny(use_flash_attention=False)
+    m = LlamaForCausalLM(cfg)
+    params = m.init_params(jax.random.PRNGKey(0), batch_size=2, seq_len=8)
+    return cfg, m, params
+
+
+@pytest.fixture(scope="module")
+def sleepy(tiny):
+    cfg, _, params = tiny
+    m = bench._sleepy_llama_cls(step_ms=8.0)(cfg)
+    return m, params
+
+
+def _gateway(m, params, *, server="asyncio", max_slots=4, max_queued=64,
+             gw_kw=None, **engine_kw):
+    engine_kw.setdefault("max_len", 64)
+    engine_kw.setdefault("prefill_chunk", 16)
+    engine_kw.setdefault("prefix_cache_mb", 0.0)
+    rs = ReplicaSet.from_factory(
+        lambda: ServingEngine(m, params, max_slots=max_slots,
+                              max_queued=max_queued, **engine_kw), 1)
+    gw = ServingGateway(rs, config=GatewayConfig(server=server, port=0,
+                                                 **(gw_kw or {})))
+    gw.start()
+    return gw
+
+
+# -- schedule ----------------------------------------------------------
+class TestArrivalSchedule:
+    def test_deterministic_and_monotonic(self):
+        a = ArrivalSchedule(200, 0.01, dist="lognormal", seed=7)
+        b = ArrivalSchedule(200, 0.01, dist="lognormal", seed=7)
+        assert np.array_equal(a.offsets(), b.offsets())
+        off = a.offsets()
+        assert off[0] == 0.0
+        assert np.all(np.diff(off) >= 0)
+        c = ArrivalSchedule(200, 0.01, dist="lognormal", seed=8)
+        assert not np.array_equal(a.offsets(), c.offsets())
+
+    @pytest.mark.parametrize("dist", ["lognormal", "pareto", "uniform"])
+    def test_mean_interarrival_tracks_target(self, dist):
+        sched = ArrivalSchedule(8000, 0.02, dist=dist, seed=0)
+        realized = sched.span_s / (sched.n - 1)
+        assert realized == pytest.approx(0.02, rel=0.25), dist
+        # offered_rps is DERIVED from the schedule, not asserted into it.
+        assert sched.offered_rps == pytest.approx(
+            (sched.n - 1) / sched.span_s)
+
+    def test_heavy_tail_is_heavier_than_uniform(self):
+        # The point of lognormal/Pareto arrivals: bursts. The largest
+        # gap should dwarf the mean in a way uniform never does.
+        ln = ArrivalSchedule(4000, 0.01, dist="lognormal", sigma=1.2,
+                             seed=0)
+        un = ArrivalSchedule(4000, 0.01, dist="uniform", seed=0)
+        assert np.diff(ln.offsets()).max() > 3 * np.diff(un.offsets()).max()
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            ArrivalSchedule(0, 0.01)
+        with pytest.raises(ValueError):
+            ArrivalSchedule(10, -1.0)
+        with pytest.raises(ValueError):
+            ArrivalSchedule(10, 0.01, dist="poisson")
+        with pytest.raises(ValueError):
+            ArrivalSchedule(10, 0.01, dist="pareto", alpha=1.0)
+
+
+class TestTrafficProfile:
+    def test_clips_and_mix(self):
+        prof = TrafficProfile(
+            prompt_len_median=8, prompt_len_min=2, prompt_len_max=16,
+            out_tokens_median=6, out_tokens_min=2, out_tokens_max=12,
+            adapters=((None, 0.5), ("fr", 0.5)),
+            sampled_fraction=0.5, seed=3)
+        bodies = [prof.sample(vocab_size=100) for _ in range(200)]
+        for b in bodies:
+            assert 2 <= len(b["prompt"]) <= 16
+            assert 2 <= b["max_new_tokens"] <= 12
+            assert all(0 <= t < 100 for t in b["prompt"])
+            assert b["priority"] in ("interactive", "batch")
+        adapters = [b.get("adapter") for b in bodies]
+        assert any(a == "fr" for a in adapters)
+        assert any(a is None for a in adapters)
+        seeded = sum("seed" in b for b in bodies)
+        assert 0 < seeded < len(bodies)
+
+    def test_deterministic(self):
+        a = TrafficProfile(seed=9)
+        b = TrafficProfile(seed=9)
+        assert [a.sample() for _ in range(20)] == [
+            b.sample() for _ in range(20)]
+
+    def test_extremes(self):
+        none = TrafficProfile(sampled_fraction=0.0, seed=0)
+        assert not any("seed" in none.sample() for _ in range(50))
+        always = TrafficProfile(sampled_fraction=1.0, seed=0)
+        assert all("seed" in always.sample() for _ in range(50))
+
+
+# -- report ------------------------------------------------------------
+class TestReport:
+    def test_percentile_nearest_rank(self):
+        vals = [1.0, 2.0, 3.0, 4.0]
+        assert percentile(vals, 50) == 2.0
+        assert percentile(vals, 100) == 4.0
+        assert percentile(vals, 0) == 1.0
+        assert percentile([], 99) is None
+        assert math.isinf(percentile([1.0, float("inf")], 100))
+
+    @staticmethod
+    def _mk(i, **kw):
+        r = StreamResult(index=i, scheduled_s=float(i))
+        for k, v in kw.items():
+            setattr(r, k, v)
+        return r
+
+    def test_buckets_and_conformance(self):
+        done_ok = {"status": "completed", "tokens": [1, 2]}
+        results = [
+            self._mk(0, code=200, ttft_s=0.1, tokens=[1, 2], done=done_ok),
+            self._mk(1, code=429, retry_after_s=1.0),
+            self._mk(2, code=503, retry_after_s=2.5),
+            self._mk(3, code=500),                   # unstructured!
+            self._mk(4, code=429),                   # missing Retry-After
+            self._mk(5, error="connect: refused"),
+            self._mk(6, code=200, truncated=True),
+            self._mk(7, code=200, aborted=True),
+            # streamed tokens disagree with the summary -> dup/lost:
+            self._mk(8, code=200, ttft_s=0.2, tokens=[1],
+                     done={"status": "completed", "tokens": [1, 9]}),
+        ]
+        sched = ArrivalSchedule(len(results), 0.01, seed=0)
+        rep = build_report({"results": results, "wall_s": 10.0,
+                            "process_cpu_s": 1.0}, sched,
+                           slo_ttft_s=1.0, clamp_s=10.0)
+        out = rep["outcomes"]
+        assert sum(out.values()) == len(results)
+        assert rep["counters_balance"]
+        assert out == {"completed": 2, "http_429": 2, "http_503": 1,
+                       "http_500": 1, "connect_error": 1,
+                       "truncated_sse": 1, "aborted": 1}
+        conf = rep["conformance"]
+        assert conf["non_2xx"] == 4
+        assert conf["unstructured_non_2xx"] == 1   # the 500
+        assert conf["missing_retry_after"] == 1    # the bare 429
+        assert conf["max_retry_after_s"] == 2.5
+        assert conf["truncated_sse"] == 1
+        assert conf["token_mismatches"] == 1
+        # 7 of 9 streams never produced a first token -> unbounded tail.
+        t = rep["ttft_s"]
+        assert t["unbounded_fraction"] == pytest.approx(7 / 9)
+        assert t["p99"] is None and t["p99_clamped"] == 10.0
+        assert t["p50_clamped"] == 10.0
+        assert rep["goodput"]["completed"] == 2
+        assert rep["goodput"]["within_slo"] == 2
+        assert rep["run"]["host_cpu_s_per_stream"] == pytest.approx(1 / 9)
+
+
+# -- live gateway: overload conformance --------------------------------
+class TestOverloadConformance:
+    def test_2x_saturation_all_refusals_structured(self, sleepy):
+        """~2x the sleepy fleet's completion rate, heavy-tailed: some
+        streams complete, the rest MUST be shed as structured 429/503
+        with bounded Retry-After — and not one SSE body may be
+        truncated or disagree with its final summary."""
+        m, params = sleepy
+        gw = _gateway(m, params, max_slots=2, max_queued=6)
+        try:
+            sched = ArrivalSchedule(60, 0.010, dist="lognormal",
+                                    sigma=0.8, seed=2)
+            prof = TrafficProfile(
+                prompt_len_median=4, prompt_len_max=16,
+                out_tokens_median=6, out_tokens_max=10,
+                sampled_fraction=0.5, seed=3)
+            run = run_open_loop(gw.url, sched, prof, vocab_size=200,
+                                wall_deadline_s=90)
+            rep = build_report(run, sched, prof, slo_ttft_s=2.0,
+                               server_metrics=fetch_gateway_metrics(gw.url))
+        finally:
+            gw.shutdown(drain=False)
+        conf = rep["conformance"]
+        # The test must actually overload: refusals prove the 2x.
+        assert conf["non_2xx"] > 0, rep["outcomes"]
+        assert conf["unstructured_non_2xx"] == 0, rep["outcomes"]
+        assert conf["missing_retry_after"] == 0
+        assert conf["max_retry_after_s"] is not None
+        assert conf["max_retry_after_s"] <= 60.0  # retry_after_max_s
+        assert conf["truncated_sse"] == 0
+        assert conf["token_mismatches"] == 0
+        assert rep["counters_balance"]
+        # submitted = completed + shed + errors, stream by stream.
+        n_err = sum(1 for r in run["results"] if r.code is None)
+        assert (rep["goodput"]["completed"] + conf["non_2xx"] + n_err
+                + rep["outcomes"].get("aborted", 0)) == sched.n
+
+
+# -- live gateway: scale ------------------------------------------------
+class TestAsyncioScale:
+    def test_1000_concurrent_sse_streams_zero_new_compiles(self, sleepy):
+        """The tentpole acceptance number: >= 1000 SSE streams open at
+        once in ONE process on the asyncio front end, no new XLA
+        programs compiled under load, and completed streams token-exact
+        vs direct ``ReplicaSet.submit`` on the same warmed engine."""
+        m, params = sleepy
+        n = 1200
+        # Pressure shedding off: this test WANTS a thousand streams
+        # parked open on the slow engine — exactly the load the shed
+        # would (correctly) 429 away in production.
+        gw = _gateway(m, params, max_slots=4, max_queued=2 * n,
+                      gw_kw={"max_connections": 2 * n,
+                             "shed_projected_pressure": False})
+        try:
+            prof_kw = dict(prompt_len_median=6, prompt_len_max=16,
+                           out_tokens_median=16, out_tokens_sigma=0.0,
+                           out_tokens_min=16, out_tokens_max=16,
+                           sampled_fraction=0.0)
+            # Priming pass: flush any lazily-compiled program (prefill
+            # bucket, decode step) so the big run must compile NOTHING.
+            prime = ArrivalSchedule(4, 0.01, seed=5)
+            run_open_loop(gw.url, prime,
+                          TrafficProfile(seed=6, **prof_kw),
+                          vocab_size=200, wall_deadline_s=60)
+            compiles_before = gw.compile_watcher.summary()["compile_events"]
+            # The gauge peaks within the first few seconds (arrivals
+            # outrun the sleepy fleet ~30x); the short wall deadline
+            # then aborts the backlog client-side, which is itself the
+            # broken-socket-cancel path at scale. Keeps the test inside
+            # the tier-1 budget.
+            sched = ArrivalSchedule(n, 0.0008, dist="lognormal",
+                                    sigma=0.3, seed=7)
+            prof = TrafficProfile(seed=8, **prof_kw)
+            run = run_open_loop(gw.url, sched, prof, vocab_size=200,
+                                wall_deadline_s=12)
+            metrics = fetch_gateway_metrics(gw.url)
+            compiles_after = gw.compile_watcher.summary()["compile_events"]
+            rep = build_report(run, sched, prof, server_metrics=metrics)
+            assert metrics["open_sse_streams_max"] >= 1000, metrics
+            assert compiles_after == compiles_before, (
+                f"{compiles_after - compiles_before} programs compiled "
+                "under open-loop load — per-request shapes are leaking "
+                "into compilation")
+            assert rep["conformance"]["truncated_sse"] == 0
+            assert rep["conformance"]["token_mismatches"] == 0
+            assert rep["counters_balance"]
+            done = [r for r in run["results"] if r.completed][:3]
+            assert len(done) == 3, rep["outcomes"]
+            for r in done:
+                ref = gw.replica_set.submit(
+                    np.asarray([r.request["prompt"]], np.int32),
+                    max_new_tokens=r.request["max_new_tokens"],
+                    ignore_eos=True, block=True)
+                ref.wait(timeout=120)
+                assert r.tokens == [int(t) for t in ref.tokens], r.index
+        finally:
+            gw.shutdown(drain=False)
+
+    def test_threading_refuses_at_connection_cap(self, sleepy):
+        """The same kind of open-loop burst against the THREADING front
+        end with a small connection cap: the excess is refused with
+        structured 503s (counted on the new conn_rejections gauge) —
+        the saturation mode the asyncio front end removes."""
+        m, params = sleepy
+        gw = _gateway(m, params, server="threading", max_slots=2,
+                      max_queued=128, gw_kw={"max_connections": 8})
+        try:
+            sched = ArrivalSchedule(64, 0.002, dist="lognormal",
+                                    sigma=0.5, seed=11)
+            prof = TrafficProfile(prompt_len_median=4, prompt_len_max=8,
+                                  out_tokens_median=8, out_tokens_max=12,
+                                  sampled_fraction=0.0, seed=12)
+            run = run_open_loop(gw.url, sched, prof, vocab_size=200,
+                                wall_deadline_s=90)
+            metrics = fetch_gateway_metrics(gw.url)
+            rep = build_report(run, sched, prof, server_metrics=metrics)
+        finally:
+            gw.shutdown(drain=False)
+        assert metrics["conn_rejections"] > 0, rep["outcomes"]
+        assert rep["outcomes"].get("http_503", 0) > 0
+        assert rep["conformance"]["unstructured_non_2xx"] == 0
+        assert rep["conformance"]["missing_retry_after"] == 0
+        # The cap bounds concurrency: the gauge can never exceed it.
+        assert metrics["open_sse_streams_max"] <= 8
+
+    @pytest.mark.slow
+    def test_soak_tens_of_thousands_of_streams(self, tiny):
+        """Soak: 20k scheduled streams from one client loop against the
+        fast tiny model. Not all complete inside the wall deadline —
+        the assertions are conformance and accounting, not throughput:
+        whatever the gateway did under minutes of sustained overload,
+        every refusal was structured and every SSE body was whole."""
+        _, m, params = tiny
+        gw = _gateway(m, params, max_slots=8, max_queued=4096,
+                      gw_kw={"max_connections": 16384})
+        try:
+            sched = ArrivalSchedule(20_000, 0.0005, dist="pareto",
+                                    alpha=1.8, seed=13)
+            prof = TrafficProfile(prompt_len_median=4, prompt_len_max=16,
+                                  out_tokens_median=4, out_tokens_max=8,
+                                  sampled_fraction=0.25, seed=14)
+            run = run_open_loop(gw.url, sched, prof, vocab_size=200,
+                                wall_deadline_s=180)
+            rep = build_report(run, sched, prof,
+                               server_metrics=fetch_gateway_metrics(gw.url))
+        finally:
+            gw.shutdown(drain=False)
+        conf = rep["conformance"]
+        assert rep["counters_balance"]
+        assert conf["unstructured_non_2xx"] == 0
+        assert conf["missing_retry_after"] == 0
+        assert conf["truncated_sse"] == 0
+        assert conf["token_mismatches"] == 0
+        assert rep["goodput"]["completed"] > 0
+
+
+# -- SSE keep-alive -----------------------------------------------------
+class TestHeartbeat:
+    def test_ping_frames_when_enabled(self, sleepy):
+        m, params = sleepy
+        gw = _gateway(m, params, max_slots=2,
+                      gw_kw={"sse_heartbeat_s": 0.02})
+        try:
+            sched = ArrivalSchedule(2, 0.01, seed=0)
+            prof = TrafficProfile(prompt_len_median=4, prompt_len_max=8,
+                                  out_tokens_median=8, out_tokens_min=8,
+                                  out_tokens_max=8, out_tokens_sigma=0.0,
+                                  sampled_fraction=0.0, seed=1)
+            run = run_open_loop(gw.url, sched, prof, vocab_size=200,
+                                wall_deadline_s=60)
+        finally:
+            gw.shutdown(drain=False)
+        results = run["results"]
+        assert all(r.completed for r in results)
+        # The sleepy model's ~8ms ticks dwarf the 20ms heartbeat only
+        # across multi-token gaps; the queue wait alone guarantees SOME
+        # idle window. At least one ping must have arrived, and pings
+        # must never corrupt the token stream.
+        assert sum(r.heartbeats for r in results) > 0
+        assert all(not r.truncated for r in results)
+
+    def test_no_pings_by_default(self, sleepy):
+        m, params = sleepy
+        gw = _gateway(m, params, max_slots=2)
+        try:
+            sched = ArrivalSchedule(2, 0.01, seed=0)
+            prof = TrafficProfile(prompt_len_median=4, prompt_len_max=8,
+                                  out_tokens_median=8, out_tokens_min=8,
+                                  out_tokens_max=8, out_tokens_sigma=0.0,
+                                  sampled_fraction=0.0, seed=1)
+            run = run_open_loop(gw.url, sched, prof, vocab_size=200,
+                                wall_deadline_s=60)
+        finally:
+            gw.shutdown(drain=False)
+        assert sum(r.heartbeats for r in run["results"]) == 0
