@@ -22,6 +22,7 @@ from .lora import (
     prepare_lora,
     target_paths,
 )
+from .quantize import quantize_base_weights, shardings_for_quantized
 from .registry import AdapterBank, AdapterBankFull, UnknownAdapterError
 
 __all__ = [
@@ -39,6 +40,8 @@ __all__ = [
     "merge_adapter",
     "pad_adapter",
     "prepare_lora",
+    "quantize_base_weights",
     "save_adapter",
+    "shardings_for_quantized",
     "target_paths",
 ]

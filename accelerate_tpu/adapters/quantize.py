@@ -1,0 +1,115 @@
+"""Serving-side base-weight quantization with an exact LoRA path.
+
+The serving engine's ``weights_dtype="int8"`` mode stores BASE weights as
+:class:`~accelerate_tpu.utils.quantization.QuantizedTensor` pytree leaves
+(per-output-channel symmetric int8, the TPU weight-only-quant layout) and
+dequantizes them at the top of each compiled program — XLA fuses the
+``convert(int8) * scale`` into the consuming dot, so weights at rest in
+HBM stay integer. The LoRA low-rank path is deliberately NOT quantized:
+adapter factors live full precision in the :class:`~.registry.AdapterBank`
+(identity row 0 included), so multi-tenant adapters apply exactly on top
+of the quantized base — per-tenant deltas never accumulate quantization
+error of their own.
+
+This module is the thin serving-facing prepare path over
+:mod:`accelerate_tpu.utils.quantization`:
+
+* :func:`quantize_base_weights` — params pytree → pytree with eligible
+  kernel leaves replaced by ``QuantizedTensor`` nodes.
+* :func:`shardings_for_quantized` — map a slice's full-precision TP
+  shardings onto a quantized tree: the int ``q`` takes the kernel's
+  Megatron spec, its ``scale`` keeps a spec axis only where the scale dim
+  equals the kernel dim (size-1 amax dims replicate) — so quantized
+  serving composes with ``tp=`` slices with zero changes to the sharding
+  rules themselves.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.quantization import (
+    QuantizationConfig,
+    QuantizedTensor,
+    _is_quantized,
+    dequantize_params,
+    quantize_params,
+    quantized_nbytes,
+)
+
+__all__ = [
+    "quantize_base_weights",
+    "shardings_for_quantized",
+    "dequantize_params",
+    "quantized_nbytes",
+]
+
+#: leaves below this size stay full precision (norms, biases, tiny heads)
+#: — small enough that the serving test models exercise the real path.
+SERVING_MIN_WEIGHT_SIZE = 256
+
+#: path regexes kept full precision for output quality: the unembedding
+#: head (reference keeps lm_head fp) and the token embedding table, whose
+#: per-column scale poorly fits a vocab-long axis.
+SERVING_SKIP_MODULES = ("lm_head", "embed")
+
+
+def quantize_base_weights(params, *, min_weight_size: int | None = None,
+                          skip_modules=None):
+    """Quantize a serving model's base params to per-channel int8.
+
+    Returns a new pytree where each eligible kernel leaf (ndim >= 2, size
+    >= ``min_weight_size``, path not matching ``skip_modules``) is a
+    :class:`QuantizedTensor`; everything else is untouched. Idempotent on
+    already-quantized leaves. LoRA adapter factors never pass through
+    here — the bank holds them full precision by construction.
+    """
+    cfg = QuantizationConfig(
+        load_in_8bit=True,
+        min_weight_size=(SERVING_MIN_WEIGHT_SIZE if min_weight_size is None
+                         else int(min_weight_size)),
+        skip_modules=list(skip_modules if skip_modules is not None
+                          else SERVING_SKIP_MODULES),
+    )
+    return quantize_params(params, cfg)
+
+
+def shardings_for_quantized(exec_, qparams):
+    """TP shardings for a quantized param tree under one serving slice.
+
+    Derives the slice's full-precision shardings from the LOGICAL shapes
+    (``QuantizedTensor.shape`` is the kernel's shape, so the Megatron
+    path-regex rules apply unchanged), then rebuilds the tree with a
+    ``QuantizedTensor`` of shardings at each quantized position: ``q``
+    takes the kernel's spec verbatim; ``scale`` keeps an axis name only
+    where its dim matches the kernel's (the amax-reduced size-1 dim
+    replicates). The treedefs match (same aux data), so ``device_put``,
+    ``jit in_shardings``, and the engine's place path all accept the
+    result exactly like a plain sharding pytree.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    shapes = jax.tree_util.tree_map(
+        lambda l: (jax.ShapeDtypeStruct(tuple(l.shape), jnp.float32)
+                   if _is_quantized(l) else l),
+        qparams, is_leaf=_is_quantized)
+    fp_sh = exec_.param_shardings(shapes)
+
+    def _pair(leaf, sh):
+        if not _is_quantized(leaf):
+            return sh
+        if leaf.bits != 8:
+            raise NotImplementedError(
+                "serving weight quantization shards int8 leaves only "
+                f"(got int{leaf.bits})")
+        spec = list(sh.spec) + [None] * (leaf.ndim - len(sh.spec))
+        sspec = [ax if (ax is not None
+                        and leaf.scale.shape[i] == leaf.q.shape[i])
+                 else None
+                 for i, ax in enumerate(spec)]
+        scale_sh = NamedSharding(sh.mesh, PartitionSpec(*sspec))
+        return QuantizedTensor(sh, scale_sh, leaf.bits, leaf.block_size)
+
+    return jax.tree_util.tree_map(_pair, qparams, fp_sh,
+                                  is_leaf=_is_quantized)

@@ -292,6 +292,12 @@ def estimate_command(args) -> int:
             print(f"  {n_fallback} leaves have no dimension divisible by "
                   f"{zero}: REPLICATED (per-chip share above includes them "
                   f"in full)")
+    if args.weights_dtype is not None:
+        print(f"Serving weights ({args.weights_dtype}): the "
+              f"{args.weights_dtype} row above is what the engine stores "
+              "under weights_dtype='int8' (per-channel scales, dequantized "
+              "on the fly); LoRA adapters ride full precision on top, so "
+              "adapter math stays exact.")
     if args.lora_rank is not None:
         from ..adapters.lora import LoRAConfig, count_lora_params
 
@@ -321,8 +327,15 @@ def estimate_command(args) -> int:
                   "name or config.json)")
             return 2
         layers, kv_heads, head_dim = geom
-        per_tok = 2 * layers * kv_heads * head_dim * 2  # k+v, bf16
-        page_bytes = per_tok * args.page_size
+        kv_int8 = args.kv_dtype == "int8"
+        itemsize = 1 if kv_int8 else 2
+        per_tok = 2 * layers * kv_heads * head_dim * itemsize  # k+v
+        # Quantized pages carry one f32 scale per pool leaf (k and v per
+        # layer = 2*layers leaves) per page — mirrors the engine's
+        # _page_bytes accounting exactly.
+        scale_bytes = 2 * layers * 4 if kv_int8 else 0
+        page_bytes = per_tok * args.page_size + scale_bytes
+        fp_page_bytes = 2 * layers * kv_heads * head_dim * 2 * args.page_size
         # Per-chip share under --tp: pool leaves shard on kv-heads (or
         # head_dim) exactly like the dense cache, so the divisor matches
         # the KV-cache-per-chip line above.
@@ -330,13 +343,18 @@ def estimate_command(args) -> int:
         if args.tp > 1:
             div = (args.tp if kv_heads % args.tp == 0
                    else args.tp if head_dim % args.tp == 0 else 1)
-        print(f"\nPaged KV pool (page_size={args.page_size} tokens, bf16, "
-              f"2 x {layers} layers x {kv_heads} kv-heads x "
+        kv_label = ("int8 + per-page scales" if kv_int8 else "bf16")
+        print(f"\nPaged KV pool (page_size={args.page_size} tokens, "
+              f"{kv_label}, 2 x {layers} layers x {kv_heads} kv-heads x "
               f"{head_dim} head-dim):")
         print(f"  bytes per token : {_fmt(per_tok)}")
         print(f"  bytes per page  : {_fmt(page_bytes)}"
               + (f"  ({_fmt(page_bytes / div)}/chip at tp={args.tp})"
                  if args.tp > 1 else ""))
+        if kv_int8:
+            print(f"  vs full precision: {_fmt(fp_page_bytes)}/page -> "
+                  f"{fp_page_bytes / page_bytes:.2f}x more pages "
+                  "at equal pool bytes")
         if args.max_pages is not None:
             pool = args.max_pages * page_bytes
             print(f"  pool ({args.max_pages} pages): {_fmt(pool)}"
@@ -362,10 +380,12 @@ def estimate_command(args) -> int:
             # draft-speculating request covers twice the pages and the
             # admission/router math charges 2x.
             print("  draft KV pages : same pool, second page-table column "
-                  "-> 2x pages per request:")
+                  "-> 2x pages per request"
+                  + (f" ({kv_label} pages)" if kv_int8 else "") + ":")
             for s in args.seq_lens:
                 pages = 2 * -(-s // args.page_size)
                 print(f"    {s:>7} tokens: {pages:>6} pages"
+                      + (f" = {_fmt(pages * page_bytes)}" if kv_int8 else "")
                       + (f"  (pool fits {args.max_pages // pages} "
                          "concurrent)" if args.max_pages else ""))
             vocab = getattr(getattr(module, "config", None),
@@ -377,11 +397,13 @@ def estimate_command(args) -> int:
                       "/slot (bf16)")
             if args.draft_rank is not None:
                 # Rank proxy for a small draft: kv-heads x head-dim
-                # collapsed to --draft-rank per layer, k+v, bf16.
-                d_per_tok = 2 * layers * args.draft_rank * 2
-                d_page = d_per_tok * args.page_size
+                # collapsed to --draft-rank per layer, k+v. The draft
+                # pool quantizes alongside the base pool, scales and all.
+                d_per_tok = 2 * layers * args.draft_rank * itemsize
+                d_page = d_per_tok * args.page_size + scale_bytes
+                d_label = "int8" if kv_int8 else "bf16"
                 print(f"  draft KV (rank-{args.draft_rank} proxy, 2 x "
-                      f"{layers} layers x {args.draft_rank} x bf16): "
+                      f"{layers} layers x {args.draft_rank} x {d_label}): "
                       f"{_fmt(d_per_tok)}/token, {_fmt(d_page)}/page"
                       + (f", pool +{_fmt(args.max_pages * d_page)}"
                          if args.max_pages is not None else ""))
@@ -475,6 +497,18 @@ def estimate_command_parser(subparsers=None):
     parser.add_argument("--seq-lens", type=int, nargs="+",
                         default=[128, 512, 2048, 8192],
                         help="Sequence lengths for the pages-per-request table")
+    parser.add_argument("--kv-dtype", default=None, choices=["int8"],
+                        help="With --page-size: size the paged pool for "
+                             "quantized KV pages (int8 + one f32 scale per "
+                             "pool leaf per page) instead of bf16, and show "
+                             "the pages-at-equal-HBM gain; matches "
+                             "'serve --kv-dtype'")
+    parser.add_argument("--weights-dtype", default=None, choices=["int8"],
+                        help="Serving weight quantization to note alongside "
+                             "the dtype table (the int8 column is the "
+                             "per-channel quantized base; LoRA adapters "
+                             "stay full precision); matches "
+                             "'serve --weights-dtype'")
     parser.add_argument("--spec-tokens", type=int, default=None,
                         help="With --page-size: speculative-decoding "
                              "columns — draft KV pages (2x per request, "

@@ -83,7 +83,8 @@ def serve_command(args) -> int:
                            max_adapters=max_adapters)
 
     paging = dict(paged=(False if args.no_paged else None),
-                  page_size=args.page_size, max_pages=args.max_pages)
+                  page_size=args.page_size, max_pages=args.max_pages,
+                  kv_dtype=args.kv_dtype, weights_dtype=args.weights_dtype)
     spec = {}
     if args.draft_model:
         dmodel, dparams = _resolve_model(args.draft_model, args)
@@ -106,6 +107,8 @@ def serve_command(args) -> int:
           f"(slots={args.max_slots}, max_len={args.max_len}, "
           f"chunk={args.prefill_chunk}"
           + (f", tp={args.tp}" if args.tp > 1 else "")
+          + (f", kv={args.kv_dtype}" if args.kv_dtype else "")
+          + (f", weights={args.weights_dtype}" if args.weights_dtype else "")
           + (f", adapters={max_adapters - 1}" if max_adapters >= 2 else "")
           + (f", spec=draft K={args.spec_tokens}" if args.draft_model
              else "")
@@ -211,6 +214,17 @@ def serve_command_parser(subparsers=None):
                         help="Use the dense per-slot KV layout instead of "
                              "the paged pool (the pre-paging engine; also "
                              "the A/B baseline)")
+    parser.add_argument("--kv-dtype", default=None, choices=["int8"],
+                        help="Store KV pages quantized (per-page scales): "
+                             "~2x concurrent streams from the same pool "
+                             "bytes at bounded logprob divergence; omit for "
+                             "the bit-exact full-precision pool (paged "
+                             "engines only)")
+    parser.add_argument("--weights-dtype", default=None, choices=["int8"],
+                        help="Store base weights per-channel int8, "
+                             "dequantized on the fly (LoRA adapters stay "
+                             "full precision and exact); omit for "
+                             "full-precision weights")
     parser.add_argument("--eos-token-id", type=int, default=None)
     parser.add_argument("--default-max-new-tokens", type=int, default=32,
                         help="Used when a request omits max_new_tokens")

@@ -122,9 +122,9 @@ class TrafficProfile:
     long), clipped into ``[min, max]`` so the engine's ``max_len``
     budget is respected by construction. ``adapters`` is a weighted mix
     where ``None`` means the base model; ``priorities`` ride in the
-    request payload (ignored by today's gateway — they exist so the
-    harness already emits the traffic the SLO-control roadmap item will
-    schedule on).
+    request payload and the gateway carries them end to end into the
+    engine's per-priority metrics series (measurement only — the
+    baseline the SLO-control roadmap item will schedule on).
     """
 
     def __init__(self, *, prompt_len_median: int = 32,

@@ -305,6 +305,27 @@ class ServingEngine:
         per-request change would be a recompile). Greedy when
         ``do_sample=False``.
       cache_dtype: KV buffer dtype (default bfloat16, like offline).
+      kv_dtype: ``"int8"`` stores the paged KV pool quantized — each page
+        row is symmetric int8 with one per-page f32 scale held in a
+        ``pscale`` state array indexed by page id, written by the same
+        executables that write the page (quantize at the page scatter,
+        dequantize at the gather into the dense view). Pages cost half
+        the bytes, so the same HBM pool admits ~2x the concurrent
+        streams; alloc/free/alias/preempt stay pure host work because
+        scales live device-side keyed by page id. Requires the paged
+        engine. ``None`` (default) keeps the full-precision pool and
+        traces byte-identical programs to before this knob existed —
+        the bit-exact mode. Exactness under ``"int8"`` is
+        bounded-divergence instead: see ``logprob_drift`` in bench and
+        docs/usage_guides/serving.md.
+      weights_dtype: ``"int8"`` quantizes eligible BASE weight kernels
+        per-output-channel (:func:`~accelerate_tpu.adapters.
+        quantize_base_weights`); each program dequantizes at its top and
+        XLA fuses the ``convert * scale`` into the consuming dots, so
+        weights at rest stay int8. The LoRA low-rank path (AdapterBank,
+        identity row 0 included) stays full precision — multi-tenant
+        adapters apply exactly on the quantized base. ``None`` (default)
+        serves full-precision weights.
       max_queued: admission-queue bound (backpressure past it).
       prefill_chunk: width of the single fixed-shape prefill executable
         (clamped to ``max_len`` and the model's position table); a prompt
@@ -425,7 +446,8 @@ class ServingEngine:
                  max_len: int = 256, eos_token_id: Optional[int] = None,
                  do_sample: bool = False, temperature: float = 1.0,
                  top_k: Optional[int] = None, top_p: Optional[float] = None,
-                 cache_dtype=None, max_queued: int = 64,
+                 cache_dtype=None, kv_dtype: Optional[str] = None,
+                 weights_dtype: Optional[str] = None, max_queued: int = 64,
                  prefill_chunk: Optional[int] = 256,
                  prefill_chunks_per_tick: int = 1,
                  prefix_cache_mb: float = 64.0,
@@ -547,6 +569,25 @@ class ServingEngine:
                     "page_size=/max_pages= only apply to the paged engine "
                     "(paged=False keeps dense per-slot rows)")
             self._page = None
+
+        # -- quantized serving resolution --------------------------------
+        # kv int8 lives at PAGE granularity (one scale per page row), so it
+        # needs the paged pool; kv_dtype=None must trace byte-identical
+        # programs to the pre-quantization engine — every quant/dequant
+        # site below is gated on the scale arrays being present at all.
+        if kv_dtype not in (None, "int8"):
+            raise ValueError(
+                f"kv_dtype must be None or 'int8' (got {kv_dtype!r})")
+        if weights_dtype not in (None, "int8"):
+            raise ValueError(
+                f"weights_dtype must be None or 'int8' (got {weights_dtype!r})")
+        if kv_dtype is not None and not self._paged:
+            raise ValueError(
+                "kv_dtype='int8' requires the paged engine (per-page scales "
+                "live in page-id-indexed state); pass paged=True or drop "
+                "kv_dtype")
+        self._kv_dtype = kv_dtype
+        self._weights_dtype = weights_dtype
 
         # -- speculative-decoding resolution ------------------------------
         # Two drafting modes share one verify program shape: a DRAFT MODEL
@@ -681,16 +722,24 @@ class ServingEngine:
             self._pool = PagePool(usable)
             self._table = np.zeros((self.max_slots, self._pages_per_slot),
                                    np.int32)
+            quant = self._kv_dtype is not None
             pool_leaves, self._page_bytes = [], 0
             for sh, ax in zip(jax.tree.leaves(probe), self._cache_axes):
                 shape = list(sh.shape)
                 shape[ax] = self._page
                 # +1: page 0 is the reserved scratch page every clamped or
                 # inactive write routes to.
-                pool_leaves.append(
-                    jnp.zeros((usable + 1,) + tuple(shape), sh.dtype))
-                self._page_bytes += (int(np.prod(shape))
-                                     * np.dtype(sh.dtype).itemsize)
+                pool_leaves.append(jnp.zeros(
+                    (usable + 1,) + tuple(shape),
+                    jnp.int8 if quant else sh.dtype))
+                # Quantized pages charge 1 byte/element + 4 bytes for the
+                # per-page scale — _page_bytes feeds every byte-accounting
+                # path (pool metrics, alias-put nbytes, per-chip HBM), so
+                # all of them report quantized bytes automatically.
+                self._page_bytes += (
+                    int(np.prod(shape))
+                    * (1 if quant else np.dtype(sh.dtype).itemsize)
+                    + (4 if quant else 0))
             self._state = {
                 "pool": jax.tree.unflatten(self._cache_struct, pool_leaves),
                 "pos": jnp.zeros((self.max_slots,), jnp.int32),
@@ -698,6 +747,14 @@ class ServingEngine:
                 "rng": jnp.zeros((self.max_slots, 2), jnp.uint32),
                 "done": jnp.zeros((self.max_slots,), bool),
             }
+            if quant:
+                # Per-page dequant scales, one row per pool leaf, indexed
+                # by page id like the pool itself — device-resident, so a
+                # host page-table alias restore (table write + incref)
+                # reuses the page's scale with zero device work. Ones keep
+                # scratch-page gathers finite before any real write.
+                self._state["pscale"] = jnp.ones(
+                    (len(pool_leaves), usable + 1), jnp.float32)
             if self._spec_mode == "draft":
                 dshape = jax.eval_shape(lambda: self._draft_factory(
                     1, self.max_len + self._spec_k, self._dtype))
@@ -720,12 +777,18 @@ class ServingEngine:
                                   self._draft_cache_axes):
                     shape = list(sh.shape)
                     shape[ax] = self._page
-                    dpool_leaves.append(
-                        jnp.zeros((usable + 1,) + tuple(shape), sh.dtype))
-                    self._draft_page_bytes += (int(np.prod(shape))
-                                               * np.dtype(sh.dtype).itemsize)
+                    dpool_leaves.append(jnp.zeros(
+                        (usable + 1,) + tuple(shape),
+                        jnp.int8 if quant else sh.dtype))
+                    self._draft_page_bytes += (
+                        int(np.prod(shape))
+                        * (1 if quant else np.dtype(sh.dtype).itemsize)
+                        + (4 if quant else 0))
                 self._state["dpool"] = jax.tree.unflatten(
                     self._draft_cache_struct, dpool_leaves)
+                if quant:
+                    self._state["dpscale"] = jnp.ones(
+                        (len(dpool_leaves), usable + 1), jnp.float32)
                 self._dtable = np.zeros(
                     (self.max_slots, self._pages_per_slot), np.int32)
         else:
@@ -748,6 +811,17 @@ class ServingEngine:
         if adapters is not None:
             self._state["adapter_idx"] = jnp.zeros((self.max_slots,),
                                                    jnp.int32)
+
+        # Base-weight quantization happens ONCE here, before any program is
+        # staged: eligible kernels become QuantizedTensor pytree leaves
+        # (per-output-channel int8) and every compiled program dequantizes
+        # at its top via _dq — XLA fuses convert*scale into the consuming
+        # dots, so weights at rest in HBM stay integer. The LoRA bank is
+        # untouched: adapter deltas apply full precision on the dequantized
+        # base, keeping multi-tenant adapters exact.
+        if self._weights_dtype is not None:
+            from ..adapters.quantize import quantize_base_weights
+            self.params = params = quantize_base_weights(params)
 
         # CPU jit warns (and ignores) donation; donate only where it works.
         donate = () if jax.default_backend() == "cpu" else (1,)
@@ -808,7 +882,15 @@ class ServingEngine:
             # axis replicated-in-index like the slot axis); the page table,
             # masks, and per-call scalars stay replicated data.
             exec_ = self._exec
-            self._param_sh = exec_.param_shardings(params)
+            if self._weights_dtype is not None:
+                # Quantized leaves shard by their LOGICAL kernel shape: q
+                # takes the kernel's Megatron spec, the size-1 amax scale
+                # dim replicates. Same treedef as params, so place/jit
+                # accept it like any sharding pytree.
+                from ..adapters.quantize import shardings_for_quantized
+                self._param_sh = shardings_for_quantized(exec_, params)
+            else:
+                self._param_sh = exec_.param_shardings(params)
             self.params = params = exec_.place(params, self._param_sh)
             if self._paged:
                 tmpl = [jax.ShapeDtypeStruct(l.shape[1:], l.dtype)
@@ -1067,6 +1149,7 @@ class ServingEngine:
         Returns (state, first_token). One executable per 128-bucketed
         prompt length — the compile-family the chunked path replaces.
         """
+        params = self._dq(params)
         cache = self._factory(1, self.max_len, self._dtype)
         logits, cache = self.module.apply(
             {"params": params}, ids_p, cache=cache, cache_pos=0,
@@ -1107,6 +1190,7 @@ class ServingEngine:
         — no separate extract program, keeping the steady state at exactly
         one chunk-prefill executable. Returns (state, first_token, block).
         """
+        params = self._dq(params)
         C = ids_c.shape[1]
         cache = jax.tree.map(
             lambda full: jax.lax.dynamic_slice(
@@ -1172,6 +1256,7 @@ class ServingEngine:
         pos/tok/rng/done advance only where ``active`` is set, so
         non-running slots stay frozen and in-bounds. Returns
         (state, tokens [S], done [S])."""
+        params = self._dq(params)
 
         def one_slot(cache, tok, pos, rng, done, aidx=None):
             logits, cache = self.module.apply(
@@ -1201,7 +1286,32 @@ class ServingEngine:
         return state, toks, dones
 
     # -- paged programs -------------------------------------------------
-    def _gather_view(self, pool, pages, axes=None, struct=None):
+    def _dq(self, params):
+        """Dequantize int8 base weights at the top of a compiled program.
+
+        Identity when ``weights_dtype`` is None, so full-precision engines
+        trace byte-identical programs. XLA fuses the ``convert * scale``
+        into each consuming dot — weights at rest in HBM stay int8."""
+        if self._weights_dtype is None:
+            return params
+        from ..adapters.quantize import dequantize_params
+        return dequantize_params(params, self._dtype)
+
+    def _quant_page(self, pb):
+        """Quantize ONE page block to (int8 page, f32 scale scalar):
+        symmetric absmax over the whole page — one scale per page row is
+        the whole point, it rides the page id through host alias/free/
+        preempt bookkeeping with zero extra device work. The 1e-6 floor
+        keeps an all-zero page's dequant finite; round-trip is idempotent
+        (q*s re-quantizes to the same q), so external-cache restores that
+        re-quantize a dequantized block are stable."""
+        f = pb.astype(jnp.float32)
+        amax = jnp.max(jnp.abs(f))
+        s = jnp.maximum(amax, 1e-6) / 127.0
+        q = jnp.clip(jnp.round(f / s), -127, 127).astype(jnp.int8)
+        return q, s
+
+    def _gather_view(self, pool, pages, axes=None, struct=None, scales=None):
         """One slot's dense cache VIEW from the pool: gather its page rows
         (``pages`` [Np] i32 pool ids, 0 = scratch for unallocated entries)
         and merge the page axis into the length axis — each leaf becomes
@@ -1209,51 +1319,64 @@ class ServingEngine:
         forward expects. Scratch garbage sits at positions the attention
         mask (causal and/or sliding-window) already excludes. ``axes`` /
         ``struct`` default to the TARGET cache geometry; speculative
-        engines pass the draft pool's."""
+        engines pass the draft pool's. ``scales`` (the pool's per-page
+        scale array, rows aligned with the pool leaves) dequantizes int8
+        page rows in the same gather — None on fp engines."""
         axes = self._cache_axes if axes is None else axes
         struct = self._cache_struct if struct is None else struct
         leaves = []
-        for l, ax in zip(jax.tree.leaves(pool), axes):
-            g = jnp.moveaxis(l[pages], 0, ax)
+        for i, (l, ax) in enumerate(zip(jax.tree.leaves(pool), axes)):
+            rows = l[pages]
+            if scales is not None:
+                s = scales[i][pages].reshape((-1,) + (1,) * (rows.ndim - 1))
+                rows = (rows.astype(jnp.float32) * s).astype(self._dtype)
+            g = jnp.moveaxis(rows, 0, ax)
             shape = (list(g.shape[:ax]) + [g.shape[ax] * g.shape[ax + 1]]
                      + list(g.shape[ax + 2:]))
             leaves.append(g.reshape(shape))
         return jax.tree.unflatten(struct, leaves)
 
     def _scatter_page(self, pool_leaves, view_leaves, src_page, tgt,
-                      axes=None):
+                      axes=None, scales=None):
         """Write view page ``src_page`` back into pool page ``tgt`` (both
         traced i32). ``tgt = 0`` discards into scratch; an out-of-range
         ``src_page`` clamps to the view's last page (jax dynamic_slice
         semantics), which callers pair with a scratch target — the two
         clamps together are what let a FIXED number of scatter steps cover
-        a variable number of genuinely-written pages."""
+        a variable number of genuinely-written pages. With ``scales``
+        the fp page block quantizes to int8 on the way in and its scale
+        lands at ``scales[leaf, tgt]`` (scratch writes overwrite row 0,
+        harmlessly). Returns ``(pool_leaves, scales)``."""
         axes = self._cache_axes if axes is None else axes
         out = []
-        for pl, vl, ax in zip(pool_leaves, view_leaves, axes):
+        for i, (pl, vl, ax) in enumerate(zip(pool_leaves, view_leaves, axes)):
             start = [0] * vl.ndim
             start[ax] = src_page * self._page
             sizes = list(vl.shape)
             sizes[ax] = self._page
             pb = jax.lax.dynamic_slice(vl, tuple(start), tuple(sizes))
+            if scales is not None:
+                pb, s = self._quant_page(pb)
+                scales = jax.lax.dynamic_update_slice(
+                    scales, s.reshape(1, 1), (i, tgt))
             out.append(jax.lax.dynamic_update_slice(
                 pl, pb[None].astype(pl.dtype), (tgt,) + (0,) * pb.ndim))
-        return out
+        return out, scales
 
     def _scatter_chunk_pages(self, pool_leaves, view_leaves, axes, pages,
-                             offset, C):
+                             offset, C, scales=None):
         """Scatter a chunk's writes (positions ``[offset, offset + C)``)
         back into the pool: at most ``C/P + 1`` pages (the pulled-back
         final chunk may start mid-page); the possibly-untouched trailing
-        step routes to scratch."""
+        step routes to scratch. Returns ``(pool_leaves, scales)``."""
         p0 = offset // self._page
         for pg in range(C // self._page + 1):
             tid = jax.lax.dynamic_slice(pages, (p0 + pg,), (1,))[0]
             touched = (p0 + pg) * self._page < offset + C
-            pool_leaves = self._scatter_page(
+            pool_leaves, scales = self._scatter_page(
                 pool_leaves, view_leaves, p0 + pg,
-                jnp.where(touched, tid, 0), axes)
-        return pool_leaves
+                jnp.where(touched, tid, 0), axes, scales)
+        return pool_leaves, scales
 
     def _paged_prefill_chunk_fn(self, params, state, ids_c, slot, pages,
                                 offset, true_len, rng, *extra):
@@ -1276,8 +1399,13 @@ class ServingEngine:
         dparams = dpages = None
         if self._spec_mode == "draft":
             dparams, dpages = extra
+        params = self._dq(params)
         C = ids_c.shape[1]
-        view = self._gather_view(state["pool"], pages)
+        # Per-page scale arrays ride the state dict only on int8 engines —
+        # state.get() is None otherwise and every quant/dequant site below
+        # vanishes, leaving the fp program byte-identical.
+        scales = state.get("pscale")
+        view = self._gather_view(state["pool"], pages, scales=scales)
         logits, view = self.module.apply(
             {"params": params}, ids_c, cache=view, cache_pos=offset,
             **self._lora_kwargs(bank, aidx))
@@ -1285,13 +1413,16 @@ class ServingEngine:
             logits, rng, self._select, self.eos_token_id, ids_c.dtype,
             true_len, offset)
         view_leaves = jax.tree.leaves(view)
+        # The block is sliced from the DEQUANTIZED view — full precision,
+        # so external prefix caches stay layout-compatible across engines
+        # (restore re-quantizes; the round-trip is idempotent).
         block = jax.tree.unflatten(
             self._cache_struct,
             [jax.lax.dynamic_slice_in_dim(l, offset, C, axis=ax)
              for l, ax in zip(view_leaves, self._cache_axes)])
-        pool_leaves = self._scatter_chunk_pages(
+        pool_leaves, scales = self._scatter_chunk_pages(
             jax.tree.leaves(state["pool"]), view_leaves, self._cache_axes,
-            pages, offset, C)
+            pages, offset, C, scales)
         new_state = dict(
             state,
             pool=jax.tree.unflatten(self._cache_struct, pool_leaves),
@@ -1300,21 +1431,26 @@ class ServingEngine:
             rng=state["rng"].at[slot].set(rng_carry),
             done=state["done"].at[slot].set(done[0]),
         )
+        if scales is not None:
+            new_state["pscale"] = scales
         if bank is not None:
             new_state["adapter_idx"] = state["adapter_idx"].at[slot].set(aidx)
         if dparams is not None:
             # The draft stays base-weight even under an adapter bank: its
             # proposals only steer acceptance, never the emitted law.
+            dscales = state.get("dpscale")
             dview = self._gather_view(state["dpool"], dpages,
                                       self._draft_cache_axes,
-                                      self._draft_cache_struct)
+                                      self._draft_cache_struct, dscales)
             _, dview = self._draft_module.apply(
                 {"params": dparams}, ids_c, cache=dview, cache_pos=offset)
+            dpool_leaves, dscales = self._scatter_chunk_pages(
+                jax.tree.leaves(state["dpool"]), jax.tree.leaves(dview),
+                self._draft_cache_axes, dpages, offset, C, dscales)
             new_state["dpool"] = jax.tree.unflatten(
-                self._draft_cache_struct,
-                self._scatter_chunk_pages(
-                    jax.tree.leaves(state["dpool"]), jax.tree.leaves(dview),
-                    self._draft_cache_axes, dpages, offset, C))
+                self._draft_cache_struct, dpool_leaves)
+            if dscales is not None:
+                new_state["dpscale"] = dscales
         return new_state, tok[0], block
 
     def _draft_chunk_fn(self, dparams, state, ids_c, slot, dpages, offset):
@@ -1326,18 +1462,20 @@ class ServingEngine:
         draft-mode speculative engines with a prefix cache attached."""
         del slot  # symmetry with the fused chunk program's signature
         C = ids_c.shape[1]
+        dscales = state.get("dpscale")
         dview = self._gather_view(state["dpool"], dpages,
                                   self._draft_cache_axes,
-                                  self._draft_cache_struct)
+                                  self._draft_cache_struct, dscales)
         _, dview = self._draft_module.apply(
             {"params": dparams}, ids_c, cache=dview, cache_pos=offset)
-        return dict(
-            state,
-            dpool=jax.tree.unflatten(
-                self._draft_cache_struct,
-                self._scatter_chunk_pages(
-                    jax.tree.leaves(state["dpool"]), jax.tree.leaves(dview),
-                    self._draft_cache_axes, dpages, offset, C)))
+        dpool_leaves, dscales = self._scatter_chunk_pages(
+            jax.tree.leaves(state["dpool"]), jax.tree.leaves(dview),
+            self._draft_cache_axes, dpages, offset, C, dscales)
+        out = dict(state, dpool=jax.tree.unflatten(
+            self._draft_cache_struct, dpool_leaves))
+        if dscales is not None:
+            out["dpscale"] = dscales
+        return out
 
     def _paged_restore_prefix_fn(self, state, block, pages_c, slot, true_len):
         """Copy-restore for paged engines with an EXTERNAL (fleet-shared)
@@ -1347,34 +1485,54 @@ class ServingEngine:
         ``pos[slot] = true_len`` like every restore. The engine's PRIVATE
         cache never calls this — it restores by host table aliasing."""
         pool_leaves = jax.tree.leaves(state["pool"])
+        scales = state.get("pscale")
         out = []
-        for pl, blk, ax in zip(pool_leaves, jax.tree.leaves(block),
-                               self._cache_axes):
+        for i, (pl, blk, ax) in enumerate(zip(pool_leaves,
+                                              jax.tree.leaves(block),
+                                              self._cache_axes)):
             Cp = blk.shape[ax] // self._page
             shape = list(blk.shape)
             shape[ax:ax + 1] = [Cp, self._page]
             pages_blk = jnp.moveaxis(blk.reshape(shape), ax, 0)
             for j in range(Cp):
+                pb = pages_blk[j]
+                if scales is not None:
+                    # Cached blocks are fp; re-quantize on restore (the
+                    # round-trip is idempotent, so restored pages dequant
+                    # to the same values the producing engine attended).
+                    pb, s = self._quant_page(pb)
+                    scales = jax.lax.dynamic_update_slice(
+                        scales, s.reshape(1, 1), (i, pages_c[j]))
                 pl = jax.lax.dynamic_update_slice(
-                    pl, pages_blk[j][None].astype(pl.dtype),
-                    (pages_c[j],) + (0,) * pages_blk[j].ndim)
+                    pl, pb[None].astype(pl.dtype),
+                    (pages_c[j],) + (0,) * pb.ndim)
             out.append(pl)
-        return dict(
+        new_state = dict(
             state,
             pool=jax.tree.unflatten(self._cache_struct, out),
             pos=state["pos"].at[slot].set(true_len),
         )
+        if scales is not None:
+            new_state["pscale"] = scales
+        return new_state
 
-    def _gather_views_all_slots(self, pool, table, axes=None, struct=None):
+    def _gather_views_all_slots(self, pool, table, axes=None, struct=None,
+                                scales=None):
         """Batched :meth:`_gather_view`: ``table`` [S, Np] → per-leaf
         ``[S, 1, Np*P, ...]`` dense views, slot axis leading so the decode
         vmap runs over it unchanged. ``axes``/``struct`` default to the
-        target cache geometry (the draft pool passes its own)."""
+        target cache geometry (the draft pool passes its own); ``scales``
+        dequantizes int8 page rows in the same gather."""
         axes = self._cache_axes if axes is None else axes
         struct = self._cache_struct if struct is None else struct
         leaves = []
-        for l, ax in zip(jax.tree.leaves(pool), axes):
-            g = jnp.moveaxis(l[table], 1, ax + 1)
+        for i, (l, ax) in enumerate(zip(jax.tree.leaves(pool), axes)):
+            rows = l[table]
+            if scales is not None:
+                s = scales[i][table].reshape(
+                    table.shape + (1,) * (rows.ndim - 2))
+                rows = (rows.astype(jnp.float32) * s).astype(self._dtype)
+            g = jnp.moveaxis(rows, 1, ax + 1)
             shape = (list(g.shape[:ax + 1])
                      + [g.shape[ax + 1] * g.shape[ax + 2]]
                      + list(g.shape[ax + 3:]))
@@ -1382,12 +1540,13 @@ class ServingEngine:
         return jax.tree.unflatten(struct, leaves)
 
     def _scatter_slot_pages(self, pool_leaves, nv_leaves, axes, table,
-                            active, pos, last_off, steps):
+                            active, pos, last_off, steps, scales=None):
         """Scatter every slot's speculative writes back into the pool: the
         pages covering positions ``pos[s] .. pos[s] + last_off``, in a
         FIXED ``steps`` scatter steps per slot. Steps past the touched
         range, and every step of an inactive slot, route to scratch (page
-        0) — the same clamp pairing as :meth:`_scatter_page`."""
+        0) — the same clamp pairing as :meth:`_scatter_page`. Returns
+        ``(pool_leaves, scales)``."""
         P = self._page
         for s in range(self.max_slots):
             p0 = pos[s] // P
@@ -1396,7 +1555,8 @@ class ServingEngine:
                 touched = (p0 + pg) * P <= pos[s] + last_off
                 tgt = jnp.where(active[s] & touched, tid, 0)
                 new_pool = []
-                for pl, vl, ax in zip(pool_leaves, nv_leaves, axes):
+                for i, (pl, vl, ax) in enumerate(zip(pool_leaves, nv_leaves,
+                                                     axes)):
                     start = [0] * vl.ndim
                     start[0] = s
                     start[ax + 1] = (p0 + pg) * P
@@ -1405,11 +1565,15 @@ class ServingEngine:
                     sizes[ax + 1] = P
                     pb = jax.lax.dynamic_slice(vl, tuple(start),
                                                tuple(sizes))[0]
+                    if scales is not None:
+                        pb, sc = self._quant_page(pb)
+                        scales = jax.lax.dynamic_update_slice(
+                            scales, sc.reshape(1, 1), (i, tgt))
                     new_pool.append(jax.lax.dynamic_update_slice(
                         pl, pb[None].astype(pl.dtype),
                         (tgt,) + (0,) * pb.ndim))
                 pool_leaves = new_pool
-        return pool_leaves
+        return pool_leaves, scales
 
     def _paged_decode_fn(self, params, state, active, table, bank=None):
         """Paged twin of :meth:`_decode_fn`: gather every slot's view, run
@@ -1422,7 +1586,10 @@ class ServingEngine:
         safety. The host guarantees an active slot's ``pos`` page is
         allocated before every tick."""
         P = self._page
-        views = self._gather_views_all_slots(state["pool"], table)
+        params = self._dq(params)
+        scales = state.get("pscale")
+        views = self._gather_views_all_slots(state["pool"], table,
+                                             scales=scales)
 
         def one_slot(cache, tok, pos, rng, done, aidx=None):
             logits, cache = self.module.apply(
@@ -1446,7 +1613,8 @@ class ServingEngine:
             tid = jax.lax.dynamic_slice(table[s], (pg,), (1,))[0]
             tgt = jnp.where(active[s], tid, 0)
             new_pool = []
-            for pl, vl, ax in zip(pool_leaves, nv_leaves, self._cache_axes):
+            for i, (pl, vl, ax) in enumerate(zip(pool_leaves, nv_leaves,
+                                                 self._cache_axes)):
                 start = [0] * vl.ndim
                 start[0] = s
                 start[ax + 1] = pg * P
@@ -1454,6 +1622,10 @@ class ServingEngine:
                 sizes[0] = 1
                 sizes[ax + 1] = P
                 pb = jax.lax.dynamic_slice(vl, tuple(start), tuple(sizes))[0]
+                if scales is not None:
+                    pb, sc = self._quant_page(pb)
+                    scales = jax.lax.dynamic_update_slice(
+                        scales, sc.reshape(1, 1), (i, tgt))
                 new_pool.append(jax.lax.dynamic_update_slice(
                     pl, pb[None].astype(pl.dtype), (tgt,) + (0,) * pb.ndim))
             pool_leaves = new_pool
@@ -1465,6 +1637,8 @@ class ServingEngine:
             rng=jnp.where(active[:, None], rngs, state["rng"]),
             done=jnp.where(active, dones, state["done"]),
         )
+        if scales is not None:
+            state["pscale"] = scales
         return state, toks, dones
 
     def _spec_accept(self, logits, drafts, done, rem, rng):
@@ -1515,10 +1689,14 @@ class ServingEngine:
         — the same overwrite-before-attend argument the chunked prefill
         pad relies on. Returns ``(state, emitted [S, K+1], n [S])``."""
         P, K = self._page, self._spec_k
-        views = self._gather_views_all_slots(state["pool"], table)
+        params = self._dq(params)
+        scales = state.get("pscale")
+        dscales = state.get("dpscale")
+        views = self._gather_views_all_slots(state["pool"], table,
+                                             scales=scales)
         dviews = self._gather_views_all_slots(
             state["dpool"], dtable, self._draft_cache_axes,
-            self._draft_cache_struct)
+            self._draft_cache_struct, dscales)
 
         def one_slot(view, dview, tok, pos, done, rem, rng, aidx=None):
             def dstep(carry, _):
@@ -1552,13 +1730,14 @@ class ServingEngine:
         # Pages past the slot's allocated frontier (table entry 0, or an
         # untouched trailing step) land in scratch; their positions are
         # rewritten by the next verify before anything attends them.
-        pool_leaves = self._scatter_slot_pages(
+        pool_leaves, scales = self._scatter_slot_pages(
             jax.tree.leaves(state["pool"]), jax.tree.leaves(new_views),
-            self._cache_axes, table, active, state["pos"], K, K // P + 2)
-        dpool_leaves = self._scatter_slot_pages(
+            self._cache_axes, table, active, state["pos"], K, K // P + 2,
+            scales)
+        dpool_leaves, dscales = self._scatter_slot_pages(
             jax.tree.leaves(state["dpool"]), jax.tree.leaves(new_dviews),
             self._draft_cache_axes, dtable, active, state["pos"], K - 1,
-            (K - 1) // P + 2)
+            (K - 1) // P + 2, dscales)
         state = dict(
             state,
             pool=jax.tree.unflatten(self._cache_struct, pool_leaves),
@@ -1568,6 +1747,10 @@ class ServingEngine:
             rng=jnp.where(active[:, None], rngs, state["rng"]),
             done=jnp.where(active, dones, state["done"]),
         )
+        if scales is not None:
+            state["pscale"] = scales
+        if dscales is not None:
+            state["dpscale"] = dscales
         return state, emit, ns
 
     def _spec_lookup_fn(self, params, state, active, table, remaining,
@@ -1580,7 +1763,10 @@ class ServingEngine:
         correctness never depends on proposal quality. Returns
         ``(state, emitted [S, K+1], n [S])`` like :meth:`_spec_fn`."""
         P, K = self._page, self._spec_k
-        views = self._gather_views_all_slots(state["pool"], table)
+        params = self._dq(params)
+        scales = state.get("pscale")
+        views = self._gather_views_all_slots(state["pool"], table,
+                                             scales=scales)
 
         def one_slot(view, tok, pos, done, rem, rng, drafts, aidx=None):
             drafts = drafts.astype(tok.dtype)
@@ -1598,9 +1784,10 @@ class ServingEngine:
             vmap_args.append(state["adapter_idx"])
         new_views, toks, ns, emit, dones, rngs = jax.vmap(one_slot)(
             *vmap_args)
-        pool_leaves = self._scatter_slot_pages(
+        pool_leaves, scales = self._scatter_slot_pages(
             jax.tree.leaves(state["pool"]), jax.tree.leaves(new_views),
-            self._cache_axes, table, active, state["pos"], K, K // P + 2)
+            self._cache_axes, table, active, state["pos"], K, K // P + 2,
+            scales)
         state = dict(
             state,
             pool=jax.tree.unflatten(self._cache_struct, pool_leaves),
@@ -1609,6 +1796,8 @@ class ServingEngine:
             rng=jnp.where(active[:, None], rngs, state["rng"]),
             done=jnp.where(active, dones, state["done"]),
         )
+        if scales is not None:
+            state["pscale"] = scales
         return state, emit, ns
 
     # ------------------------------------------------------------------
@@ -1883,7 +2072,8 @@ class ServingEngine:
                max_new_tokens: int = 20, seed: Optional[int] = None,
                rng=None, timeout: Optional[float] = None, on_token=None,
                ignore_eos: bool = False, adapter: Optional[str] = None,
-               trace_id: Optional[str] = None, block: bool = False,
+               trace_id: Optional[str] = None,
+               priority: Optional[str] = None, block: bool = False,
                block_timeout: Optional[float] = None) -> Request:
         """Enqueue one request; returns its :class:`Request` handle
         immediately. Raises :class:`scheduler.QueueFull` under backpressure
@@ -1897,7 +2087,8 @@ class ServingEngine:
             request = Request(prompt_ids, max_new_tokens=max_new_tokens,
                               rng=rng, seed=seed, timeout=timeout,
                               on_token=on_token, ignore_eos=ignore_eos,
-                              adapter=adapter, trace_id=trace_id)
+                              adapter=adapter, trace_id=trace_id,
+                              priority=priority)
         elif (request.status is not RequestStatus.QUEUED
                 or request.submitted_at is not None):
             raise ValueError(
@@ -1968,9 +2159,12 @@ class ServingEngine:
                 "serving engine is not accepting requests "
                 "(not started, shutting down, or preempted)") from e
         self._stats.record_submit(len(self._queue))
-        self._tracer.instant(
-            "submit", trace_id=request.trace_id,
-            args={"prompt_len": S, "queue_depth": len(self._queue)})
+        if request.priority is not None:
+            self._stats.record_priority_request(request.priority)
+        args = {"prompt_len": S, "queue_depth": len(self._queue)}
+        if request.priority is not None:
+            args["priority"] = request.priority
+        self._tracer.instant("submit", trace_id=request.trace_id, args=args)
         return request
 
     def serving_metrics(self) -> dict:
@@ -2057,25 +2251,44 @@ class ServingEngine:
         """Whether ``name`` currently occupies a bank row (router affinity)."""
         return self._adapters is not None and self._adapters.resident(name)
 
+    @property
+    def kv_dtype(self) -> Optional[str]:
+        """``"int8"`` when KV pages are stored quantized; None = the
+        bit-exact full-precision pool."""
+        return self._kv_dtype
+
+    @property
+    def weights_dtype(self) -> Optional[str]:
+        """``"int8"`` when base weights are stored quantized (LoRA path
+        full precision); None = full-precision weights."""
+        return self._weights_dtype
+
     def kv_cache_per_chip_bytes(self) -> int:
         """Per-device byte footprint of the decode KV state (max shard per
         leaf): the HBM-planning number, ≈ ``1/tp`` of the single-chip
         figure for heads-sharded leaves (docs/performance.md). For a
         paged engine this is the page POOL — the number ``max_pages``
-        controls directly, independent of ``max_slots``."""
+        controls directly, independent of ``max_slots`` — plus the
+        per-page scale arrays on a quantized engine (they're replicated,
+        so they count at full size per chip)."""
         tree = (self._state["pool"] if self._paged
                 else self._state["cache"])
+        extra = sum(self._state[k].nbytes for k in ("pscale", "dpscale")
+                    if k in self._state)
         if self._exec is not None:
-            return self._exec.per_chip_bytes(tree)
-        return sum(l.nbytes for l in jax.tree.leaves(tree))
+            return self._exec.per_chip_bytes(tree) + extra
+        return sum(l.nbytes for l in jax.tree.leaves(tree)) + extra
 
     def page_pool_metrics(self) -> dict:
         """Host-side pool snapshot (empty for dense engines): page size,
-        totals, occupancy, allocation and preemption counters."""
+        totals, occupancy, allocation and preemption counters. On a
+        quantized engine ``page_bytes`` is already the int8 figure
+        (1 byte/element + 4-byte scale per leaf)."""
         if not self._paged:
             return {}
         out = {
             "page_size": self._page,
+            "kv_dtype": self._kv_dtype,
             "pages_per_slot": self._pages_per_slot,
             "page_bytes": self._page_bytes,
             "pages_total": self._pool.num_pages,
@@ -2639,10 +2852,15 @@ class ServingEngine:
         equal chunk contents. The chain is seeded with the request's
         adapter identity: a LoRA adapter changes the KV a prefix produces,
         so two tenants with byte-identical prompts must never share cached
-        blocks (cross-tenant KV leak)."""
+        blocks (cross-tenant KV leak) — and, the same way, with the KV
+        dtype: an int8 engine's pages carry quantization error a
+        full-precision engine must never alias (and aliased int8 pages
+        need the producing pool's scales, which an fp entry lacks)."""
         flat = np.ascontiguousarray(prompt_ids[0], np.int32)
         C = self._chunk
         seed = b"chunk:%d" % C
+        if self._kv_dtype is not None:
+            seed += b"/kv:" + self._kv_dtype.encode("utf-8")
         if adapter is not None:
             seed += b"/adapter:" + adapter.encode("utf-8")
         keys, prev = [], seed
@@ -3132,11 +3350,15 @@ class ServingEngine:
             self._adapters.release(req.adapter)
         if req.adapter is not None:
             self._stats.record_adapter_tokens(req.adapter, len(req.tokens))
+        if req.priority is not None:
+            self._stats.record_priority_tokens(req.priority, len(req.tokens))
         self._finish_req(req, status, error)
         self._stats.record_finish(req.status)
+        retire_args = {"status": req.status.value, "tokens": len(req.tokens)}
+        if req.priority is not None:
+            retire_args["priority"] = req.priority
         self._tracer.instant("retire", trace_id=req.trace_id,
-                             args={"status": req.status.value,
-                                   "tokens": len(req.tokens)})
+                             args=retire_args)
         if req.status is RequestStatus.FAILED and error is not self._error:
             # Engine-fatal retirements are already covered by the single
             # "fatal" event; request-level failures get their own.

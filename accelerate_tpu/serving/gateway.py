@@ -295,6 +295,12 @@ def parse_completion(body: dict, cfg: GatewayConfig) -> dict:
                                 or not adapter):
         raise _BadRequest('"adapter" must be a non-empty string '
                           "(a registered LoRA adapter name) or omitted")
+    priority = body.get("priority")
+    if priority is not None and (not isinstance(priority, str)
+                                 or not priority):
+        raise _BadRequest('"priority" must be a non-empty string '
+                          '(a traffic class like "interactive"/"batch") '
+                          "or omitted")
     return {
         "prompt_ids": ids,
         "max_new_tokens": max_new,
@@ -302,6 +308,7 @@ def parse_completion(body: dict, cfg: GatewayConfig) -> dict:
         "timeout": None if timeout is None else float(timeout),
         "ignore_eos": bool(body.get("ignore_eos", False)),
         "adapter": adapter,
+        "priority": priority,
         "stream": bool(body.get("stream", False)),
     }
 
@@ -532,6 +539,7 @@ class ServingGateway:
                 seed=spec["seed"], timeout=spec["timeout"],
                 ignore_eos=spec["ignore_eos"],
                 adapter=spec["adapter"],
+                priority=spec.get("priority"),
                 trace_id=trace_id,
                 on_token=on_token)
         except QueueFull:
@@ -567,7 +575,7 @@ class ServingGateway:
 
         merged = self.replica_set.merged_stats()
         for k, v in self.replica_set.fleet_metrics().items():
-            if k.startswith("adapter/"):
+            if k.startswith(("adapter/", "priority/")):
                 continue  # re-emitted below as properly labeled series
             emit(f"accelerate_tpu_serving_{k}", v)
         # Latency distributions: the *_ms summary gauges above keep their
@@ -597,6 +605,20 @@ class ServingGateway:
                     lines.append(
                         f'accelerate_tpu_serving_adapter_{c}'
                         f'{{adapter="{name}"}} {per_adapter[name][c]}')
+        per_priority = merged.per_priority()
+        if per_priority:
+            counters = sorted(next(iter(per_priority.values())))
+            for c in counters:
+                lines.append(
+                    f"# HELP accelerate_tpu_serving_priority_{c} "
+                    f"Per-priority (traffic class) {c} across the fleet — "
+                    "measurement only, scheduling does not consult it.")
+                lines.append(
+                    f"# TYPE accelerate_tpu_serving_priority_{c} counter")
+                for name in sorted(per_priority):
+                    lines.append(
+                        f'accelerate_tpu_serving_priority_{c}'
+                        f'{{priority="{name}"}} {per_priority[name][c]}')
         if self.compile_watcher is not None:
             cs = self.compile_watcher.summary()
             emit("accelerate_tpu_xla_compile_events_total",

@@ -227,7 +227,13 @@ class SliceExec:
         zero collectives — only the wide target verify pays (and benefits
         from) the tp sharding. This is the GSPMD composition the
         speculative ``_spec`` program relies on: replicated draft feeding
-        a tp-sharded verify needs no new communication machinery."""
+        a tp-sharded verify needs no new communication machinery.
+
+        A QUANTIZED engine's per-page scale arrays (``pscale``/``dpscale``,
+        ``[n_leaves, num_pages+1]`` f32) also fall through to the
+        replicated bucket: one scalar per page is tiny, and replicating it
+        lets the heads-sharded int8 page rows dequantize chip-locally —
+        no code here needs to know the pool is quantized at all."""
         import jax
 
         kv_key = "pool" if "pool" in state else "cache"

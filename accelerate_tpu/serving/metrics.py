@@ -165,6 +165,13 @@ class ServingStats:
             # Per-adapter (multi-tenant LoRA) counters:
             # name -> {requests, tokens, hits, misses, loads, evictions}.
             self._adapter: dict = {}
+            # Per-priority traffic classes (measurement only — scheduling
+            # never consults these): name -> {requests, tokens}.
+            self._priority: dict = {}
+            # Quantized serving: running max of the sampled per-tick
+            # |Δlogprob| vs a full-precision reference (bench/tests feed
+            # it; 0.0 = never sampled or bit-exact engine).
+            self._logprob_drift = 0.0
             # Prometheus-shaped phase-latency histograms (fixed shared
             # buckets; itl_ms observes each decode tick's wall time).
             self._hists = {name: LatencyHistogram()
@@ -333,6 +340,37 @@ class ServingStats:
         with self._lock:
             return {name: dict(entry) for name, entry in self._adapter.items()}
 
+    def _priority_entry(self, name: str) -> dict:
+        # call with self._lock held
+        entry = self._priority.get(name)
+        if entry is None:
+            entry = {"requests": 0, "tokens": 0}
+            self._priority[name] = entry
+        return entry
+
+    def record_priority_request(self, name: str):
+        """One request submitted under a client-declared traffic class."""
+        with self._lock:
+            self._priority_entry(name)["requests"] += 1
+
+    def record_priority_tokens(self, name: str, tokens: int):
+        """Tokens emitted by one retiring prioritized request."""
+        with self._lock:
+            self._priority_entry(name)["tokens"] += int(tokens)
+
+    def per_priority(self) -> dict:
+        """``name -> {requests, tokens}`` snapshot — the gateway's labeled
+        per-priority Prometheus series (measurement only)."""
+        with self._lock:
+            return {name: dict(entry)
+                    for name, entry in self._priority.items()}
+
+    def record_logprob_drift(self, value: float):
+        """Observe one sampled per-tick max |Δlogprob| vs the fp reference
+        (quantized engines; the gauge keeps the running max)."""
+        with self._lock:
+            self._logprob_drift = max(self._logprob_drift, float(value))
+
     def record_finish(self, status):
         """One request retired; ``status`` is a RequestStatus."""
         from .request import RequestStatus
@@ -361,6 +399,8 @@ class ServingStats:
             o = dict(other.__dict__)
             o_samples = list(other._ttft_samples)
             o_adapter = {name: dict(e) for name, e in other._adapter.items()}
+            o_priority = {name: dict(e)
+                          for name, e in other._priority.items()}
             o_hists = {name: h.copy() for name, h in other._hists.items()}
         with self._lock:
             for name, hist in o_hists.items():
@@ -371,6 +411,10 @@ class ServingStats:
                     mine.merge(hist)
             for name, entry in o_adapter.items():
                 mine = self._adapter_entry(name)
+                for k, v in entry.items():
+                    mine[k] += v
+            for name, entry in o_priority.items():
+                mine = self._priority_entry(name)
                 for k, v in entry.items():
                     mine[k] += v
             for k in ("_submitted", "_admitted", "_completed", "_failed",
@@ -391,7 +435,8 @@ class ServingStats:
                       "_host_us_ticks", "_emission_stalls"):
                 setattr(self, k, getattr(self, k) + o[k])
             for k in ("_queue_wait_ms_max", "_ttft_ms_max",
-                      "_prefill_backlog_max", "_host_us_max"):
+                      "_prefill_backlog_max", "_host_us_max",
+                      "_logprob_drift"):
                 setattr(self, k, max(getattr(self, k), o[k]))
             self._ttft_samples.extend(o_samples)
             if len(self._ttft_samples) > self.MAX_TTFT_SAMPLES:
@@ -494,6 +539,10 @@ class ServingStats:
                     if self._host_us_ticks else 0.0,
                 "host_us_per_tick_max": round(self._host_us_max, 3),
                 "emission_stalls": self._emission_stalls,
+                # Quantized serving: sampled bounded-divergence gauge
+                # (running max |Δlogprob| vs fp reference; 0.0 when the
+                # engine is bit-exact or never sampled).
+                "logprob_drift": round(self._logprob_drift, 6),
             }
             # Multi-tenant LoRA: flat aggregates plus per-name counters
             # ("adapter/<name>/<counter>" — slash-pathed like tracker keys;
@@ -514,6 +563,11 @@ class ServingStats:
             for name in sorted(self._adapter):
                 for k, v in self._adapter[name].items():
                     out[f"adapter/{name}/{k}"] = v
+            # Traffic classes ("priority/<name>/<counter>", same slash
+            # pathing) — measurement-only series for the SLO baseline.
+            for name in sorted(self._priority):
+                for k, v in self._priority[name].items():
+                    out[f"priority/{name}/{k}"] = v
             return out
 
 

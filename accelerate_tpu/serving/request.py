@@ -49,7 +49,8 @@ class Request:
                  on_token: Optional[Callable[[int], None]] = None,
                  ignore_eos: bool = False,
                  adapter: Optional[str] = None,
-                 trace_id: Optional[str] = None):
+                 trace_id: Optional[str] = None,
+                 priority: Optional[str] = None):
         ids = np.asarray(prompt_ids, np.int32)
         if ids.ndim == 1:
             ids = ids[None, :]
@@ -79,6 +80,15 @@ class Request:
         #: correlation id carried through every lifecycle edge (gateway-minted
         #: or client-supplied); engine spans and the SSE done-summary tag it.
         self.trace_id = trace_id
+        if priority is not None and (not isinstance(priority, str)
+                                     or not priority):
+            raise ValueError(
+                f"priority must be a non-empty string or None (got {priority!r})")
+        #: client-declared traffic class (e.g. ``"interactive"``/``"batch"``).
+        #: MEASUREMENT ONLY today: it labels tracer spans and per-priority
+        #: metrics series so the SLO-control work starts with a baseline —
+        #: scheduling does not consult it.
+        self.priority = priority
 
         self.tokens: list[int] = []        # committed tokens, streamed order
         self.status = RequestStatus.QUEUED

@@ -100,11 +100,13 @@ class FleetRequest:
                  on_token: Optional[Callable[[int], None]] = None,
                  ignore_eos: bool = False,
                  adapter: Optional[str] = None,
-                 trace_id: Optional[str] = None):
+                 trace_id: Optional[str] = None,
+                 priority: Optional[str] = None):
         # Reuse Request's prompt validation (shape + max_new bounds +
-        # adapter/trace id form).
+        # adapter/trace id/priority form).
         proto = Request(prompt_ids, max_new_tokens=max_new_tokens,
-                        adapter=adapter, trace_id=trace_id)
+                        adapter=adapter, trace_id=trace_id,
+                        priority=priority)
         self.prompt_ids = proto.prompt_ids
         self.max_new_tokens = proto.max_new_tokens
         self.rng = rng
@@ -114,6 +116,8 @@ class FleetRequest:
         self.ignore_eos = ignore_eos
         #: named LoRA adapter, preserved across failovers (None = base).
         self.adapter = proto.adapter
+        #: traffic class, preserved across failovers (measurement only).
+        self.priority = proto.priority
         #: correlation id shared by every flight this request takes —
         #: minted here (when the gateway didn't) so the spans a failover
         #: leaves on replica A and the resumed spans on replica B carry
@@ -623,6 +627,7 @@ class ReplicaSet:
                timeout: Optional[float] = None, on_token=None,
                ignore_eos: bool = False, adapter: Optional[str] = None,
                trace_id: Optional[str] = None,
+               priority: Optional[str] = None,
                block: bool = False,
                block_timeout: Optional[float] = None) -> FleetRequest:
         """Route one request to the least-loaded healthy replica; returns
@@ -637,7 +642,8 @@ class ReplicaSet:
         fleet = FleetRequest(prompt_ids, max_new_tokens=max_new_tokens,
                              rng=rng, seed=seed, timeout=timeout,
                              on_token=on_token, ignore_eos=ignore_eos,
-                             adapter=adapter, trace_id=trace_id)
+                             adapter=adapter, trace_id=trace_id,
+                             priority=priority)
         fleet.submitted_at = time.monotonic()
         with self._lock:
             self._submitted += 1
@@ -722,7 +728,8 @@ class ReplicaSet:
                         timeout=remaining_t, on_token=None,
                         ignore_eos=fleet.ignore_eos,
                         adapter=fleet.adapter,
-                        trace_id=fleet.trace_id)
+                        trace_id=fleet.trace_id,
+                        priority=fleet.priority)
         inner.on_token = lambda tok, _inner=inner: fleet._emit_from(
             _inner, tok)
         inner._on_finish = lambda req: self._on_inner_finish(

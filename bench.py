@@ -1371,6 +1371,195 @@ def speculative_bench(prompt_len: int = 5, new_tokens: int = 24,
     return out
 
 
+def quantized_serving_bench(dense_slots: int = 2, max_len: int = 64,
+                            page_size: int = 8, prompt_len: int = 4,
+                            new_tokens: int = 16, step_ms: float = 2.0,
+                            spec_tokens: int = 4) -> dict:
+    """Equal-HBM quantized-KV A/B — the int8 serving tentpole's claim.
+
+    Capacity: the fp paged engine gets the 16-page template pool
+    (``dense_slots * max_len / page_size`` pages, same as
+    :func:`paged_capacity_bench`); the ``kv_dtype="int8"`` engine gets
+    the SAME pool BYTES, which buy it ``itemsize``-ish times more pages
+    (per-page f32 scales included — the engine's own ``_page_bytes``
+    accounting) and proportionally more slots. ``concurrency_ratio`` is
+    peak overlapping admitted->finished intervals, int8/fp, at equal
+    HBM — the perf guard pins >= 1.8. Decode throughput rides along.
+
+    Divergence: on the real (non-sleepy) tiny model, int8-kv and
+    int8-kv+weights engines report per-stream prefix token agreement vs
+    the fp engine, and ``logprob_drift`` — max |delta logprob| of the
+    quantized engine's emitted tokens between the full-precision and
+    quantized-weights forwards, teacher-forced — fed through
+    ``ServingStats.record_logprob_drift`` so it surfaces exactly where
+    /metrics reports it (``kv_dtype=None`` engines pin 0.0 drift and
+    bit-exactness in the test suite, not here).
+
+    Speculation: the draft-model A/B from :func:`speculative_bench`
+    re-runs with int8 kv pages — draft and target both read the
+    dequantized view, so the accept rate must not collapse."""
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from accelerate_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from accelerate_tpu.serving import ServingEngine
+
+    pool_pages = dense_slots * max_len // page_size
+    pages_per_req = -(-(prompt_len + new_tokens) // page_size)
+    fp_slots = pool_pages // pages_per_req
+
+    model = _sleepy_llama_cls(step_ms)(LlamaConfig.tiny())
+    params = model.init_params(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+
+    def serve(n_req, prompts, **kw):
+        engine = ServingEngine(model, params, max_len=max_len,
+                               prefill_chunk=page_size, eos_token_id=None,
+                               **kw)
+        try:
+            kv_bytes = engine.kv_cache_per_chip_bytes()
+            page_bytes = engine._page_bytes
+            pool = [np.asarray(l)
+                    for l in jax.tree_util.tree_leaves(engine._state["pool"])]
+            t0 = _time.perf_counter()
+            reqs = [engine.submit(prompts[i:i + 1],
+                                  max_new_tokens=new_tokens,
+                                  ignore_eos=True, block=True)
+                    for i in range(n_req)]
+            toks = [np.asarray(r.result(timeout=300)) for r in reqs]
+            wall = _time.perf_counter() - t0
+            events = sorted([(r.admitted_at, 1) for r in reqs]
+                            + [(r.finished_at, -1) for r in reqs])
+            peak = cur = 0
+            for _, d in events:
+                cur += d
+                peak = max(peak, cur)
+            stats = engine.serving_metrics()
+        finally:
+            engine.shutdown()
+        return dict(toks=toks, peak=peak, kv_bytes=kv_bytes,
+                    page_bytes=page_bytes, pool=pool, wall=wall,
+                    stats=stats)
+
+    fp_prompts = rng.integers(1, 200,
+                              size=(fp_slots, prompt_len)).astype(np.int32)
+    fp = serve(fp_slots, fp_prompts, max_slots=fp_slots,
+               max_pages=pool_pages)
+    # Equal pool bytes: derive the int8 per-page cost from the fp pool's
+    # own geometry (elements/page + one f32 scale per leaf per page —
+    # the formula ServingEngine._page_bytes uses), then buy as many int8
+    # pages as the fp pool's bytes cover.
+    n_leaves = len(fp["pool"])
+    elems = fp["page_bytes"] // fp["pool"][0].dtype.itemsize
+    int8_page_bytes = elems + 4 * n_leaves
+    int8_pages = (pool_pages * fp["page_bytes"]) // int8_page_bytes
+    int8_slots = int8_pages // pages_per_req
+    q_prompts = rng.integers(1, 200,
+                             size=(int8_slots, prompt_len)).astype(np.int32)
+    q = serve(int8_slots, q_prompts, max_slots=int8_slots,
+              max_pages=int8_pages, kv_dtype="int8")
+    assert q["page_bytes"] == int8_page_bytes, \
+        f"page-byte accounting drifted: {q['page_bytes']} != {int8_page_bytes}"
+
+    # --- divergence on the real tiny model (no sleeps) ---------------
+    dmodel = LlamaForCausalLM(LlamaConfig.tiny())
+    dparams = dmodel.init_params(jax.random.PRNGKey(0))
+    div_prompts = rng.integers(1, 200, size=(3, prompt_len)).astype(np.int32)
+
+    def run_engine(**kw):
+        engine = ServingEngine(dmodel, dparams, max_slots=3, max_len=max_len,
+                               prefill_chunk=page_size, eos_token_id=None,
+                               max_pages=pool_pages, **kw)
+        try:
+            toks = [np.asarray(
+                engine.submit(div_prompts[i:i + 1], max_new_tokens=new_tokens,
+                              ignore_eos=True, block=True).result(timeout=300))
+                for i in range(3)]
+        finally:
+            engine.shutdown()
+        return toks, engine.stats
+
+    def agreement(a, b):
+        # Mean fraction of positions that agree before the first split
+        # (after a split greedy trajectories are incomparable).
+        fracs = []
+        for x, y in zip(a, b):
+            n = min(len(x), len(y))
+            eq = int(np.argmin(np.equal(x[:n], y[:n]))) \
+                if not np.array_equal(x[:n], y[:n]) else n
+            fracs.append(eq / max(n, 1))
+        return round(float(np.mean(fracs)), 4)
+
+    base_toks, _ = run_engine()
+    kv_toks, _ = run_engine(kv_dtype="int8")
+    both_toks, both_stats = run_engine(kv_dtype="int8", weights_dtype="int8")
+
+    # logprob drift: teacher-forced fp vs quantized-weights forwards on
+    # the quantized engine's own emitted sequences.
+    from accelerate_tpu.adapters.quantize import (dequantize_params,
+                                                  quantize_base_weights)
+    dq = dequantize_params(quantize_base_weights(dparams), jnp.float32)
+
+    def token_logprobs(p, seq):
+        logits = dmodel.apply({"params": p}, jnp.asarray(seq[None, :-1]))
+        lp = jax.nn.log_softmax(logits[0].astype(jnp.float32), axis=-1)
+        picked = jnp.take_along_axis(
+            lp, jnp.asarray(seq[1:, None], jnp.int32), axis=-1)
+        return np.asarray(picked[:, 0])
+
+    drift = 0.0
+    for i, toks in enumerate(both_toks):
+        seq = np.concatenate([div_prompts[i], np.asarray(toks, np.int32)])
+        d = np.abs(token_logprobs(dparams, seq) - token_logprobs(dq, seq))
+        drift = max(drift, float(d[len(div_prompts[i]) - 1:].max()))
+    both_stats.record_logprob_drift(drift)
+
+    # --- speculation accept rate with int8 kv pages ------------------
+    bmodel = _biased_llama_cls()(LlamaConfig.tiny())
+    bparams = bmodel.init_params(jax.random.PRNGKey(0))
+    b_prompts = rng.integers(9, 15, size=(3, 5)).astype(np.int32)
+
+    def spec_run(**kw):
+        engine = ServingEngine(bmodel, bparams, max_slots=2, max_len=max_len,
+                               prefill_chunk=8, eos_token_id=None,
+                               draft_model=bmodel, draft_params=bparams,
+                               spec_tokens=spec_tokens, **kw)
+        try:
+            for i in range(3):
+                engine.submit(b_prompts[i:i + 1], max_new_tokens=16,
+                              ignore_eos=True,
+                              block=True).result(timeout=300)
+            stats = engine.serving_metrics()
+        finally:
+            engine.shutdown()
+        return stats
+
+    s_fp = spec_run()
+    s_q = spec_run(kv_dtype="int8")
+
+    return {
+        "pool_pages": {"fp": pool_pages, "int8": int8_pages},
+        "page_bytes": {"fp": fp["page_bytes"], "int8": q["page_bytes"]},
+        "kv_bytes": {"fp": fp["kv_bytes"], "int8": q["kv_bytes"]},
+        "slots": {"fp": fp_slots, "int8": int8_slots},
+        "peak_concurrency": {"fp": fp["peak"], "int8": q["peak"]},
+        "concurrency_ratio": round(q["peak"] / max(fp["peak"], 1), 3),
+        "decode_tok_s": {
+            "fp": round(fp_slots * new_tokens / max(fp["wall"], 1e-9), 1),
+            "int8": round(int8_slots * new_tokens / max(q["wall"], 1e-9), 1),
+        },
+        "preemptions": q["stats"]["preemptions"],
+        "token_agreement": {"kv": agreement(base_toks, kv_toks),
+                            "kv+weights": agreement(base_toks, both_toks)},
+        "logprob_drift": both_stats.summary()["logprob_drift"],
+        "spec_accept_rate": {"fp": s_fp["spec_accept_rate"],
+                             "int8": s_q["spec_accept_rate"]},
+    }
+
+
 def host_overlap_bench(n_streams: int = 2, new_tokens: int = 24,
                        step_ms: float = 12.0, consume_ms: float = 4.0,
                        prompt_len: int = 5, max_len: int = 64) -> dict:
@@ -1612,6 +1801,7 @@ def serving_extra(on_tpu: bool) -> dict:
         "chaos": chaos_recovery_bench(),
         "tp": serving_tp_bench(),
         "paged": paged_capacity_bench(),
+        "quantized": quantized_serving_bench(),
         "speculative": speculative_bench(),
         "host_overlap": host_overlap_bench(),
     }

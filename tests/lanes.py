@@ -55,6 +55,7 @@ LANES: dict[str, tuple[int, list[str]]] = {
         "test_hf_interop.py",
         "test_host_offload.py",
         "test_loadgen.py",
+        "test_loadtest_smoke.py",
         "test_memory_properties.py",
         "test_models.py",
         "test_observability.py",
@@ -65,6 +66,7 @@ LANES: dict[str, tuple[int, list[str]]] = {
         "test_serving_gateway.py",
         "test_serving_mesh.py",
         "test_serving_paged.py",
+        "test_serving_quantized.py",
         "test_serving_supervisor.py",
     ]),
     "subproc": (12, [

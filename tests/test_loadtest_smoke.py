@@ -1,0 +1,62 @@
+"""CI smoke for ``accelerate-tpu loadtest --check``.
+
+Drives the real command end-to-end in-process — self-hosted tiny fleet,
+asyncio SSE front end, open-loop arrivals, conformance report — on a
+schedule small enough for the fast lane. ``--check`` is the contract:
+exit 0 means zero protocol violations (non-2xx without structure,
+missing Retry-After, truncated SSE, token mismatches) and balanced
+gateway counters, so a regression anywhere on the serving path turns
+this test red without any perf-threshold flakiness.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from accelerate_tpu.commands.loadtest import (  # noqa: E402
+    loadtest_command,
+    loadtest_command_parser,
+)
+
+
+def test_loadtest_check_passes_on_tiny_schedule(tmp_path):
+    out = tmp_path / "report.json"
+    args = loadtest_command_parser().parse_args([
+        "--n-streams", "8", "--rps", "50",
+        "--prompt-len", "4", "--prompt-max", "8",
+        "--out-tokens", "4", "--out-max", "8",
+        "--wall-deadline", "30",
+        "--output", str(out),
+        "--check",
+    ])
+    rc = loadtest_command(args)
+    assert rc == 0, "loadtest --check flagged conformance violations"
+    report = json.loads(out.read_text())
+    assert report["goodput"]["completed"] == 8, report["goodput"]
+    conf = report["conformance"]
+    assert conf["token_mismatches"] == 0 and conf["truncated_sse"] == 0
+    assert report["counters_balance"]
+
+
+def test_loadtest_check_exit_code_reflects_violations(monkeypatch):
+    # --check must actually gate on the report: force a violation count
+    # into the built report and the command has to exit non-zero.
+    from accelerate_tpu import loadgen
+
+    real = loadgen.build_report
+
+    def tainted(*a, **kw):
+        rep = real(*a, **kw)
+        rep["conformance"]["token_mismatches"] += 1
+        return rep
+
+    monkeypatch.setattr("accelerate_tpu.loadgen.build_report", tainted)
+    args = loadtest_command_parser().parse_args([
+        "--n-streams", "2", "--rps", "50",
+        "--prompt-len", "4", "--prompt-max", "8",
+        "--out-tokens", "2", "--out-max", "4",
+        "--wall-deadline", "30", "--check",
+    ])
+    assert loadtest_command(args) == 1
