@@ -5,9 +5,10 @@ inter-arrivals and request shapes) from one asyncio client against
 either a running gateway (``--url``) or a self-hosted tiny-model fleet
 (the default — the demo/smoke path, same as ``accelerate-tpu serve
 --model tiny``), then prints the JSON report: goodput, p50/p99/p99.9
-TTFT and ITL measured from each stream's *scheduled* arrival,
-429/Retry-After conformance, token-accounting balance, and host CPU
-per stream.
+TTFT and ITL measured from each stream's *scheduled* arrival, a
+per-priority-class breakdown (goodput and latency tails per declared
+traffic class — the SLO-control legibility view), 429/Retry-After
+conformance, token-accounting balance, and host CPU per stream.
 
 ``--check`` turns conformance into the exit code: non-zero when any
 non-2xx was unstructured, a 429/503 lacked a bounded ``Retry-After``,
@@ -20,6 +21,28 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+
+
+def _parse_priorities(spec: str):
+    """``"interactive=0.2,batch=0.8"`` -> ``(("interactive", 0.2), ...)``."""
+    out = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, eq, weight = part.partition("=")
+        try:
+            w = float(weight)
+        except ValueError:
+            w = -1.0
+        if not eq or not name.strip() or w <= 0:
+            raise SystemExit(
+                f"--priorities: expected CLASS=WEIGHT[,CLASS=WEIGHT...] "
+                f"with positive weights (got {part!r})")
+        out.append((name.strip(), w))
+    if not out:
+        raise SystemExit("--priorities: no classes given")
+    return tuple(out)
 
 
 def loadtest_command(args) -> int:
@@ -61,11 +84,14 @@ def loadtest_command(args) -> int:
     sched = ArrivalSchedule(args.n_streams, 1.0 / args.rps,
                             dist=args.dist, sigma=args.sigma,
                             alpha=args.alpha, seed=args.seed)
+    profile_kw = {}
+    if args.priorities is not None:
+        profile_kw["priorities"] = _parse_priorities(args.priorities)
     profile = TrafficProfile(
         prompt_len_median=args.prompt_len, prompt_len_max=args.prompt_max,
         out_tokens_median=args.out_tokens, out_tokens_max=args.out_max,
         sampled_fraction=args.sampled_fraction, timeout_s=args.timeout,
-        seed=args.seed + 1)
+        seed=args.seed + 1, **profile_kw)
     try:
         run = run_open_loop(url, sched, profile,
                             vocab_size=args.vocab_size,
@@ -98,6 +124,18 @@ def loadtest_command(args) -> int:
           f"{report['goodput']['completed']} completed, "
           f"{conf['non_2xx']} refused, conformance "
           f"{'OK' if ok else 'VIOLATED'}", file=sys.stderr)
+
+    def _ms(v):
+        return "-" if v is None else f"{v * 1e3:.0f}ms"
+
+    for cls, pr in sorted(report.get("per_priority", {}).items()):
+        print(f"  class {cls}: {pr['completed']}/{pr['offered']} completed, "
+              f"{pr['within_slo']} within SLO, "
+              f"ttft p50 {_ms(pr['ttft_s'].get('p50_clamped'))} "
+              f"p99 {_ms(pr['ttft_s'].get('p99_clamped'))}, "
+              f"itl p50 {_ms(pr['itl_s'].get('p50_clamped'))} "
+              f"p99 {_ms(pr['itl_s'].get('p99_clamped'))} (clamped)",
+              file=sys.stderr)
     return 0 if (ok or not args.check) else 1
 
 
@@ -144,6 +182,13 @@ def loadtest_command_parser(subparsers=None):
                         help="Median max_new_tokens (lognormal tail)")
     parser.add_argument("--out-max", type=int, default=48,
                         help="max_new_tokens clip")
+    parser.add_argument("--priorities", default=None,
+                        metavar="CLASS=WEIGHT[,...]",
+                        help="Traffic-class mix, e.g. "
+                             "'interactive=0.2,batch=0.8' (default: the "
+                             "profile's 80/20 interactive/batch split); "
+                             "the report breaks goodput and latency "
+                             "tails out per class")
     parser.add_argument("--sampled-fraction", type=float, default=0.5,
                         help="Fraction of requests with a sampling seed")
     parser.add_argument("--vocab-size", type=int, default=256,

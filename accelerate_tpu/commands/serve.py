@@ -57,6 +57,25 @@ def _parse_adapter_specs(specs):
     return out
 
 
+def _parse_tenant_floats(specs, flag: str, what: str):
+    """``NAME=FLOAT`` pairs → dict (None when no pairs). ``*`` is the
+    wildcard tenant (default for anyone unlisted); ``_base`` is
+    base-model traffic."""
+    out = {}
+    for spec in specs or ():
+        name, sep, val = spec.partition("=")
+        if not sep or not name:
+            raise SystemExit(f"{flag} must be TENANT={what} (got {spec!r})")
+        try:
+            out[name] = float(val)
+        except ValueError:
+            raise SystemExit(
+                f"{flag}: {what} must be a number (got {spec!r})") from None
+        if out[name] <= 0:
+            raise SystemExit(f"{flag}: {what} must be > 0 (got {spec!r})")
+    return out or None
+
+
 def serve_command(args) -> int:
     from ..serving import (
         FleetSupervisor,
@@ -65,6 +84,26 @@ def serve_command(args) -> int:
         ServingEngine,
         ServingGateway,
     )
+
+    # Validate cheap usage errors before any model build/warmup.
+    # --autoscale-max turns the fixed fleet into a min..max elastic one:
+    # `autoscale_min` replicas run, the rest sit PARKED (factory retained,
+    # no engine) until the supervisor's autoscaler unparks them.
+    autoscale = args.autoscale_max is not None
+    if autoscale:
+        autoscale_min = (args.autoscale_min if args.autoscale_min is not None
+                         else 1)
+        if autoscale_min < 1:
+            raise SystemExit("--autoscale-min must be >= 1")
+        if args.autoscale_max < autoscale_min:
+            raise SystemExit("--autoscale-max must be >= --autoscale-min")
+        n_build = args.autoscale_max if args.tp > 1 else autoscale_min
+    else:
+        autoscale_min = args.replicas
+        n_build = args.replicas
+    rate_limits = _parse_tenant_floats(args.rate_limit, "--rate-limit", "RPS")
+    fair_share = _parse_tenant_floats(args.fair_share, "--fair-share",
+                                      "WEIGHT")
 
     model, params = _resolve_model(args.model, args)
     adapter_specs = _parse_adapter_specs(args.adapter)
@@ -94,16 +133,19 @@ def serve_command(args) -> int:
         spec = dict(spec_lookup=args.spec_lookup,
                     spec_tokens=args.spec_tokens)
 
+    priority_policy = "default" if args.priority_preemption else None
+
     def factory():
         return ServingEngine(
             model, params, max_slots=args.max_slots, max_len=args.max_len,
             max_queued=args.max_queued, eos_token_id=args.eos_token_id,
             prefill_chunk=args.prefill_chunk,
             prefix_cache_mb=args.prefix_cache_mb,
+            priority_policy=priority_policy,
             adapters=make_bank(), trace_dir=args.trace_dir, **paging,
             **spec)
 
-    print(f"warming up {args.replicas} replica(s) "
+    print(f"warming up {n_build} replica(s) "
           f"(slots={args.max_slots}, max_len={args.max_len}, "
           f"chunk={args.prefill_chunk}"
           + (f", tp={args.tp}" if args.tp > 1 else "")
@@ -118,16 +160,27 @@ def serve_command(args) -> int:
     if args.tp > 1:
         # One replica = one tp-wide mesh slice; the fleet shares a
         # host-portable prefix cache so failover keeps its prefix hits.
+        # Mesh slices claim their devices at build time, so an elastic
+        # fleet builds all max_replicas slices and parks the surplus
+        # (park releases the engine; the retained slice factory rebuilds
+        # it on scale-up).
         replica_set = ReplicaSet.from_mesh(
-            model, params, tp=args.tp, num_slices=args.replicas,
+            model, params, tp=args.tp, num_slices=n_build,
             make_adapters=(make_bank if max_adapters >= 2 else None),
             max_slots=args.max_slots, max_len=args.max_len,
             max_queued=args.max_queued, eos_token_id=args.eos_token_id,
             prefill_chunk=args.prefill_chunk,
             prefix_cache_mb=args.prefix_cache_mb,
+            priority_policy=priority_policy,
             trace_dir=args.trace_dir, **paging, **spec)
+        if autoscale:
+            for i in range(autoscale_min, args.autoscale_max):
+                replica_set.park_replica(i)
     else:
-        replica_set = ReplicaSet.from_factory(factory, args.replicas)
+        replica_set = ReplicaSet.from_factory(factory, n_build)
+        if autoscale:
+            for _ in range(args.autoscale_max - autoscale_min):
+                replica_set.add_parked(factory)
     if adapter_specs:
         from ..adapters import load_adapter
 
@@ -140,18 +193,31 @@ def serve_command(args) -> int:
         replica_set,
         config=GatewayConfig(host=args.host, port=args.port,
                              default_max_new_tokens=args.default_max_new_tokens,
-                             max_connections=args.max_connections))
+                             max_connections=args.max_connections,
+                             rate_limits=rate_limits,
+                             fair_share_weights=fair_share))
     gateway.start()
     gateway.install_signal_handlers()
     supervisor = None
-    if args.supervise:
+    if args.supervise or autoscale:
+        autoscaler = None
+        if autoscale:
+            from ..serving import AutoscaleConfig, FleetAutoscaler
+
+            autoscaler = FleetAutoscaler(
+                replica_set,
+                config=AutoscaleConfig(min_replicas=autoscale_min,
+                                       max_replicas=args.autoscale_max))
         supervisor = FleetSupervisor(
             replica_set, hang_timeout_s=args.hang_timeout,
-            max_restarts=args.max_restarts)
+            max_restarts=args.max_restarts, autoscaler=autoscaler)
         supervisor.start()
         print(f"supervisor on (hang_timeout={args.hang_timeout:g}s, "
               f"max_restarts={args.max_restarts} before the circuit "
-              "breaker parks a replica)", flush=True)
+              "breaker parks a replica"
+              + (f", autoscale {autoscale_min}..{args.autoscale_max}"
+                 if autoscale else "")
+              + ")", flush=True)
     print(f"serving on {gateway.url}  "
           "(POST /v1/completions, GET /healthz /readyz /metrics "
           "/debug/trace)",
@@ -243,6 +309,39 @@ def serve_command_parser(subparsers=None):
                         help="Preload a saved adapter (save_adapter dir) "
                              "under NAME on every replica; repeatable. "
                              "Implies an adapter bank sized to fit")
+    parser.add_argument("--priority-preemption",
+                        action=argparse.BooleanOptionalAction, default=True,
+                        help="Act on per-request priority classes "
+                             "(interactive/standard/batch): priority "
+                             "admission queues and lowest-class-first "
+                             "preemption victim selection. "
+                             "--no-priority-preemption reverts to "
+                             "measurement-only FCFS (the A/B baseline)")
+    parser.add_argument("--rate-limit", action="append",
+                        metavar="TENANT=RPS",
+                        help="Per-tenant token-bucket rate limit at the "
+                             "gateway (tenant = adapter name, '_base' for "
+                             "base-model traffic, '*' for everyone "
+                             "unlisted); repeatable. Over-limit requests "
+                             "get a structured 429 with Retry-After from "
+                             "bucket refill time")
+    parser.add_argument("--fair-share", action="append",
+                        metavar="TENANT=WEIGHT",
+                        help="Weighted fair-share admission under pressure "
+                             "(work-conserving: only binds near capacity); "
+                             "tenants as for --rate-limit, default weight "
+                             "1.0; repeatable")
+    parser.add_argument("--autoscale-min", type=int, default=None,
+                        help="Elastic fleet floor: replicas kept running "
+                             "(default 1 when --autoscale-max is set; "
+                             "ignored otherwise)")
+    parser.add_argument("--autoscale-max", type=int, default=None,
+                        help="Elastic fleet ceiling: surplus replicas sit "
+                             "PARKED (factory retained, engine released) "
+                             "until queue depth or standing page pressure "
+                             "makes the supervisor's autoscaler unpark "
+                             "them; idle replicas drain back down. Implies "
+                             "--supervise; overrides --replicas")
     parser.add_argument("--supervise", action="store_true",
                         help="Run a FleetSupervisor over the replicas: "
                              "heartbeat watchdog fencing hung engines, "

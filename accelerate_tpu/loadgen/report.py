@@ -130,6 +130,36 @@ def build_report(run: dict, schedule, profile=None, *,
         if r.done.get("tokens") is not None
         and r.tokens != [int(t) for t in r.done["tokens"]])
 
+    # -- per-priority-class breakdown --------------------------------------
+    # Keyed on the class each request DECLARED (the body's "priority";
+    # requests without one land under "_none") — the legibility layer for
+    # SLO claims: one run shows interactive's clamped tail next to
+    # batch's, over offered streams per class like the headline numbers.
+    def _class_of(r) -> str:
+        body = r.request or {}
+        return body.get("priority") or "_none"
+
+    per_priority: dict = {}
+    for r in results:
+        per_priority.setdefault(_class_of(r), []).append(r)
+    priority_report = {}
+    for cls in sorted(per_priority):
+        rs = per_priority[cls]
+        cls_completed = [r for r in rs if r.completed]
+        cls_good = sum(1 for r in cls_completed if met_slo(r))
+        cls_ttfts = [r.ttft_s if r.ttft_s is not None else inf for r in rs]
+        cls_itls: list = []
+        for r in rs:
+            cls_itls.extend(r.token_gaps_s)
+        priority_report[cls] = {
+            "offered": len(rs),
+            "completed": len(cls_completed),
+            "within_slo": cls_good,
+            "goodput_rps": (cls_good / wall) if wall else None,
+            "ttft_s": _pcts(cls_ttfts, clamp_s),
+            "itl_s": _pcts(cls_itls, clamp_s),
+        }
+
     report = {
         "offered": dict(schedule.describe(),
                         **({"profile": profile.describe()}
@@ -151,6 +181,7 @@ def build_report(run: dict, schedule, profile=None, *,
         },
         "ttft_s": _pcts(ttfts, clamp_s),
         "itl_s": _pcts(itls, clamp_s),
+        "per_priority": priority_report,
         "conformance": {
             "non_2xx": len(non2xx),
             "unstructured_non_2xx": len(unstructured),

@@ -17,8 +17,16 @@ Public surface:
   hit rate/bytes) — ``engine.serving_metrics()``,
   ``Accelerator.log(include_serving=True)``.
 * :class:`AdmissionQueue` / :class:`QueueFull` / :class:`QueueClosed` /
-  :class:`SlotScheduler` — the bounded FCFS admission layer and slot
-  free-list.
+  :class:`SlotScheduler` — the bounded admission layer (FCFS, or a
+  priority queue when built with a ``rank_fn``) and slot free-list.
+* :class:`PriorityPolicy` / :class:`TokenBucket` /
+  :class:`TenantRateLimiter` / :class:`FairShareAdmission` /
+  :class:`AutoscaleConfig` / :class:`FleetAutoscaler` — the SLO control
+  plane (``serving.control``): priority classes acted on by admission
+  order and preemption victim selection, per-tenant rate limits and
+  weighted fair share at the gateway, and supervisor-driven replica
+  autoscaling over retained factories. See
+  ``docs/usage_guides/slo_control.md``.
 * :class:`PrefixCache` — byte-bounded LRU of chunk-aligned prefix KV
   blocks keyed by token-prefix hash chains (shared system prompts skip
   their prefill FLOPs).
@@ -73,6 +81,14 @@ See ``docs/usage_guides/serving.md``.
 """
 
 from .chaos import ChaosKilled, ChaosSchedule
+from .control import (
+    AutoscaleConfig,
+    FairShareAdmission,
+    FleetAutoscaler,
+    PriorityPolicy,
+    TenantRateLimiter,
+    TokenBucket,
+)
 from .engine import ServingEngine
 from .gateway import GatewayConfig, ServingGateway
 from .mesh_exec import SliceExec, SlicePlan
@@ -106,6 +122,12 @@ __all__ = [
     "SliceExec",
     "ServingGateway",
     "GatewayConfig",
+    "PriorityPolicy",
+    "TokenBucket",
+    "TenantRateLimiter",
+    "FairShareAdmission",
+    "AutoscaleConfig",
+    "FleetAutoscaler",
     "FleetSupervisor",
     "HungReplicaError",
     "ChaosSchedule",

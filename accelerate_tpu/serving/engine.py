@@ -177,6 +177,7 @@ from ..inference import resolve_model_source
 from ..observability import FlightRecorder, Tracer, new_trace_id
 from .metrics import ServingStats
 from .request import Request, RequestStatus
+from .control import PriorityPolicy
 from .scheduler import (
     AdmissionQueue,
     PagePool,
@@ -448,6 +449,7 @@ class ServingEngine:
                  top_k: Optional[int] = None, top_p: Optional[float] = None,
                  cache_dtype=None, kv_dtype: Optional[str] = None,
                  weights_dtype: Optional[str] = None, max_queued: int = 64,
+                 priority_policy: Optional[PriorityPolicy] = "default",
                  prefill_chunk: Optional[int] = 256,
                  prefill_chunks_per_tick: int = 1,
                  prefix_cache_mb: float = 64.0,
@@ -965,7 +967,18 @@ class ServingEngine:
         if stats is None and accelerator is not None:
             stats = getattr(accelerator, "serving_stats", None)
         self._stats = stats if stats is not None else ServingStats()
-        self._queue = AdmissionQueue(max_queued)
+        if priority_policy == "default":
+            priority_policy = PriorityPolicy()
+        elif priority_policy is not None and not isinstance(
+                priority_policy, PriorityPolicy):
+            raise TypeError(
+                "priority_policy must be a PriorityPolicy, None (FCFS), or "
+                f"the string 'default' (got {priority_policy!r})")
+        self._priority_policy = priority_policy
+        self._queue = AdmissionQueue(
+            max_queued,
+            rank_fn=priority_policy.rank if priority_policy is not None
+            else None)
         self._slots = SlotScheduler(self.max_slots)
 
         # Observability: per-engine span tracer + flight recorder (black
@@ -2636,20 +2649,29 @@ class ServingEngine:
             for pid in val if self._pool.refcount(int(pid)) == 1)
 
     def _preempt_one(self, requester: Request) -> bool:
-        """Pool exhausted: evict the NEWEST-admitted other stream back to
-        the FRONT of the queue and free its pages. Newest loses because it
-        has the least sunk prefill work and the shortest resume. The
-        victim resumes token-exactly later: its prompt becomes
-        ``prompt + tokens`` (for greedy decoding the resumed prefill's
-        first token IS the interrupted stream's next token — the router
-        failover argument; sampled streams re-draw from the resume point).
-        Returns False when no other stream holds a slot."""
+        """Pool exhausted: evict another stream back to the FRONT of its
+        queue class and free its pages. Victim selection is policy-driven:
+        with a priority policy, the LOWEST-priority stream loses first and
+        the newest-admitted within that class breaks the tie (least sunk
+        prefill work, shortest resume); without a policy this degenerates
+        to the historical newest-admitted rule. The victim resumes
+        token-exactly later: its prompt becomes ``prompt + tokens`` (for
+        greedy decoding the resumed prefill's first token IS the
+        interrupted stream's next token — the router failover argument;
+        sampled streams re-draw from the resume point). Returns False
+        when no other stream holds a slot."""
+        policy = self._priority_policy
+
+        def _victim_key(r):
+            rank = (policy.rank(getattr(r, "priority", None))
+                    if policy is not None else 0)
+            return (rank, r.admitted_at or 0.0)
+
         victim = None
         for _, r in self._slots.active():
             if r is requester:
                 continue
-            if victim is None or (r.admitted_at or 0.0) > (victim.admitted_at
-                                                           or 0.0):
+            if victim is None or _victim_key(r) > _victim_key(victim):
                 victim = r
         if victim is None:
             return False
@@ -2870,6 +2892,28 @@ class ServingEngine:
                 digest_size=16).digest()
             keys.append(prev)
         return keys
+
+    def cached_prefix_tokens(self, prompt_ids,
+                             adapter: Optional[str] = None) -> int:
+        """How many leading prompt tokens THIS engine could restore from
+        its prefix cache right now — the router's cache-aware routing
+        probe. Pure host work (hashing + dict lookups, no LRU promotion,
+        no device calls), so probing every replica per dispatch is cheap
+        and cannot perturb cache eviction order. Mirrors the restore
+        bound in ``_begin_prefill``: the final chunk always re-runs, so
+        at most ``ceil(S/C) - 1`` full chunks count."""
+        if self._prefix_cache is None or self._chunk is None:
+            return 0
+        ids = np.asarray(prompt_ids, np.int32)
+        if ids.ndim == 1:
+            ids = ids[None, :]
+        S = int(ids.shape[1])
+        C = self._chunk
+        restorable = min(S // C, -(-S // C) - 1)
+        if restorable < 1:
+            return 0
+        keys = self._prefix_keys(ids, restorable, adapter)
+        return self._prefix_cache.longest_prefix(keys) * C
 
     def _advance_one_prefill(self) -> bool:
         """Run ONE chunk for the oldest live entry of the PREFILLING

@@ -43,8 +43,13 @@ growing without bound: every healthy replica's admission queue full →
 queued work past the paged pools' headroom (and not clearing within
 ``shed_wait_s`` at the observed page-drain rate) → **429** whose
 ``Retry-After`` is *derived from that drain rate*, shedding work the
-queues would accept and then time out on; per-request deadline expired
-→ **408**;
+queues would accept and then time out on; a tenant over its token-bucket
+rate limit (``rate_limits``) → **429** whose ``Retry-After`` is the
+bucket's refill time; a tenant over its weighted fair share of in-flight
+streams while the fleet is under pressure (``fair_share_weights``) →
+**429**; all derived Retry-After values clamp into the shared
+``[retry_after_s, retry_after_max_s]`` window; per-request deadline
+expired → **408**;
 request body over the cap → **413**; connection cap hit, gateway
 draining, or no healthy replica → **503**; malformed request → **400**.
 Multi-tenant LoRA maps the same way: ``"adapter"`` naming an adapter no
@@ -137,7 +142,24 @@ class GatewayConfig:
         projected page deficit clears within this many seconds of
         observed drain.
       retry_after_max_s: cap on the drain-rate-derived ``Retry-After``
-        of a pressure shed (the floor is ``retry_after_s``).
+        of a pressure shed (the floor is ``retry_after_s``); the same
+        clamp bounds rate-limit Retry-After values.
+      rate_limits: per-tenant token-bucket request rates — a dict
+        ``{tenant: requests_per_s}`` keyed on adapter name (base-model
+        traffic is tenant ``"_base"``; the ``"*"`` key sets a default
+        for unlisted tenants). ``None`` (default) disables rate
+        limiting. Refusals are structured 429s whose ``Retry-After``
+        derives from the tenant bucket's refill time.
+      rate_limit_burst_s: bucket capacity in seconds of budget — a
+        tenant may burst ``rate * burst_s`` requests after idling.
+      fair_share_weights: weighted fair-share admission over in-flight
+        streams — ``{tenant: weight}`` (``"*"`` = default weight).
+        ``None`` disables fair share. Work-conserving: tenants borrow
+        idle capacity freely until fleet admission occupancy crosses
+        ``fair_share_pressure``, past which a tenant over its weighted
+        share is shed (429) so under-share tenants keep finding room.
+      fair_share_pressure: occupancy fraction of fleet admission
+        capacity (slots + queue depth) past which fair share enforces.
     """
 
     #: per-front-end ``max_connections=None`` defaults (threads are the
@@ -157,7 +179,11 @@ class GatewayConfig:
                  shed_wait_s: float = 5.0,
                  retry_after_max_s: float = 60.0,
                  sse_heartbeat_s: Optional[float] = None,
-                 stream_queue_tokens: int = 256):
+                 stream_queue_tokens: int = 256,
+                 rate_limits: Optional[dict] = None,
+                 rate_limit_burst_s: float = 2.0,
+                 fair_share_weights: Optional[dict] = None,
+                 fair_share_pressure: float = 0.85):
         if server not in self.DEFAULT_MAX_CONNECTIONS:
             raise ValueError(
                 f"server must be one of "
@@ -188,6 +214,11 @@ class GatewayConfig:
         self.shed_projected_pressure = bool(shed_projected_pressure)
         self.shed_wait_s = float(shed_wait_s)
         self.retry_after_max_s = float(retry_after_max_s)
+        self.rate_limits = None if rate_limits is None else dict(rate_limits)
+        self.rate_limit_burst_s = float(rate_limit_burst_s)
+        self.fair_share_weights = (None if fair_share_weights is None
+                                   else dict(fair_share_weights))
+        self.fair_share_pressure = float(fair_share_pressure)
 
 
 #: request terminal status -> (HTTP code, wire status string)
@@ -235,9 +266,25 @@ _METRIC_HELP = {
         "Replicas currently parked in CRASH_LOOP awaiting operator reset.",
     "accelerate_tpu_serving_fleet_page_drain_rate":
         "Observed KV pages freed per second across healthy replicas.",
+    "accelerate_tpu_serving_replicas_parked":
+        "Replicas currently scaled down to PARKED (engine released, "
+        "factory retained for autoscale spawn).",
+    "accelerate_tpu_serving_fleet_scale_ups":
+        "PARKED replicas rebuilt into rotation by autoscaling.",
+    "accelerate_tpu_serving_fleet_scale_downs":
+        "Idle replicas drained and parked by autoscaling.",
+    "accelerate_tpu_serving_fleet_autoscale_events":
+        "Total autoscale actuations (scale-ups plus scale-downs) — the "
+        "loop-closure signal.",
     "accelerate_tpu_gateway_pressure_sheds":
         "Completions refused (429) on projected KV-page pressure rather "
         "than queue depth.",
+    "accelerate_tpu_gateway_rate_limit_sheds":
+        "Completions refused (429) by the per-tenant token-bucket rate "
+        "limit; Retry-After derives from the bucket's refill time.",
+    "accelerate_tpu_gateway_fair_share_sheds":
+        "Completions refused (429) by weighted fair-share admission — "
+        "tenant over its share while the fleet is under pressure.",
     "accelerate_tpu_gateway_http_requests":
         "HTTP requests accepted past the connection cap.",
     "accelerate_tpu_gateway_http_inflight":
@@ -313,6 +360,22 @@ def parse_completion(body: dict, cfg: GatewayConfig) -> dict:
     }
 
 
+def clamp_retry_after(cfg: GatewayConfig, seconds: float) -> float:
+    """Bound a derived ``Retry-After`` into the gateway's shared
+    ``[retry_after_s, retry_after_max_s]`` window. EVERY shed that
+    computes its own backoff (pressure drain-rate, rate-limit bucket
+    refill) funnels through here — one clamp, both front ends, so no
+    response ever advertises an unbounded or sub-floor retry."""
+    return min(max(float(seconds), cfg.retry_after_s), cfg.retry_after_max_s)
+
+
+def tenant_of(spec: dict) -> str:
+    """The tenant identity a parsed completion spec bills to: its
+    adapter name, or ``"_base"`` for base-model traffic (the underscore
+    keeps it out of the valid adapter-name space)."""
+    return spec.get("adapter") or "_base"
+
+
 def summary_payload(fleet, status: str) -> dict:
     """The single summary shape for JSON responses AND the SSE final
     done-event: ``trace_id`` here is what lets a client hand the id
@@ -374,6 +437,20 @@ class ServingGateway:
         if stats is None and accelerator is not None:
             stats = getattr(accelerator, "gateway_stats", None)
         self.stats = stats if stats is not None else GatewayStats()
+        # Tenant policy (control plane): built once from config; both
+        # front ends consult them through submit_or_error only.
+        from .control import FairShareAdmission, TenantRateLimiter
+
+        self.rate_limiter = None
+        if self.config.rate_limits:
+            self.rate_limiter = TenantRateLimiter(
+                self.config.rate_limits,
+                burst_s=self.config.rate_limit_burst_s)
+        self.fair_share = None
+        if self.config.fair_share_weights is not None:
+            self.fair_share = FairShareAdmission(
+                self.config.fair_share_weights,
+                pressure=self.config.fair_share_pressure)
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._draining = False
@@ -513,25 +590,66 @@ class ServingGateway:
         rate = rs.page_drain_rate()
         if rate <= 0 or deficit <= rate * cfg.shed_wait_s:
             return None
-        return min(max(deficit / rate, cfg.retry_after_s),
-                   cfg.retry_after_max_s)
+        return clamp_retry_after(cfg, deficit / rate)
 
     def submit_or_error(self, spec: dict, trace_id: str, on_token=None):
         """Admit one parsed completion spec: ``(fleet, None)`` on success,
         ``(None, (code, payload, extra_headers))`` on any refusal —
-        projected-pressure 429, queue-full 429, unknown-adapter 404,
-        no-healthy-replica 503, or invalid-parameter 400. The single
-        admission path both front ends share, so backpressure semantics
-        cannot drift between them."""
-        retry_headers = {"Retry-After": f"{self.config.retry_after_s:g}"}
+        rate-limit 429, fair-share 429, projected-pressure 429,
+        queue-full 429, unknown-adapter 404, no-healthy-replica 503, or
+        invalid-parameter 400. The single admission path both front ends
+        share, so backpressure semantics cannot drift between them.
+
+        Tenant policy runs first, cheapest-check-first: the token-bucket
+        rate limit (pure arithmetic, its Retry-After is the bucket's own
+        refill time clamped through :func:`clamp_retry_after` like every
+        other shed), then weighted fair share (a successful acquire is
+        released exactly once via the fleet request's done callback —
+        including failure/cancel terminals), then the fleet-pressure and
+        submit paths exactly as before."""
+        cfg = self.config
+        retry_headers = {"Retry-After": f"{cfg.retry_after_s:g}"}
+        tenant = tenant_of(spec)
+        if self.rate_limiter is not None:
+            refill_in = self.rate_limiter.admit(tenant)
+            if refill_in is not None:
+                self.stats.record_rate_limit_shed()
+                return None, (
+                    429, {"error": "rate_limited",
+                          "detail": f"tenant {tenant!r} is over its "
+                                    "request rate; retry later",
+                          "tenant": tenant},
+                    {"Retry-After":
+                     f"{clamp_retry_after(cfg, refill_in):g}"})
+        acquired = False
+        if self.fair_share is not None:
+            capacity = self.replica_set.admission_capacity()
+            if not self.fair_share.try_acquire(tenant, capacity):
+                self.stats.record_fair_share_shed()
+                return None, (
+                    429, {"error": "fair_share_exceeded",
+                          "detail": f"tenant {tenant!r} is over its "
+                                    "weighted share of in-flight streams "
+                                    "under fleet pressure; retry later",
+                          "tenant": tenant},
+                    retry_headers)
+            acquired = True
+
+        def _refuse(resp):
+            # Any refusal past a successful fair-share acquire returns
+            # the tenant's in-flight slot — no leaked shares.
+            if acquired:
+                self.fair_share.release(tenant)
+            return None, resp
+
         retry_in = self.pressure_retry_after(spec)
         if retry_in is not None:
             self.stats.record_pressure_shed()
-            return None, (
+            return _refuse((
                 429, {"error": "projected KV page pressure: admitted and "
                                "queued work exceeds pool headroom; "
                                "retry later"},
-                {"Retry-After": f"{retry_in:g}"})
+                {"Retry-After": f"{retry_in:g}"}))
         try:
             fleet = self.replica_set.submit(
                 spec["prompt_ids"],
@@ -543,16 +661,23 @@ class ServingGateway:
                 trace_id=trace_id,
                 on_token=on_token)
         except QueueFull:
-            return None, (429, {"error": "all replicas saturated; "
-                                         "retry later"}, retry_headers)
+            return _refuse((429, {"error": "all replicas saturated; "
+                                           "retry later"}, retry_headers))
         except LookupError as e:
-            return None, (404, {"error": "unknown_adapter",
-                                "detail": str(e)}, {})
+            return _refuse((404, {"error": "unknown_adapter",
+                                  "detail": str(e)}, {}))
         except RuntimeError as e:
-            return None, (503, {"error": f"no healthy replica: {e}"},
-                          retry_headers)
+            return _refuse((503, {"error": f"no healthy replica: {e}"},
+                            retry_headers))
         except ValueError as e:
-            return None, (400, {"error": str(e)}, {})
+            return _refuse((400, {"error": str(e)}, {}))
+        except BaseException:
+            if acquired:
+                self.fair_share.release(tenant)
+            raise
+        if acquired:
+            fleet.add_done_callback(
+                lambda _f, fs=self.fair_share, t=tenant: fs.release(t))
         return fleet, None
 
     # -- metrics ----------------------------------------------------------
@@ -612,7 +737,8 @@ class ServingGateway:
                 lines.append(
                     f"# HELP accelerate_tpu_serving_priority_{c} "
                     f"Per-priority (traffic class) {c} across the fleet — "
-                    "measurement only, scheduling does not consult it.")
+                    "the class each engine's priority policy schedules "
+                    "and preempts by.")
                 lines.append(
                     f"# TYPE accelerate_tpu_serving_priority_{c} counter")
                 for name in sorted(per_priority):

@@ -594,6 +594,8 @@ class GatewayStats:
             self._tokens_streamed = 0
             self._bytes_in = 0
             self._pressure_sheds = 0
+            self._rate_limit_sheds = 0
+            self._fair_share_sheds = 0
             # SSE saturation observables: how many event-stream responses
             # are OPEN right now (the front end's true concurrency — the
             # number the asyncio refactor exists to scale) and how many
@@ -617,6 +619,20 @@ class GatewayStats:
         overall 429 count."""
         with self._lock:
             self._pressure_sheds += 1
+
+    def record_rate_limit_shed(self):
+        """One 429 issued by the per-tenant token-bucket rate limiter
+        (Retry-After derives from the tenant bucket's refill time) —
+        per-cause accounting alongside the pressure/queue-full sheds."""
+        with self._lock:
+            self._rate_limit_sheds += 1
+
+    def record_fair_share_shed(self):
+        """One 429 issued by weighted fair-share admission: the fleet was
+        past its pressure threshold and this tenant past its guaranteed
+        share of in-flight streams."""
+        with self._lock:
+            self._fair_share_sheds += 1
 
     def record_stream(self, tokens: int):
         """One SSE stream that delivered ``tokens`` token events."""
@@ -685,6 +701,8 @@ class GatewayStats:
                 "tokens_streamed": self._tokens_streamed,
                 "request_bytes_in": self._bytes_in,
                 "pressure_sheds": self._pressure_sheds,
+                "rate_limit_sheds": self._rate_limit_sheds,
+                "fair_share_sheds": self._fair_share_sheds,
                 "open_sse_streams": self._open_streams,
                 "open_sse_streams_max": self._open_streams_max,
                 "conn_rejections": self._conn_rejections,

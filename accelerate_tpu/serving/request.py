@@ -85,9 +85,12 @@ class Request:
             raise ValueError(
                 f"priority must be a non-empty string or None (got {priority!r})")
         #: client-declared traffic class (e.g. ``"interactive"``/``"batch"``).
-        #: MEASUREMENT ONLY today: it labels tracer spans and per-priority
-        #: metrics series so the SLO-control work starts with a baseline —
-        #: scheduling does not consult it.
+        #: With the engine's default :class:`~.control.PriorityPolicy` this
+        #: is ACTED ON: admission is a priority queue (FIFO within class)
+        #: and pool-exhaustion preemption evicts the lowest class first.
+        #: It also labels tracer spans and per-priority metrics series.
+        #: Engines built with ``priority_policy=None`` fall back to the
+        #: historical measurement-only FCFS behaviour.
         self.priority = priority
 
         self.tokens: list[int] = []        # committed tokens, streamed order

@@ -7,12 +7,14 @@ in-flight stream. The :class:`ReplicaSet` is the serving-side analogue of
 data-parallel sharding over the device mesh — N independently compiled,
 independently failing engine replicas behind one submit surface:
 
-* **Routing** — least-loaded: a new request goes to the healthy replica
-  with the most free decode slots (ties broken by total occupancy
-  ``engine.load``, then index). When the best replica's admission queue
-  is full the next one is tried; only when EVERY healthy replica is
-  saturated does the router surface :class:`~.scheduler.QueueFull` — the
-  signal the gateway maps to HTTP 429.
+* **Routing** — least-loaded, cache-aware: a new request goes to the
+  healthy replica with a free slot and the longest prefix-cache hit for
+  its prompt, then most free decode slots (ties broken by total
+  occupancy ``engine.load``, page headroom, then index). When the best
+  replica's admission queue is full the next one is tried; only when
+  EVERY healthy replica is saturated does the router surface
+  :class:`~.scheduler.QueueFull` — the signal the gateway maps to
+  HTTP 429.
 * **Health** — per-replica :class:`ReplicaState`:
   HEALTHY (in rotation) → DRAINING (out of rotation, finishing its
   streams — operator-initiated via :meth:`ReplicaSet.drain_replica`) →
@@ -67,12 +69,15 @@ class ReplicaState(enum.Enum):
     FAILED = "failed"           # fenced: run loop died or operator killed it
     RESTARTING = "restarting"   # fenced, replacement engine being built
     CRASH_LOOP = "crash_loop"   # circuit open: too many restarts in a window
+    PARKED = "parked"           # scaled down: engine released, factory kept
 
 
 class _Replica:
-    """One engine plus its routing state (router internals)."""
+    """One engine plus its routing state (router internals). A PARKED
+    replica holds NO engine (``engine is None``) — only its retained
+    factory, from which :meth:`ReplicaSet.unpark_replica` rebuilds it."""
 
-    def __init__(self, index: int, engine: ServingEngine):
+    def __init__(self, index: int, engine: Optional[ServingEngine]):
         self.index = index
         self.engine = engine
         self.state = ReplicaState.HEALTHY
@@ -80,8 +85,9 @@ class _Replica:
         self.restarts = 0  # successful engine rebuilds (supervisor)
 
     def __repr__(self):
+        free = self.engine.free_slots if self.engine is not None else "-"
         return (f"_Replica({self.index}, {self.state.value}, "
-                f"free={self.engine.free_slots})")
+                f"free={free})")
 
 
 class FleetRequest:
@@ -116,7 +122,8 @@ class FleetRequest:
         self.ignore_eos = ignore_eos
         #: named LoRA adapter, preserved across failovers (None = base).
         self.adapter = proto.adapter
-        #: traffic class, preserved across failovers (measurement only).
+        #: traffic class, preserved across failovers (acted on by each
+        #: engine's priority policy: queue order + preemption victims).
         self.priority = proto.priority
         #: correlation id shared by every flight this request takes —
         #: minted here (when the gateway didn't) so the spans a failover
@@ -292,6 +299,10 @@ class ReplicaSet:
             raise ValueError(
                 "replicas disagree on sampling config or eos id — failover "
                 f"would change the stream's distribution (eos={eos})")
+        # Captured fleet-wide config: a parked replica has no engine to
+        # read these from, and unpark validates rebuilds against them.
+        self._eos = engines[0].eos_token_id
+        self._sampling = engines[0]._sampling
         self._replicas = [_Replica(i, e) for i, e in enumerate(engines)]
         #: the SlicePlan behind a from_mesh fleet (None otherwise).
         self.slice_plan = None
@@ -324,6 +335,8 @@ class ReplicaSet:
         self._restarts = 0       # replicas rebuilt back to HEALTHY
         self._hang_fences = 0    # fences on heartbeat stall (watchdog)
         self._crash_loops = 0    # circuit-breaker trips to CRASH_LOOP
+        self._scale_ups = 0      # replicas unparked back into rotation
+        self._scale_downs = 0    # replicas parked (engine released)
         # Bounded postmortem log: one entry per failover hop, carrying
         # the dead replica's flight-recorder dump (see failover_reports).
         self._failover_reports: list[dict] = []
@@ -423,11 +436,12 @@ class ReplicaSet:
     # -- health ----------------------------------------------------------
     #: states a fence/kill must leave alone: FAILED is already fenced
     #: (double-fencing would double-count and, via kill, re-inject a fault
-    #: into a replacement engine), RESTARTING is mid-rebuild, and
-    #: CRASH_LOOP is deliberately parked — only restart_replica or
-    #: reset_circuit move a replica out of these.
+    #: into a replacement engine), RESTARTING is mid-rebuild, CRASH_LOOP
+    #: is deliberately parked by the breaker, and PARKED holds no engine
+    #: at all — only restart_replica, unpark_replica, or reset_circuit
+    #: move a replica out of these.
     _FENCED_STATES = (ReplicaState.FAILED, ReplicaState.RESTARTING,
-                      ReplicaState.CRASH_LOOP)
+                      ReplicaState.CRASH_LOOP, ReplicaState.PARKED)
 
     def refresh_health(self):
         """Demote any replica whose engine died since the last look. Lazy —
@@ -564,6 +578,106 @@ class ReplicaSet:
         with self._lock:
             self._hang_fences += 1
 
+    # -- autoscaling (used by control.FleetAutoscaler; callable manually) --
+    def park_replica(self, index: int):
+        """Scale-down terminal step: release an IDLE replica's engine
+        entirely (decode state, KV pool, compiled executables all freed)
+        while keeping its slot and factory, so :meth:`unpark_replica` can
+        bring it back later. Only an idle HEALTHY or DRAINING replica may
+        be parked — parking live streams would drop tokens, so the
+        autoscaler drains first and parks once ``free_slots == max_slots``
+        and the queue is empty. The engine's counters fold into the
+        retired-stats ledger (fleet totals stay monotone). Raises
+        ``RuntimeError`` when the replica has no factory, is not
+        HEALTHY/DRAINING, or still holds work."""
+        r = self._replicas[index]
+        if self._factories[index] is None:
+            raise RuntimeError(
+                f"replica {index} has no factory — a parked replica could "
+                "never be rebuilt (build the fleet with from_factory/"
+                "from_mesh, or pass factories= to ReplicaSet)")
+        with self._lock:
+            if r.state not in (ReplicaState.HEALTHY, ReplicaState.DRAINING):
+                raise RuntimeError(
+                    f"replica {index} is {r.state.value} — only a healthy "
+                    "or draining replica can be parked")
+            engine = r.engine
+            if (engine.free_slots != engine.max_slots
+                    or engine.queue_depth > 0):
+                raise RuntimeError(
+                    f"replica {index} still holds work "
+                    f"({engine.max_slots - engine.free_slots} active, "
+                    f"{engine.queue_depth} queued) — drain it first")
+            r.state = ReplicaState.PARKED
+        try:
+            engine.shutdown(drain=False, timeout=1.0)
+        except Exception:
+            pass  # an already-dead engine re-raises its own error here
+        with self._lock:
+            self._retired_stats.merge(engine.stats)
+            r.engine = None
+            self._scale_downs += 1
+
+    def unpark_replica(self, index: int) -> ServingEngine:
+        """Scale-up: rebuild a PARKED replica from its retained factory
+        and return it to HEALTHY rotation — :meth:`restart_replica`'s
+        twin minus the dead-engine teardown (there is no engine to tear
+        down). The rebuild is validated against the CAPTURED fleet
+        eos/sampling config and replays every fleet adapter registration,
+        so scale-up is tenant-preserving. Propagates factory/warmup
+        errors with the replica returned to PARKED — the autoscaler
+        counts those and backs off."""
+        r = self._replicas[index]
+        factory = self._factories[index]
+        if factory is None:
+            raise RuntimeError(f"replica {index} has no factory")
+        with self._lock:
+            if r.state is not ReplicaState.PARKED:
+                raise RuntimeError(
+                    f"replica {index} is {r.state.value}, not parked — "
+                    "only a parked replica can be unparked")
+            r.state = ReplicaState.RESTARTING
+        try:
+            new_engine = factory()
+            new_engine.start()
+            if not new_engine.healthy:
+                raise RuntimeError(
+                    "replacement engine came up unhealthy"
+                ) from new_engine.error
+            if (new_engine.eos_token_id != self._eos
+                    or new_engine._sampling != self._sampling):
+                raise ValueError(
+                    "factory built an engine whose eos/sampling config "
+                    "disagrees with the fleet — failover would change the "
+                    "stream's distribution")
+            with self._lock:
+                registry = list(self._adapter_registry.items())
+            for name, (adapter, kwargs) in registry:
+                new_engine.register_adapter(name, adapter, **kwargs)
+        except BaseException:
+            with self._lock:
+                r.state = ReplicaState.PARKED
+            raise
+        with self._lock:
+            r.engine = new_engine
+            r.state = ReplicaState.HEALTHY
+            r.restarts += 1
+            self._scale_ups += 1
+        return new_engine
+
+    def add_parked(self, factory: Callable[[], ServingEngine]) -> int:
+        """Append a PARKED engine-less replica slot holding only
+        ``factory`` — headroom the autoscaler can later spawn into
+        without the fleet ever paying for an engine it hasn't needed yet.
+        Returns the new replica's index."""
+        with self._lock:
+            index = len(self._replicas)
+            r = _Replica(index, None)
+            r.state = ReplicaState.PARKED
+            self._replicas.append(r)
+            self._factories.append(factory)
+        return index
+
     # -- projected pressure (gateway shed inputs) -------------------------
     def projected_page_deficit(self, total_tokens: int) -> int:
         """Fleet-level projected page shortfall for a ``total_tokens``
@@ -584,23 +698,41 @@ class ReplicaSet:
         return sum(r.engine.page_drain_rate() for r in self._replicas
                    if r.state is ReplicaState.HEALTHY and r.engine.healthy)
 
+    def admission_capacity(self) -> int:
+        """Total streams the healthy fleet can hold at once — decode
+        slots plus admission-queue depth, summed over healthy replicas.
+        The denominator of the gateway's fair-share occupancy check."""
+        return sum(r.engine.max_slots + r.engine._queue.max_queued
+                   for r in self._replicas
+                   if r.state is ReplicaState.HEALTHY and r.engine.healthy)
+
     @property
     def eos_token_id(self):
-        """The fleet-shared eos id (validated identical across replicas)."""
-        return self._replicas[0].engine.eos_token_id
+        """The fleet-shared eos id (validated identical across replicas;
+        captured at construction so it survives replica 0 being parked)."""
+        return self._eos
 
     # -- routing ---------------------------------------------------------
     def _candidates(self, adapter: Optional[str] = None,
-                    total_tokens: int = 0) -> list[_Replica]:
-        """Healthy replicas, best-first: most free decode slots, then
-        lowest total occupancy, then KV-page headroom, then index
-        (stable). ``total_tokens`` (prompt + max_new) folds the paged
-        pool into the score: a replica whose pool is short pages for THIS
-        request (``engine.page_deficit``) loses the tie-break to one with
-        room, and among un-starved replicas more ``free_pages`` wins — so
-        long prompts route to replicas with free pages instead of forcing
-        preemption (``fleet_free_pages`` is the same signal summed
-        fleet-wide in :meth:`fleet_metrics`). When the request names a
+                    total_tokens: int = 0,
+                    prompt_ids=None) -> list[_Replica]:
+        """Healthy replicas, best-first: replicas with a free slot before
+        saturated ones, then longest cached prefix for THIS prompt, then
+        most free decode slots, then lowest total occupancy, then KV-page
+        headroom, then index (stable). ``total_tokens`` (prompt + max_new)
+        folds the paged pool into the score: a replica whose pool is
+        short pages for THIS request (``engine.page_deficit``) loses the
+        tie-break to one with room, and among un-starved replicas more
+        ``free_pages`` wins — so long prompts route to replicas with free
+        pages instead of forcing preemption (``fleet_free_pages`` is the
+        same signal summed fleet-wide in :meth:`fleet_metrics`).
+        ``prompt_ids`` enables prefix-cache-aware placement: each
+        replica's :meth:`~.engine.ServingEngine.cached_prefix_tokens`
+        probe (pure host hashing, no LRU promotion) scores how much
+        prefill the replica can skip, so shared-system-prompt traffic
+        lands where its KV already lives — but never at the cost of
+        queueing behind a saturated replica while another has a free slot
+        (the leading ``no-free-slot`` term). When the request names a
         LoRA adapter, replicas with that adapter already RESIDENT in
         their device bank rank first (routing affinity saves a host→
         device row upload), engines built without a bank drop out
@@ -610,14 +742,21 @@ class ReplicaSet:
                  if r.state is ReplicaState.HEALTHY and r.engine.healthy
                  and (adapter is None or r.engine.adapters is not None)]
 
+        def _cached(r):
+            if prompt_ids is None:
+                return 0
+            return r.engine.cached_prefix_tokens(prompt_ids, adapter)
+
         def _pages_key(r):
             return (r.engine.page_deficit(total_tokens), -r.engine.free_pages)
 
         if adapter is None:
-            cands.sort(key=lambda r: (-r.engine.free_slots, r.engine.load,
+            cands.sort(key=lambda r: (r.engine.free_slots == 0, -_cached(r),
+                                      -r.engine.free_slots, r.engine.load,
                                       *_pages_key(r), r.index))
         else:
             cands.sort(key=lambda r: (not r.engine.adapter_resident(adapter),
+                                      r.engine.free_slots == 0, -_cached(r),
                                       -r.engine.free_slots, r.engine.load,
                                       *_pages_key(r), r.index))
         return cands
@@ -662,8 +801,13 @@ class ReplicaSet:
         # already-generated on failover resume + remaining decode budget).
         total_tokens = (int(fleet.prompt_ids.shape[1]) + len(fleet.tokens)
                         + int(fleet.max_new_tokens))
+        # Cache-aware score input: the prompt that will actually prefill
+        # (the RESUME prompt on failover — its longer prefix is exactly
+        # what the dead replica's shared-cache inserts make warm).
+        probe_ids = fleet._resume_prompt()
         for attempt in range(2):
-            for r in self._candidates(fleet.adapter, total_tokens=total_tokens):
+            for r in self._candidates(fleet.adapter, total_tokens=total_tokens,
+                                      prompt_ids=probe_ids):
                 inner = self._make_inner(fleet, r)
                 if inner is None:  # cancelled or deadline passed meanwhile
                     return
@@ -751,9 +895,11 @@ class ReplicaSet:
         Raises ``RuntimeError`` if any replica was built without an
         :class:`~..adapters.registry.AdapterBank`. Registrations are
         RECORDED: a replica rebuilt by :meth:`restart_replica` replays
-        them onto its fresh bank, so restarts are tenant-preserving."""
+        them onto its fresh bank, so restarts are tenant-preserving —
+        and a PARKED replica (no engine) picks them up at unpark."""
         for r in self._replicas:
-            r.engine.register_adapter(name, adapter, **kwargs)
+            if r.engine is not None:
+                r.engine.register_adapter(name, adapter, **kwargs)
         with self._lock:
             self._adapter_registry[name] = (adapter, dict(kwargs))
 
@@ -763,7 +909,7 @@ class ReplicaSet:
         with self._lock:
             self._adapter_registry.pop(name, None)
         for r in self._replicas:
-            bank = r.engine.adapters
+            bank = r.engine.adapters if r.engine is not None else None
             if bank is not None and name in bank.names():
                 bank.unregister(name)
 
@@ -824,7 +970,8 @@ class ReplicaSet:
         from ..observability import merge_chrome_traces
 
         return merge_chrome_traces(
-            r.engine.chrome_trace(trace_id) for r in self._replicas)
+            r.engine.chrome_trace(trace_id) for r in self._replicas
+            if r.engine is not None)
 
     # -- metrics ----------------------------------------------------------
     def merged_stats(self) -> ServingStats:
@@ -837,7 +984,8 @@ class ReplicaSet:
         with self._lock:
             merged.merge(self._retired_stats)
         for r in self._replicas:
-            merged.merge(r.engine.stats)
+            if r.engine is not None:
+                merged.merge(r.engine.stats)
         return merged
 
     def fleet_metrics(self) -> dict:
@@ -859,6 +1007,8 @@ class ReplicaSet:
                     s is ReplicaState.RESTARTING for s in states),
                 "replicas_crash_loop": sum(
                     s is ReplicaState.CRASH_LOOP for s in states),
+                "replicas_parked": sum(
+                    s is ReplicaState.PARKED for s in states),
                 "fleet_submitted": self._submitted,
                 "fleet_failovers": self._failovers,
                 "fleet_fences": self._fences,
@@ -866,6 +1016,11 @@ class ReplicaSet:
                 "fleet_restarts": self._restarts,
                 "fleet_hang_fences": self._hang_fences,
                 "fleet_crash_loops": self._crash_loops,
+                "fleet_scale_ups": self._scale_ups,
+                "fleet_scale_downs": self._scale_downs,
+                # One autoscale actuation = one unpark or one park; the
+                # loop-closure gauge the SLO acceptance reads.
+                "fleet_autoscale_events": self._scale_ups + self._scale_downs,
                 "fleet_free_slots": sum(
                     r.engine.free_slots for r in self._replicas
                     if r.state is ReplicaState.HEALTHY and r.engine.healthy),
@@ -898,6 +1053,8 @@ class ReplicaSet:
         their error was already delivered to their requests."""
         first_exc: Optional[BaseException] = None
         for r in self._replicas:
+            if r.engine is None:  # parked: nothing to shut down
+                continue
             try:
                 r.engine.shutdown(drain=drain, timeout=timeout)
             except RuntimeError as e:

@@ -3,7 +3,9 @@ the serving engine.
 
 Three small host-side structures, deliberately independent of jax:
 
-* :class:`AdmissionQueue` — a bounded FCFS queue with backpressure. The
+* :class:`AdmissionQueue` — a bounded queue with backpressure: FCFS by
+  default, a PRIORITY queue (strict class order, FIFO within class) when
+  built with a ``rank_fn`` (see :class:`~.control.PriorityPolicy`). The
   bound is the engine's only flow control: when the queue is full,
   ``submit`` either raises :class:`QueueFull` (``block=False``) or blocks
   the caller until the engine drains a request (``block=True``), so a
@@ -48,20 +50,34 @@ class QueueClosed(RuntimeError):
 
 
 class AdmissionQueue:
-    """Bounded FCFS request queue (thread-safe; many producers, one engine
-    consumer).
+    """Bounded request queue (thread-safe; many producers, one engine
+    consumer). FCFS by default; pass ``rank_fn`` to make it a PRIORITY
+    queue — strict rank order across classes (lower rank pops first,
+    so interactive traffic admits ahead of queued batch work), FIFO
+    within each class.
 
     Built on a condition pair rather than ``queue.Queue`` so the consumer
     can :meth:`close` it: a producer blocked in ``put(block=True)`` against
     a full queue is woken with :class:`QueueClosed` the moment the engine
     stops, instead of sleeping forever on space that will never free.
+
+    Args:
+      max_queued: the bound (the engine's only flow control).
+      rank_fn: maps a request's ``priority`` (a string or None) to an
+        integer rank, 0 = most important — typically
+        :meth:`~.control.PriorityPolicy.rank`. ``None`` (default) ranks
+        everything equal, which is exactly the old FCFS behavior.
     """
 
-    def __init__(self, max_queued: int = 64):
+    def __init__(self, max_queued: int = 64, rank_fn=None):
         if max_queued < 1:
             raise ValueError(f"max_queued must be >= 1 (got {max_queued})")
         self.max_queued = int(max_queued)
-        self._items: collections.deque[Request] = collections.deque()
+        self._rank_fn = rank_fn
+        # rank -> FIFO deque; gets scan ranks ascending. With rank_fn=None
+        # everything lands in bucket 0 and this IS a plain FIFO deque.
+        self._buckets: dict[int, collections.deque[Request]] = {}
+        self._n = 0
         self._lock = threading.Lock()
         self._not_full = threading.Condition(self._lock)
         self._not_empty = threading.Condition(self._lock)
@@ -91,6 +107,17 @@ class AdmissionQueue:
         except AttributeError:
             return 0
 
+    def _rank_of(self, request) -> int:
+        if self._rank_fn is None:
+            return 0
+        return int(self._rank_fn(getattr(request, "priority", None)))
+
+    def _bucket(self, rank: int) -> collections.deque:
+        bucket = self._buckets.get(rank)
+        if bucket is None:
+            bucket = self._buckets[rank] = collections.deque()
+        return bucket
+
     def put(self, request: Request, block: bool = True,
             timeout: Optional[float] = None):
         """Enqueue; raises :class:`QueueFull` on backpressure (immediately
@@ -104,8 +131,9 @@ class AdmissionQueue:
                     raise QueueClosed(
                         "serving engine stopped; the admission queue is "
                         "closed and will never drain")
-                if len(self._items) < self.max_queued:
-                    self._items.append(request)
+                if self._n < self.max_queued:
+                    self._bucket(self._rank_of(request)).append(request)
+                    self._n += 1
                     self._pending_tokens += self._footprint(request)
                     self._not_empty.notify()
                     return
@@ -121,30 +149,38 @@ class AdmissionQueue:
                 "retry later or submit with block=True")
 
     def putleft(self, request: Request):
-        """Requeue at the FRONT, bypassing the bound — the paged engine's
-        preemption path: a request evicted from its slot on pool
-        exhaustion goes back ahead of everything younger (it was admitted
-        first; FCFS order is preserved, not reset), and it must never
-        bounce off a momentarily-full queue it already passed through."""
+        """Requeue at the FRONT of the request's class, bypassing the
+        bound — the paged engine's preemption path: a request evicted
+        from its slot on pool exhaustion goes back ahead of everything
+        younger IN ITS CLASS (it was admitted first; within-class FCFS
+        order is preserved, not reset — but it never jumps a class the
+        priority policy ranks above it), and it must never bounce off a
+        momentarily-full queue it already passed through."""
         with self._lock:
             if self._closed:
                 raise QueueClosed(
                     "serving engine stopped; the admission queue is "
                     "closed and will never drain")
-            self._items.appendleft(request)
+            self._bucket(self._rank_of(request)).appendleft(request)
+            self._n += 1
             self._pending_tokens += self._footprint(request)
             self._not_empty.notify()
 
     def get(self, timeout: Optional[float] = None) -> Optional[Request]:
-        """Pop the oldest request, or None after ``timeout`` (engine poll).
-        Close does not interrupt gets — the engine keeps draining what is
-        already queued during shutdown."""
+        """Pop the best-ranked oldest request, or None after ``timeout``
+        (engine poll). Close does not interrupt gets — the engine keeps
+        draining what is already queued during shutdown."""
         with self._lock:
-            if not self._items and timeout is not None and timeout > 0:
+            if not self._n and timeout is not None and timeout > 0:
                 self._not_empty.wait(timeout)
-            if not self._items:
+            if not self._n:
                 return None
-            item = self._items.popleft()
+            for rank in sorted(self._buckets):
+                bucket = self._buckets[rank]
+                if bucket:
+                    item = bucket.popleft()
+                    break
+            self._n -= 1
             self._pending_tokens -= self._footprint(item)
             self._not_full.notify()
             return item
@@ -162,7 +198,7 @@ class AdmissionQueue:
             self._not_empty.notify_all()
 
     def __len__(self) -> int:
-        return len(self._items)
+        return self._n
 
     def drain(self) -> list[Request]:
         """Remove and return everything currently queued (shutdown path)."""
@@ -345,6 +381,21 @@ class PrefixCache:
                 self._entries.move_to_end(key)
                 out.append(entry[0])
         return out
+
+    def longest_prefix(self, keys) -> int:
+        """How many leading ``keys`` are resident, WITHOUT touching LRU
+        order or refcounts — the cheap probe behind prefix-cache-aware
+        routing (:meth:`~.router.ReplicaSet._candidates` calls it per
+        candidate replica per routing decision, so it must not promote
+        entries a request may never actually restore). Stops at the
+        first miss for the same chain reason :meth:`match` does."""
+        n = 0
+        with self._lock:
+            for key in keys:
+                if key not in self._entries:
+                    break
+                n += 1
+        return n
 
     def put(self, key, block, nbytes: int) -> bool:
         """Insert one chunk's block (touch if already present), then evict

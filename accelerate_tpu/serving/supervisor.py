@@ -28,6 +28,11 @@ factories):
   ``restart_window_s`` trips the breaker: the replica parks in
   CRASH_LOOP and the supervisor stops burning chips on it until an
   operator calls :meth:`~.router.ReplicaSet.reset_circuit`.
+* **Autoscaling clock** — pass an ``autoscaler``
+  (:class:`~.control.FleetAutoscaler`) and each scan ends by stepping
+  it: replicas spawn from retained factories under sustained pressure
+  and drain/park back down when idle, on the same thread that just
+  fenced and restarted, so scale decisions never race recovery.
 
 Every decision lands in the supervisor's own flight recorder (and, via
 the router's counters, in ``fleet_metrics()`` → Prometheus
@@ -100,6 +105,10 @@ class FleetSupervisor:
         replica in CRASH_LOOP instead of trying again.
       flight_capacity: events kept in the supervisor's flight recorder.
       tracing: emit supervisor spans (fence/restart) into a tracer ring.
+      autoscaler: an optional :class:`~.control.FleetAutoscaler` stepped
+        once per scan AFTER health/restart handling — the supervisor
+        thread is the autoscale control loop's clock, so scale decisions
+        always see post-fence state and never race a restart.
     """
 
     def __init__(self, replica_set: ReplicaSet, *,
@@ -112,7 +121,8 @@ class FleetSupervisor:
                  max_restarts: int = 3,
                  restart_window_s: float = 60.0,
                  flight_capacity: int = 256,
-                 tracing: bool = True):
+                 tracing: bool = True,
+                 autoscaler=None):
         if hang_timeout_s <= 0 or poll_interval_s <= 0:
             raise ValueError("hang_timeout_s and poll_interval_s must be > 0")
         if max_restarts < 1:
@@ -127,6 +137,12 @@ class FleetSupervisor:
         self._max_restarts = int(max_restarts)
         self._window_s = float(restart_window_s)
 
+        if (autoscaler is not None
+                and getattr(autoscaler, "fleet", None) is not replica_set):
+            raise ValueError(
+                "autoscaler is bound to a different ReplicaSet than the "
+                "one this supervisor watches")
+        self._autoscaler = autoscaler
         self._watch = {r.index: _ReplicaWatch(self._backoff_s)
                        for r in replica_set.replicas}
         self._tracer = Tracer(capacity=1024, enabled=bool(tracing),
@@ -206,11 +222,20 @@ class FleetSupervisor:
                 self._check_hang(replica, now)
             elif state is ReplicaState.FAILED and self._restart:
                 self._maybe_restart(replica, now)
+        if self._autoscaler is not None:
+            self._autoscaler.step(now)
 
     # -- watchdog --------------------------------------------------------
+    def _watch_for(self, replica) -> _ReplicaWatch:
+        """Per-replica watch, created lazily: replicas added after init
+        (``ReplicaSet.add_parked`` autoscale headroom) get one on first
+        contact instead of KeyError-ing the scan."""
+        return self._watch.setdefault(replica.index,
+                                      _ReplicaWatch(self._backoff_s))
+
     def _check_hang(self, replica, now: float):
         engine = replica.engine
-        watch = self._watch[replica.index]
+        watch = self._watch_for(replica)
         if not engine.running or engine.error is not None:
             return  # dead/dying: refresh_health's jurisdiction, not ours
         _, beat_wall = engine.heartbeat
@@ -274,7 +299,7 @@ class FleetSupervisor:
     def _maybe_restart(self, replica, now: float):
         if self.fleet._factories[replica.index] is None:
             return  # nothing to rebuild from
-        watch = self._watch[replica.index]
+        watch = self._watch_for(replica)
         if now < watch.next_attempt_at:
             return
         while watch.attempts and now - watch.attempts[0] > self._window_s:

@@ -863,6 +863,86 @@ def open_loop_ab_bench(n_streams: int = 48,
     return out
 
 
+def slo_control_bench(n_streams: int = 96,
+                      mean_interarrival_s: float = 0.01,
+                      step_ms: float = 5.0,
+                      interactive_fraction: float = 0.25,
+                      slo_ttft_s: float = 0.5,
+                      wall_deadline_s: float = 60.0) -> dict:
+    """SLO control plane A/B at ~2x saturation: the same seeded open-loop
+    schedule and mixed interactive/batch traffic profile drive two
+    single-replica fleets that differ ONLY in the engine's priority
+    policy — ``priority_policy=None`` (the historical FCFS baseline:
+    priority declared but not acted on) vs the default
+    :class:`~accelerate_tpu.serving.PriorityPolicy` (priority admission
+    queue + lowest-class-first preemption). Offered load is ~2x the
+    fleet's decode throughput, so a deep admission queue builds; under
+    FCFS an interactive arrival waits behind every batch stream already
+    queued and its TTFT tail tracks the full backlog, while under the
+    control plane it jumps to the interactive bucket and the tail tracks
+    only same-class work. The perf guard pins the interactive-class
+    clamped-p99-TTFT ratio (FCFS over control) at >= 2x — the headline
+    SLO claim — and that batch still completes (work-conserving, not
+    starvation)."""
+    import jax
+
+    from accelerate_tpu.loadgen import (
+        ArrivalSchedule,
+        TrafficProfile,
+        build_report,
+        fetch_gateway_metrics,
+        run_open_loop,
+    )
+    from accelerate_tpu.models.llama import LlamaConfig
+    from accelerate_tpu.serving import (
+        GatewayConfig,
+        ReplicaSet,
+        ServingEngine,
+        ServingGateway,
+    )
+
+    cfg = LlamaConfig.tiny()
+    model = _sleepy_llama_cls(step_ms)(cfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+    out = {"n_streams": n_streams, "step_ms": step_ms,
+           "mean_interarrival_s": mean_interarrival_s,
+           "interactive_fraction": interactive_fraction}
+    for side, policy in (("fcfs", None), ("control", "default")):
+        rs = ReplicaSet.from_factory(
+            lambda p=policy: ServingEngine(
+                model, params, max_slots=2, max_len=64, prefill_chunk=16,
+                prefix_cache_mb=0.0, max_queued=2 * n_streams,
+                priority_policy=p), 1)
+        # Same seeds both sides: identical arrivals, shapes, and class
+        # assignments — the only variable is the scheduling policy.
+        sched = ArrivalSchedule(n_streams, mean_interarrival_s,
+                                dist="lognormal", sigma=0.8, seed=0)
+        prof = TrafficProfile(
+            prompt_len_median=4, prompt_len_max=8, out_tokens_median=6,
+            out_tokens_max=10, sampled_fraction=0.0,
+            priorities=(("interactive", interactive_fraction),
+                        ("batch", 1.0 - interactive_fraction)),
+            seed=1)
+        with ServingGateway(rs, config=GatewayConfig(server="asyncio",
+                                                     port=0)) as gw:
+            run = run_open_loop(gw.url, sched, prof,
+                                vocab_size=cfg.vocab_size,
+                                wall_deadline_s=wall_deadline_s)
+            metrics = fetch_gateway_metrics(gw.url)
+        out[side] = build_report(run, sched, prof, slo_ttft_s=slo_ttft_s,
+                                 clamp_s=wall_deadline_s,
+                                 server_metrics=metrics)
+    fcfs = (out["fcfs"]["per_priority"].get("interactive", {})
+            .get("ttft_s", {}).get("p99_clamped"))
+    ctrl = (out["control"]["per_priority"].get("interactive", {})
+            .get("ttft_s", {}).get("p99_clamped"))
+    out["interactive_p99_ttft_ratio_fcfs_over_control"] = (
+        round(fcfs / ctrl, 3) if fcfs and ctrl else None)
+    out["batch_completed_under_control"] = (
+        out["control"]["per_priority"].get("batch", {}).get("completed"))
+    return out
+
+
 def replica_failover_bench(n_inflight: int = 4, step_ms: float = 20.0,
                            prompt_len: int = 6,
                            max_new_tokens: int = 24) -> dict:
@@ -1798,6 +1878,7 @@ def serving_extra(on_tpu: bool) -> dict:
             "failover": replica_failover_bench(),
         },
         "open_loop": open_loop_ab_bench(),
+        "slo": slo_control_bench(),
         "chaos": chaos_recovery_bench(),
         "tp": serving_tp_bench(),
         "paged": paged_capacity_bench(),

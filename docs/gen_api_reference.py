@@ -43,7 +43,8 @@ GROUPS = [
       "accelerate_tpu.serving.mesh_exec",
       "accelerate_tpu.serving.router", "accelerate_tpu.serving.gateway",
       "accelerate_tpu.serving.gateway_aio",
-      "accelerate_tpu.serving.supervisor", "accelerate_tpu.serving.chaos"],
+      "accelerate_tpu.serving.supervisor", "accelerate_tpu.serving.chaos",
+      "accelerate_tpu.serving.control"],
      "Continuous-batching decode service: slot scheduler, fixed-shape "
      "prefill/decode programs, request handles, serving counters — plus "
      "mesh-sliced tensor-parallel execution (one replica = a multi-chip "
@@ -54,7 +55,11 @@ GROUPS = [
      "chaos-injection harness. The gateway has two wire front ends: the "
      "threading handler in `gateway` and the single-event-loop asyncio "
      "front end in `gateway_aio` that multiplexes thousands of SSE "
-     "streams on one thread."),
+     "streams on one thread. `control` is the SLO policy layer over all "
+     "of it: priority classes (queue ordering + preemption victim "
+     "selection), per-tenant rate limits and weighted fair share at the "
+     "gateway, and the supervisor-driven autoscaler that unparks/parks "
+     "replicas against queue and page pressure."),
     ("loadgen", "Load generation",
      ["accelerate_tpu.loadgen.generator", "accelerate_tpu.loadgen.report"],
      "Open-loop serving load: seeded heavy-tailed arrival schedules and "

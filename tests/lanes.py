@@ -63,6 +63,7 @@ LANES: dict[str, tuple[int, list[str]]] = {
         "test_quantization.py",
         "test_serving.py",
         "test_serving_async.py",
+        "test_serving_control.py",
         "test_serving_gateway.py",
         "test_serving_mesh.py",
         "test_serving_paged.py",

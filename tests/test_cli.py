@@ -423,8 +423,38 @@ class TestCLISubprocess:
         out = _run_cli("serve", "--help")
         assert out.returncode == 0, out.stderr
         for flag in ["--model", "--replicas", "--port", "--max-slots", "--tp",
-                     "--page-size", "--max-pages", "--no-paged"]:
+                     "--page-size", "--max-pages", "--no-paged",
+                     "--priority-preemption", "--no-priority-preemption",
+                     "--rate-limit", "--fair-share",
+                     "--autoscale-min", "--autoscale-max"]:
             assert flag in out.stdout
+
+    def test_serve_tenant_float_specs(self):
+        """--rate-limit/--fair-share NAME=FLOAT parsing: valid pairs (incl.
+        the '*' wildcard) build a dict, malformed or non-positive values
+        exit with a usage error, and no pairs means None (feature off)."""
+        from accelerate_tpu.commands.serve import _parse_tenant_floats
+
+        got = _parse_tenant_floats(["alice=5", "*=1.5"], "--rate-limit",
+                                   "RPS")
+        assert got == {"alice": 5.0, "*": 1.5}
+        assert _parse_tenant_floats([], "--rate-limit", "RPS") is None
+        assert _parse_tenant_floats(None, "--fair-share", "WEIGHT") is None
+        for bad in ["alice", "=3", "alice=", "alice=zero", "alice=0",
+                    "alice=-1"]:
+            with pytest.raises(SystemExit):
+                _parse_tenant_floats([bad], "--rate-limit", "RPS")
+
+    def test_serve_autoscale_bounds_validated(self):
+        """Bad --autoscale-min/--autoscale-max combos die before any
+        model warmup (fast usage errors, not a traceback mid-build)."""
+        for argv in (["serve", "--model", "tiny", "--autoscale-max", "2",
+                      "--autoscale-min", "0"],
+                     ["serve", "--model", "tiny", "--autoscale-max", "1",
+                      "--autoscale-min", "3"]):
+            out = _run_cli(*argv)
+            assert out.returncode != 0
+            assert "--autoscale" in out.stderr
 
     @pytest.mark.slow
     def test_serve_tiny_end_to_end(self):
@@ -464,6 +494,71 @@ class TestCLISubprocess:
             assert 1 <= len(body["tokens"]) <= 4
             with urllib.request.urlopen(url + "/readyz", timeout=10) as resp:
                 assert resp.status == 200
+            proc.send_signal(signal.SIGTERM)
+            out, err = proc.communicate(timeout=60)
+            assert proc.returncode == 0, err
+            assert "gateway drained; bye" in out
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.communicate(timeout=30)
+
+    @pytest.mark.slow
+    def test_serve_slo_flags_end_to_end(self):
+        """`serve --rate-limit '*=0.5' --autoscale-max 2`: the elastic
+        fleet announces autoscale supervision, /metrics exports the
+        parked-replica gauge, and a second immediate request trips the
+        token bucket into a structured 429 with a bounded Retry-After."""
+        import json as _json
+        import re
+        import signal
+        import urllib.error
+        import urllib.request
+
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "accelerate_tpu.commands.accelerate_cli",
+             "serve", "--model", "tiny", "--port", "0",
+             "--max-slots", "2", "--max-len", "64", "--prefill-chunk", "32",
+             "--eos-token-id", "7", "--rate-limit", "*=0.5",
+             "--fair-share", "*=1", "--autoscale-min", "1",
+             "--autoscale-max", "2"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            cwd=os.path.dirname(os.path.dirname(__file__)))
+        try:
+            url = None
+            saw_autoscale = False
+            for line in proc.stdout:
+                saw_autoscale |= "autoscale 1..2" in line
+                m = re.search(r"serving on (http://\S+)", line)
+                if m:
+                    url = m.group(1)
+                    break
+            assert url, "serve never announced its URL"
+            assert saw_autoscale, "autoscale supervision never announced"
+
+            def post():
+                req = urllib.request.Request(
+                    url + "/v1/completions",
+                    data=_json.dumps({"prompt": [3, 5, 7, 11],
+                                      "max_new_tokens": 4}).encode(),
+                    headers={"Content-Type": "application/json"})
+                return urllib.request.urlopen(req, timeout=60)
+
+            with post() as resp:  # burst of 1 token at 0.5 rps
+                assert resp.status == 200
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                post().close()
+            assert ei.value.code == 429
+            retry_after = float(ei.value.headers["Retry-After"])
+            assert 0 < retry_after <= 60.0
+            assert _json.loads(ei.value.read())["error"] == "rate_limited"
+            with urllib.request.urlopen(url + "/metrics",
+                                        timeout=10) as resp:
+                metrics = resp.read().decode()
+            assert "accelerate_tpu_serving_replicas_parked 1" in metrics
+            assert ("accelerate_tpu_gateway_rate_limit_sheds 1"
+                    in metrics)
             proc.send_signal(signal.SIGTERM)
             out, err = proc.communicate(timeout=60)
             assert proc.returncode == 0, err

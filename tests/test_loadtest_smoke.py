@@ -13,12 +13,24 @@ import json
 import os
 import sys
 
+import pytest
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from accelerate_tpu.commands.loadtest import (  # noqa: E402
+    _parse_priorities,
     loadtest_command,
     loadtest_command_parser,
 )
+
+
+def test_parse_priorities():
+    assert _parse_priorities("interactive=0.2,batch=0.8") == (
+        ("interactive", 0.2), ("batch", 0.8))
+    assert _parse_priorities(" a=1 , b=2 ") == (("a", 1.0), ("b", 2.0))
+    for bad in ("", "interactive", "=0.5", "a=", "a=zero", "a=0", "a=-1"):
+        with pytest.raises(SystemExit):
+            _parse_priorities(bad)
 
 
 def test_loadtest_check_passes_on_tiny_schedule(tmp_path):
@@ -28,6 +40,7 @@ def test_loadtest_check_passes_on_tiny_schedule(tmp_path):
         "--prompt-len", "4", "--prompt-max", "8",
         "--out-tokens", "4", "--out-max", "8",
         "--wall-deadline", "30",
+        "--priorities", "interactive=0.5,batch=0.5",
         "--output", str(out),
         "--check",
     ])
@@ -38,6 +51,11 @@ def test_loadtest_check_passes_on_tiny_schedule(tmp_path):
     conf = report["conformance"]
     assert conf["token_mismatches"] == 0 and conf["truncated_sse"] == 0
     assert report["counters_balance"]
+    # The declared class mix surfaces as the per-class breakdown, and
+    # every stream lands in exactly one class.
+    per = report["per_priority"]
+    assert set(per) <= {"interactive", "batch"}
+    assert sum(pr["offered"] for pr in per.values()) == 8
 
 
 def test_loadtest_check_exit_code_reflects_violations(monkeypatch):
